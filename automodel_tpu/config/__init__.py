@@ -1,0 +1,4 @@
+from automodel_tpu.config.loader import ConfigNode, instantiate, load_config
+from automodel_tpu.config.cli_overrides import parse_args_and_load_config
+
+__all__ = ["ConfigNode", "instantiate", "load_config", "parse_args_and_load_config"]

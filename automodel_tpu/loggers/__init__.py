@@ -1,0 +1,4 @@
+from automodel_tpu.loggers.log_utils import setup_logging
+from automodel_tpu.loggers.metric_logger import MetricLogger, MetricsSample
+
+__all__ = ["setup_logging", "MetricLogger", "MetricsSample"]

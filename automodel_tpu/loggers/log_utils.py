@@ -1,0 +1,38 @@
+"""Rank-aware logging setup (reference components/loggers/log_utils.py)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import jax
+
+__all__ = ["setup_logging", "rank_prefix"]
+
+
+def rank_prefix() -> str:
+    try:
+        return f"[p{jax.process_index()}]"
+    except RuntimeError:
+        return "[p?]"
+
+
+def setup_logging(level: int | str = logging.INFO, main_process_only: bool = True) -> None:
+    """Configure root logging; non-main hosts log warnings+ only by default."""
+    if isinstance(level, str):
+        level = getattr(logging, level.upper())
+    try:
+        is_main = jax.process_index() == 0
+    except RuntimeError:
+        is_main = True
+    effective = level if (is_main or not main_process_only) else logging.WARNING
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter(
+            fmt=f"%(asctime)s {rank_prefix()} %(levelname)s %(name)s: %(message)s",
+            datefmt="%H:%M:%S",
+        )
+    )
+    root = logging.getLogger()
+    root.handlers = [handler]
+    root.setLevel(effective)

@@ -1,0 +1,70 @@
+"""Always-on JSONL metric streams (reference loggers/metric_logger.py:27,83).
+
+One JSONL file per stream (``training.jsonl``, ``validation.jsonl``); each line is a
+flat dict of step metrics. Main process writes; other hosts no-op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, IO
+
+import jax
+
+__all__ = ["MetricsSample", "MetricLogger"]
+
+
+@dataclasses.dataclass
+class MetricsSample:
+    step: int
+    metrics: dict[str, Any]
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+    def to_json(self) -> str:
+        rec = {"step": self.step, "ts": round(self.timestamp, 3)}
+        for k, v in self.metrics.items():
+            rec[k] = _jsonable(v)
+        return json.dumps(rec)
+
+
+def _jsonable(v: Any) -> Any:
+    if hasattr(v, "item") and getattr(v, "ndim", None) in (0, None):
+        try:
+            v = v.item()
+        except Exception:
+            pass
+    if isinstance(v, float):
+        return round(v, 6)
+    return v
+
+
+class MetricLogger:
+    """Append-only JSONL writer, flushed per line so tail -f works mid-run."""
+
+    def __init__(self, path: str | os.PathLike, main_process_only: bool = True):
+        self.path = str(path)
+        self._fh: IO[str] | None = None
+        self.enabled = not main_process_only or jax.process_index() == 0
+        if self.enabled:
+            os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+            self._fh = open(self.path, "a")
+
+    def log(self, step: int, **metrics: Any) -> None:
+        if not self.enabled or self._fh is None:
+            return
+        self._fh.write(MetricsSample(step=step, metrics=metrics).to_json() + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "MetricLogger":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

@@ -1,0 +1,12 @@
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope, rope_frequencies
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.losses import masked_cross_entropy
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+    "dot_product_attention",
+    "masked_cross_entropy",
+]

@@ -1,0 +1,143 @@
+"""Backend-agnostic attention (reference components/attention/utils.py:25).
+
+The reference switches between TE fused attention / SDPA / FlexAttention; here the
+switchboard is ``backend="xla" | "flash"``:
+
+- ``xla``: plain einsum-softmax attention. XLA fuses it well and it runs anywhere
+  (CPU tests, interpreter); also the reference implementation for kernel parity tests.
+- ``flash``: Pallas blockwise flash attention (automodel_tpu.ops.pallas.flash_attention)
+  on TPU; falls back to ``xla`` off-TPU.
+
+Sequence packing uses segment ids (the TPU-native replacement for the reference's whole
+BSHD/THD machinery, distributed/thd_utils.py): tokens attend only within their segment.
+GQA/MQA is handled by broadcasting kv heads.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["dot_product_attention"]
+
+Backend = Literal["xla", "flash"]
+
+
+def _attention_bias(
+    seq_q: int,
+    seq_kv: int,
+    *,
+    causal: bool,
+    segment_ids_q: jnp.ndarray | None,
+    segment_ids_kv: jnp.ndarray | None,
+    positions_q: jnp.ndarray | None = None,
+    positions_kv: jnp.ndarray | None = None,
+    sliding_window: int | None = None,
+    dtype=jnp.float32,
+) -> jnp.ndarray | None:
+    """Additive mask bias (0 allowed / -inf disallowed), shape (b or 1, 1, sq, skv)."""
+    masks = []
+    if causal:
+        if positions_q is None:
+            q_pos = jnp.arange(seq_q)[:, None]
+            kv_pos = jnp.arange(seq_kv)[None, :]
+            masks.append((q_pos >= kv_pos)[None, None])
+        else:
+            q_pos = positions_q[:, :, None]
+            kv_pos = (positions_kv if positions_kv is not None else positions_q)[:, None, :]
+            masks.append((q_pos >= kv_pos)[:, None])
+    if sliding_window is not None:
+        if positions_q is None:
+            q_pos = jnp.arange(seq_q)[:, None]
+            kv_pos = jnp.arange(seq_kv)[None, :]
+            masks.append((q_pos - kv_pos < sliding_window)[None, None])
+        else:
+            q_pos = positions_q[:, :, None]
+            kv_pos = (positions_kv if positions_kv is not None else positions_q)[:, None, :]
+            masks.append((q_pos - kv_pos < sliding_window)[:, None])
+    if segment_ids_q is not None:
+        kv_seg = segment_ids_kv if segment_ids_kv is not None else segment_ids_q
+        masks.append((segment_ids_q[:, :, None] == kv_seg[:, None, :])[:, None])
+    if not masks:
+        return None
+    allowed = masks[0]
+    for m in masks[1:]:
+        allowed = jnp.logical_and(allowed, m)
+    return jnp.where(allowed, 0.0, jnp.finfo(dtype).min).astype(dtype)
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # (b, sq, n_heads, head_dim)
+    k: jnp.ndarray,  # (b, skv, n_kv_heads, head_dim)
+    v: jnp.ndarray,  # (b, skv, n_kv_heads, head_dim_v)
+    *,
+    causal: bool = True,
+    segment_ids_q: jnp.ndarray | None = None,
+    segment_ids_kv: jnp.ndarray | None = None,
+    positions_q: jnp.ndarray | None = None,
+    positions_kv: jnp.ndarray | None = None,
+    sliding_window: int | None = None,
+    softmax_scale: float | None = None,
+    logit_soft_cap: float | None = None,
+    sinks: jnp.ndarray | None = None,  # (n_heads,) attention sink logits (gpt-oss)
+    backend: Backend = "xla",
+) -> jnp.ndarray:
+    """Multi-head attention with GQA, packing segments, sliding window, soft-cap, sinks."""
+    if (
+        backend == "flash"
+        and jax.default_backend() == "tpu"
+        and logit_soft_cap is None
+        and sinks is None
+        and positions_q is None  # flash path masks by absolute index, not positions
+        and positions_kv is None
+    ):
+        try:
+            from automodel_tpu.ops.pallas.flash_attention import flash_attention
+        except ImportError:
+            flash_attention = None
+        if flash_attention is not None:
+            return flash_attention(
+                q, k, v,
+                causal=causal,
+                segment_ids_q=segment_ids_q,
+                segment_ids_kv=segment_ids_kv,
+                sliding_window=sliding_window,
+                softmax_scale=softmax_scale,
+            )
+
+    b, sq, nh, hd = q.shape
+    _, skv, nkv, _ = k.shape
+    if softmax_scale is None:
+        softmax_scale = hd**-0.5
+    groups = nh // nkv
+
+    qf = q.astype(jnp.float32) * softmax_scale
+    # (b, sq, kv, g, d) x (b, skv, kv, d) -> (b, kv, g, sq, skv)
+    qf = qf.reshape(b, sq, nkv, groups, hd)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qf, k.astype(jnp.float32))
+    if logit_soft_cap is not None:
+        logits = jnp.tanh(logits / logit_soft_cap) * logit_soft_cap
+    bias = _attention_bias(
+        sq, skv,
+        causal=causal,
+        segment_ids_q=segment_ids_q,
+        segment_ids_kv=segment_ids_kv,
+        positions_q=positions_q,
+        positions_kv=positions_kv,
+        sliding_window=sliding_window,
+    )
+    if bias is not None:
+        logits = logits + bias[:, :, None]  # broadcast over the GQA group dim
+    if sinks is not None:
+        # gpt-oss attention sinks: an extra per-head logit column that absorbs mass.
+        sink = jnp.broadcast_to(sinks.reshape(1, nkv, groups, 1, 1), (b, nkv, groups, sq, 1)).astype(jnp.float32)
+        logits_max = jnp.max(jnp.concatenate([logits, sink], axis=-1), axis=-1, keepdims=True)
+        unnorm = jnp.exp(logits - logits_max)
+        denom = unnorm.sum(-1, keepdims=True) + jnp.exp(sink - logits_max)
+        probs = unnorm / denom
+    else:
+        probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, sq, nh, v.shape[-1]).astype(q.dtype)

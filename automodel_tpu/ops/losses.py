@@ -1,0 +1,129 @@
+"""Loss functions (reference components/loss/).
+
+All losses return an *unreduced sum* over valid tokens plus the valid-token count, and
+the recipe divides by the *global* ``num_label_tokens`` after a psum over the data axes —
+the same normalization contract as the reference (every loss normalizes by global label
+tokens, loss/masked_ce.py:22).
+
+- ``masked_cross_entropy``: fp32 log-softmax CE with ignore_index masking
+  (reference MaskedCrossEntropy, loss/masked_ce.py:22).
+- ``chunked_cross_entropy``: vocab-chunked CE that never materializes the full
+  (tokens, vocab) fp32 tensor at once (reference ChunkedCrossEntropy, chunked_ce.py:43).
+- ``linear_cross_entropy``: fused hidden->logits->CE that takes the hidden states and
+  the unembedding matrix and computes CE blockwise over the sequence, so the full logits
+  tensor never exists (reference FusedLinearCrossEntropy via cut-cross-entropy,
+  loss/linear_ce.py:119). XLA fuses each block's matmul+softmax; a Pallas variant can
+  slot in underneath without changing the signature.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["masked_cross_entropy", "chunked_cross_entropy", "linear_cross_entropy", "kd_loss"]
+
+IGNORE_INDEX = -100
+
+
+def _ce_sum(logits: jnp.ndarray, labels: jnp.ndarray, ignore_index: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Sum of token CE over valid labels + count of valid labels. fp32 math."""
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logits32 = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits32, axis=-1)
+    gold = jnp.take_along_axis(logits32, safe_labels[..., None], axis=-1)[..., 0]
+    tok_loss = jnp.where(valid, logz - gold, 0.0)
+    return tok_loss.sum(), valid.sum()
+
+
+def masked_cross_entropy(
+    logits: jnp.ndarray,  # (..., vocab)
+    labels: jnp.ndarray,  # (...,) int, ignore_index = masked
+    num_label_tokens: jnp.ndarray | int | None = None,
+    ignore_index: int = IGNORE_INDEX,
+) -> jnp.ndarray:
+    """Mean CE over valid tokens; denominator overridable with the global token count."""
+    total, count = _ce_sum(logits, labels, ignore_index)
+    denom = count if num_label_tokens is None else num_label_tokens
+    return total / jnp.maximum(denom, 1).astype(jnp.float32)
+
+
+def chunked_cross_entropy(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    num_label_tokens: jnp.ndarray | int | None = None,
+    ignore_index: int = IGNORE_INDEX,
+    num_chunks: int = 8,
+) -> jnp.ndarray:
+    """CE computed over sequence chunks to bound the fp32 logits working set."""
+    v = logits.shape[-1]
+    flat_logits = logits.reshape(-1, v)
+    flat_labels = labels.reshape(-1)
+    n = flat_labels.shape[0]
+    pad = (-n) % num_chunks
+    if pad:
+        flat_logits = jnp.pad(flat_logits, ((0, pad), (0, 0)))
+        flat_labels = jnp.pad(flat_labels, (0, pad), constant_values=ignore_index)
+    flat_logits = flat_logits.reshape(num_chunks, -1, v)
+    flat_labels = flat_labels.reshape(num_chunks, -1)
+
+    def body(carry, chunk):
+        logits_c, labels_c = chunk
+        s, c = _ce_sum(logits_c, labels_c, ignore_index)
+        return (carry[0] + s, carry[1] + c), None
+
+    (total, count), _ = jax.lax.scan(body, (jnp.float32(0), jnp.int32(0)), (flat_logits, flat_labels))
+    denom = count if num_label_tokens is None else num_label_tokens
+    return total / jnp.maximum(denom, 1).astype(jnp.float32)
+
+
+def linear_cross_entropy(
+    hidden: jnp.ndarray,  # (..., embed)
+    unembed: jnp.ndarray,  # (embed, vocab)
+    labels: jnp.ndarray,  # (...,)
+    num_label_tokens: jnp.ndarray | int | None = None,
+    ignore_index: int = IGNORE_INDEX,
+    block_size: int = 1024,
+) -> jnp.ndarray:
+    """Fused projection+CE: logits exist only one (block, vocab) tile at a time."""
+    e = hidden.shape[-1]
+    flat_h = hidden.reshape(-1, e)
+    flat_labels = labels.reshape(-1)
+    n = flat_h.shape[0]
+    pad = (-n) % block_size
+    if pad:
+        flat_h = jnp.pad(flat_h, ((0, pad), (0, 0)))
+        flat_labels = jnp.pad(flat_labels, (0, pad), constant_values=ignore_index)
+    blocks_h = flat_h.reshape(-1, block_size, e)
+    blocks_l = flat_labels.reshape(-1, block_size)
+
+    def body(carry, blk):
+        h_b, l_b = blk
+        logits_b = h_b.astype(jnp.float32) @ unembed.astype(jnp.float32)
+        s, c = _ce_sum(logits_b, l_b, ignore_index)
+        return (carry[0] + s, carry[1] + c), None
+
+    (total, count), _ = jax.lax.scan(body, (jnp.float32(0), jnp.int32(0)), (blocks_h, blocks_l))
+    denom = count if num_label_tokens is None else num_label_tokens
+    return total / jnp.maximum(denom, 1).astype(jnp.float32)
+
+
+def kd_loss(
+    student_logits: jnp.ndarray,
+    teacher_logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    temperature: float = 1.0,
+    ignore_index: int = IGNORE_INDEX,
+    num_label_tokens: jnp.ndarray | int | None = None,
+) -> jnp.ndarray:
+    """Forward-KL distillation on valid tokens (reference loss/kd_loss.py:21)."""
+    valid = labels != ignore_index
+    t = jax.nn.log_softmax(teacher_logits.astype(jnp.float32) / temperature, axis=-1)
+    s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / temperature, axis=-1)
+    kl = (jnp.exp(t) * (t - s)).sum(-1) * (temperature**2)
+    total = jnp.where(valid, kl, 0.0).sum()
+    denom = valid.sum() if num_label_tokens is None else num_label_tokens
+    return total / jnp.maximum(denom, 1).astype(jnp.float32)

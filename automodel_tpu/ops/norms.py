@@ -1,0 +1,22 @@
+"""Normalization ops.
+
+The reference reaches for TransformerEngine's fused RMSNorm (models/common/utils.py:166);
+on TPU a plain jnp expression is the right call — XLA fuses the reduction+scale into
+neighbouring ops, and the accumulation is forced to fp32 regardless of activation dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["rms_norm"]
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6, offset: float = 0.0) -> jnp.ndarray:
+    """RMSNorm with fp32 accumulation; ``offset=1.0`` gives the (1+scale) Gemma variant."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = x32 * lax.rsqrt(var + eps)
+    return (y * (offset + scale.astype(jnp.float32))).astype(dtype)

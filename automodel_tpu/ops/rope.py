@@ -1,0 +1,106 @@
+"""Rotary position embeddings, HF-compatible (rotate-half convention).
+
+Matches transformers' Llama rotary layout (first half / second half split, not
+interleaved) so HF checkpoints produce identical activations. Supports the scaling
+variants the reference gets from HF configs (llama3, linear, yarn) — the reference
+keeps per-family rope_utils.py files; here one module serves all families.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax.numpy as jnp
+
+__all__ = ["rope_frequencies", "apply_rope"]
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 10000.0,
+    rope_scaling: dict[str, Any] | None = None,
+    partial_rotary_factor: float = 1.0,
+) -> jnp.ndarray:
+    """Inverse frequencies ``(rotary_dim // 2,)`` in fp32, with optional HF scaling."""
+    rotary_dim = int(head_dim * partial_rotary_factor)
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim))
+    if not rope_scaling:
+        return inv_freq
+    rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+    if rope_type in ("default", None):
+        return inv_freq
+    if rope_type == "linear":
+        return inv_freq / float(rope_scaling["factor"])
+    if rope_type == "llama3":
+        # transformers modeling_rope_utils._compute_llama3_parameters
+        factor = float(rope_scaling["factor"])
+        low_factor = float(rope_scaling.get("low_freq_factor", 1.0))
+        high_factor = float(rope_scaling.get("high_freq_factor", 4.0))
+        old_len = float(rope_scaling.get("original_max_position_embeddings", 8192))
+        wavelen = 2 * math.pi / inv_freq
+        low_wl = old_len / low_factor
+        high_wl = old_len / high_factor
+        smooth = (old_len / wavelen - low_factor) / (high_factor - low_factor)
+        scaled = jnp.where(
+            wavelen > low_wl,
+            inv_freq / factor,
+            jnp.where(wavelen < high_wl, inv_freq, (1 - smooth) * inv_freq / factor + smooth * inv_freq),
+        )
+        return scaled
+    if rope_type == "yarn":
+        factor = float(rope_scaling["factor"])
+        orig_len = float(rope_scaling.get("original_max_position_embeddings", 4096))
+        beta_fast = float(rope_scaling.get("beta_fast", 32.0))
+        beta_slow = float(rope_scaling.get("beta_slow", 1.0))
+
+        def find_dim(num_rot: float) -> float:
+            return (rotary_dim * math.log(orig_len / (num_rot * 2 * math.pi))) / (2 * math.log(theta))
+
+        low = max(math.floor(find_dim(beta_fast)), 0)
+        high = min(math.ceil(find_dim(beta_slow)), rotary_dim - 1)
+        ramp = jnp.clip((jnp.arange(rotary_dim // 2, dtype=jnp.float32) - low) / max(high - low, 1e-3), 0, 1)
+        mask = 1.0 - ramp
+        return inv_freq / factor * (1 - mask) + inv_freq * mask
+    raise NotImplementedError(f"rope scaling type {rope_type!r}")
+
+
+def rope_attention_scaling(rope_scaling: dict[str, Any] | None) -> float:
+    """YaRN mscale applied to q/k (transformers applies it as cos/sin scale)."""
+    if not rope_scaling:
+        return 1.0
+    rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+    if rope_type == "yarn":
+        factor = float(rope_scaling["factor"])
+        mscale = rope_scaling.get("attention_factor")
+        if mscale is not None:
+            return float(mscale)
+        return 0.1 * math.log(factor) + 1.0 if factor > 1 else 1.0
+    return 1.0
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    inv_freq: jnp.ndarray,
+    attention_scaling: float = 1.0,
+) -> jnp.ndarray:
+    """Rotate ``x (batch, seq, heads, head_dim)`` by ``positions (batch, seq)``.
+
+    rotate_half convention: out = x*cos + [-x2, x1]*sin with the half split at
+    head_dim//2, matching transformers' apply_rotary_pos_emb.
+    """
+    dtype = x.dtype
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (b, s, rot/2)
+    cos = jnp.cos(angles) * attention_scaling
+    sin = jnp.sin(angles) * attention_scaling
+    cos = jnp.concatenate([cos, cos], axis=-1)[:, :, None, :]  # (b, s, 1, rot)
+    sin = jnp.concatenate([sin, sin], axis=-1)[:, :, None, :]
+    rot = cos.shape[-1]
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    x1, x2 = jnp.split(x_rot.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    out = x_rot.astype(jnp.float32) * cos + rotated * sin
+    if x_pass.shape[-1]:
+        return jnp.concatenate([out.astype(dtype), x_pass], axis=-1)
+    return out.astype(dtype)

@@ -1,0 +1,15 @@
+from automodel_tpu.parallel.mesh import (
+    MeshAxis,
+    MeshContext,
+    ShardingRules,
+    create_device_mesh,
+    default_sharding_rules,
+)
+
+__all__ = [
+    "MeshAxis",
+    "MeshContext",
+    "ShardingRules",
+    "create_device_mesh",
+    "default_sharding_rules",
+]

@@ -1,0 +1,95 @@
+"""Multi-host initialization (reference distributed/init_utils.py:90).
+
+The entire NCCL+gloo split of the reference collapses to one call:
+``jax.distributed.initialize`` wires every host into the same XLA runtime; collectives
+then ride ICI (intra-slice) / DCN (multi-slice) automatically. Host-side side-channels
+(barriers, checkpoint coordination) go through ``jax.experimental.multihost_utils``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DistInfo", "initialize_distributed", "barrier", "is_main_process"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DistInfo:
+    """Rank/world view after initialization (reference init_utils.py DistInfo)."""
+
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+    backend: str
+
+    @property
+    def is_main(self) -> bool:
+        return self.process_index == 0
+
+
+_INITIALIZED = False
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> DistInfo:
+    """Initialize the JAX distributed runtime if running multi-host.
+
+    Single-process (one host, however many chips) needs no initialization.
+    Multi-host coordinates via args or standard env vars
+    (``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID``, or cloud-TPU metadata
+    which ``jax.distributed.initialize()`` discovers on its own).
+    """
+    global _INITIALIZED
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+
+    want_multihost = coordinator_address is not None or (num_processes or 0) > 1
+    if want_multihost and not _INITIALIZED:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _INITIALIZED = True
+
+    info = DistInfo(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+        backend=jax.default_backend(),
+    )
+    logger.info(
+        "distributed: process %d/%d, %d local / %d global %s devices",
+        info.process_index,
+        info.process_count,
+        info.local_device_count,
+        info.global_device_count,
+        info.backend,
+    )
+    return info
+
+
+def is_main_process() -> bool:
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cross-host sync point (reference _barrier_with_timeout, distributed/utils.py:51)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)

@@ -1,0 +1,4 @@
+from automodel_tpu.training.rng import ScopedRNG, StatefulRNG
+from automodel_tpu.training.step_scheduler import StepScheduler
+
+__all__ = ["ScopedRNG", "StatefulRNG", "StepScheduler"]

@@ -1,0 +1,120 @@
+"""Deterministic RNG threading (reference components/training/rng.py:83,115).
+
+The torch ``StatefulRNG`` (capturing python/numpy/torch/cuda states) collapses to
+``jax.random.key`` + ``fold_in``: determinism is structural, not captured state. The
+stateful wrapper below exists so recipes can checkpoint/restore the stream position and
+scope named substreams exactly like the reference's ``ScopedRNG``.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Iterator
+from contextlib import contextmanager
+
+import jax
+import numpy as np
+
+__all__ = ["StatefulRNG", "ScopedRNG"]
+
+
+def _hash_name(name: str) -> int:
+    # Stable across processes (python hash() is salted); fold scope names into keys.
+    h = 2166136261
+    for b in name.encode():
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+class StatefulRNG:
+    """A named, checkpointable PRNG stream.
+
+    ``key(name)`` derives a per-call subkey: fold_in(seed_key, hash(name), counter).
+    Also seeds python/numpy so host-side shuffles (dataloaders) are deterministic,
+    matching the reference's intent of seeding every build phase (train_ft.py:171,439).
+    """
+
+    def __init__(self, seed: int = 42, ranked: bool = False):
+        self.seed = int(seed)
+        self.ranked = bool(ranked)
+        offset = jax.process_index() if ranked else 0
+        self._base = jax.random.key(self.seed + offset)
+        self._counters: dict[str, int] = {}
+        random.seed(self.seed + offset)
+        np.random.seed((self.seed + offset) % (2**32))
+
+    def key(self, name: str = "default") -> jax.Array:
+        """Next subkey in the named stream; advances the stream counter."""
+        count = self._counters.get(name, 0)
+        self._counters[name] = count + 1
+        return jax.random.fold_in(jax.random.fold_in(self._base, _hash_name(name)), count)
+
+    def peek(self, name: str = "default") -> jax.Array:
+        count = self._counters.get(name, 0)
+        return jax.random.fold_in(jax.random.fold_in(self._base, _hash_name(name)), count)
+
+    # -- checkpointable state (BaseRecipe tracks attrs exposing these) ------
+    def state_dict(self) -> dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "ranked": self.ranked,
+            "counters": dict(self._counters),
+            "python_random": random.getstate(),
+            "numpy_random": np.random.get_state(),
+        }
+
+    def load_state_dict(self, state: dict[str, Any]) -> None:
+        self.seed = state["seed"]
+        self.ranked = state["ranked"]
+        offset = jax.process_index() if self.ranked else 0
+        self._base = jax.random.key(self.seed + offset)
+        self._counters = dict(state["counters"])
+        pr = state.get("python_random")
+        if pr is not None:
+            random.setstate(_to_random_state(pr))
+        nr = state.get("numpy_random")
+        if nr is not None:
+            np.random.set_state(_to_numpy_state(nr))
+
+
+def _to_random_state(state: Any) -> Any:
+    # random.getstate() is (version, tuple_of_ints, gauss_next); orbax/json round-trips
+    # may turn tuples into lists.
+    if isinstance(state, (list, tuple)):
+        v, ints, g = state
+        return (v, tuple(int(i) for i in ints), g)
+    return state
+
+
+def _to_numpy_state(state: Any) -> Any:
+    if isinstance(state, (list, tuple)) and len(state) == 5:
+        name, keys, pos, has_gauss, cached = state
+        return (name, np.asarray(keys, dtype=np.uint32), int(pos), int(has_gauss), float(cached))
+    return state
+
+
+class ScopedRNG:
+    """Context manager giving a scope-local stream (reference rng.py:115).
+
+    >>> rng = StatefulRNG(seed=0)
+    >>> with ScopedRNG(rng, "model_init") as r:
+    ...     k = r.key()
+    """
+
+    def __init__(self, rng: StatefulRNG, scope: str):
+        self.rng = rng
+        self.scope = scope
+
+    def key(self, name: str = "default") -> jax.Array:
+        return self.rng.key(f"{self.scope}/{name}")
+
+    def __enter__(self) -> "ScopedRNG":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        return None
+
+
+@contextmanager
+def scoped_rng(rng: StatefulRNG, scope: str) -> Iterator[ScopedRNG]:
+    yield ScopedRNG(rng, scope)

@@ -1,0 +1,117 @@
+"""Gradient-accumulation batching and run cadence (reference training/step_scheduler.py:48,136,217).
+
+Yields lists of ``grad_acc_steps`` microbatches per optimizer step, tracks epoch/step
+counters, and answers "is it time to checkpoint/validate/log?". Checkpointable via
+state_dict/load_state_dict like every training service.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Any, Iterable, Iterator
+
+__all__ = ["StepScheduler"]
+
+
+class StepScheduler:
+    def __init__(
+        self,
+        grad_acc_steps: int = 1,
+        ckpt_every_steps: int = 0,
+        val_every_steps: int = 0,
+        log_every_steps: int = 1,
+        num_epochs: int = 1,
+        max_steps: int | None = None,
+        dataloader: Iterable | None = None,
+        handle_sigterm: bool = True,
+    ):
+        if grad_acc_steps < 1:
+            raise ValueError(f"grad_acc_steps must be >= 1, got {grad_acc_steps}")
+        self.grad_acc_steps = grad_acc_steps
+        self.ckpt_every_steps = ckpt_every_steps
+        self.val_every_steps = val_every_steps
+        self.log_every_steps = log_every_steps
+        self.num_epochs = num_epochs
+        self.max_steps = max_steps
+        self.dataloader = dataloader
+
+        self.step = 0  # completed optimizer steps
+        self.epoch = 0
+        self._sigterm = threading.Event()
+        if handle_sigterm:
+            self._install_sigterm_handler()
+
+    # -- SIGTERM -> checkpoint-on-preemption (reference signal_handler.py) --
+    def _install_sigterm_handler(self) -> None:
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def handler(signum, frame):
+                self._sigterm.set()
+                if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
+                    prev(signum, frame)
+
+            signal.signal(signal.SIGTERM, handler)
+        except ValueError:
+            pass  # not in main thread (e.g. under pytest-xdist)
+
+    @property
+    def sigterm_received(self) -> bool:
+        # Local flag only; recipes all-gather it across hosts before acting
+        # (reference step_scheduler.py:217 all-gathers so every rank checkpoints).
+        return self._sigterm.is_set()
+
+    # -- iteration ----------------------------------------------------------
+    def __iter__(self) -> Iterator[list[Any]]:
+        """Yield lists of microbatches, one list per optimizer step."""
+        if self.dataloader is None:
+            raise ValueError("StepScheduler has no dataloader")
+        while self.epoch < self.num_epochs:
+            batches: list[Any] = []
+            for batch in self.dataloader:
+                batches.append(batch)
+                if len(batches) == self.grad_acc_steps:
+                    # step is 1-indexed while the consumer processes it, so cadence
+                    # flags (is_ckpt_step etc.) are correct inside the loop body.
+                    self.step += 1
+                    yield batches
+                    batches = []
+                    if self.max_steps is not None and self.step >= self.max_steps:
+                        return
+                    if self.sigterm_received:
+                        return
+            # trailing partial accumulation at epoch end still steps the optimizer
+            if batches:
+                self.step += 1
+                yield batches
+                if self.max_steps is not None and self.step >= self.max_steps:
+                    return
+            self.epoch += 1
+
+    # -- cadence ------------------------------------------------------------
+    @property
+    def is_ckpt_step(self) -> bool:
+        return self.ckpt_every_steps > 0 and self.step > 0 and self.step % self.ckpt_every_steps == 0
+
+    @property
+    def is_val_step(self) -> bool:
+        return self.val_every_steps > 0 and self.step > 0 and self.step % self.val_every_steps == 0
+
+    @property
+    def is_log_step(self) -> bool:
+        return self.log_every_steps > 0 and self.step % self.log_every_steps == 0
+
+    @property
+    def done(self) -> bool:
+        if self.max_steps is not None and self.step >= self.max_steps:
+            return True
+        return self.epoch >= self.num_epochs
+
+    # -- checkpointable state ----------------------------------------------
+    def state_dict(self) -> dict[str, int]:
+        return {"step": self.step, "epoch": self.epoch}
+
+    def load_state_dict(self, state: dict[str, int]) -> None:
+        self.step = int(state["step"])
+        self.epoch = int(state["epoch"])

@@ -1,0 +1,42 @@
+"""Test env: force a virtual 8-device CPU platform BEFORE any JAX backend initializes.
+
+This is the TPU-native analogue of the reference's mocked torch.distributed unit tests
+(tests/unit_tests/distributed/README.md:44-52) — real SPMD semantics, no hardware.
+
+Note: the ambient environment pins JAX_PLATFORMS=axon (a single-chip TPU tunnel) and a
+sitecustomize hook registers that platform at interpreter startup — before this conftest
+runs. Backend *initialization* is lazy though, so overriding jax.config here (before any
+test touches a device) reliably lands tests on the 8-device CPU platform.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(cpu_devices):
+    """A (dp_shard=2, cp=2, tp=2) 8-device mesh shared across tests."""
+    from automodel_tpu.parallel.mesh import MeshContext
+
+    ctx = MeshContext(dp_shard=2, cp=2, tp=2, world_size=8)
+    return ctx.build_mesh(cpu_devices)

@@ -1,0 +1,134 @@
+import os
+import textwrap
+
+import pytest
+
+from automodel_tpu.config.loader import ConfigNode, instantiate, load_config, resolve_target
+from automodel_tpu.config.cli_overrides import parse_args_and_load_config, parse_cli_argv
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(textwrap.dedent(text))
+    return str(p)
+
+
+class TestConfigNode:
+    def test_attr_and_item_access(self, tmp_path):
+        cfg = load_config(_write(tmp_path, """
+            model:
+              name: llama
+              hidden: 64
+            lr: 0.001
+        """))
+        assert cfg.model.name == "llama"
+        assert cfg["model"]["hidden"] == 64
+        assert cfg.lr == 0.001
+
+    def test_dotted_get_with_default(self, tmp_path):
+        cfg = load_config(_write(tmp_path, "a:\n  b:\n    c: 3\n"))
+        assert cfg.get("a.b.c") == 3
+        assert cfg.get("a.b.missing", "dflt") == "dflt"
+        assert "a.b.c" in cfg
+        assert "a.x" not in cfg
+
+    def test_set_by_path_creates_nodes(self):
+        cfg = ConfigNode({})
+        cfg.set_by_path("x.y.z", 5)
+        assert cfg.x.y.z == 5
+
+    def test_to_dict_roundtrip(self):
+        d = {"a": {"b": [1, 2, {"c": 3}]}, "d": None}
+        assert ConfigNode(d).to_dict() == d
+
+    def test_missing_key_raises(self):
+        with pytest.raises(AttributeError):
+            ConfigNode({"a": 1}).nope
+
+    def test_env_interpolation_deferred(self, tmp_path):
+        cfg = load_config(_write(tmp_path, "token: ${oc.env:AMT_TEST_TOKEN}\nother: ok\n"))
+        # secret not resolved in raw_dict (safe to print)
+        assert cfg.raw_dict["token"] == "${oc.env:AMT_TEST_TOKEN}"
+        os.environ["AMT_TEST_TOKEN"] = "s3cret"
+        try:
+            assert cfg.token == "s3cret"
+        finally:
+            del os.environ["AMT_TEST_TOKEN"]
+
+    def test_env_default(self, tmp_path):
+        cfg = load_config(_write(tmp_path, "v: ${oc.env:AMT_UNSET_VAR,fallback}\n"))
+        assert cfg.v == "fallback"
+
+
+class _Dummy:
+    def __init__(self, a, b=2, fn=None, child=None):
+        self.a, self.b, self.fn, self.child = a, b, fn, child
+
+
+class _DummyWithFn:
+    def __init__(self, a, loss_fn=None):
+        self.a, self.loss_fn = a, loss_fn
+
+
+class TestInstantiate:
+    def test_basic_target(self):
+        node = ConfigNode({"_target_": "tests.unit.test_config._Dummy", "a": 1, "b": 7})
+        obj = instantiate(node)
+        assert isinstance(obj, _Dummy) and obj.a == 1 and obj.b == 7
+
+    def test_nested_target(self):
+        node = ConfigNode({
+            "_target_": "tests.unit.test_config._Dummy",
+            "a": 0,
+            "child": {"_target_": "tests.unit.test_config._Dummy", "a": 9},
+        })
+        obj = instantiate(node)
+        assert isinstance(obj.child, _Dummy) and obj.child.a == 9
+
+    def test_fn_reference_resolution(self):
+        node = ConfigNode({
+            "_target_": "tests.unit.test_config._DummyWithFn",
+            "a": 0,
+            "loss_fn": "os.path.join",
+        })
+        obj = instantiate(node)
+        assert obj.loss_fn is os.path.join
+
+    def test_fn_suffix_resolves_to_callable(self):
+        node = ConfigNode({"_target_": "tests.unit.test_config._Dummy", "a": 1, "fn": 0})
+        node2 = ConfigNode({"_target_": "tests.unit.test_config._Dummy", "a": 1})
+        node2.set_by_path("fn", "os.path.join")
+        # key "fn" doesn't end with _fn, stays a string
+        assert instantiate(node2).fn == "os.path.join"
+
+    def test_overrides_win(self):
+        node = ConfigNode({"_target_": "tests.unit.test_config._Dummy", "a": 1})
+        assert instantiate(node, a=99).a == 99
+
+    def test_resolve_target_colon(self):
+        assert resolve_target("os.path:join") is os.path.join
+
+    def test_instantiate_method_on_node(self):
+        node = ConfigNode({"_target_": "tests.unit.test_config._Dummy", "a": 4})
+        assert node.instantiate().a == 4
+
+
+class TestCliOverrides:
+    def test_parse_argv(self):
+        path, ov = parse_cli_argv(["-c", "x.yaml", "--model.hidden", "128", "--flag", "--k=v"])
+        assert path == "x.yaml"
+        assert ("model.hidden", 128) in ov
+        assert ("flag", True) in ov
+        assert ("k", "v") in ov
+
+    def test_load_with_overrides(self, tmp_path):
+        p = _write(tmp_path, "model:\n  hidden: 64\nlr: 0.1\n")
+        cfg = parse_args_and_load_config(["-c", p, "--model.hidden", "256", "--new.key", "true"])
+        assert cfg.model.hidden == 256
+        assert cfg.lr == 0.1
+        assert cfg.new.key is True
+
+    def test_value_translation(self):
+        _, ov = parse_cli_argv(["--a", "1.5", "--b", "none", "--c", "[1,2]"])
+        d = dict(ov)
+        assert d["a"] == 1.5 and d["b"] is None and d["c"] == [1, 2]

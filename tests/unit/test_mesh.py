@@ -1,0 +1,84 @@
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from automodel_tpu.parallel.mesh import MeshAxis, MeshContext, ShardingRules, default_sharding_rules
+
+
+class TestMeshContext:
+    def test_infer_dp_shard(self):
+        ctx = MeshContext(tp=2, world_size=8)
+        assert ctx.dp_shard == 4
+        assert ctx.dp_size == 4
+
+    def test_explicit_sizes_validate(self):
+        ctx = MeshContext(pp=2, dp_shard=2, tp=2, world_size=8)
+        assert ctx.shape == {"pp": 2, "dp_replicate": 1, "dp_shard": 2, "ep": 1, "cp": 1, "tp": 2}
+
+    def test_bad_world_size_raises(self):
+        with pytest.raises(ValueError):
+            MeshContext(pp=3, world_size=8)
+        with pytest.raises(ValueError):
+            MeshContext(dp_shard=3, tp=2, world_size=8)
+
+    def test_negative_axis_raises(self):
+        with pytest.raises(ValueError):
+            MeshContext(tp=0, world_size=8)
+
+    def test_build_mesh(self, cpu_devices):
+        ctx = MeshContext(dp_shard=2, cp=2, tp=2, world_size=8)
+        mesh = ctx.build_mesh(cpu_devices)
+        assert mesh.axis_names == ("pp", "dp_replicate", "dp_shard", "ep", "cp", "tp")
+        assert mesh.shape["dp_shard"] == 2 and mesh.shape["tp"] == 2
+
+    def test_ep_carved_from_data(self):
+        ctx = MeshContext(ep=4, tp=2, world_size=8)
+        assert ctx.dp_shard == 1
+        assert ctx.dp_size == 4  # ep counts toward data parallel degree
+
+
+class TestShardingRules:
+    def test_spec_translation(self, mesh8):
+        rules = default_sharding_rules().with_mesh(mesh8)
+        spec = rules.spec(("embed", "mlp"))
+        assert spec == P(("dp_shard", "ep", "cp"), "tp")
+
+    def test_none_dims(self, mesh8):
+        rules = default_sharding_rules().with_mesh(mesh8)
+        assert rules.spec((None, "heads", None)) == P(None, "tp")
+        assert rules.spec(None) == P()
+
+    def test_batch_spec(self, mesh8):
+        rules = default_sharding_rules().with_mesh(mesh8)
+        assert rules.spec(("batch", "act_seq")) == P(("dp_replicate", "dp_shard", "ep"), ("cp", "tp"))
+
+    def test_conflict_within_spec_dropped(self, mesh8):
+        # Same mesh axis mapped twice in one spec: second use is dropped.
+        rules = ShardingRules({"a": "tp", "b": "tp"}, mesh8)
+        assert rules.spec(("a", "b")) == P("tp")
+
+    def test_unknown_logical_axis_is_replicated(self, mesh8):
+        rules = default_sharding_rules().with_mesh(mesh8)
+        assert rules.spec(("nonexistent",)) == P()
+
+    def test_sharding_shards_array(self, mesh8):
+        import numpy as np
+
+        rules = default_sharding_rules().with_mesh(mesh8)
+        x = jax.device_put(np.zeros((8, 16)), rules.sharding(("embed", "mlp")))
+        # embed dim split over dp_shard(2)*cp(2)=4 -> local shards 2 rows; mlp over tp=2
+        assert x.sharding.shard_shape(x.shape) == (2, 8)
+
+    def test_updated_rules(self, mesh8):
+        rules = default_sharding_rules().with_mesh(mesh8).updated(mlp=None)
+        assert rules.spec(("embed", "mlp")) == P(("dp_shard", "ep", "cp"))
+
+    def test_bad_mesh_axis_raises(self, mesh8):
+        with pytest.raises(ValueError):
+            ShardingRules({"a": "bogus_axis"}, mesh8)
+
+
+class TestMeshAxisGroups:
+    def test_groups(self):
+        assert MeshAxis.DATA == ("dp_replicate", "dp_shard", "ep")
+        assert MeshAxis.FSDP == ("dp_shard", "ep", "cp")

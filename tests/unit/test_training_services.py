@@ -1,0 +1,92 @@
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from automodel_tpu.loggers.metric_logger import MetricLogger
+from automodel_tpu.training.rng import ScopedRNG, StatefulRNG
+from automodel_tpu.training.step_scheduler import StepScheduler
+
+
+class TestStatefulRNG:
+    def test_deterministic_streams(self):
+        a = StatefulRNG(seed=7)
+        b = StatefulRNG(seed=7)
+        assert jax.random.uniform(a.key("x")) == jax.random.uniform(b.key("x"))
+
+    def test_stream_advances(self):
+        r = StatefulRNG(seed=7)
+        k1, k2 = r.key("x"), r.key("x")
+        assert jax.random.uniform(k1) != jax.random.uniform(k2)
+
+    def test_named_streams_independent(self):
+        r = StatefulRNG(seed=7)
+        assert jax.random.uniform(r.key("a")) != jax.random.uniform(r.key("b"))
+
+    def test_state_roundtrip(self):
+        r = StatefulRNG(seed=3)
+        r.key("x")
+        r.key("x")
+        state = r.state_dict()
+        v_expected = jax.random.uniform(r.peek("x"))
+        r2 = StatefulRNG(seed=999)
+        r2.load_state_dict(state)
+        assert jax.random.uniform(r2.key("x")) == v_expected
+
+    def test_scoped(self):
+        r = StatefulRNG(seed=0)
+        with ScopedRNG(r, "init") as s:
+            k = s.key("w")
+        # scope prefixes the stream name
+        assert r._counters.get("init/w") == 1
+
+
+class TestStepScheduler:
+    def test_grad_accum_batching(self):
+        data = list(range(10))
+        s = StepScheduler(grad_acc_steps=3, dataloader=data, num_epochs=1, handle_sigterm=False)
+        groups = list(s)
+        assert groups[0] == [0, 1, 2]
+        assert groups[-1] == [9]  # trailing partial group still steps
+        assert s.step == 4
+
+    def test_max_steps(self):
+        s = StepScheduler(grad_acc_steps=1, dataloader=range(100), max_steps=5, handle_sigterm=False)
+        assert len(list(s)) == 5
+        assert s.done
+
+    def test_epochs(self):
+        s = StepScheduler(grad_acc_steps=2, dataloader=range(4), num_epochs=3, handle_sigterm=False)
+        assert len(list(s)) == 6
+        assert s.epoch == 3
+
+    def test_cadence_flags(self):
+        s = StepScheduler(grad_acc_steps=1, ckpt_every_steps=2, val_every_steps=3,
+                          dataloader=range(6), handle_sigterm=False)
+        ckpts, vals = [], []
+        for _ in s:
+            if s.is_ckpt_step:
+                ckpts.append(s.step)
+            if s.is_val_step:
+                vals.append(s.step)
+        assert ckpts == [2, 4, 6]
+        assert vals == [3, 6]
+
+    def test_state_roundtrip(self):
+        s = StepScheduler(grad_acc_steps=1, dataloader=range(3), handle_sigterm=False)
+        s.step, s.epoch = 7, 2
+        s2 = StepScheduler(grad_acc_steps=1, handle_sigterm=False)
+        s2.load_state_dict(s.state_dict())
+        assert s2.step == 7 and s2.epoch == 2
+
+
+class TestMetricLogger:
+    def test_jsonl_stream(self, tmp_path):
+        p = tmp_path / "training.jsonl"
+        with MetricLogger(p) as ml:
+            ml.log(1, loss=np.float32(2.5), lr=1e-4)
+            ml.log(2, loss=jax.numpy.asarray(2.25), grad_norm=0.9)
+        lines = [json.loads(line) for line in p.read_text().splitlines()]
+        assert lines[0]["step"] == 1 and lines[0]["loss"] == 2.5
+        assert lines[1]["grad_norm"] == 0.9
