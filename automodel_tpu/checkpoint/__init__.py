@@ -1,0 +1,6 @@
+from automodel_tpu.checkpoint.safetensors_io import (
+    load_safetensors,
+    save_safetensors,
+)
+
+__all__ = ["load_safetensors", "save_safetensors"]

@@ -1,0 +1,127 @@
+"""HF safetensors read/write (reference checkpoint/_backports/hf_storage.py +
+consolidate_hf_safetensors.py, rebuilt on the safetensors library).
+
+Reading: accepts a directory (single file, or sharded ``model-XXXXX-of-YYYYY`` files
+with ``model.safetensors.index.json``) or one ``.safetensors`` file, and returns a
+lazy mapping so tensors are materialized one at a time (host RAM bounded by the
+largest tensor, not the checkpoint).
+
+Writing: emits HF-layout sharded files + index.json so any checkpoint we save is
+loadable by ``transformers.AutoModel.from_pretrained`` — the reference's dual-format
+guarantee (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections.abc import Mapping
+from typing import Iterator
+
+import numpy as np
+
+__all__ = ["load_safetensors", "save_safetensors", "LazySafetensors"]
+
+_INDEX_NAME = "model.safetensors.index.json"
+
+
+def _open_file(path: str):
+    from safetensors import safe_open
+
+    # numpy framework keeps tensors on host (bf16 via ml_dtypes) — no device round-trip
+    return safe_open(path, framework="numpy")
+
+
+class LazySafetensors(Mapping):
+    """Dict-like view over one or more safetensors files; loads tensors on access."""
+
+    def __init__(self, files: dict[str, str]):
+        # files: tensor key -> file path
+        self._files = files
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        path = self._files[key]
+        with _open_file(path) as f:
+            t = f.get_tensor(key)
+        return np.asarray(t)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._files)
+
+    def __len__(self) -> int:
+        return len(self._files)
+
+
+def load_safetensors(path: str) -> LazySafetensors:
+    """Load a safetensors file / HF model dir into a lazy key->tensor mapping."""
+    if os.path.isfile(path):
+        files = [path]
+    else:
+        index = os.path.join(path, _INDEX_NAME)
+        if os.path.exists(index):
+            with open(index) as f:
+                weight_map = json.load(f)["weight_map"]
+            key_to_file = {k: os.path.join(path, v) for k, v in weight_map.items()}
+            return LazySafetensors(key_to_file)
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path) if f.endswith(".safetensors")
+        )
+        if not files:
+            raise FileNotFoundError(f"no .safetensors files under {path!r}")
+    key_to_file: dict[str, str] = {}
+    for fp in files:
+        with _open_file(fp) as f:
+            for k in f.keys():
+                key_to_file[k] = fp
+    return LazySafetensors(key_to_file)
+
+
+def save_safetensors(
+    tensors: Mapping[str, np.ndarray],
+    out_dir: str,
+    max_shard_bytes: int = 5 * 1024**3,
+    metadata: dict[str, str] | None = None,
+) -> list[str]:
+    """Write tensors as HF-sharded safetensors (+ index.json when sharded)."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    items = list(tensors.items())
+    # greedy sharding by byte size
+    shards: list[list[tuple[str, np.ndarray]]] = [[]]
+    size = 0
+    for k, v in items:
+        v = np.asarray(v)
+        nbytes = v.nbytes
+        if size + nbytes > max_shard_bytes and shards[-1]:
+            shards.append([])
+            size = 0
+        shards[-1].append((k, v))
+        size += nbytes
+
+    meta = {"format": "pt", **(metadata or {})}
+    written: list[str] = []
+    if len(shards) == 1:
+        fp = os.path.join(out_dir, "model.safetensors")
+        save_file(_to_numpy_dict(dict(shards[0])), fp, metadata=meta)
+        return [fp]
+
+    weight_map: dict[str, str] = {}
+    total = 0
+    n = len(shards)
+    for idx, shard in enumerate(shards, start=1):
+        name = f"model-{idx:05d}-of-{n:05d}.safetensors"
+        fp = os.path.join(out_dir, name)
+        save_file(_to_numpy_dict(dict(shard)), fp, metadata=meta)
+        written.append(fp)
+        for k, v in shard:
+            weight_map[k] = name
+            total += np.asarray(v).nbytes
+    with open(os.path.join(out_dir, _INDEX_NAME), "w") as f:
+        json.dump({"metadata": {"total_size": total}, "weight_map": weight_map}, f, indent=2)
+    return written
+
+
+def _to_numpy_dict(d: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    # np.asarray on a jax array device-gets to host; ml_dtypes covers bf16
+    return {k: np.ascontiguousarray(np.asarray(v)) for k, v in d.items()}

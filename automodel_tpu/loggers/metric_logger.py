@@ -31,11 +31,11 @@ class MetricsSample:
 
 
 def _jsonable(v: Any) -> Any:
-    if hasattr(v, "item") and getattr(v, "ndim", None) in (0, None):
-        try:
-            v = v.item()
-        except Exception:
-            pass
+    ndim = getattr(v, "ndim", None)
+    if ndim == 0:
+        v = v.item()
+    elif ndim is not None and hasattr(v, "tolist"):
+        return v.tolist()
     if isinstance(v, float):
         return round(v, 6)
     return v

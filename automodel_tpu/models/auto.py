@@ -1,0 +1,89 @@
+"""AutoModel factory — the day-0 HF entry point
+(reference NeMoAutoModelForCausalLM, _transformers/auto_model.py:583,340,480).
+
+``from_pretrained(path)`` reads an HF model directory (config.json + safetensors),
+resolves the family via the architecture registry, and loads weights through the
+family's state-dict adapter — directly into (optionally sharded) jax arrays; there is
+no intermediate torch model and no meta-device dance (jax.eval_shape covers abstract
+init natively).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.checkpoint.safetensors_io import load_safetensors
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.registry import resolve_model_class
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["AutoModelForCausalLM", "load_hf_config"]
+
+
+def load_hf_config(path: str) -> dict:
+    with open(os.path.join(path, "config.json")) as f:
+        return json.load(f)
+
+
+class AutoModelForCausalLM:
+    """Build a model (+ params) from an HF checkpoint directory or config dict."""
+
+    @classmethod
+    def from_config(cls, config: dict, backend: BackendConfig | None = None):
+        arch = (config.get("architectures") or ["LlamaForCausalLM"])[0]
+        model_cls = resolve_model_class(arch)
+        return model_cls.from_config(config, backend)
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        path: str,
+        backend: BackendConfig | None = None,
+        dtype=jnp.bfloat16,
+        rules=None,
+        return_params: bool = True,
+    ):
+        """Load model + params from an HF dir.
+
+        When ``rules`` (a mesh-bound ShardingRules) is given, each param lands directly
+        on devices with its PartitionSpec — per-tensor host->device streaming, never a
+        full replicated copy (reference load-before-shard rules,
+        _transformers/infrastructure.py:397-403).
+        """
+        config = load_hf_config(path)
+        model = cls.from_config(config, backend)
+        if not return_params:
+            return model
+        adapter = model.state_dict_adapter()
+        tensors = load_safetensors(path)
+        host_params = adapter.from_hf(tensors, dtype=_np_dtype(dtype))
+        params = _place(host_params, model, rules)
+        return model, params
+
+
+def _np_dtype(dtype):
+    import ml_dtypes  # ships with jax
+
+    return np.dtype(dtype) if dtype is not None else None
+
+
+def _place(host_params, model, rules):
+    """Host numpy tree -> device arrays, sharded per the model's logical axes."""
+    if rules is None or rules.mesh is None:
+        return jax.tree.map(jnp.asarray, host_params)
+    axes = model.logical_axes()
+
+    def put(x, logical):
+        return jax.device_put(x, rules.sharding(logical))
+
+    return jax.tree.map(
+        put, host_params, axes,
+        is_leaf=lambda x: isinstance(x, (np.ndarray, jnp.ndarray)),
+    )

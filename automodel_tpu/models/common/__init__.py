@@ -1,0 +1,13 @@
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.common.transformer import (
+    decoder_forward,
+    init_dense_decoder_params,
+    dense_decoder_logical_axes,
+)
+
+__all__ = [
+    "BackendConfig",
+    "decoder_forward",
+    "init_dense_decoder_params",
+    "dense_decoder_logical_axes",
+]

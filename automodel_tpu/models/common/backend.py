@@ -1,0 +1,57 @@
+"""Backend switchboard (reference BackendConfig, models/common/utils.py:139).
+
+The reference toggles between TE/flex/SDPA attention, Triton/gmm experts, fused losses.
+On TPU the choices collapse to: XLA einsum vs Pallas kernels, and how to rematerialize.
+One config object threads through every model family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["BackendConfig"]
+
+# policy name -> jax.checkpoint policy ("full" = no remat; None = remat everything)
+_REMAT_POLICIES = {
+    "none": None,
+    "dots": jax.checkpoint_policies.checkpoint_dots,
+    "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    "full": "full",
+}
+
+
+@dataclasses.dataclass
+class BackendConfig:
+    """Compute-backend knobs shared by all model families.
+
+    attention:    "xla" (einsum softmax) | "flash" (Pallas, TPU only)
+    remat_policy: "none" | "dots" | "dots_no_batch" | "full"
+    scan_layers:  stack layer params and lax.scan over them (fast compiles, PP-friendly)
+    dtype:        activation/param compute dtype (bf16 default; optimizer keeps fp32 master)
+    """
+
+    attention: str = "xla"
+    remat_policy: str = "none"
+    scan_layers: bool = True
+    dtype: str = "bfloat16"
+    # MoE knobs (used by MoE families only)
+    experts_backend: str = "ragged_dot"  # "ragged_dot" | "dense" | "pallas_gmm"
+    dispatcher: str = "dense"  # "dense" (one-hot matmul) | "a2a" (EP all_to_all)
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def layer_remat(self, fn):
+        """Wrap a layer fn with jax.checkpoint per the policy."""
+        if self.remat_policy not in _REMAT_POLICIES:
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r} (choose from {list(_REMAT_POLICIES)})"
+            )
+        policy = _REMAT_POLICIES[self.remat_policy]
+        if policy == "full":
+            return fn
+        return jax.checkpoint(fn, policy=policy)

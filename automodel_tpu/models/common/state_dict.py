@@ -1,0 +1,115 @@
+"""HF-checkpoint <-> param-pytree state-dict adapters (reference per-family
+state_dict_adapter.py files + checkpoint/state_dict_adapter.py).
+
+This is the day-0 HF value proposition: read HF safetensors into our stacked,
+sharding-friendly layout, and write checkpoints back out HF-loadable. Adapters are
+declarative tables of :class:`Entry` — an HF key template, a dotted path into the
+param tree, and a pair of transforms — so new families are data, not code.
+
+Transforms run in numpy on one tensor at a time (host RAM bounded by the largest
+tensor, not the model), and layer stacking/unstacking happens here so models always
+see the scan-ready (L, ...) layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterable, Mapping
+
+import numpy as np
+
+__all__ = ["Entry", "MappingAdapter", "get_path", "set_path"]
+
+Transform = Callable[[np.ndarray], np.ndarray]
+
+
+def _identity(x: np.ndarray) -> np.ndarray:
+    return x
+
+
+@dataclasses.dataclass
+class Entry:
+    """One HF tensor -> one (possibly per-layer) slot in the param tree."""
+
+    hf: str  # e.g. "model.layers.{i}.self_attn.q_proj.weight"
+    ours: str  # e.g. "layers.wq"
+    to_ours: Transform = _identity
+    to_hf: Transform = _identity
+    optional: bool = False
+
+    @property
+    def per_layer(self) -> bool:
+        return "{i}" in self.hf
+
+
+def get_path(tree: dict, path: str) -> Any:
+    node = tree
+    for part in path.split("."):
+        node = node[part]
+    return node
+
+
+def set_path(tree: dict, path: str, value: Any) -> None:
+    parts = path.split(".")
+    node = tree
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+    node[parts[-1]] = value
+
+
+class MappingAdapter:
+    """Applies an Entry table in either direction, handling layer stacking."""
+
+    def __init__(self, entries: Iterable[Entry], num_layers: int, scan_layers: bool = True):
+        self.entries = list(entries)
+        self.num_layers = num_layers
+        self.scan_layers = scan_layers
+
+    def from_hf(self, tensors: Mapping[str, np.ndarray], dtype=None) -> dict:
+        """HF flat dict -> our nested param tree (layers stacked when scan_layers)."""
+        params: dict = {}
+        for e in self.entries:
+            if e.per_layer:
+                per = []
+                missing = False
+                for i in range(self.num_layers):
+                    key = e.hf.format(i=i)
+                    if key not in tensors:
+                        if e.optional:
+                            missing = True
+                            break
+                        raise KeyError(f"missing tensor {key!r} in checkpoint")
+                    per.append(e.to_ours(np.asarray(tensors[key])))
+                if missing:
+                    continue
+                # models consume the stacked (L, ...) layout whether or not they scan
+                stacked = np.stack(per, axis=0)
+                set_path(params, e.ours, stacked if dtype is None else stacked.astype(dtype))
+            else:
+                if e.hf not in tensors:
+                    if e.optional:
+                        continue
+                    raise KeyError(f"missing tensor {e.hf!r} in checkpoint")
+                t = e.to_ours(np.asarray(tensors[e.hf]))
+                set_path(params, e.ours, t if dtype is None else t.astype(dtype))
+        return params
+
+    def to_hf(self, params: dict, dtype=None) -> dict[str, np.ndarray]:
+        """Our param tree -> HF flat dict (unstacking layers)."""
+        out: dict[str, np.ndarray] = {}
+        for e in self.entries:
+            try:
+                value = get_path(params, e.ours)
+            except KeyError:
+                if e.optional:
+                    continue
+                raise
+            value = np.asarray(value)
+            if e.per_layer:
+                for i in range(self.num_layers):
+                    t = e.to_hf(value[i])
+                    out[e.hf.format(i=i)] = t if dtype is None else t.astype(dtype)
+            else:
+                t = e.to_hf(value)
+                out[e.hf] = t if dtype is None else t.astype(dtype)
+        return out

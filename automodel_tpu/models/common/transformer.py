@@ -1,0 +1,262 @@
+"""Shared dense-decoder machinery for all Llama-lineage model families.
+
+TPU-native counterpart of the reference's per-family model.py/layers.py pairs
+(e.g. models/llama/model.py, models/qwen2/): models here are *pure functions over
+param pytrees* — no modules, no wrappers — so pjit/GSPMD shards them by annotating
+logical axes, and parallelism never appears in model code (the reference's
+"parallelism is configuration" contract, README.md:74-80, taken to its fixed point).
+
+Layers are stacked along a leading axis and iterated with ``lax.scan``: one layer
+gets traced/compiled once regardless of depth (fast compiles at 100+ layers), and the
+stacked layout is exactly what pipeline-stage slicing wants later.
+
+Param tree layout (per layer, stacked to (L, ...) under scan):
+  attn_norm (D,) | wq (D,N,H) | wk/wv (D,K,H) | wo (N,H,D) | [bq (N,H) bk/bv (K,H)]
+  [q_norm/k_norm (H,)] | mlp_norm (D,) | w_gate/w_up (D,I) | w_down (I,D)
+Top level: embed (V,D) | final_norm (D,) | [lm_head (D,V) unless tied].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope, rope_attention_scaling, rope_frequencies
+
+__all__ = [
+    "DenseDecoderConfig",
+    "init_dense_decoder_params",
+    "dense_decoder_logical_axes",
+    "decoder_forward",
+]
+
+
+@dataclasses.dataclass
+class DenseDecoderConfig:
+    """Architecture knobs shared by Llama/Qwen2/Qwen3/Mistral-style decoders."""
+
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_hidden_layers: int = 16
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 8
+    head_dim: int | None = None
+    max_position_embeddings: int = 4096
+    rope_theta: float = 10000.0
+    rope_scaling: dict[str, Any] | None = None
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False  # qwen2: bias on q/k/v only
+    qk_norm: bool = False  # qwen3: RMSNorm on per-head q/k
+    sliding_window: int | None = None
+    layer_types: list[str] | None = None  # "full_attention" | "sliding_attention"
+    initializer_range: float = 0.02
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+    @property
+    def sliding_flags(self) -> list[bool]:
+        if self.layer_types is not None:
+            return [t == "sliding_attention" for t in self.layer_types]
+        if self.sliding_window is not None:
+            return [True] * self.num_hidden_layers
+        return [False] * self.num_hidden_layers
+
+
+def _layer_shapes(cfg: DenseDecoderConfig) -> dict[str, tuple[int, ...]]:
+    d, n, k, h, i = (
+        cfg.hidden_size,
+        cfg.num_attention_heads,
+        cfg.num_key_value_heads,
+        cfg.head_dim,
+        cfg.intermediate_size,
+    )
+    shapes = {
+        "attn_norm": (d,),
+        "wq": (d, n, h),
+        "wk": (d, k, h),
+        "wv": (d, k, h),
+        "wo": (n, h, d),
+        "mlp_norm": (d,),
+        "w_gate": (d, i),
+        "w_up": (d, i),
+        "w_down": (i, d),
+    }
+    if cfg.attention_bias:
+        shapes |= {"bq": (n, h), "bk": (k, h), "bv": (k, h)}
+    if cfg.qk_norm:
+        shapes |= {"q_norm": (h,), "k_norm": (h,)}
+    return shapes
+
+
+_LAYER_AXES = {
+    "attn_norm": ("norm",),
+    "wq": ("embed", "heads", "head_dim"),
+    "wk": ("embed", "kv_heads", "head_dim"),
+    "wv": ("embed", "kv_heads", "head_dim"),
+    "wo": ("heads", "head_dim", "embed"),
+    "bq": ("heads", "head_dim"),
+    "bk": ("kv_heads", "head_dim"),
+    "bv": ("kv_heads", "head_dim"),
+    "q_norm": ("norm",),
+    "k_norm": ("norm",),
+    "mlp_norm": ("norm",),
+    "w_gate": ("embed", "mlp"),
+    "w_up": ("embed", "mlp"),
+    "w_down": ("mlp", "embed"),
+}
+
+
+def init_dense_decoder_params(
+    cfg: DenseDecoderConfig, key: jax.Array, dtype=jnp.float32, scan_layers: bool = True
+) -> dict:
+    """Random init matching HF conventions (normal(0, initializer_range), norms=1).
+
+    Layer params are always stacked (L, ...); ``scan_layers`` only controls whether the
+    forward iterates them with lax.scan or an unrolled loop.
+    """
+    del scan_layers
+    shapes = _layer_shapes(cfg)
+    keys = jax.random.split(key, len(shapes) + 2)
+    std = cfg.initializer_range
+    L = cfg.num_hidden_layers
+
+    layers = {}
+    for idx, (name, shape) in enumerate(shapes.items()):
+        if name.endswith("norm"):
+            layers[name] = jnp.ones((L, *shape), dtype)
+        elif name.startswith("b"):
+            layers[name] = jnp.zeros((L, *shape), dtype)
+        else:
+            layers[name] = (jax.random.normal(keys[idx], (L, *shape), jnp.float32) * std).astype(dtype)
+
+    params = {
+        "embed": (jax.random.normal(keys[-2], (cfg.vocab_size, cfg.hidden_size), jnp.float32) * std).astype(dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.hidden_size,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(keys[-1], (cfg.hidden_size, cfg.vocab_size), jnp.float32) * std
+        ).astype(dtype)
+    return params
+
+
+def dense_decoder_logical_axes(cfg: DenseDecoderConfig, scan_layers: bool = True) -> dict:
+    """Pytree of logical-axis tuples matching init_dense_decoder_params' layout."""
+    del scan_layers  # layer params are always stacked (L, ...)
+    layers = {name: ("layers",) + _LAYER_AXES[name] for name in _layer_shapes(cfg)}
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": ("norm",),
+    }
+    if not cfg.tie_word_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def _constrain(x, rules, names):
+    if rules is None or rules.mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, rules.sharding(names))
+
+
+def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, x, positions,
+                     segment_ids, inv_freq, attn_scale, sliding, rules):
+    q = jnp.einsum("bsd,dnh->bsnh", x, lp["wq"])
+    k = jnp.einsum("bsd,dkh->bskh", x, lp["wk"])
+    v = jnp.einsum("bsd,dkh->bskh", x, lp["wv"])
+    if cfg.attention_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    q = apply_rope(q, positions, inv_freq, attn_scale)
+    k = apply_rope(k, positions, inv_freq, attn_scale)
+    q = _constrain(q, rules, ("batch", "act_attn_seq", "act_heads", None))
+    k = _constrain(k, rules, ("batch", "act_attn_seq", "act_heads", None))
+    out = dot_product_attention(
+        q, k, v,
+        causal=True,
+        segment_ids_q=segment_ids,
+        sliding_window=sliding,
+        backend=backend.attention,
+    )
+    return jnp.einsum("bsnh,nhd->bsd", out, lp["wo"])
+
+
+def _mlp_block(lp: dict, x, rules):
+    gate = jnp.einsum("bsd,di->bsi", x, lp["w_gate"])
+    up = jnp.einsum("bsd,di->bsi", x, lp["w_up"])
+    act = _constrain(jax.nn.silu(gate) * up, rules, ("batch", "act_attn_seq", "act_mlp"))
+    return jnp.einsum("bsi,id->bsd", act, lp["w_down"])
+
+
+def decoder_forward(
+    cfg: DenseDecoderConfig,
+    backend: BackendConfig,
+    params: dict,
+    input_ids: jnp.ndarray,  # (B, S) int32
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
+    rules=None,
+    return_hidden: bool = False,
+):
+    """Forward pass -> logits (B, S, V), or final hidden states for fused linear-CE."""
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+    dtype = backend.jnp_dtype
+    h = params["embed"].astype(dtype)[input_ids]
+    h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    attn_scale = rope_attention_scaling(cfg.rope_scaling)
+
+    sliding_flags = jnp.asarray(cfg.sliding_flags, dtype=jnp.int32)
+    big_window = jnp.int32(cfg.max_position_embeddings + input_ids.shape[1])
+    window = jnp.int32(cfg.sliding_window or 0)
+
+    any_sliding = any(cfg.sliding_flags)
+
+    def layer_fn(h, layer_inputs):
+        lp, is_sliding = layer_inputs
+        lp = jax.tree.map(lambda a: a.astype(dtype), lp)
+        # traced per-layer window (scan-compatible); None disables the mask entirely
+        eff_window = jnp.where(is_sliding > 0, window, big_window) if any_sliding else None
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        h = h + _attention_block(cfg, backend, lp, x, positions, segment_ids,
+                                 inv_freq, attn_scale, eff_window, rules)
+        h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = h + _mlp_block(lp, x, rules)
+        h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+        return h, None
+
+    if backend.scan_layers:
+        body = backend.layer_remat(layer_fn)
+        h, _ = jax.lax.scan(body, h, (params["layers"], sliding_flags))
+    else:
+        body = backend.layer_remat(layer_fn)
+        for i in range(cfg.num_hidden_layers):
+            lp = jax.tree.map(lambda a: a[i], params["layers"])
+            h, _ = body(h, (lp, sliding_flags[i]))
+
+    h = rms_norm(h, params["final_norm"].astype(dtype), cfg.rms_norm_eps)
+    if return_hidden:
+        return h
+    unembed = params.get("lm_head")
+    if unembed is None:
+        unembed = params["embed"].T
+    logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
+    return logits

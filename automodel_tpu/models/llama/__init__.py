@@ -1,0 +1,3 @@
+from automodel_tpu.models.llama.model import LlamaConfig, LlamaForCausalLM
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM"]

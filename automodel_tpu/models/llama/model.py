@@ -1,0 +1,99 @@
+"""Llama family (Llama 2/3/3.x) — TPU-native (reference models/llama/model.py).
+
+Also serves Qwen2 (attention_bias=True) and Qwen3 (qk_norm=True, head_dim override)
+through config, the way the reference's optimized TP plans treat these families as one
+lineage (distributed/optimized_tp_plans.py:406).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.common.transformer import (
+    DenseDecoderConfig,
+    decoder_forward,
+    dense_decoder_logical_axes,
+    init_dense_decoder_params,
+)
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM"]
+
+
+@dataclasses.dataclass
+class LlamaConfig(DenseDecoderConfig):
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "LlamaConfig":
+        """Build from an HF config.json dict (llama/qwen2/qwen3/mistral compatible)."""
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_hidden_layers=hf["num_hidden_layers"],
+            num_attention_heads=hf["num_attention_heads"],
+            num_key_value_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get("head_dim"),
+            max_position_embeddings=hf.get("max_position_embeddings", 4096),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rope_scaling=hf.get("rope_scaling"),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            attention_bias=hf.get("attention_bias", hf.get("qkv_bias", False)),
+            qk_norm="Qwen3" in "".join(hf.get("architectures", [])),
+            sliding_window=hf.get("sliding_window") if hf.get("use_sliding_window", True) else None,
+            layer_types=hf.get("layer_types"),
+            initializer_range=hf.get("initializer_range", 0.02),
+        )
+
+
+class LlamaForCausalLM:
+    """Functional model: holds config + backend, operates on param pytrees."""
+
+    config_class = LlamaConfig
+    hf_architectures = (
+        "LlamaForCausalLM",
+        "Qwen2ForCausalLM",
+        "Qwen3ForCausalLM",
+        "MistralForCausalLM",
+    )
+
+    def __init__(self, config: LlamaConfig, backend: BackendConfig | None = None):
+        self.config = config
+        self.backend = backend or BackendConfig()
+
+    # -- params -------------------------------------------------------------
+    def init(self, key: jax.Array, dtype=jnp.float32) -> dict:
+        return init_dense_decoder_params(self.config, key, dtype, self.backend.scan_layers)
+
+    def logical_axes(self) -> dict:
+        return dense_decoder_logical_axes(self.config, self.backend.scan_layers)
+
+    def abstract_params(self, dtype=jnp.bfloat16) -> dict:
+        """Shape/dtype skeleton without allocating (reference meta-device init,
+        auto_model.py:235-242) — feed to jax.eval_shape / checkpoint restore."""
+        return jax.eval_shape(lambda k: self.init(k, dtype), jax.random.key(0))
+
+    # -- forward ------------------------------------------------------------
+    def __call__(self, params, input_ids, positions=None, segment_ids=None, rules=None,
+                 return_hidden=False):
+        return decoder_forward(
+            self.config, self.backend, params, input_ids,
+            positions=positions, segment_ids=segment_ids, rules=rules,
+            return_hidden=return_hidden,
+        )
+
+    # -- HF interop ---------------------------------------------------------
+    def state_dict_adapter(self):
+        from automodel_tpu.models.llama.state_dict_adapter import LlamaStateDictAdapter
+
+        return LlamaStateDictAdapter(self.config, scan_layers=self.backend.scan_layers)
+
+    @classmethod
+    def from_config(cls, config: LlamaConfig | dict, backend: BackendConfig | None = None):
+        if isinstance(config, dict):
+            config = LlamaConfig.from_hf(config)
+        return cls(config, backend)

@@ -1,0 +1,97 @@
+"""Llama-lineage HF key/layout mapping (reference models/llama/state_dict_adapter.py).
+
+HF linear weights are (out_features, in_features); our layout is (in, out) — or
+(in, heads, head_dim) / (heads, head_dim, out) for attention — so every projection
+transposes + reshapes on the way in and back out.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.common.transformer import DenseDecoderConfig
+
+__all__ = ["LlamaStateDictAdapter"]
+
+
+def _proj_in(heads: int, head_dim: int):
+    """HF (heads*head_dim, D) -> ours (D, heads, head_dim)."""
+
+    def f(w: np.ndarray) -> np.ndarray:
+        return np.ascontiguousarray(w.T).reshape(w.shape[1], heads, head_dim)
+
+    return f
+
+
+def _proj_out(heads: int, head_dim: int):
+    def f(w: np.ndarray) -> np.ndarray:
+        return np.ascontiguousarray(w.reshape(w.shape[0], heads * head_dim).T)
+
+    return f
+
+
+def _o_in(heads: int, head_dim: int):
+    """HF o_proj (D, heads*head_dim) -> ours (heads, head_dim, D)."""
+
+    def f(w: np.ndarray) -> np.ndarray:
+        return np.ascontiguousarray(w.T).reshape(heads, head_dim, w.shape[0])
+
+    return f
+
+
+def _o_out(heads: int, head_dim: int):
+    def f(w: np.ndarray) -> np.ndarray:
+        return np.ascontiguousarray(w.reshape(heads * head_dim, w.shape[2]).T)
+
+    return f
+
+
+def _bias_in(heads: int, head_dim: int):
+    def f(b: np.ndarray) -> np.ndarray:
+        return b.reshape(heads, head_dim)
+
+    return f
+
+
+def _bias_out(heads: int, head_dim: int):
+    def f(b: np.ndarray) -> np.ndarray:
+        return b.reshape(heads * head_dim)
+
+    return f
+
+
+def _t(w: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(w.T)
+
+
+class LlamaStateDictAdapter(MappingAdapter):
+    def __init__(self, cfg: DenseDecoderConfig, scan_layers: bool = True):
+        n, k, h = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        entries = [
+            Entry("model.embed_tokens.weight", "embed"),
+            Entry("model.norm.weight", "final_norm"),
+            Entry("model.layers.{i}.input_layernorm.weight", "layers.attn_norm"),
+            Entry("model.layers.{i}.post_attention_layernorm.weight", "layers.mlp_norm"),
+            Entry("model.layers.{i}.self_attn.q_proj.weight", "layers.wq", _proj_in(n, h), _proj_out(n, h)),
+            Entry("model.layers.{i}.self_attn.k_proj.weight", "layers.wk", _proj_in(k, h), _proj_out(k, h)),
+            Entry("model.layers.{i}.self_attn.v_proj.weight", "layers.wv", _proj_in(k, h), _proj_out(k, h)),
+            Entry("model.layers.{i}.self_attn.o_proj.weight", "layers.wo", _o_in(n, h), _o_out(n, h)),
+            Entry("model.layers.{i}.mlp.gate_proj.weight", "layers.w_gate", _t, _t),
+            Entry("model.layers.{i}.mlp.up_proj.weight", "layers.w_up", _t, _t),
+            Entry("model.layers.{i}.mlp.down_proj.weight", "layers.w_down", _t, _t),
+        ]
+        if cfg.attention_bias:
+            entries += [
+                Entry("model.layers.{i}.self_attn.q_proj.bias", "layers.bq", _bias_in(n, h), _bias_out(n, h)),
+                Entry("model.layers.{i}.self_attn.k_proj.bias", "layers.bk", _bias_in(k, h), _bias_out(k, h)),
+                Entry("model.layers.{i}.self_attn.v_proj.bias", "layers.bv", _bias_in(k, h), _bias_out(k, h)),
+            ]
+        if cfg.qk_norm:
+            entries += [
+                Entry("model.layers.{i}.self_attn.q_norm.weight", "layers.q_norm"),
+                Entry("model.layers.{i}.self_attn.k_norm.weight", "layers.k_norm"),
+            ]
+        if not cfg.tie_word_embeddings:
+            entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
+        super().__init__(entries, cfg.num_hidden_layers, scan_layers)

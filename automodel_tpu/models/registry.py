@@ -1,0 +1,33 @@
+"""HF architecture-name -> model family registry (reference _transformers/registry.py:33).
+
+The reference scans components/models/*/model.py for classes; here registration is
+explicit and lazy (import strings) so importing the registry stays cheap.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+__all__ = ["MODEL_REGISTRY", "resolve_model_class", "register_model"]
+
+# architecture name (HF config.json "architectures"[0]) -> "module:Class"
+MODEL_REGISTRY: dict[str, str] = {
+    "LlamaForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
+    "Qwen2ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
+    "Qwen3ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
+    "MistralForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
+}
+
+
+def register_model(architecture: str, target: str) -> None:
+    MODEL_REGISTRY[architecture] = target
+
+
+def resolve_model_class(architecture: str):
+    target = MODEL_REGISTRY.get(architecture)
+    if target is None:
+        raise KeyError(
+            f"architecture {architecture!r} is not supported; known: {sorted(MODEL_REGISTRY)}"
+        )
+    mod_name, cls_name = target.split(":")
+    return getattr(importlib.import_module(mod_name), cls_name)

@@ -41,13 +41,16 @@ def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    auto: bool = False,
 ) -> DistInfo:
     """Initialize the JAX distributed runtime if running multi-host.
 
     Single-process (one host, however many chips) needs no initialization.
-    Multi-host coordinates via args or standard env vars
-    (``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID``, or cloud-TPU metadata
-    which ``jax.distributed.initialize()`` discovers on its own).
+    Multi-host coordinates via explicit args or env vars
+    (``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID``). On cloud TPU pods,
+    pass ``auto=True`` (or set ``JAX_DIST_AUTO=1``) to call
+    ``jax.distributed.initialize()`` argument-free and let it discover the topology
+    from TPU metadata.
     """
     global _INITIALIZED
     coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
@@ -55,14 +58,18 @@ def initialize_distributed(
         num_processes = int(os.environ["NUM_PROCESSES"])
     if process_id is None and "PROCESS_ID" in os.environ:
         process_id = int(os.environ["PROCESS_ID"])
+    auto = auto or os.environ.get("JAX_DIST_AUTO", "0") == "1"
 
-    want_multihost = coordinator_address is not None or (num_processes or 0) > 1
+    want_multihost = auto or coordinator_address is not None or (num_processes or 0) > 1
     if want_multihost and not _INITIALIZED:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+        if auto and coordinator_address is None:
+            jax.distributed.initialize()
+        else:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
         _INITIALIZED = True
 
     info = DistInfo(

@@ -131,11 +131,19 @@ def create_device_mesh(ctx: MeshContext, devices: Sequence[Any] | None = None) -
     """
     if devices is None:
         devices = jax.devices()
-    devices = np.asarray(devices)
+    devices = list(devices)
     shape = tuple(ctx.shape.values())
-    if devices.size != math.prod(shape):
-        raise ValueError(f"got {devices.size} devices for mesh shape {shape}")
-    return Mesh(devices.reshape(shape), axis_names=tuple(ctx.shape.keys()))
+    if len(devices) != math.prod(shape):
+        raise ValueError(f"got {len(devices)} devices for mesh shape {shape}")
+    # ICI/DCN-topology-aware assignment (keeps tp on the shortest torus hops); falls
+    # back to enumeration order where no topology info exists (CPU test platform).
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, NotImplementedError, AssertionError):
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=tuple(ctx.shape.keys()))
 
 
 class ShardingRules:
