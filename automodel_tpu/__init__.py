@@ -18,6 +18,8 @@ _LAZY = {
     "parse_args_and_load_config": "automodel_tpu.config.cli_overrides",
     "MeshContext": "automodel_tpu.parallel.mesh",
     "create_device_mesh": "automodel_tpu.parallel.mesh",
+    "AutoModelForCausalLM": "automodel_tpu.models.auto",
+    "AutoTokenizer": "automodel_tpu.models.auto_tokenizer",
 }
 
 

@@ -1,0 +1,224 @@
+"""Distributed checkpointing (reference components/checkpoint/checkpointing.py:100,142).
+
+Orbax replaces torch DCP: sharded jax arrays save/restore in parallel across hosts with
+no gloo side-channels, and restore reads directly into the target sharding (the
+reference's shard-then-load rules collapse into Orbax restore_args). The reference's
+dual-format guarantee is kept: every model checkpoint can also be consolidated to
+HF-layout safetensors so any step is ``transformers``-loadable (SURVEY.md §3.4).
+
+Layout per save (mirrors the reference's epoch/step dirs + ``latest`` symlink,
+base_recipe.py:241,383):
+
+    <root>/step_{N}/model/        orbax pytree (sharded)
+    <root>/step_{N}/optim/        orbax pytree (sharded)
+    <root>/step_{N}/client.json   rng/step-scheduler/dataloader state_dicts
+    <root>/step_{N}/hf/           consolidated safetensors (optional)
+    <root>/latest -> step_{N}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import shutil
+from typing import Any, Callable, Mapping
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CheckpointingConfig", "Checkpointer"]
+
+
+@dataclasses.dataclass
+class CheckpointingConfig:
+    enabled: bool = True
+    checkpoint_dir: str = "checkpoints"
+    save_consolidated: bool = False  # also write HF safetensors per save
+    keep_last_k: int | None = None  # prune old step dirs
+    async_save: bool = False
+
+
+class Checkpointer:
+    """Save/restore model params, optimizer state, and client (host) states."""
+
+    def __init__(self, config: CheckpointingConfig, state_dict_adapter=None, hf_config: dict | None = None):
+        self.config = config
+        self.state_dict_adapter = state_dict_adapter  # for consolidated HF export
+        self.hf_config = hf_config
+        self._ckptr = None
+        self._pending = None
+
+    # lazily create so importing this module never touches orbax/devices
+    @property
+    def ckptr(self):
+        if self._ckptr is None:
+            import orbax.checkpoint as ocp
+
+            if self.config.async_save:
+                self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+            else:
+                self._ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+        return self._ckptr
+
+    # -- paths --------------------------------------------------------------
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.config.checkpoint_dir, f"step_{step}")
+
+    def latest_step(self) -> int | None:
+        root = self.config.checkpoint_dir
+        link = os.path.join(root, "latest")
+        if os.path.islink(link):
+            target = os.readlink(link)
+            if target.startswith("step_"):
+                return int(target.split("_")[1])
+        if not os.path.isdir(root):
+            return None
+        steps = [
+            int(d.split("_")[1])
+            for d in os.listdir(root)
+            if d.startswith("step_") and os.path.isdir(os.path.join(root, d))
+        ]
+        return max(steps) if steps else None
+
+    # -- save ---------------------------------------------------------------
+    def save(
+        self,
+        step: int,
+        params: Any,
+        opt_state: Any = None,
+        client_states: Mapping[str, Any] | None = None,
+    ) -> str:
+        if not self.config.enabled:
+            return ""
+        self.wait()  # finalize any in-flight async save (writes its latest symlink)
+        d = self.step_dir(step)
+        os.makedirs(d, exist_ok=True)
+        self.ckptr.save(os.path.join(d, "model"), params, force=True)
+        if opt_state is not None:
+            self.ckptr.save(os.path.join(d, "optim"), opt_state, force=True)
+        if jax.process_index() == 0 and client_states:
+            with open(os.path.join(d, "client.json"), "w") as f:
+                json.dump({k: _jsonify(v.state_dict() if hasattr(v, "state_dict") else v)
+                           for k, v in client_states.items()}, f)
+        if self.config.save_consolidated and self.state_dict_adapter is not None:
+            self.save_hf(os.path.join(d, "hf"), params)
+        # async: the array write may still be in flight — defer the latest symlink
+        # to wait() so a crash mid-write can't leave latest -> incomplete step
+        self._pending = step
+        if not self.config.async_save:
+            self.wait()
+        self._prune()
+        logger.info("saved checkpoint step=%d -> %s", step, d)
+        return d
+
+    def save_hf(self, out_dir: str, params: Any) -> None:
+        """Consolidated HF-layout safetensors export (any rank count -> one HF dir)."""
+        from automodel_tpu.checkpoint.safetensors_io import save_safetensors
+
+        host = jax.tree.map(_full_host_array, params)
+        tensors = self.state_dict_adapter.to_hf(host)
+        if jax.process_index() == 0:
+            save_safetensors(tensors, out_dir)
+            if self.hf_config is not None:
+                with open(os.path.join(out_dir, "config.json"), "w") as f:
+                    json.dump(self.hf_config, f, indent=2)
+
+    def wait(self) -> None:
+        """Block until an in-flight async save lands, then commit its ``latest``
+        symlink (reference maybe_wait_for_staging, train_ft.py:1336)."""
+        if self._ckptr is not None and hasattr(self._ckptr, "wait_until_finished"):
+            self._ckptr.wait_until_finished()
+        if self._pending is not None:
+            if jax.process_index() == 0:
+                self._update_latest(self._pending)
+            self._pending = None
+
+    # -- load ---------------------------------------------------------------
+    def load(
+        self,
+        params_template: Any,
+        opt_state_template: Any = None,
+        step: int | None = None,
+    ) -> tuple[Any, Any, dict[str, Any]]:
+        """Restore into the shardings/dtypes of the provided templates."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.config.checkpoint_dir!r}")
+        import orbax.checkpoint as ocp
+
+        d = self.step_dir(step)
+
+        def _resharded(restored, template):
+            # orbax can land scalars/small leaves on a single device; force every
+            # leaf back onto the template's sharding so jit sees consistent placement
+            def put(r, t):
+                if hasattr(t, "sharding"):
+                    return jax.device_put(r, t.sharding)
+                return r
+
+            return jax.tree.map(put, restored, template)
+
+        params = _resharded(
+            self.ckptr.restore(os.path.join(d, "model"), args=ocp.args.StandardRestore(params_template)),
+            params_template,
+        )
+        opt_state = None
+        if opt_state_template is not None and os.path.isdir(os.path.join(d, "optim")):
+            opt_state = _resharded(
+                self.ckptr.restore(os.path.join(d, "optim"), args=ocp.args.StandardRestore(opt_state_template)),
+                opt_state_template,
+            )
+        client: dict[str, Any] = {}
+        cj = os.path.join(d, "client.json")
+        if os.path.exists(cj):
+            with open(cj) as f:
+                client = json.load(f)
+        return params, opt_state, client
+
+    # -- internals ----------------------------------------------------------
+    def _update_latest(self, step: int) -> None:
+        link = os.path.join(self.config.checkpoint_dir, "latest")
+        tmp = link + ".tmp"
+        if os.path.islink(tmp) or os.path.exists(tmp):
+            os.remove(tmp)
+        os.symlink(f"step_{step}", tmp)
+        os.replace(tmp, link)
+
+    def _prune(self) -> None:
+        k = self.config.keep_last_k
+        if not k or jax.process_index() != 0:
+            return
+        root = self.config.checkpoint_dir
+        steps = sorted(
+            int(d.split("_")[1])
+            for d in os.listdir(root)
+            if d.startswith("step_") and os.path.isdir(os.path.join(root, d))
+        )
+        for s in steps[:-k]:
+            shutil.rmtree(self.step_dir(s), ignore_errors=True)
+
+
+def _full_host_array(a: Any) -> np.ndarray:
+    """Device/sharded array -> full host array, gathering across hosts if needed."""
+    if hasattr(a, "is_fully_addressable") and not a.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(a, tiled=True))
+    return np.asarray(a)
+
+
+def _jsonify(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        return {k: _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return {"__ndarray__": obj.tolist(), "dtype": str(obj.dtype)}
+    if isinstance(obj, (np.integer, np.floating)):
+        return obj.item()
+    return obj

@@ -1,0 +1,66 @@
+"""``automodel`` CLI (reference _cli/app.py:45-61, pyproject.toml:144).
+
+Usage::
+
+    automodel finetune llm -c examples/llm_finetune/llama_1b.yaml [--a.b.c v ...]
+    automodel pretrain llm -c cfg.yaml
+    automodel benchmark llm -c cfg.yaml
+
+Unlike the reference there is no torchrun fan-out: JAX is one process per host, so the
+CLI either runs the recipe inline, or — when the config has a ``slurm:`` section —
+renders an sbatch script that runs this same CLI on every node (reference
+launcher/slurm/utils.py:65 behavior).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from automodel_tpu.config.cli_overrides import parse_args_and_load_config
+
+__all__ = ["main", "RECIPES"]
+
+# (command, domain) -> recipe main
+RECIPES: dict[tuple[str, str], str] = {
+    ("finetune", "llm"): "automodel_tpu.recipes.llm.train_ft:main",
+    ("pretrain", "llm"): "automodel_tpu.recipes.llm.train_ft:main",
+    ("benchmark", "llm"): "automodel_tpu.recipes.llm.benchmark:main",
+    ("kd", "llm"): "automodel_tpu.recipes.llm.kd:main",
+    ("finetune", "vlm"): "automodel_tpu.recipes.vlm.finetune:main",
+}
+
+
+def _resolve(command: str, domain: str):
+    key = (command, domain)
+    if key not in RECIPES:
+        known = ", ".join(f"{c} {d}" for c, d in RECIPES)
+        raise SystemExit(f"unknown recipe '{command} {domain}'; known: {known}")
+    target = RECIPES[key]
+    mod_name, fn_name = target.split(":")
+    import importlib
+
+    try:
+        mod = importlib.import_module(mod_name)
+    except ModuleNotFoundError as e:
+        raise SystemExit(f"recipe '{command} {domain}' is not available yet ({e})")
+    return getattr(mod, fn_name)
+
+
+def main(argv: list[str] | None = None):
+    if argv is None:
+        argv = sys.argv[1:]
+    if len(argv) < 2 or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        raise SystemExit(0 if argv and argv[0] in ("-h", "--help") else 2)
+    command, domain, *rest = argv
+    cfg = parse_args_and_load_config(rest)
+    if "slurm" in cfg:
+        from automodel_tpu.launcher.slurm import submit_slurm_job
+
+        return submit_slurm_job(cfg, command, domain)
+    recipe_main = _resolve(command, domain)
+    return recipe_main(cfg)
+
+
+if __name__ == "__main__":
+    main()

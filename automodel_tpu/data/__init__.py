@@ -1,0 +1,4 @@
+from automodel_tpu.data.loader import DataLoader
+from automodel_tpu.data.collate import sft_collate, stack_batches
+
+__all__ = ["DataLoader", "sft_collate", "stack_batches"]

@@ -1,0 +1,69 @@
+"""Batch collation for next-token prediction (reference components/datasets/utils.py).
+
+Examples are dicts with ``input_ids`` and optional ``labels`` (pre-masked) or
+``prompt_len`` (mask the prompt span). Collation pads/truncates to a *fixed* seq_len —
+static shapes are non-negotiable under jit — and emits:
+
+  input_ids (B, S) int32 | labels (B, S) int32 (-100 = ignored) | positions (B, S)
+  segment_ids (B, S): 1 for real tokens, 0 for padding (packing reuses this field
+  with per-sequence ids — the TPU-native THD replacement, SURVEY.md §5 long-context).
+
+Labels are pre-shifted here (labels[t] = token[t+1]) so the model's logits align
+1:1 and the loss never re-slices — one less place for off-by-ones.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+__all__ = ["sft_collate", "stack_batches", "IGNORE_INDEX"]
+
+IGNORE_INDEX = -100
+
+
+def sft_collate(
+    examples: Sequence[Mapping[str, Any]],
+    seq_len: int,
+    pad_token_id: int = 0,
+    answer_only_loss: bool = True,
+) -> dict[str, np.ndarray]:
+    b = len(examples)
+    input_ids = np.full((b, seq_len), pad_token_id, dtype=np.int32)
+    labels = np.full((b, seq_len), IGNORE_INDEX, dtype=np.int32)
+    segment_ids = np.zeros((b, seq_len), dtype=np.int32)
+    positions = np.zeros((b, seq_len), dtype=np.int32)
+
+    for row, ex in enumerate(examples):
+        ids = np.asarray(ex["input_ids"], dtype=np.int32)[: seq_len + 1]
+        # next-token shift: inputs are ids[:-1], targets ids[1:]
+        if "labels" in ex and ex["labels"] is not None:
+            tgt_full = np.asarray(ex["labels"], dtype=np.int32)[: seq_len + 1]
+            inp, tgt = ids[:-1], tgt_full[1:]
+        else:
+            inp, tgt = ids[:-1], ids[1:].copy()
+            if answer_only_loss and "prompt_len" in ex:
+                # mask targets that belong to the prompt (target index t predicts
+                # token t+1, so prompt_len-1 targets are masked)
+                cut = max(int(ex["prompt_len"]) - 1, 0)
+                tgt[:cut] = IGNORE_INDEX
+        n = len(inp)
+        input_ids[row, :n] = inp
+        labels[row, :n] = tgt
+        segment_ids[row, :n] = 1
+        positions[row, :n] = np.arange(n)
+    # padded label positions stay IGNORE_INDEX; mask pad targets too
+    labels[segment_ids == 0] = IGNORE_INDEX
+    return {
+        "input_ids": input_ids,
+        "labels": labels,
+        "positions": positions,
+        "segment_ids": segment_ids,
+    }
+
+
+def stack_batches(batches: Sequence[Mapping[str, np.ndarray]]) -> dict[str, np.ndarray]:
+    """Stack microbatches into (n_micro, B, S) arrays for the scan inside train_step."""
+    keys = batches[0].keys()
+    return {k: np.stack([np.asarray(b[k]) for b in batches], axis=0) for k in keys}

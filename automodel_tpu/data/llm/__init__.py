@@ -1,0 +1,5 @@
+from automodel_tpu.data.llm.column_mapped import ColumnMappedTextInstructionDataset
+from automodel_tpu.data.llm.hellaswag import HellaSwagDataset
+from automodel_tpu.data.llm.mock import MockSFTDataset
+
+__all__ = ["ColumnMappedTextInstructionDataset", "HellaSwagDataset", "MockSFTDataset"]

@@ -1,0 +1,78 @@
+"""Column-mapped instruction dataset
+(reference datasets/llm/column_mapped_text_instruction_dataset.py behavior).
+
+Loads a JSON/JSONL file or an HF dataset name, maps arbitrary column names onto
+(context, question, answer) roles, tokenizes into SFT examples with prompt-span loss
+masking.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Mapping
+
+__all__ = ["ColumnMappedTextInstructionDataset"]
+
+
+def _load_rows(path_or_name: str, split: str | None) -> list[dict]:
+    if os.path.exists(path_or_name):
+        rows = []
+        with open(path_or_name) as f:
+            if path_or_name.endswith(".jsonl"):
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(json.loads(line))
+            else:
+                data = json.load(f)
+                rows = data if isinstance(data, list) else data["data"]
+        return rows
+    # fall back to HF datasets hub (needs network or local cache)
+    import datasets as hf_datasets
+
+    ds = hf_datasets.load_dataset(path_or_name, split=split or "train")
+    return list(ds)
+
+
+class ColumnMappedTextInstructionDataset:
+    def __init__(
+        self,
+        path_or_dataset_id: str,
+        column_mapping: Mapping[str, str],
+        tokenizer=None,
+        split: str | None = None,
+        answer_only_loss_mask: bool = True,
+        limit_dataset_samples: int | None = None,
+    ):
+        if "answer" not in column_mapping:
+            raise ValueError("column_mapping must include an 'answer' role")
+        self.rows = _load_rows(path_or_dataset_id, split)
+        if limit_dataset_samples:
+            self.rows = self.rows[:limit_dataset_samples]
+        self.mapping = dict(column_mapping)
+        self.tokenizer = tokenizer
+        self.answer_only = answer_only_loss_mask
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def format_prompt(self, row: Mapping[str, Any]) -> tuple[str, str]:
+        parts = []
+        for role in ("context", "question", "instruction"):
+            if role in self.mapping:
+                parts.append(str(row[self.mapping[role]]))
+        prompt = "\n".join(parts)
+        answer = str(row[self.mapping["answer"]])
+        return prompt, answer
+
+    def __getitem__(self, i: int) -> dict[str, Any]:
+        from automodel_tpu.data.tokenize import tokenize_sft_example
+
+        prompt, answer = self.format_prompt(self.rows[i])
+        if self.tokenizer is None:
+            raise ValueError("tokenizer required to materialize examples")
+        ex = tokenize_sft_example(self.tokenizer, prompt, answer)
+        if not self.answer_only:
+            ex["prompt_len"] = 0
+        return ex

@@ -1,0 +1,45 @@
+"""HellaSwag SFT dataset (reference datasets/llm/hellaswag.py behavior):
+context -> prompt, gold ending -> answer; loss on the ending span only."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+__all__ = ["HellaSwagDataset"]
+
+
+class HellaSwagDataset:
+    def __init__(
+        self,
+        path_or_dataset_id: str = "rowan/hellaswag",
+        tokenizer=None,
+        split: str = "train",
+        limit_dataset_samples: int | None = None,
+    ):
+        if os.path.exists(path_or_dataset_id):
+            rows = []
+            with open(path_or_dataset_id) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(json.loads(line))
+        else:
+            import datasets as hf_datasets
+
+            rows = list(hf_datasets.load_dataset(path_or_dataset_id, split=split))
+        if limit_dataset_samples:
+            rows = rows[:limit_dataset_samples]
+        self.rows = rows
+        self.tokenizer = tokenizer
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __getitem__(self, i: int) -> dict[str, Any]:
+        from automodel_tpu.data.tokenize import tokenize_sft_example
+
+        row = self.rows[i]
+        ending = row["endings"][int(row["label"])]
+        return tokenize_sft_example(self.tokenizer, row["ctx"], ending)

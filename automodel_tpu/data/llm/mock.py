@@ -1,0 +1,51 @@
+"""Mock datasets isolating compute perf from data noise
+(reference datasets/llm/mock_iterable_dataset.py:19, mock.py — used by every benchmark
+config, SURVEY.md §4 fixtures)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+__all__ = ["MockSFTDataset"]
+
+
+class MockSFTDataset:
+    """Deterministic synthetic examples; loss over the whole sequence.
+
+    pattern="random": i.i.d. uniform tokens — incompressible, the right fixture for
+    benchmarks (loss stays at ln(vocab), isolating compute perf from learning).
+    pattern="arith": per-sample arithmetic progressions mod vocab — highly learnable,
+    the right fixture for loss-decreases tests.
+    """
+
+    def __init__(
+        self,
+        vocab_size: int,
+        seq_len: int,
+        num_samples: int = 1024,
+        seed: int = 0,
+        pattern: str = "random",
+    ):
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self.num_samples = num_samples
+        self.seed = seed
+        if pattern not in ("random", "arith"):
+            raise ValueError(f"unknown pattern {pattern!r}")
+        self.pattern = pattern
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, i: int) -> dict[str, Any]:
+        rng = np.random.RandomState(self.seed * 100003 + i)
+        # seq_len + 1 so the next-token shift still yields seq_len targets
+        if self.pattern == "arith":
+            step = rng.randint(1, 8)
+            start = rng.randint(0, self.vocab_size)
+            ids = (start + step * np.arange(self.seq_len + 1)) % self.vocab_size
+        else:
+            ids = rng.randint(0, self.vocab_size, size=self.seq_len + 1)
+        return {"input_ids": ids.tolist(), "prompt_len": 0}

@@ -1,0 +1,75 @@
+"""Deterministic, resumable host-side data loading.
+
+Replaces the reference's torch DataLoader + resumable Megatron sampler
+(datasets/llm/megatron/sampler.py) with a small stateful batcher: shuffled epoch
+permutations derived from (seed, epoch), a position cursor for exact resume, and
+optional per-process striding for multi-host (each process reads only its slice —
+what the reference gets from DistributedSampler).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+
+__all__ = ["DataLoader"]
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset: Sequence[Any],
+        batch_size: int,
+        collate_fn: Callable[[list[Any]], Any] | None = None,
+        seed: int = 0,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        process_index: int = 0,
+        process_count: int = 1,
+    ):
+        if batch_size % process_count != 0:
+            raise ValueError(f"batch_size {batch_size} not divisible by process_count {process_count}")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.local_batch_size = batch_size // process_count
+        self.collate_fn = collate_fn or (lambda x: x)
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.process_index = process_index
+        self.process_count = process_count
+        self.epoch = 0
+        self._cursor = 0  # global-batch index within the epoch
+
+    def _epoch_order(self) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            return np.random.RandomState(self.seed + self.epoch).permutation(n)
+        return np.arange(n)
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[Any]:
+        order = self._epoch_order()
+        nb = len(self)
+        while self._cursor < nb:
+            start = self._cursor * self.batch_size
+            idx = order[start : start + self.batch_size]
+            # per-process slice of the global batch
+            local = idx[self.process_index * self.local_batch_size : (self.process_index + 1) * self.local_batch_size]
+            self._cursor += 1
+            yield self.collate_fn([self.dataset[int(i)] for i in local])
+        self.epoch += 1
+        self._cursor = 0
+
+    # -- resumable state ----------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch, "cursor": self._cursor, "seed": self.seed}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = int(state["epoch"])
+        self._cursor = int(state["cursor"])
+        self.seed = int(state.get("seed", self.seed))

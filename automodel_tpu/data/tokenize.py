@@ -1,0 +1,21 @@
+"""Shared prompt/answer tokenization for SFT datasets."""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["tokenize_sft_example"]
+
+
+def tokenize_sft_example(tokenizer, prompt: str, answer: str, sep: str = " ") -> dict[str, Any]:
+    """Tokenize prompt+answer; return input_ids (EOS-terminated) and prompt_len.
+
+    prompt_len counts the prompt's tokens inside the full encoding so collation can
+    mask the prompt span from the loss (answer-only loss).
+    """
+    prompt_ids = tokenizer.encode(prompt)
+    full_ids = list(tokenizer.encode(prompt + sep + answer))
+    eos = getattr(tokenizer, "eos_token_id", None)
+    if eos is not None and (not full_ids or full_ids[-1] != eos):
+        full_ids = full_ids + [eos]
+    return {"input_ids": full_ids, "prompt_len": len(prompt_ids)}

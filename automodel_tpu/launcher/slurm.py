@@ -1,0 +1,94 @@
+"""SLURM submission for multi-host TPU jobs
+(reference components/launcher/slurm/: config.py:43, template.py:91, utils.py:65).
+
+Renders an sbatch script that starts one process per node running the same
+``automodel`` CLI; JAX's distributed runtime wires the hosts together
+(``JAX_DIST_AUTO=1`` -> jax.distributed.initialize()), replacing the reference's
+torchrun-per-node + MASTER_ADDR ceremony with the coordinator env vars.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import tempfile
+
+__all__ = ["SlurmConfig", "render_script", "submit_slurm_job"]
+
+
+@dataclasses.dataclass
+class SlurmConfig:
+    job_name: str = "automodel"
+    nodes: int = 1
+    account: str | None = None
+    partition: str | None = None
+    time: str = "04:00:00"
+    container_image: str | None = None
+    container_mounts: list[str] | None = None
+    env_vars: dict[str, str] | None = None
+    extra_sbatch: list[str] | None = None
+    hf_home: str | None = None
+
+
+def render_script(slurm: SlurmConfig, command: str, domain: str, config_path: str) -> str:
+    lines = [
+        "#!/bin/bash",
+        f"#SBATCH --job-name={slurm.job_name}",
+        f"#SBATCH --nodes={slurm.nodes}",
+        "#SBATCH --ntasks-per-node=1",
+        f"#SBATCH --time={slurm.time}",
+    ]
+    if slurm.account:
+        lines.append(f"#SBATCH --account={slurm.account}")
+    if slurm.partition:
+        lines.append(f"#SBATCH --partition={slurm.partition}")
+    for extra in slurm.extra_sbatch or []:
+        lines.append(f"#SBATCH {extra}")
+    lines.append("")
+    env = {
+        "COORDINATOR_ADDRESS": "$(scontrol show hostnames $SLURM_JOB_NODELIST | head -n1):12345",
+        "NUM_PROCESSES": "$SLURM_NNODES",
+        **(slurm.env_vars or {}),
+    }
+    if slurm.hf_home:
+        env["HF_HOME"] = slurm.hf_home
+    for k, v in env.items():
+        lines.append(f"export {k}={v}")
+    srun = "srun "
+    if slurm.container_image:
+        srun += f"--container-image={slurm.container_image} "
+        if slurm.container_mounts:
+            srun += f"--container-mounts={','.join(slurm.container_mounts)} "
+    lines.append("")
+    # PROCESS_ID must be the per-task rank: $SLURM_PROCID only exists inside each
+    # srun task (the batch shell's $SLURM_NODEID is always 0), so expand it there.
+    lines.append(
+        f"{srun}bash -c 'PROCESS_ID=$SLURM_PROCID "
+        f"python -m automodel_tpu.cli.app {command} {domain} -c {config_path}'"
+    )
+    return "\n".join(lines) + "\n"
+
+
+def submit_slurm_job(cfg, command: str, domain: str) -> str:
+    """Render + sbatch; returns the rendered script path (reference utils.py:65)."""
+    slurm_cfg = SlurmConfig(**cfg.slurm.to_dict())
+    # persist the resolved config next to the script so the job is self-contained
+    workdir = cfg.get("slurm_workdir", tempfile.mkdtemp(prefix="automodel_slurm_"))
+    os.makedirs(workdir, exist_ok=True)
+    cfg_path = os.path.join(workdir, "config.yaml")
+    import yaml
+
+    clean = {k: v for k, v in cfg.raw_dict.items() if k != "slurm"}
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump(clean, f)
+    script = render_script(slurm_cfg, command, domain, cfg_path)
+    script_path = os.path.join(workdir, "job.sbatch")
+    with open(script_path, "w") as f:
+        f.write(script)
+    try:
+        out = subprocess.run(["sbatch", script_path], capture_output=True, text=True, check=True)
+        print(out.stdout.strip())
+    except FileNotFoundError:
+        print(f"sbatch not found; rendered script at {script_path}")
+    return script_path

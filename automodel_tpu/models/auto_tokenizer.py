@@ -1,0 +1,51 @@
+"""Tokenizer wrapper with BOS/EOS enforcement
+(reference NeMoAutoTokenizer, _transformers/auto_tokenizer.py:50 and
+tokenization/nemo_auto_tokenizer.py:19).
+
+Delegates to ``transformers.AutoTokenizer`` and guarantees encode() emits BOS/EOS
+when the model expects them — several HF tokenizers ship with add_bos/eos disabled,
+which silently degrades SFT quality.
+"""
+
+from __future__ import annotations
+
+__all__ = ["AutoTokenizer"]
+
+
+class AutoTokenizer:
+    @classmethod
+    def from_pretrained(
+        cls,
+        path: str,
+        ensure_bos: bool = True,
+        ensure_eos: bool = False,
+        **kwargs,
+    ):
+        import transformers
+
+        tok = transformers.AutoTokenizer.from_pretrained(path, **kwargs)
+        return _EnforcingTokenizer(tok, ensure_bos=ensure_bos, ensure_eos=ensure_eos)
+
+
+class _EnforcingTokenizer:
+    def __init__(self, tok, ensure_bos: bool, ensure_eos: bool):
+        self._tok = tok
+        self.ensure_bos = ensure_bos and tok.bos_token_id is not None
+        self.ensure_eos = ensure_eos and tok.eos_token_id is not None
+
+    def __getattr__(self, name):
+        return getattr(self._tok, name)
+
+    def encode(self, text: str, **kwargs) -> list[int]:
+        ids = list(self._tok.encode(text, **kwargs))
+        if self.ensure_bos and (not ids or ids[0] != self._tok.bos_token_id):
+            ids = [self._tok.bos_token_id] + ids
+        if self.ensure_eos and (not ids or ids[-1] != self._tok.eos_token_id):
+            ids = ids + [self._tok.eos_token_id]
+        return ids
+
+    def __call__(self, *args, **kwargs):
+        return self._tok(*args, **kwargs)
+
+    def __len__(self) -> int:
+        return len(self._tok)

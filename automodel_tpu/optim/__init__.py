@@ -1,0 +1,4 @@
+from automodel_tpu.optim.scheduler import OptimizerParamScheduler, build_lr_schedule
+from automodel_tpu.optim.builder import build_optimizer
+
+__all__ = ["OptimizerParamScheduler", "build_lr_schedule", "build_optimizer"]

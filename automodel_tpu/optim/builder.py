@@ -1,0 +1,77 @@
+"""Optimizer construction (reference recipes/llm/train_ft.py:275 build_optimizer).
+
+Params stay fp32 (the master copy); the model casts to bf16 at use. optax keeps
+moments in fp32 alongside — the same mixed-precision contract as the reference's
+FSDP2 mp_policy (bf16 compute / fp32 params+grads, distributed/config.py:74-81) with
+none of the wrapping ceremony.
+
+Weight decay is masked off 1-D params (norm scales, biases) matching standard HF
+finetune behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+
+__all__ = ["build_optimizer", "no_decay_mask"]
+
+
+def no_decay_mask(params: Any) -> Any:
+    """True where weight decay applies (rank >= 2 tensors only).
+
+    Layer-stacked params have a leading L dim, so the cutoff is rank >= 3 for
+    stacked leaves; top-level embed/lm_head are rank 2; norms/biases stacked are
+    rank 2 or 1 — decide by trailing dims instead: decay iff the *per-layer* rank
+    (total rank minus the stack dim for leaves under "layers") is >= 2.
+    """
+
+    def mask_tree(tree, under_layers=False):
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out[k] = mask_tree(v, under_layers or k == "layers")
+            else:
+                rank = v.ndim - (1 if under_layers else 0)
+                out[k] = rank >= 2
+        return out
+
+    return mask_tree(params)
+
+
+def build_optimizer(
+    lr: float | Callable[[int], float],
+    weight_decay: float = 0.0,
+    betas: tuple[float, float] = (0.9, 0.95),
+    eps: float = 1e-8,
+    max_grad_norm: float | None = None,
+    optimizer: str = "adamw",
+) -> optax.GradientTransformation:
+    """AdamW (or SGD/adafactor) with decay masking and optional global-norm clip.
+
+    Note: when grads are pre-normalized by global num_label_tokens (the recipe's
+    contract), clipping here operates on that normalized gradient, matching the
+    reference's scale-then-clip order (training/utils.py:276).
+    """
+    chain = []
+    if max_grad_norm is not None and max_grad_norm > 0:
+        chain.append(optax.clip_by_global_norm(max_grad_norm))
+    if optimizer == "adamw":
+        chain.append(
+            optax.adamw(
+                learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
+                weight_decay=weight_decay,
+                mask=no_decay_mask if weight_decay else None,
+            )
+        )
+    elif optimizer == "adam":
+        chain.append(optax.adam(learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps))
+    elif optimizer == "sgd":
+        chain.append(optax.sgd(learning_rate=lr, momentum=betas[0]))
+    elif optimizer == "adafactor":
+        chain.append(optax.adafactor(learning_rate=lr))
+    else:
+        raise ValueError(f"unknown optimizer {optimizer!r}")
+    return optax.chain(*chain)

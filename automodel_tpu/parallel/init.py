@@ -100,3 +100,17 @@ def barrier(name: str = "barrier") -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(name)
+
+
+def any_process_flag(flag: bool) -> bool:
+    """True iff ANY host's flag is set — how SIGTERM must be agreed on before acting
+    (reference StepScheduler.sigterm_received all-gather, step_scheduler.py:217): if
+    hosts acted on local flags alone, one host would exit a collective early and hang
+    the rest."""
+    if jax.process_count() == 1:
+        return flag
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(np.asarray([flag], dtype=np.bool_))
+    return bool(np.any(flags))

@@ -1,0 +1,52 @@
+"""Sharding helpers for derived pytrees (optimizer state, EMA copies, ...).
+
+Optimizer moments must shard exactly like their params (the reference gets this for
+free because FSDP2 wraps the optimizer too; under explicit SPMD we say it once here).
+``opt_state_shardings`` walks any optax state pytree and assigns:
+
+- leaves whose tree path ends with a param path (mu['layers']['wq'] ...) -> that
+  param's sharding;
+- everything else (step counts, scalar hyperparams) -> fully replicated on the mesh.
+
+Passing the result as ``jit(init, out_shardings=...)`` means moments are *born*
+sharded — no single-device materialization spike.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["opt_state_shardings", "make_sharded_init"]
+
+
+def _keystr(path) -> str:
+    return jax.tree_util.keystr(path)
+
+
+def opt_state_shardings(opt_state_shapes: Any, params: Any, mesh: Mesh) -> Any:
+    """Pytree of NamedShardings matching ``opt_state_shapes``' structure."""
+    param_paths = [
+        (_keystr(path), leaf.sharding)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+        if hasattr(leaf, "sharding")
+    ]
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def assign(path, leaf):
+        ks = _keystr(path)
+        for pks, sharding in param_paths:
+            if ks.endswith(pks) and getattr(leaf, "shape", None) is not None:
+                return sharding
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(assign, opt_state_shapes)
+
+
+def make_sharded_init(optimizer, params: Any, mesh: Mesh):
+    """jit-compiled optimizer.init whose outputs are born with correct shardings."""
+    shapes = jax.eval_shape(optimizer.init, params)
+    shardings = opt_state_shardings(shapes, params, mesh)
+    return jax.jit(optimizer.init, out_shardings=shardings)

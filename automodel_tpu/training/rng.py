@@ -53,14 +53,16 @@ class StatefulRNG:
         count = self._counters.get(name, 0)
         return jax.random.fold_in(jax.random.fold_in(self._base, _hash_name(name)), count)
 
-    # -- checkpointable state (BaseRecipe tracks attrs exposing these) ------
+    # -- checkpointable state (JSON-safe so client.json can hold it) --------
     def state_dict(self) -> dict[str, Any]:
+        pr = random.getstate()
+        ns = np.random.get_state()
         return {
             "seed": self.seed,
             "ranked": self.ranked,
             "counters": dict(self._counters),
-            "python_random": random.getstate(),
-            "numpy_random": np.random.get_state(),
+            "python_random": [pr[0], list(pr[1]), pr[2]],
+            "numpy_random": [ns[0], np.asarray(ns[1]).tolist(), int(ns[2]), int(ns[3]), float(ns[4])],
         }
 
     def load_state_dict(self, state: dict[str, Any]) -> None:

@@ -58,9 +58,14 @@ class StepScheduler:
 
     @property
     def sigterm_received(self) -> bool:
-        # Local flag only; recipes all-gather it across hosts before acting
-        # (reference step_scheduler.py:217 all-gathers so every rank checkpoints).
-        return self._sigterm.is_set()
+        """Cross-host-agreed SIGTERM: any host's local flag triggers ALL hosts, so
+        everyone exits the step loop together and checkpoints (reference
+        step_scheduler.py:217 all-gathers the flag). The 1-byte allgather runs once
+        per optimizer step — negligible next to the step itself — and every host
+        calls it at the same loop point, so it cannot hang."""
+        from automodel_tpu.parallel.init import any_process_flag
+
+        return any_process_flag(self._sigterm.is_set())
 
     # -- iteration ----------------------------------------------------------
     def __iter__(self) -> Iterator[list[Any]]:

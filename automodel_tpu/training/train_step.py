@@ -1,0 +1,79 @@
+"""The jitted training step (reference _run_train_optim_step, recipes/llm/train_ft.py:1284).
+
+One compiled function does what the reference's python loop + FSDP hooks do:
+
+- gradient accumulation is a ``lax.scan`` over stacked microbatches — no "defer grad
+  sync until last microbatch" ceremony (distributed/utils.py:216): grads live sharded
+  and XLA inserts exactly one reduce-scatter/all-reduce where the sharding demands it;
+- loss normalization by *global* label-token count happens inside, so summed microbatch
+  grads equal the true global-mean gradient (training/utils.py:276 contract);
+- params/optimizer state are donated — updates happen in place in HBM.
+
+The returned step fn is pure: (params, opt_state, batch_stack, step) -> (params,
+opt_state, metrics). Shard once with jit's in_shardings/out_shardings and every
+collective is derived, not written.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from automodel_tpu.ops.losses import IGNORE_INDEX
+
+__all__ = ["make_train_step", "make_eval_step", "count_label_tokens"]
+
+
+def count_label_tokens(labels: jnp.ndarray, ignore_index: int = IGNORE_INDEX) -> jnp.ndarray:
+    return (labels != ignore_index).sum()
+
+
+def make_train_step(
+    forward_loss: Callable[..., jnp.ndarray],
+    optimizer: optax.GradientTransformation,
+):
+    """Build the accumulating train step.
+
+    ``forward_loss(params, batch, num_label_tokens)`` must return the *sum* CE over the
+    microbatch divided by ``num_label_tokens`` (the global count) — i.e. microbatch
+    losses are additive.
+    """
+
+    def train_step(params, opt_state, batch_stack):
+        """batch_stack: pytree whose leaves are stacked (n_micro, ...) arrays."""
+        # global label-token count: computed inside jit on the sharded labels, so the
+        # sum is automatically global across data axes (reference allreduces by hand,
+        # train_ft.py:1284)
+        num_label_tokens = count_label_tokens(batch_stack["labels"])
+
+        def micro_step(carry, microbatch):
+            grads_acc, loss_acc = carry
+            loss, grads = jax.value_and_grad(forward_loss)(params, microbatch, num_label_tokens)
+            grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+            return (grads_acc, loss_acc + loss), None
+
+        zero_grads = jax.tree.map(jnp.zeros_like, params)
+        (grads, loss), _ = jax.lax.scan(
+            micro_step, (zero_grads, jnp.float32(0.0)), batch_stack
+        )
+        grad_norm = optax.global_norm(grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "num_label_tokens": num_label_tokens,
+        }
+        return params, opt_state, metrics
+
+    return train_step
+
+
+def make_eval_step(forward_loss: Callable[..., jnp.ndarray]):
+    def eval_step(params, batch, num_label_tokens):
+        return forward_loss(params, batch, num_label_tokens)
+
+    return eval_step

@@ -1,0 +1,3 @@
+from automodel_tpu.utils.flops import flops_per_token, mfu
+
+__all__ = ["flops_per_token", "mfu"]

@@ -1,0 +1,73 @@
+"""Analytic training-FLOPs formulas + MFU (reference utils/flops_utils.py:18-830).
+
+``flops_per_token`` covers dense decoders and MoE (active-expert counting, MLA
+projections); train FLOPs = 3x forward (fwd + 2x bwd). Peak TFLOPs table carries the
+common TPU generations; MFU = achieved / peak.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["flops_per_token", "mfu", "PEAK_TFLOPS"]
+
+# bf16 dense peak per chip
+PEAK_TFLOPS: dict[str, float] = {
+    "tpu v4": 275.0,
+    "tpu v5e": 197.0,
+    "tpu v5 lite": 197.0,
+    "tpu v5p": 459.0,
+    "tpu v6e": 918.0,
+    "h100": 989.0,
+    "a100": 312.0,
+}
+
+
+def flops_per_token(cfg: Any, seq_len: int, training: bool = True) -> float:
+    """FLOPs per token for a decoder config (ours or an HF-config-like dict)."""
+    get = (lambda k, d=None: cfg.get(k, d)) if isinstance(cfg, dict) else (
+        lambda k, d=None: getattr(cfg, k, d)
+    )
+    d = get("hidden_size")
+    L = get("num_hidden_layers")
+    v = get("vocab_size")
+    n = get("num_attention_heads")
+    k = get("num_key_value_heads", n) or n
+    h = get("head_dim") or d // n
+    inter = get("intermediate_size")
+
+    # attention projections + scores
+    qkv = 2 * d * (n + 2 * k) * h
+    o = 2 * n * h * d
+    scores = 2 * 2 * seq_len * n * h  # QK^T + PV, causal ~ /2 but count full (ref does)
+
+    # MLP: dense or MoE (active experts + shared)
+    n_routed = get("num_experts") or get("n_routed_experts") or 0
+    if n_routed:
+        top_k = get("num_experts_per_tok") or get("top_k") or 1
+        moe_inter = get("moe_intermediate_size") or inter
+        shared = get("n_shared_experts") or 0
+        dense_layers = get("first_k_dense_replace") or 0
+        moe_mlp = 3 * 2 * d * moe_inter * (top_k + shared)
+        dense_mlp = 3 * 2 * d * inter
+        mlp_total = dense_layers * dense_mlp + (L - dense_layers) * moe_mlp
+        attn_total = L * (qkv + o + scores)
+        fwd = attn_total + mlp_total + 2 * d * v
+    else:
+        mlp = 3 * 2 * d * inter
+        fwd = L * (qkv + o + scores + mlp) + 2 * d * v
+    return 3.0 * fwd if training else fwd
+
+
+def mfu(tokens_per_sec: float, flops_per_tok: float, device_kind: str, n_devices: int = 1) -> float:
+    """Model FLOPs utilization in [0,1]; 0.0 if the device kind is unknown."""
+    key = device_kind.lower()
+    peak = None
+    for name, tf in PEAK_TFLOPS.items():
+        if name in key:
+            peak = tf
+            break
+    if peak is None:
+        return 0.0
+    achieved = tokens_per_sec * flops_per_tok / 1e12
+    return achieved / (peak * n_devices)

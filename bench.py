@@ -1,0 +1,119 @@
+"""Single-chip SFT throughput benchmark (driver-run; prints ONE JSON line).
+
+Benchmarks the BASELINE.json config #1 shape — Llama-3.2-1B-class SFT, mock data,
+bf16 — on whatever single accelerator is attached, and reports tokens/sec/chip.
+
+``vs_baseline`` normalizes against the reference's headline single-GPU number
+(Llama3-8B LoRA on H100: 12,473 tok/s/GPU, BASELINE.md) by converting our measured
+tokens/s into "8B-equivalent" tokens/s via the per-token training-FLOPs ratio of the
+two models, i.e. vs_baseline = (tok/s * F_model / F_8B) / 12473. This keeps the
+number honest across model sizes until the full 8B config fits one chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def llama_flops_per_token(cfg, seq_len: int) -> float:
+    """Training FLOPs/token (fwd+bwd = 3x fwd) incl. attention quadratic term."""
+    d, i, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+    n, k, h, v = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim, cfg.vocab_size
+    qkv = 2 * d * (n + 2 * k) * h
+    o = 2 * n * h * d
+    attn_scores = 2 * 2 * seq_len * n * h  # qk^T + av per token
+    mlp = 3 * 2 * d * i
+    per_layer = qkv + o + attn_scores + mlp
+    embed_head = 2 * d * v
+    return 3.0 * (L * per_layer + embed_head)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from automodel_tpu.models.common.backend import BackendConfig
+    from automodel_tpu.models.llama.model import LlamaConfig, LlamaForCausalLM
+    from automodel_tpu.ops.losses import masked_cross_entropy
+    from automodel_tpu.training.train_step import make_train_step
+
+    # Llama-3.2-1B dims
+    cfg = LlamaConfig(
+        vocab_size=128256,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_hidden_layers=16,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+        head_dim=64,
+        rope_theta=500000.0,
+        tie_word_embeddings=True,
+        max_position_embeddings=131072,
+    )
+    seq_len = 2048
+    micro_batch = 4
+    backend = BackendConfig(dtype="bfloat16", remat_policy="dots")
+    model = LlamaForCausalLM(cfg, backend)
+
+    params = model.init(jax.random.key(0), jnp.bfloat16)
+    optimizer = optax.adamw(1e-5, mu_dtype=jnp.bfloat16)
+    opt_state = jax.jit(optimizer.init)(params)
+
+    def forward_loss(p, batch, num_label_tokens):
+        logits = model(p, batch["input_ids"], positions=batch["positions"],
+                       segment_ids=batch["segment_ids"])
+        return masked_cross_entropy(logits, batch["labels"], num_label_tokens)
+
+    step = jax.jit(make_train_step(forward_loss, optimizer), donate_argnums=(0, 1))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (1, micro_batch, seq_len)).astype(np.int32)
+    batch = {
+        "input_ids": jnp.asarray(ids),
+        "labels": jnp.asarray(ids),
+        "positions": jnp.broadcast_to(jnp.arange(seq_len, dtype=jnp.int32), ids.shape),
+        "segment_ids": jnp.ones_like(jnp.asarray(ids)),
+    }
+
+    # warmup/compile
+    params, opt_state, m = step(params, opt_state, batch)
+    jax.block_until_ready(m["loss"])
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, m = step(params, opt_state, batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = n_steps * micro_batch * seq_len
+    tps = tokens / dt
+    f_model = llama_flops_per_token(cfg, seq_len)
+    # reference 8B dims for the FLOPs-equivalent conversion
+    cfg8b = LlamaConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+    )
+    f_8b = llama_flops_per_token(cfg8b, 4096)
+    tps_8b_equiv = tps * f_model / f_8b
+    tflops = tps * f_model / 1e12
+
+    print(json.dumps({
+        "metric": "llama3.2-1b SFT tokens/sec/chip (bf16, seq 2048)",
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tps_8b_equiv / 12473.0, 4),
+        "extra": {
+            "model_tflops_per_sec": round(tflops, 1),
+            "8b_equiv_tokens_per_sec": round(tps_8b_equiv, 1),
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

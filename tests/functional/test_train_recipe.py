@@ -1,0 +1,111 @@
+"""End-to-end recipe runs on the virtual 8-device mesh — the analogue of the
+reference's 2-GPU L2 functional tests (SURVEY.md §4): tiny model, few steps, real
+SPMD semantics, loss must fall, checkpoints must resume exactly."""
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+from automodel_tpu.config.loader import load_config
+from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+
+
+def _write_cfg(tmp_path, extra="", dp_shard=4, tp=2, max_steps=6, grad_acc=2, ckpt=False):
+    cfg = f"""
+    seed: 7
+    output_dir: {tmp_path}/out
+    model:
+      config:
+        architectures: [LlamaForCausalLM]
+        vocab_size: 128
+        hidden_size: 64
+        intermediate_size: 128
+        num_hidden_layers: 2
+        num_attention_heads: 4
+        num_key_value_heads: 2
+        max_position_embeddings: 128
+    distributed:
+      dp_shard: {dp_shard}
+      tp: {tp}
+    backend:
+      dtype: float32
+    dataset:
+      _target_: automodel_tpu.data.llm.mock.MockSFTDataset
+      vocab_size: 128
+      seq_len: 32
+      num_samples: 256
+      seed: 0
+      pattern: arith
+    micro_batch_size: 8
+    seq_len: 32
+    step_scheduler:
+      grad_acc_steps: {grad_acc}
+      max_steps: {max_steps}
+      num_epochs: 10
+      handle_sigterm: false
+      ckpt_every_steps: {3 if ckpt else 0}
+    optimizer:
+      lr: 1.0e-2
+      weight_decay: 0.0
+      max_grad_norm: 1.0
+    lr_scheduler:
+      lr_warmup_steps: 2
+    checkpoint:
+      enabled: {str(ckpt).lower()}
+      checkpoint_dir: {tmp_path}/ckpt
+    {extra}
+    """
+    p = tmp_path / "cfg.yaml"
+    p.write_text(textwrap.dedent(cfg))
+    return p
+
+
+def _read_jsonl(path):
+    return [json.loads(line) for line in open(path)]
+
+
+class TestTrainRecipeE2E:
+    def test_loss_decreases_sharded(self, tmp_path, cpu_devices):
+        cfg = load_config(_write_cfg(tmp_path))
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        recipe.run_train_validation_loop()
+        rows = _read_jsonl(tmp_path / "out" / "training.jsonl")
+        assert len(rows) == 6
+        losses = [r["loss"] for r in rows]
+        # 128-vocab: initial loss ~ln(128)=4.85; learnable data must drop w/ lr=1e-2
+        assert losses[0] > 4.0
+        assert losses[-1] < losses[0] - 0.3
+        assert all(np.isfinite(r["grad_norm"]) for r in rows)
+
+    def test_resume_exact(self, tmp_path, cpu_devices):
+        # run 1: 6 steps with ckpt at 3 and final at 6
+        cfg = load_config(_write_cfg(tmp_path, ckpt=True))
+        r1 = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        r1.run_train_validation_loop()
+        rows1 = _read_jsonl(tmp_path / "out" / "training.jsonl")
+
+        # run 2: resume from step 3 checkpoint by removing later ckpts
+        import shutil
+
+        shutil.rmtree(tmp_path / "ckpt" / "step_6")
+        (tmp_path / "ckpt" / "latest").unlink()
+        (tmp_path / "out" / "training.jsonl").unlink()
+        cfg2 = load_config(_write_cfg(tmp_path, ckpt=True))
+        r2 = TrainFinetuneRecipeForNextTokenPrediction(cfg2).setup()
+        assert r2.step_scheduler.step == 3
+        r2.run_train_validation_loop()
+        rows2 = _read_jsonl(tmp_path / "out" / "training.jsonl")
+        # steps 4..6 must reproduce run 1 exactly (same data order, same params)
+        l1 = {r["step"]: r["loss"] for r in rows1}
+        l2 = {r["step"]: r["loss"] for r in rows2}
+        for s in (4, 5, 6):
+            assert l2[s] == pytest.approx(l1[s], rel=1e-5), f"step {s} diverged"
+
+    def test_linear_ce_loss_matches(self, tmp_path, cpu_devices):
+        cfg = load_config(_write_cfg(tmp_path, extra="loss:\n      name: linear_ce", max_steps=2))
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        recipe.run_train_validation_loop()
+        rows = _read_jsonl(tmp_path / "out" / "training.jsonl")
+        assert rows[0]["loss"] > 4.0  # sane CE for random data

@@ -1,0 +1,101 @@
+import json
+
+import numpy as np
+import pytest
+
+from automodel_tpu.data.collate import IGNORE_INDEX, sft_collate, stack_batches
+from automodel_tpu.data.loader import DataLoader
+from automodel_tpu.data.llm.column_mapped import ColumnMappedTextInstructionDataset
+from automodel_tpu.data.llm.mock import MockSFTDataset
+
+
+class _Tok:
+    eos_token_id = 2
+
+    def encode(self, text):
+        return [ord(c) % 50 + 3 for c in text][:32]
+
+
+class TestCollate:
+    def test_shift_and_mask(self):
+        ex = {"input_ids": [5, 6, 7, 8, 9], "prompt_len": 2}
+        out = sft_collate([ex], seq_len=8)
+        np.testing.assert_array_equal(out["input_ids"][0, :4], [5, 6, 7, 8])
+        # target t predicts token t+1; prompt_len-1 first targets masked
+        assert out["labels"][0, 0] == IGNORE_INDEX
+        np.testing.assert_array_equal(out["labels"][0, 1:4], [7, 8, 9])
+        assert (out["labels"][0, 4:] == IGNORE_INDEX).all()
+        np.testing.assert_array_equal(out["segment_ids"][0, :4], [1, 1, 1, 1])
+        assert (out["segment_ids"][0, 4:] == 0).all()
+
+    def test_truncation(self):
+        ex = {"input_ids": list(range(3, 20)), "prompt_len": 0}
+        out = sft_collate([ex], seq_len=8)
+        assert out["input_ids"].shape == (1, 8)
+        assert (out["labels"][0] != IGNORE_INDEX).sum() == 8
+
+    def test_stack(self):
+        b1 = sft_collate([{"input_ids": [1, 2, 3], "prompt_len": 0}], seq_len=4)
+        b2 = sft_collate([{"input_ids": [4, 5, 6], "prompt_len": 0}], seq_len=4)
+        s = stack_batches([b1, b2])
+        assert s["input_ids"].shape == (2, 1, 4)
+
+
+class TestDataLoader:
+    def test_determinism_and_len(self):
+        ds = list(range(100))
+        dl1 = DataLoader(ds, batch_size=8, seed=1)
+        dl2 = DataLoader(ds, batch_size=8, seed=1)
+        assert len(dl1) == 12
+        assert list(dl1)[0] == list(dl2)[0]
+
+    def test_epochs_reshuffle(self):
+        ds = list(range(32))
+        dl = DataLoader(ds, batch_size=8, seed=1)
+        e0 = [tuple(b) for b in dl]
+        e1 = [tuple(b) for b in dl]
+        assert e0 != e1
+
+    def test_resume_mid_epoch(self):
+        ds = list(range(64))
+        dl = DataLoader(ds, batch_size=8, seed=3)
+        it = iter(dl)
+        first_two = [next(it), next(it)]
+        state = dl.state_dict()
+        rest = list(it)
+
+        dl2 = DataLoader(ds, batch_size=8, seed=3)
+        dl2.load_state_dict(state)
+        rest2 = list(dl2)
+        assert [tuple(b) for b in rest] == [tuple(b) for b in rest2]
+
+    def test_process_sharding(self):
+        ds = list(range(16))
+        a = DataLoader(ds, batch_size=8, seed=0, process_index=0, process_count=2)
+        b = DataLoader(ds, batch_size=8, seed=0, process_index=1, process_count=2)
+        ba, bb = next(iter(a)), next(iter(b))
+        assert len(ba) == 4 and len(bb) == 4
+        assert set(ba).isdisjoint(bb)
+
+
+class TestDatasets:
+    def test_column_mapped_jsonl(self, tmp_path):
+        p = tmp_path / "d.jsonl"
+        rows = [{"q": "what is 2+2?", "a": "4"}, {"q": "capital of france?", "a": "paris"}]
+        p.write_text("\n".join(json.dumps(r) for r in rows))
+        ds = ColumnMappedTextInstructionDataset(
+            str(p), {"question": "q", "answer": "a"}, tokenizer=_Tok()
+        )
+        assert len(ds) == 2
+        ex = ds[0]
+        assert ex["prompt_len"] > 0
+        assert ex["input_ids"][-1] == _Tok.eos_token_id
+
+    def test_column_mapped_requires_answer(self, tmp_path):
+        with pytest.raises(ValueError):
+            ColumnMappedTextInstructionDataset("x.jsonl", {"question": "q"})
+
+    def test_mock_dataset_deterministic(self):
+        ds = MockSFTDataset(vocab_size=100, seq_len=16, num_samples=4)
+        assert ds[2]["input_ids"] == ds[2]["input_ids"]
+        assert len(ds[0]["input_ids"]) == 17

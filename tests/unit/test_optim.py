@@ -1,0 +1,69 @@
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.optim import build_lr_schedule, build_optimizer, OptimizerParamScheduler
+from automodel_tpu.optim.builder import no_decay_mask
+
+
+class TestLrSchedule:
+    def test_warmup_then_cosine(self):
+        s = build_lr_schedule(max_lr=1.0, min_lr=0.1, lr_warmup_steps=10, lr_decay_steps=110)
+        assert float(s(0)) == pytest.approx(0.0)
+        assert float(s(5)) == pytest.approx(0.5)
+        assert float(s(10)) == pytest.approx(1.0)
+        mid = float(s(60))
+        assert 0.1 < mid < 1.0
+        assert float(s(110)) == pytest.approx(0.1, abs=1e-6)
+        assert float(s(1000)) == pytest.approx(0.1, abs=1e-6)
+
+    def test_linear_decay(self):
+        s = build_lr_schedule(max_lr=1.0, min_lr=0.0, lr_warmup_steps=0, lr_decay_steps=100, lr_decay_style="linear")
+        assert float(s(50)) == pytest.approx(0.5, abs=1e-5)
+
+    def test_constant(self):
+        s = build_lr_schedule(max_lr=0.3, lr_decay_style="constant")
+        assert float(s(7)) == pytest.approx(0.3)
+
+    def test_traced(self):
+        s = build_lr_schedule(max_lr=1.0, lr_warmup_steps=4, lr_decay_steps=10)
+        out = jax.jit(s)(jnp.int32(2))
+        assert float(out) == pytest.approx(0.5)
+
+    def test_bad_style_raises(self):
+        with pytest.raises(ValueError):
+            build_lr_schedule(max_lr=1.0, lr_decay_style="exp")
+
+
+class TestParamScheduler:
+    def test_wd_ramp(self):
+        ps = OptimizerParamScheduler(max_lr=1.0, start_wd=0.0, end_wd=0.1, wd_incr_steps=10, wd_incr_style="linear")
+        ps.step_to(5)
+        assert ps.wd == pytest.approx(0.05)
+        assert ps.state_dict() == {"step": 5}
+
+
+class TestOptimizer:
+    def test_no_decay_mask(self):
+        params = {
+            "embed": jnp.zeros((8, 4)),
+            "layers": {"wq": jnp.zeros((2, 4, 2, 2)), "attn_norm": jnp.zeros((2, 4)), "bq": jnp.zeros((2, 2, 2))},
+            "final_norm": jnp.zeros((4,)),
+        }
+        m = no_decay_mask(params)
+        assert m["embed"] is True
+        assert m["layers"]["wq"] is True
+        assert m["layers"]["attn_norm"] is False  # per-layer rank 1
+        assert m["layers"]["bq"] is True or m["layers"]["bq"] is False  # bias: rank 2 per layer
+        assert m["final_norm"] is False
+
+    def test_adamw_steps(self):
+        opt = build_optimizer(lr=0.1, weight_decay=0.01, max_grad_norm=1.0)
+        params = {"w": jnp.ones((4, 4))}
+        state = opt.init(params)
+        grads = {"w": jnp.full((4, 4), 100.0)}  # should be clipped
+        updates, state = opt.update(grads, state, params)
+        new = jax.tree.map(lambda p, u: p + u, params, updates)
+        assert float(jnp.abs(new["w"] - 1.0).max()) <= 0.2  # bounded step
