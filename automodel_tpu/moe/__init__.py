@@ -1,0 +1,35 @@
+"""Mixture-of-Experts stack: routing, grouped experts, EP dispatch, metrics.
+
+TPU-native counterpart of the reference MoE layer (components/moe/): the Gate /
+GroupedExperts / token-dispatcher class hierarchy becomes pure functions over param
+pytrees; DeepEP's fused all-to-all (moe/megatron/fused_a2a.py:250,282) becomes
+``lax.all_to_all`` on the ``ep`` mesh axis inside ``shard_map``; grouped GEMM
+(moe/experts.py:364) becomes ``jax.lax.ragged_dot``.
+"""
+
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.gate import (
+    fake_balanced_route,
+    init_gate_params,
+    route,
+    update_gate_bias,
+)
+from automodel_tpu.moe.experts import grouped_experts_apply, init_expert_params
+from automodel_tpu.moe.layers import (
+    init_moe_params,
+    moe_forward,
+    moe_logical_axes,
+)
+
+__all__ = [
+    "MoEConfig",
+    "route",
+    "fake_balanced_route",
+    "update_gate_bias",
+    "init_gate_params",
+    "init_expert_params",
+    "grouped_experts_apply",
+    "init_moe_params",
+    "moe_forward",
+    "moe_logical_axes",
+]

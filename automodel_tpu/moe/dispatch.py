@@ -1,0 +1,149 @@
+"""Explicit expert-parallel token dispatch over the ``ep`` mesh axis.
+
+TPU-native replacement for DeepEP fused dispatch/combine
+(reference moe/megatron/fused_a2a.py:250,282 + MoEFlexTokenDispatcher,
+token_dispatcher.py:339): NVSHMEM buffers + fused CUDA all-to-alls become two
+``lax.all_to_all`` collectives over ICI inside a partial-manual ``shard_map`` —
+manual over ``ep`` only, so FSDP/TP sharding on other axes stays GSPMD-managed.
+
+Protocol per ep-shard (capacity-bucketed, static shapes):
+  route -> bucket token copies by destination rank (expert // E_local) with a fixed
+  per-destination capacity -> all_to_all (dispatch) -> local grouped GEMM via
+  ``ragged_dot`` -> all_to_all (combine) -> weighted scatter-add at origin.
+Copies beyond capacity are dropped (standard capacity-factor trade-off; DeepEP is
+dropless, the dropless path here is ``grouped_experts_apply`` under plain GSPMD).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.experts import sorted_ragged_ffn
+from automodel_tpu.moe.gate import fake_balanced_route, route
+from automodel_tpu.moe.layers import _shared_experts_forward
+
+__all__ = ["make_ep_moe_forward"]
+
+
+def _local_grouped_gemm(cfg: MoEConfig, expert_params: dict, x, expert_ids, n_local_experts):
+    """Sorted ragged_dot over the local expert shard; x (N, D), expert_ids (N,)."""
+    sort_idx = jnp.argsort(expert_ids)
+    group_sizes = jnp.bincount(expert_ids, length=n_local_experts).astype(jnp.int32)
+    out = sorted_ragged_ffn(cfg, expert_params, x[sort_idx], expert_ids[sort_idx], group_sizes)
+    # unsort back to slot order
+    return jnp.zeros_like(out).at[sort_idx].set(out)
+
+
+def make_ep_moe_forward(
+    cfg: MoEConfig,
+    mesh: Mesh,
+    *,
+    capacity_factor: float = 1.5,
+    capacity: int | None = None,
+    training: bool = True,
+    fake_balanced_gate: bool = False,
+    fake_gate_noise: float = 0.0,
+    ep_axis: str = "ep",
+):
+    """Build ``fn(params, x, token_mask) -> (y, aux_loss, expert_load)`` with explicit
+    EP a2a dispatch. ``x`` is (B, S, D) with batch sharded over data axes (incl. ep);
+    expert params are sharded over ``ep`` on their leading dim.
+    """
+    ep = mesh.shape[ep_axis]
+    if cfg.n_routed_experts % ep != 0:
+        raise ValueError(f"n_routed_experts {cfg.n_routed_experts} not divisible by ep {ep}")
+    n_local = cfg.n_routed_experts // ep
+
+    def shard_fn(params, x, token_mask):
+        B, S, D = x.shape  # B already divided by ep (manual), auto-sharded over dp
+        x2 = x.reshape(-1, D)
+        mask = token_mask.reshape(-1)
+        T = x2.shape[0]
+        K = cfg.n_activated_experts
+
+        if fake_balanced_gate:
+            weights, indices, aux_loss, expert_load = fake_balanced_route(
+                cfg, x2, noise=fake_gate_noise
+            )
+        else:
+            weights, indices, aux_loss, expert_load = route(
+                cfg, params["gate"], x2, mask, training=training
+            )
+
+        cap = capacity if capacity is not None else max(1, int(capacity_factor * T * K / ep))
+
+        dest = (indices // n_local).reshape(-1)  # (T*K,) destination ep rank
+        local_eid = (indices % n_local).reshape(-1)
+        tok = jnp.arange(T * K) // K
+        # Masked (padding) copies go to rank `ep` (out of bounds): they neither
+        # consume capacity (all-zero one_hot row) nor get scattered (drop mode).
+        valid_copy = mask[tok]
+        dest = jnp.where(valid_copy, dest, ep)
+
+        # Queue position of each copy within its destination bucket.
+        oh = jax.nn.one_hot(dest, ep, dtype=jnp.int32)
+        pos = ((jnp.cumsum(oh, axis=0) - oh) * oh).sum(-1)
+        keep = (pos < cap) & valid_copy
+        slot = jnp.where(keep, pos, cap)  # cap is out-of-bounds -> scatter drops it
+
+        send_x = jnp.zeros((ep, cap, D), x.dtype).at[dest, slot].set(x2[tok], mode="drop")
+        send_eid = jnp.zeros((ep, cap), jnp.int32).at[dest, slot].set(local_eid, mode="drop")
+
+        recv_x = jax.lax.all_to_all(send_x, ep_axis, split_axis=0, concat_axis=0)
+        recv_eid = jax.lax.all_to_all(send_eid, ep_axis, split_axis=0, concat_axis=0)
+
+        out = _local_grouped_gemm(
+            cfg, params["experts"], recv_x.reshape(ep * cap, D), recv_eid.reshape(-1), n_local
+        ).reshape(ep, cap, D)
+
+        back = jax.lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0)
+
+        # Combine at origin: gather each copy's result, weight it, drop overflow.
+        gathered = back[dest, jnp.minimum(slot, cap - 1)]  # (T*K, D)
+        w = (weights.reshape(-1) * keep).astype(jnp.float32)
+        y = jnp.zeros((T, D), jnp.float32).at[tok].add(gathered.astype(jnp.float32) * w[:, None])
+        y = y.astype(x.dtype)
+
+        if cfg.n_shared_experts > 0:
+            y = y + _shared_experts_forward(cfg, params, x2)
+
+        if aux_loss is not None:
+            aux_loss = jax.lax.pmean(aux_loss, ep_axis)
+        expert_load = jax.lax.psum(expert_load, ep_axis)
+        return y.reshape(B, S, D), aux_loss, expert_load
+
+    # Manual specs cover only the ep axis; everything else stays auto/GSPMD.
+    def param_specs(params):
+        return {
+            "gate": jax.tree.map(lambda _: P(), params["gate"]),
+            "experts": jax.tree.map(lambda _: P(ep_axis), params["experts"]),
+            **(
+                {"shared_experts": jax.tree.map(lambda _: P(), params["shared_experts"])}
+                if "shared_experts" in params
+                else {}
+            ),
+            **(
+                {"shared_expert_gate": P()}
+                if "shared_expert_gate" in params
+                else {}
+            ),
+        }
+
+    def fn(params, x, token_mask=None):
+        if token_mask is None:
+            token_mask = jnp.ones(x.shape[:2], bool)
+        aux_spec = P() if (cfg.aux_loss_coeff > 0 and training and not fake_balanced_gate) else None
+        out_specs = (P(ep_axis), aux_spec, P())
+        mapped = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(param_specs(params), P(ep_axis), P(ep_axis)),
+            out_specs=out_specs,
+            axis_names={ep_axis},
+        )
+        return mapped(params, x, token_mask)
+
+    return fn

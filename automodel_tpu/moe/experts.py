@@ -1,0 +1,179 @@
+"""Grouped expert FFNs (reference GroupedExperts*, components/moe/experts.py:158,478,661).
+
+Two TPU-native compute paths replace the reference's four CUDA backends
+(loop / torch._grouped_mm / DeepEP+gmm / TransformerEngine):
+
+- ``ragged_dot`` (default, dropless): sort token copies by expert id, one
+  ``jax.lax.ragged_dot`` per projection (the MXU-native grouped GEMM — the analogue of
+  megablocks/gmm), scatter-add back. No capacity, no dropped tokens, static shapes.
+- ``capacity`` (GShard-style): one-hot dispatch/combine einsums with a fixed per-expert
+  capacity. Fully dense — XLA lays the all-to-all automatically when experts are sharded
+  on ``ep`` — at the cost of dropped tokens past capacity.
+
+Weight layout: ``gate_up_proj`` (E, D, 2I) with [gate | up] concatenated on the last dim
+(non-gated activations: (E, D, I)), ``down_proj`` (E, I, D). HF interleaved layouts
+(gpt-oss) are de-interleaved by the family state-dict adapter.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.moe.config import MoEConfig
+
+__all__ = [
+    "init_expert_params",
+    "expert_logical_axes",
+    "expert_activation",
+    "grouped_experts_apply",
+    "capacity_experts_apply",
+]
+
+
+def init_expert_params(cfg: MoEConfig, key: jax.Array, dtype=jnp.float32, init_std: float = 0.02) -> dict:
+    E, D, I = cfg.n_routed_experts, cfg.dim, cfg.moe_inter_dim
+    up_cols = 2 * I if cfg.gated else I
+    k1, k2 = jax.random.split(key)
+    params = {
+        "gate_up_proj": (jax.random.normal(k1, (E, D, up_cols), jnp.float32) * init_std).astype(dtype),
+        "down_proj": (jax.random.normal(k2, (E, I, D), jnp.float32) * init_std).astype(dtype),
+    }
+    if cfg.expert_bias:
+        params["gate_up_bias"] = jnp.zeros((E, up_cols), dtype)
+        params["down_bias"] = jnp.zeros((E, D), dtype)
+    return params
+
+
+def expert_logical_axes(cfg: MoEConfig) -> dict:
+    axes = {
+        "gate_up_proj": ("expert", "expert_embed", "expert_mlp"),
+        "down_proj": ("expert", "expert_mlp", "expert_embed"),
+    }
+    if cfg.expert_bias:
+        axes["gate_up_bias"] = ("expert", "expert_mlp")
+        axes["down_bias"] = ("expert", "expert_embed")
+    return axes
+
+
+def expert_activation(cfg: MoEConfig, h: jnp.ndarray) -> jnp.ndarray:
+    """Activation between the two expert GEMMs; h is (..., 2I) gated or (..., I) not.
+
+    quick_geglu matches gpt-oss (reference quick_geglu_deepep, moe/experts.py:434):
+    clamp, x*sigmoid(alpha*x) gate, and a +1 linear offset on the up branch.
+    """
+    if cfg.expert_activation == "swiglu":
+        gate, up = jnp.split(h, 2, axis=-1)
+        return jax.nn.silu(gate) * up
+    if cfg.expert_activation == "quick_geglu":
+        gate, up = jnp.split(h, 2, axis=-1)
+        gate = jnp.minimum(gate, cfg.activation_limit)
+        up = jnp.clip(up, -cfg.activation_limit, cfg.activation_limit)
+        glu = gate * jax.nn.sigmoid(cfg.activation_alpha * gate)
+        return glu * (up + 1.0)
+    # relu2
+    return jnp.square(jax.nn.relu(h))
+
+
+def sorted_ragged_ffn(
+    cfg: MoEConfig,
+    params: dict,
+    xs: jnp.ndarray,  # (N, D) tokens sorted so each expert's rows are contiguous
+    sorted_expert_ids: jnp.ndarray,  # (N,) expert id of each row (ascending)
+    group_sizes: jnp.ndarray,  # (n_experts_in_params,) per-expert row counts
+) -> jnp.ndarray:
+    """The grouped-GEMM FFN core shared by the GSPMD and explicit-EP paths:
+    ragged_dot gate_up -> bias -> activation -> ragged_dot down -> bias."""
+    h = jax.lax.ragged_dot(xs, params["gate_up_proj"], group_sizes)
+    if "gate_up_bias" in params:
+        h = h + params["gate_up_bias"][sorted_expert_ids]
+    act = expert_activation(cfg, h).astype(xs.dtype)
+    out = jax.lax.ragged_dot(act, params["down_proj"], group_sizes)
+    if "down_bias" in params:
+        out = out + params["down_bias"][sorted_expert_ids]
+    return out
+
+
+def grouped_experts_apply(
+    cfg: MoEConfig,
+    params: dict,
+    x: jnp.ndarray,  # (T, D)
+    weights: jnp.ndarray,  # (T, K)
+    indices: jnp.ndarray,  # (T, K) int32
+    token_mask: jnp.ndarray | None = None,  # (T,) bool; masked tokens contribute zero
+) -> jnp.ndarray:
+    """Dropless grouped-GEMM expert compute; returns (T, D).
+
+    Token copies are sorted by expert id so each expert's tokens are contiguous, which
+    is exactly the operand layout ``lax.ragged_dot`` wants (group_sizes = per-expert
+    counts). The final combine scatter-adds in fp32.
+    """
+    T, D = x.shape
+    K = indices.shape[1]
+    E = cfg.n_routed_experts
+    if token_mask is not None:
+        weights = weights * token_mask[:, None].astype(weights.dtype)
+
+    flat_expert = indices.reshape(-1)  # (T*K,)
+    sort_idx = jnp.argsort(flat_expert)  # stable: preserves token order within expert
+    token_ids = sort_idx // K  # source token of each sorted copy
+    group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
+
+    xs = x[token_ids]  # (T*K, D) gathered copies, expert-contiguous
+    out = sorted_ragged_ffn(cfg, params, xs, flat_expert[sort_idx], group_sizes)
+
+    w_sorted = weights.reshape(-1)[sort_idx].astype(jnp.float32)
+    y = jnp.zeros((T, D), jnp.float32)
+    y = y.at[token_ids].add(out.astype(jnp.float32) * w_sorted[:, None])
+    return y.astype(x.dtype)
+
+
+def capacity_experts_apply(
+    cfg: MoEConfig,
+    params: dict,
+    x: jnp.ndarray,  # (T, D)
+    weights: jnp.ndarray,  # (T, K)
+    indices: jnp.ndarray,  # (T, K)
+    token_mask: jnp.ndarray | None = None,  # (T,) bool; masked tokens take no slots
+    *,
+    capacity_factor: float = 1.25,
+    capacity: int | None = None,
+) -> jnp.ndarray:
+    """GShard-style one-hot dispatch/combine with per-expert capacity; returns (T, D).
+
+    Tokens past an expert's capacity are dropped (contribute zero), the standard
+    capacity-factor trade-off. Position within each expert's queue comes from a cumsum
+    over the token dim, so earlier tokens win slots deterministically. Masked (padding)
+    tokens neither consume capacity nor contribute output.
+    """
+    T, D = x.shape
+    E, K = cfg.n_routed_experts, cfg.n_activated_experts
+    if capacity is None:
+        capacity = max(1, int(capacity_factor * T * K / E))
+
+    onehot = jax.nn.one_hot(indices, E, dtype=jnp.int32)  # (T, K, E)
+    if token_mask is not None:
+        onehot = onehot * token_mask[:, None, None].astype(jnp.int32)
+    # Queue position of each (token, k) copy within its expert, counting across both
+    # the token dim and the k dim (k-major within a token).
+    flat = onehot.reshape(T * K, E)
+    pos = jnp.cumsum(flat, axis=0) - flat  # (T*K, E) position if routed there
+    pos = (pos * flat).sum(-1).reshape(T, K)  # (T, K) queue position of each copy
+    keep = pos < capacity
+
+    # (T, K, C) slot one-hot for kept copies (dropped copies -> all-zero row)
+    slot = jax.nn.one_hot(jnp.where(keep, pos, -1), capacity, dtype=x.dtype)
+    expert_oh = onehot.astype(x.dtype)  # (T, K, E); masked tokens already zeroed
+    disp = jnp.einsum("tke,tkc->tec", expert_oh, slot)
+    xd = jnp.einsum("tec,td->ecd", disp, x)  # (E, C, D)
+
+    h = jnp.einsum("ecd,edf->ecf", xd, params["gate_up_proj"].astype(x.dtype))
+    if "gate_up_bias" in params:
+        h = h + params["gate_up_bias"][:, None, :]
+    act = expert_activation(cfg, h).astype(x.dtype)
+    out = jnp.einsum("ecf,efd->ecd", act, params["down_proj"].astype(x.dtype))
+    if "down_bias" in params:
+        out = out + params["down_bias"][:, None, :]
+
+    combine = jnp.einsum("tke,tkc,tk->tec", expert_oh, slot, weights.astype(x.dtype))
+    return jnp.einsum("tec,ecd->td", combine, out)

@@ -1,0 +1,172 @@
+"""MoE routing as pure functions (reference Gate, components/moe/layers.py:201).
+
+The reference Gate is a stateful nn.Module accumulating expert load across grad-accum
+microbatches and updating its correction bias in-place. Here routing is a pure function
+returning ``(weights, indices, aux_loss, expert_load)``; the caller accumulates
+``expert_load`` in the train-step carry and applies :func:`update_gate_bias` as a pure
+param update at optimizer-step time. Under pjit the ``jnp.sum`` over tokens is already a
+global (cross-data-shard) sum, so the reference's DTensor Partial/Replicate dance
+(layers.py:400-436) disappears.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.moe.config import MoEConfig
+
+__all__ = [
+    "init_gate_params",
+    "gate_logical_axes",
+    "route",
+    "fake_balanced_route",
+    "update_gate_bias",
+]
+
+
+def init_gate_params(cfg: MoEConfig, key: jax.Array, dtype=jnp.float32, init_std: float = 0.02) -> dict:
+    """weight (E, D); optional bias (E,); correction bias kept fp32 (layers.py:262-266:
+    small bf16 quantization errors flip routing decisions, so it never downcasts)."""
+    params = {
+        "weight": (jax.random.normal(key, (cfg.n_routed_experts, cfg.dim), jnp.float32) * init_std).astype(dtype)
+    }
+    if cfg.router_bias:
+        params["bias"] = jnp.zeros((cfg.n_routed_experts,), dtype)
+    if cfg.has_correction_bias:
+        params["score_correction_bias"] = jnp.zeros((cfg.n_routed_experts,), jnp.float32)
+    return params
+
+
+def gate_logical_axes(cfg: MoEConfig) -> dict:
+    axes = {"weight": (None, "embed")}
+    if cfg.router_bias:
+        axes["bias"] = (None,)
+    if cfg.has_correction_bias:
+        axes["score_correction_bias"] = (None,)
+    return axes
+
+
+def route(
+    cfg: MoEConfig,
+    gate_params: dict,
+    x: jnp.ndarray,  # (T, D)
+    token_mask: jnp.ndarray | None = None,  # (T,) bool
+    *,
+    training: bool = True,
+):
+    """Select top-k experts per token.
+
+    Returns ``(weights (T, K), indices (T, K) int32, aux_loss scalar|None,
+    expert_load (E,) fp32)``. ``expert_load`` counts valid tokens routed to each expert
+    (reference _compute_expert_load, layers.py:444); aux_loss is the sequence-wise
+    f_i·P_i balance loss (layers.py:467) when ``aux_loss_coeff > 0``.
+    """
+    T = x.shape[0]
+    E, K = cfg.n_routed_experts, cfg.n_activated_experts
+    if token_mask is None:
+        token_mask = jnp.ones((T,), bool)
+
+    # Gate math in fp32 regardless of activation dtype (reference gate_precision).
+    # train_gate=False freezes the router (reference sets requires_grad, layers.py:244).
+    gp = gate_params if cfg.train_gate else jax.lax.stop_gradient(gate_params)
+    scores = x.astype(jnp.float32) @ gp["weight"].astype(jnp.float32).T
+    if "bias" in gp:
+        scores = scores + gp["bias"].astype(jnp.float32)
+
+    if cfg.score_func == "softmax":
+        if cfg.softmax_before_topk:
+            probs = jax.nn.softmax(scores, axis=-1)
+            original_scores = probs
+            weights, indices = jax.lax.top_k(probs, K)
+        else:
+            original_scores = scores
+            values, indices = jax.lax.top_k(scores, K)
+            weights = jax.nn.softmax(values, axis=-1)
+    else:  # sigmoid (DeepSeek-V3 noaux-tc)
+        original_scores = jax.nn.sigmoid(scores)
+        cand = original_scores
+        if "score_correction_bias" in gp:
+            cand = cand + gp["score_correction_bias"]
+        if cfg.n_expert_groups > 1:
+            grouped = cand.reshape(T, cfg.n_expert_groups, -1)
+            if "score_correction_bias" in gp:
+                group_scores = jax.lax.top_k(grouped, 2)[0].sum(-1)
+            else:
+                group_scores = grouped.max(-1)
+            top_groups = jax.lax.top_k(group_scores, cfg.n_limited_groups)[1]
+            group_mask = jnp.zeros((T, cfg.n_expert_groups), bool)
+            group_mask = group_mask.at[jnp.arange(T)[:, None], top_groups].set(True)
+            cand = jnp.where(group_mask[:, :, None], grouped, 0.0).reshape(T, E)
+        indices = jax.lax.top_k(cand, K)[1]
+        weights = jnp.take_along_axis(original_scores, indices, axis=-1)
+
+    if cfg.norm_topk_prob and K > 1:
+        weights = weights / (weights.sum(-1, keepdims=True) + 1e-20)
+        original_scores = original_scores / (original_scores.sum(-1, keepdims=True) + 1e-20)
+    weights = weights * cfg.route_scale
+
+    valid = token_mask.astype(jnp.float32)
+    # (T, K) one-hot sum -> (E,) load of valid tokens per expert.
+    expert_load = jnp.zeros((E,), jnp.float32).at[indices].add(valid[:, None])
+
+    aux_loss = None
+    if cfg.aux_loss_coeff > 0 and training:
+        context_length = valid.sum()
+        expert_scores = (original_scores * valid[:, None]).sum(0)  # (E,)
+        f_i = expert_load * E / (K * context_length)
+        p_i = expert_scores / context_length
+        aux_loss = jnp.sum(f_i * p_i)
+
+    return weights.astype(x.dtype), indices.astype(jnp.int32), aux_loss, expert_load
+
+
+def fake_balanced_route(
+    cfg: MoEConfig,
+    x: jnp.ndarray,  # (T, D)
+    *,
+    noise: float = 0.0,
+    skip_first_n_experts: int = 0,
+):
+    """Uniform round-robin routing for benchmarking (reference FakeBalancedGate,
+    layers.py:116): isolates compute perf from data-dependent routing imbalance.
+
+    ``noise > 0`` adds content-seeded randomness (same x -> same routing, so remat
+    recompute stays consistent — the reference derives the seed the same way,
+    layers.py:166).
+    """
+    T = x.shape[0]
+    E, K = cfg.n_routed_experts, cfg.n_activated_experts
+    avail = E - skip_first_n_experts
+    if noise > 0:
+        seed = jnp.abs(jnp.sum(x.reshape(-1)[:4].astype(jnp.float32)) * 1e6).astype(jnp.int32)
+        key = jax.random.key(seed)
+        k1, k2, k3 = jax.random.split(key, 3)
+        uniform = jnp.full((T, K), 1.0 / K)
+        raw = jax.random.uniform(k1, (T, K))
+        raw = raw / raw.sum(-1, keepdims=True)
+        weights = (1 - noise) * uniform + noise * raw
+        expert_bias = jax.random.normal(k2, (avail,)) * noise * 0.1
+        scores = jax.random.uniform(k3, (T, avail)) + expert_bias
+        indices = jax.lax.top_k(scores, K)[1] + skip_first_n_experts
+    else:
+        weights = jnp.full((T, K), 1.0 / K)
+        indices = jnp.arange(T * K, dtype=jnp.int32).reshape(T, K) % avail + skip_first_n_experts
+    expert_load = jnp.zeros((E,), jnp.float32).at[indices].add(1.0)
+    return weights.astype(x.dtype), indices.astype(jnp.int32), None, expert_load
+
+
+def update_gate_bias(
+    score_correction_bias: jnp.ndarray,  # (E,) fp32
+    cumulative_expert_load: jnp.ndarray,  # (E,) fp32, already global (pjit-summed)
+    update_factor: float,
+) -> jnp.ndarray:
+    """DeepSeek-V3 loss-free balancing (reference Gate.update_bias, layers.py:379):
+    push bias up for under-loaded experts, down for over-loaded, by sign(mean - load).
+
+    Pure: returns the new bias; call once per optimizer step with the load accumulated
+    over all microbatches, then reset the accumulator.
+    """
+    load = cumulative_expert_load.astype(jnp.float32)
+    bias_update = jnp.sign(load.mean() - load)
+    return score_correction_bias + bias_update * update_factor

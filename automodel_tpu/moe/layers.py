@@ -1,0 +1,120 @@
+"""MoE block: gate + grouped experts + shared experts (reference MoE,
+components/moe/layers.py:515).
+
+The reference overlaps shared experts with the EP all-to-all on a separate CUDA stream
+(layers.py:615-630); under XLA the scheduler overlaps independent ops inside one jit
+program, so the block is just straight-line code.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.experts import (
+    capacity_experts_apply,
+    expert_logical_axes,
+    grouped_experts_apply,
+    init_expert_params,
+)
+from automodel_tpu.moe.gate import (
+    fake_balanced_route,
+    gate_logical_axes,
+    init_gate_params,
+    route,
+)
+
+__all__ = ["init_moe_params", "moe_logical_axes", "moe_forward"]
+
+
+def init_moe_params(cfg: MoEConfig, key: jax.Array, dtype=jnp.float32, init_std: float = 0.02) -> dict:
+    kg, ke, ks, ksg = jax.random.split(key, 4)
+    params = {
+        "gate": init_gate_params(cfg, kg, dtype, init_std),
+        "experts": init_expert_params(cfg, ke, dtype, init_std),
+    }
+    if cfg.n_shared_experts > 0:
+        D, I = cfg.dim, cfg.shared_inter_dim
+        keys = jax.random.split(ks, 3)
+        shared = {
+            "w_up": (jax.random.normal(keys[0], (D, I), jnp.float32) * init_std).astype(dtype),
+            "w_down": (jax.random.normal(keys[1], (I, D), jnp.float32) * init_std).astype(dtype),
+        }
+        if cfg.shared_expert_activation == "swiglu":
+            shared["w_gate"] = (jax.random.normal(keys[2], (D, I), jnp.float32) * init_std).astype(dtype)
+        params["shared_experts"] = shared
+        if cfg.shared_expert_gate:
+            params["shared_expert_gate"] = (
+                jax.random.normal(ksg, (D, 1), jnp.float32) * init_std
+            ).astype(dtype)
+    return params
+
+
+def moe_logical_axes(cfg: MoEConfig) -> dict:
+    axes = {"gate": gate_logical_axes(cfg), "experts": expert_logical_axes(cfg)}
+    if cfg.n_shared_experts > 0:
+        shared = {"w_up": ("embed", "mlp"), "w_down": ("mlp", "embed")}
+        if cfg.shared_expert_activation == "swiglu":
+            shared["w_gate"] = ("embed", "mlp")
+        axes["shared_experts"] = shared
+        if cfg.shared_expert_gate:
+            axes["shared_expert_gate"] = ("embed", None)
+    return axes
+
+
+def _shared_experts_forward(cfg: MoEConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    sp = params["shared_experts"]
+    up = x @ sp["w_up"]
+    if cfg.shared_expert_activation == "swiglu":
+        act = jax.nn.silu(x @ sp["w_gate"]) * up
+    else:  # relu2
+        act = jnp.square(jax.nn.relu(up))
+    z = act @ sp["w_down"]
+    if "shared_expert_gate" in params:
+        z = jax.nn.sigmoid(x @ params["shared_expert_gate"]) * z
+    return z
+
+
+def moe_forward(
+    cfg: MoEConfig,
+    params: dict,
+    x: jnp.ndarray,  # (B, S, D) or (T, D)
+    token_mask: jnp.ndarray | None = None,  # (B, S) or (T,) bool; True = valid
+    *,
+    training: bool = True,
+    dispatcher: str = "ragged",  # "ragged" (dropless) | "capacity" (GShard one-hot)
+    capacity_factor: float = 1.25,
+    fake_balanced_gate: bool = False,
+    fake_gate_noise: float = 0.0,
+):
+    """Returns ``(y, aux_loss|None, expert_load (E,))``; y has x's shape.
+
+    aux_loss is *unscaled* — the recipe adds ``cfg.aux_loss_coeff * aux_loss``
+    (x num-tokens correction) to the train loss, replacing the reference's autograd-hook
+    scaler (megatron/moe_utils.py MoEAuxLossAutoScaler).
+    """
+    shape = x.shape
+    x2 = x.reshape(-1, cfg.dim)
+    mask = None if token_mask is None else token_mask.reshape(-1)
+
+    if fake_balanced_gate:
+        weights, indices, aux_loss, expert_load = fake_balanced_route(
+            cfg, x2, noise=fake_gate_noise
+        )
+    else:
+        weights, indices, aux_loss, expert_load = route(
+            cfg, params["gate"], x2, mask, training=training
+        )
+
+    if dispatcher == "capacity":
+        y = capacity_experts_apply(
+            cfg, params["experts"], x2, weights, indices, mask, capacity_factor=capacity_factor
+        )
+    else:
+        y = grouped_experts_apply(cfg, params["experts"], x2, weights, indices, mask)
+
+    if cfg.n_shared_experts > 0:
+        y = y + _shared_experts_forward(cfg, params, x2)
+
+    return y.reshape(shape), aux_loss, expert_load

@@ -1,0 +1,331 @@
+"""MoE stack: routing semantics, grouped experts vs naive reference, EP dispatch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.moe import (
+    MoEConfig,
+    fake_balanced_route,
+    grouped_experts_apply,
+    init_expert_params,
+    init_gate_params,
+    init_moe_params,
+    moe_forward,
+    route,
+    update_gate_bias,
+)
+from automodel_tpu.moe.experts import capacity_experts_apply, expert_activation
+from automodel_tpu.moe.metrics import compute_load_balance_metrics
+
+
+def small_cfg(**kw):
+    base = dict(n_routed_experts=8, n_activated_experts=2, dim=16, moe_inter_dim=32)
+    base.update(kw)
+    return MoEConfig(**base)
+
+
+def naive_experts(cfg, params, x, weights, indices):
+    """Per-expert python-loop reference (mirrors reference _forward_loop semantics)."""
+    x = np.asarray(x, np.float32)
+    w_gu = np.asarray(params["gate_up_proj"], np.float32)
+    w_d = np.asarray(params["down_proj"], np.float32)
+    T, D = x.shape
+    y = np.zeros((T, D), np.float32)
+    for t in range(T):
+        for k in range(indices.shape[1]):
+            e = int(indices[t, k])
+            h = x[t] @ w_gu[e]
+            if "gate_up_bias" in params:
+                h = h + np.asarray(params["gate_up_bias"], np.float32)[e]
+            a = np.asarray(expert_activation(cfg, jnp.asarray(h)), np.float32)
+            out = a @ w_d[e]
+            if "down_bias" in params:
+                out = out + np.asarray(params["down_bias"], np.float32)[e]
+            y[t] += float(weights[t, k]) * out
+    return y
+
+
+class TestRoute:
+    def test_softmax_topk_after(self):
+        cfg = small_cfg(score_func="softmax")
+        gp = init_gate_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (10, cfg.dim))
+        w, idx, aux, load = route(cfg, gp, x)
+        assert w.shape == (10, 2) and idx.shape == (10, 2)
+        # weights are a softmax over the top-k values -> sum to 1
+        np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, atol=1e-5)
+        assert aux is None
+        assert float(load.sum()) == 20.0  # T * K valid tokens
+
+    def test_softmax_before_topk(self):
+        cfg = small_cfg(score_func="softmax", softmax_before_topk=True)
+        gp = init_gate_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (10, cfg.dim))
+        w, idx, _, _ = route(cfg, gp, x)
+        # weights are probabilities of the full softmax -> sum < 1
+        assert np.all(np.asarray(w.sum(-1)) < 1.0)
+        # top-1 weight >= top-2
+        assert np.all(np.asarray(w[:, 0]) >= np.asarray(w[:, 1]))
+
+    def test_sigmoid_weights_are_sigmoid_scores(self):
+        cfg = small_cfg(score_func="sigmoid", route_scale=2.5)
+        gp = init_gate_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (6, cfg.dim))
+        w, idx, _, _ = route(cfg, gp, x)
+        scores = jax.nn.sigmoid(x @ gp["weight"].T)
+        expect = np.take_along_axis(np.asarray(scores), np.asarray(idx), axis=-1) * 2.5
+        np.testing.assert_allclose(np.asarray(w), expect, rtol=1e-5)
+
+    def test_correction_bias_changes_selection_not_weights(self):
+        cfg = small_cfg(score_func="sigmoid", gate_bias_update_factor=0.01)
+        gp = init_gate_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (32, cfg.dim))
+        _, idx0, _, _ = route(cfg, gp, x)
+        # huge bias on expert 3 -> every token must select it
+        gp2 = dict(gp, score_correction_bias=gp["score_correction_bias"].at[3].set(100.0))
+        w, idx, _, _ = route(cfg, gp2, x)
+        assert np.all(np.any(np.asarray(idx) == 3, axis=-1))
+        # but weights still come from unbiased sigmoid scores (noaux-tc contract)
+        scores = jax.nn.sigmoid(x @ gp["weight"].T)
+        expect = np.take_along_axis(np.asarray(scores), np.asarray(idx), axis=-1)
+        np.testing.assert_allclose(np.asarray(w), expect, rtol=1e-5)
+
+    def test_group_limited_routing(self):
+        # 8 experts, 4 groups of 2, only 1 group allowed -> both picks in same group
+        cfg = small_cfg(score_func="sigmoid", n_expert_groups=4, n_limited_groups=1)
+        gp = init_gate_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (20, cfg.dim))
+        _, idx, _, _ = route(cfg, gp, x)
+        groups = np.asarray(idx) // 2
+        assert np.all(groups[:, 0] == groups[:, 1])
+
+    def test_norm_topk_prob(self):
+        cfg = small_cfg(score_func="sigmoid", norm_topk_prob=True)
+        gp = init_gate_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (10, cfg.dim))
+        w, _, _, _ = route(cfg, gp, x)
+        np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, atol=1e-5)
+
+    def test_expert_load_respects_token_mask(self):
+        cfg = small_cfg()
+        gp = init_gate_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (10, cfg.dim))
+        mask = jnp.array([True] * 4 + [False] * 6)
+        _, _, _, load = route(cfg, gp, x, mask)
+        assert float(load.sum()) == 4 * cfg.n_activated_experts
+
+    def test_aux_loss_balanced_is_one(self):
+        # perfectly uniform scores + balanced load -> f_i = 1, sum(f_i * P_i) = sum(P_i)
+        cfg = small_cfg(aux_loss_coeff=0.01, score_func="softmax")
+        gp = init_gate_params(cfg, jax.random.key(0))
+        gp["weight"] = jnp.zeros_like(gp["weight"])  # all scores equal
+        x = jax.random.normal(jax.random.key(1), (16, cfg.dim))
+        _, _, aux, load = route(cfg, gp, x)
+        assert aux is not None and np.isfinite(float(aux))
+
+    def test_jit_and_grad(self):
+        cfg = small_cfg(aux_loss_coeff=0.01, score_func="sigmoid", norm_topk_prob=True)
+        gp = init_gate_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (10, cfg.dim))
+
+        def loss(gp):
+            w, _, aux, _ = route(cfg, gp, x)
+            return w.sum() + aux
+
+        g = jax.jit(jax.grad(loss))(gp)
+        assert np.isfinite(np.asarray(g["weight"])).all()
+
+
+class TestFakeBalancedGate:
+    def test_perfectly_balanced(self):
+        cfg = small_cfg()
+        x = jax.random.normal(jax.random.key(0), (16, cfg.dim))
+        w, idx, aux, load = fake_balanced_route(cfg, x)
+        assert aux is None
+        np.testing.assert_allclose(np.asarray(w), 1.0 / cfg.n_activated_experts)
+        np.testing.assert_allclose(np.asarray(load), load.sum() / cfg.n_routed_experts)
+
+    def test_noise_is_content_deterministic(self):
+        cfg = small_cfg()
+        x = jax.random.normal(jax.random.key(0), (16, cfg.dim))
+        _, idx1, _, _ = fake_balanced_route(cfg, x, noise=0.5)
+        _, idx2, _, _ = fake_balanced_route(cfg, x, noise=0.5)
+        np.testing.assert_array_equal(np.asarray(idx1), np.asarray(idx2))
+        # unique experts per token (required by scatter-back)
+        for row in np.asarray(idx1):
+            assert len(set(row.tolist())) == len(row)
+
+
+class TestGroupedExperts:
+    @pytest.mark.parametrize("activation", ["swiglu", "quick_geglu", "relu2"])
+    def test_matches_naive_loop(self, activation):
+        cfg = small_cfg(expert_activation=activation, expert_bias=(activation == "quick_geglu"))
+        ep = init_expert_params(cfg, jax.random.key(0))
+        gp = init_gate_params(cfg, jax.random.key(1))
+        x = jax.random.normal(jax.random.key(2), (12, cfg.dim))
+        w, idx, _, _ = route(cfg, gp, x)
+        got = grouped_experts_apply(cfg, ep, x, w, idx)
+        want = naive_experts(cfg, ep, x, np.asarray(w), np.asarray(idx))
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+
+    def test_capacity_path_matches_when_no_drops(self):
+        cfg = small_cfg()
+        ep = init_expert_params(cfg, jax.random.key(0))
+        gp = init_gate_params(cfg, jax.random.key(1))
+        x = jax.random.normal(jax.random.key(2), (12, cfg.dim))
+        w, idx, _, _ = route(cfg, gp, x)
+        dropless = grouped_experts_apply(cfg, ep, x, w, idx)
+        # capacity = T*K guarantees no drops
+        capped = capacity_experts_apply(cfg, ep, x, w, idx, capacity=24)
+        np.testing.assert_allclose(np.asarray(capped), np.asarray(dropless), atol=1e-4)
+
+    def test_capacity_drops_overflow(self):
+        cfg = small_cfg()
+        ep = init_expert_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(2), (12, cfg.dim))
+        # route everything to expert 0 with capacity 1 -> only first token contributes
+        idx = jnp.zeros((12, 2), jnp.int32)
+        w = jnp.ones((12, 2)) * 0.5
+        out = capacity_experts_apply(cfg, ep, x, w, idx, capacity=1)
+        assert np.abs(np.asarray(out[2:])).max() == 0.0
+        assert np.abs(np.asarray(out[0])).max() > 0.0
+
+    def test_masked_tokens_do_not_consume_capacity(self):
+        cfg = small_cfg()
+        ep = init_expert_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(2), (12, cfg.dim))
+        idx = jnp.zeros((12, 2), jnp.int32)  # everyone wants expert 0
+        w = jnp.ones((12, 2)) * 0.5
+        # first 10 tokens masked out; capacity 2 -> the two valid tokens get the slots
+        mask = jnp.array([False] * 10 + [True] * 2)
+        out = capacity_experts_apply(cfg, ep, x, w, idx, mask, capacity=2)
+        assert np.abs(np.asarray(out[:10])).max() == 0.0
+        assert np.abs(np.asarray(out[10:])).max() > 0.0
+
+    def test_grad_flows(self):
+        cfg = small_cfg()
+        ep = init_expert_params(cfg, jax.random.key(0))
+        gp = init_gate_params(cfg, jax.random.key(1))
+        x = jax.random.normal(jax.random.key(2), (8, cfg.dim))
+
+        def loss(ep, x):
+            w, idx, _, _ = route(cfg, gp, x)
+            return grouped_experts_apply(cfg, ep, x, w, idx).sum()
+
+        g_ep, g_x = jax.jit(jax.grad(loss, argnums=(0, 1)))(ep, x)
+        assert np.isfinite(np.asarray(g_ep["gate_up_proj"])).all()
+        assert np.abs(np.asarray(g_x)).max() > 0
+
+
+class TestMoEForward:
+    def test_shared_experts_and_shapes(self):
+        cfg = small_cfg(n_shared_experts=2, shared_expert_gate=True)
+        params = init_moe_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (2, 6, cfg.dim))
+        y, aux, load = moe_forward(cfg, params, x)
+        assert y.shape == x.shape
+        assert load.shape == (cfg.n_routed_experts,)
+        # shared experts contribute: zeroing them changes the output
+        params2 = dict(params)
+        params2["shared_experts"] = jax.tree.map(jnp.zeros_like, params["shared_experts"])
+        y2, _, _ = moe_forward(cfg, params2, x)
+        assert np.abs(np.asarray(y - y2)).max() > 0
+
+    def test_aux_loss_emitted_in_training(self):
+        cfg = small_cfg(aux_loss_coeff=0.01)
+        params = init_moe_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (2, 6, cfg.dim))
+        _, aux, _ = moe_forward(cfg, params, x, training=True)
+        assert aux is not None
+        _, aux_eval, _ = moe_forward(cfg, params, x, training=False)
+        assert aux_eval is None
+
+    def test_fake_gate(self):
+        cfg = small_cfg()
+        params = init_moe_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (2, 8, cfg.dim))
+        y, _, load = moe_forward(cfg, params, x, fake_balanced_gate=True)
+        np.testing.assert_allclose(np.asarray(load), load.sum() / cfg.n_routed_experts)
+
+
+class TestGateBiasUpdate:
+    def test_sign_update(self):
+        bias = jnp.zeros(4)
+        load = jnp.array([10.0, 0.0, 5.0, 5.0])  # mean 5
+        new = update_gate_bias(bias, load, 0.1)
+        np.testing.assert_allclose(np.asarray(new), [-0.1, 0.1, 0.0, 0.0], atol=1e-7)
+
+
+class TestMetrics:
+    def test_balanced_load(self):
+        m = compute_load_balance_metrics(np.full((3, 8), 10.0))
+        assert m["moe_load/max_util_mean"] == 1.0
+        assert m["moe_load/zero_expert_frac"] == 0.0
+
+    def test_imbalanced(self):
+        loads = np.zeros((1, 4))
+        loads[0, 0] = 8.0
+        m = compute_load_balance_metrics(loads, mode="detailed")
+        assert m["moe_load/max_util_mean"] == 4.0
+        assert m["moe_load/zero_expert_frac"] == 0.75
+        assert "moe_load/layer0/max_util" in m
+
+
+class TestEPDispatch:
+    def test_matches_dropless_on_ep_mesh(self, cpu_devices):
+        from automodel_tpu.moe.dispatch import make_ep_moe_forward
+        from automodel_tpu.parallel.mesh import MeshContext
+
+        ctx = MeshContext(ep=4, dp_shard=2, world_size=8)
+        mesh = ctx.build_mesh(cpu_devices)
+        cfg = small_cfg(n_routed_experts=8, n_activated_experts=2, n_shared_experts=1)
+        params = init_moe_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (8, 4, cfg.dim))
+
+        # generous capacity -> no drops -> exact match with the dropless GSPMD path
+        fn = make_ep_moe_forward(cfg, mesh, capacity=64)
+        with jax.sharding.set_mesh(mesh):
+            y, aux, load = fn(params, x)
+        ref_y, _, ref_load = moe_forward(cfg, params, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref_y), atol=2e-4)
+        np.testing.assert_allclose(np.asarray(load), np.asarray(ref_load))
+
+    def test_masked_tokens_dropped(self, cpu_devices):
+        from automodel_tpu.moe.dispatch import make_ep_moe_forward
+        from automodel_tpu.parallel.mesh import MeshContext
+
+        ctx = MeshContext(ep=4, dp_shard=2, world_size=8)
+        mesh = ctx.build_mesh(cpu_devices)
+        cfg = small_cfg()
+        params = init_moe_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (8, 4, cfg.dim))
+        token_mask = jnp.ones((8, 4), bool).at[:, 2:].set(False)
+        fn = make_ep_moe_forward(cfg, mesh, capacity=64)
+        with jax.sharding.set_mesh(mesh):
+            y, _, load = fn(params, x, token_mask)
+        # masked positions produce zero routed output (no shared experts configured)
+        assert np.abs(np.asarray(y[:, 2:])).max() == 0.0
+        assert np.abs(np.asarray(y[:, :2])).max() > 0.0
+        assert float(load.sum()) == 8 * 2 * cfg.n_activated_experts
+
+    def test_grad_through_dispatch(self, cpu_devices):
+        from automodel_tpu.moe.dispatch import make_ep_moe_forward
+        from automodel_tpu.parallel.mesh import MeshContext
+
+        ctx = MeshContext(ep=2, dp_shard=4, world_size=8)
+        mesh = ctx.build_mesh(cpu_devices)
+        cfg = small_cfg(n_routed_experts=4)
+        params = init_moe_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (4, 4, cfg.dim))
+        fn = make_ep_moe_forward(cfg, mesh, capacity=64)
+
+        def loss(params):
+            y, _, _ = fn(params, x)
+            return (y**2).sum()
+
+        with jax.sharding.set_mesh(mesh):
+            g = jax.jit(jax.grad(loss))(params)
+        assert np.isfinite(np.asarray(g["experts"]["gate_up_proj"])).all()
+        assert np.abs(np.asarray(g["experts"]["down_proj"])).max() > 0
