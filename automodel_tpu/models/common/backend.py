@@ -40,6 +40,8 @@ class BackendConfig:
     # MoE knobs (used by MoE families only)
     experts_backend: str = "ragged_dot"  # "ragged_dot" | "dense" | "pallas_gmm"
     dispatcher: str = "dense"  # "dense" (one-hot matmul) | "a2a" (EP all_to_all)
+    fake_balanced_gate: bool = False  # benchmark mode: uniform routing, no gate math
+    fake_gate_noise: float = 0.0
 
     @property
     def jnp_dtype(self):

@@ -1,0 +1,235 @@
+"""Shared GQA + MoE decoder machinery (reference per-family model.py Block pattern,
+e.g. models/qwen3_moe/model.py, models/gpt_oss/model.py).
+
+Same contract as models.common.transformer: pure functions over stacked param pytrees,
+``lax.scan`` over layers. A model may have a *dense prefix* (DeepSeek's
+first_k_dense_replace) — those layers are stacked separately and scanned first; the MoE
+layers follow. Scans emit per-layer ``(aux_loss, expert_load)`` which the forward
+returns as a stats dict for the recipe (aux-loss term, load-balance metrics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.common.transformer import (
+    DenseDecoderConfig,
+    _LAYER_AXES,
+    _attention_block,
+    _constrain,
+    _layer_shapes,
+    _mlp_block,
+)
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.layers import (
+    cast_moe_compute_params,
+    init_moe_params,
+    moe_forward,
+    moe_logical_axes,
+)
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope, rope_attention_scaling, rope_frequencies
+
+__all__ = [
+    "MoEDecoderConfig",
+    "init_moe_decoder_params",
+    "moe_decoder_logical_axes",
+    "moe_decoder_forward",
+]
+
+
+@dataclasses.dataclass
+class MoEDecoderConfig(DenseDecoderConfig):
+    """GQA decoder where layers >= first_k_dense_replace use an MoE block."""
+
+    moe: MoEConfig | None = None
+    first_k_dense_replace: int = 0
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.moe is None:
+            raise ValueError("MoEDecoderConfig requires a MoEConfig in .moe")
+
+    @property
+    def num_moe_layers(self) -> int:
+        return self.num_hidden_layers - self.first_k_dense_replace
+
+
+def _attn_only_shapes(cfg: MoEDecoderConfig) -> dict:
+    """Attention + norms from the dense layer table, minus the dense-MLP weights."""
+    shapes = _layer_shapes(cfg)
+    for k in ("w_gate", "w_up", "w_down"):
+        shapes.pop(k)
+    return shapes
+
+
+def init_moe_decoder_params(cfg: MoEDecoderConfig, key: jax.Array, dtype=jnp.float32) -> dict:
+    std = cfg.initializer_range
+    k_embed, k_dense, k_moe_attn, k_moe, k_head = jax.random.split(key, 5)
+
+    def init_layer_stack(shapes: dict, L: int, key) -> dict:
+        keys = jax.random.split(key, len(shapes))
+        out = {}
+        for idx, (name, shape) in enumerate(shapes.items()):
+            if name.endswith("norm"):
+                out[name] = jnp.ones((L, *shape), dtype)
+            elif name.startswith("b") or name == "sinks":
+                out[name] = jnp.zeros((L, *shape), dtype)
+            else:
+                out[name] = (jax.random.normal(keys[idx], (L, *shape), jnp.float32) * std).astype(dtype)
+        return out
+
+    params: dict = {
+        "embed": (jax.random.normal(k_embed, (cfg.vocab_size, cfg.hidden_size), jnp.float32) * std).astype(dtype),
+        "final_norm": jnp.ones((cfg.hidden_size,), dtype),
+    }
+    if cfg.first_k_dense_replace > 0:
+        params["dense_layers"] = init_layer_stack(_layer_shapes(cfg), cfg.first_k_dense_replace, k_dense)
+    Lm = cfg.num_moe_layers
+    moe_layers = init_layer_stack(_attn_only_shapes(cfg), Lm, k_moe_attn)
+    moe_layers["moe"] = jax.vmap(
+        lambda k: init_moe_params(cfg.moe, k, dtype, std)
+    )(jax.random.split(k_moe, Lm))
+    params["moe_layers"] = moe_layers
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(k_head, (cfg.hidden_size, cfg.vocab_size), jnp.float32) * std
+        ).astype(dtype)
+    return params
+
+
+def moe_decoder_logical_axes(cfg: MoEDecoderConfig) -> dict:
+    axes: dict = {
+        "embed": ("vocab", "embed"),
+        "final_norm": ("norm",),
+    }
+    if cfg.first_k_dense_replace > 0:
+        axes["dense_layers"] = {
+            name: ("layers",) + _LAYER_AXES[name] for name in _layer_shapes(cfg)
+        }
+    moe_axes = {name: ("layers",) + _LAYER_AXES[name] for name in _attn_only_shapes(cfg)}
+    moe_axes["moe"] = jax.tree.map(
+        lambda t: ("layers",) + t,
+        moe_logical_axes(cfg.moe),
+        is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(e, (str, type(None))) for e in x),
+    )
+    axes["moe_layers"] = moe_axes
+    if not cfg.tie_word_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def moe_decoder_forward(
+    cfg: MoEDecoderConfig,
+    backend: BackendConfig,
+    params: dict,
+    input_ids: jnp.ndarray,  # (B, S)
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
+    token_mask: jnp.ndarray | None = None,  # (B, S) True = valid (counts for routing)
+    rules=None,
+    return_hidden: bool = False,
+    training: bool = True,
+    attention_fn=None,
+) -> tuple[jnp.ndarray, dict[str, Any]]:
+    """Returns ``(logits_or_hidden, stats)``; stats has ``aux_loss`` (scalar or None)
+    and ``expert_load`` (num_moe_layers, E).
+
+    ``attention_fn(lp, x, positions, segment_ids, is_sliding, rules) -> attn_out``
+    overrides the default GQA block — the hook MLA-style families plug into (so the
+    scan / aux / dense-prefix machinery here is the single copy).
+    """
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+    dtype = backend.jnp_dtype
+    h = params["embed"].astype(dtype)[input_ids]
+    h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+
+    sliding_flags = jnp.asarray(cfg.sliding_flags, dtype=jnp.int32)
+    emit_aux = cfg.moe.aux_loss_coeff > 0 and training and not backend.fake_balanced_gate
+
+    if attention_fn is None:
+        inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+        attn_scale = rope_attention_scaling(cfg.rope_scaling)
+        big_window = jnp.int32(cfg.max_position_embeddings + input_ids.shape[1])
+        window = jnp.int32(cfg.sliding_window or 0)
+        any_sliding = any(cfg.sliding_flags)
+
+        def attention_fn(lp, x, positions, segment_ids, is_sliding, rules):
+            eff_window = jnp.where(is_sliding > 0, window, big_window) if any_sliding else None
+            return _attention_block(cfg, backend, lp, x, positions, segment_ids,
+                                    inv_freq, attn_scale, eff_window, rules)
+
+    def attn(h, lp, is_sliding):
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        h = h + attention_fn(lp, x, positions, segment_ids, is_sliding, rules)
+        return _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+
+    def dense_layer_fn(h, layer_inputs):
+        lp, is_sliding = layer_inputs
+        lp = jax.tree.map(lambda a: a.astype(dtype), lp)
+        h = attn(h, lp, is_sliding)
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = h + _mlp_block(lp, x, rules)
+        return _constrain(h, rules, ("batch", "act_seq", "act_embed")), None
+
+    def moe_layer_fn(h, layer_inputs):
+        lp, is_sliding = layer_inputs
+        moe_params = lp["moe"]
+        lp = jax.tree.map(lambda a: a.astype(dtype), {k: v for k, v in lp.items() if k != "moe"})
+        h = attn(h, lp, is_sliding)
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        moe_params = cast_moe_compute_params(moe_params, dtype)
+        y, aux, load = moe_forward(
+            cfg.moe, moe_params, x, token_mask,
+            training=training,
+            dispatcher="capacity" if backend.experts_backend == "dense" else "ragged",
+            fake_balanced_gate=backend.fake_balanced_gate,
+            fake_gate_noise=backend.fake_gate_noise,
+        )
+        h = h + y
+        h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+        return h, (aux if emit_aux else jnp.float32(0), load)
+
+    k_dense = cfg.first_k_dense_replace
+    if k_dense > 0:
+        body = backend.layer_remat(dense_layer_fn)
+        if backend.scan_layers:
+            h, _ = jax.lax.scan(body, h, (params["dense_layers"], sliding_flags[:k_dense]))
+        else:
+            for i in range(k_dense):
+                lp = jax.tree.map(lambda a: a[i], params["dense_layers"])
+                h, _ = body(h, (lp, sliding_flags[i]))
+
+    moe_sliding = sliding_flags[k_dense:]
+    body = backend.layer_remat(moe_layer_fn)
+    if backend.scan_layers:
+        h, (auxs, loads) = jax.lax.scan(body, h, (params["moe_layers"], moe_sliding))
+    else:
+        auxs, loads = [], []
+        for i in range(cfg.num_moe_layers):
+            lp = jax.tree.map(lambda a: a[i], params["moe_layers"])
+            h, (aux, load) = body(h, (lp, moe_sliding[i]))
+            auxs.append(aux)
+            loads.append(load)
+        auxs = jnp.stack(auxs)
+        loads = jnp.stack(loads)
+
+    stats = {
+        "aux_loss": auxs.sum() if emit_aux else None,
+        "expert_load": loads,
+    }
+
+    h = rms_norm(h, params["final_norm"].astype(dtype), cfg.rms_norm_eps)
+    if return_hidden:
+        return h, stats
+    unembed = params.get("lm_head")
+    if unembed is None:
+        unembed = params["embed"].T
+    logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
+    return logits, stats

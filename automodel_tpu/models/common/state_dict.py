@@ -29,17 +29,35 @@ def _identity(x: np.ndarray) -> np.ndarray:
 
 @dataclasses.dataclass
 class Entry:
-    """One HF tensor -> one (possibly per-layer) slot in the param tree."""
+    """One or more HF tensors -> one (possibly per-layer/per-expert) tree slot.
 
-    hf: str  # e.g. "model.layers.{i}.self_attn.q_proj.weight"
+    ``hf`` may be a tuple of key templates: the tensors are passed together to
+    ``to_ours(*arrays)`` (e.g. merging HF gate_proj + up_proj into one gate_up array),
+    and ``to_hf`` must return a matching tuple. ``{i}`` expands over layers (within
+    ``layer_range`` when set), ``{e}`` over experts; expert-stacked entries produce an
+    extra leading E dim under the layer dim (the reference's MoE expert split/merge,
+    moe/state_dict_mixin.py).
+    """
+
+    hf: str | tuple[str, ...]  # e.g. "model.layers.{i}.self_attn.q_proj.weight"
     ours: str  # e.g. "layers.wq"
     to_ours: Transform = _identity
     to_hf: Transform = _identity
     optional: bool = False
+    layer_range: tuple[int, int] | None = None  # [start, stop) HF layer indices
+    keep_dtype: bool = False  # exempt from the load-time cast (e.g. fp32 routing bias)
+
+    @property
+    def hf_keys(self) -> tuple[str, ...]:
+        return (self.hf,) if isinstance(self.hf, str) else tuple(self.hf)
 
     @property
     def per_layer(self) -> bool:
-        return "{i}" in self.hf
+        return "{i}" in self.hf_keys[0]
+
+    @property
+    def per_expert(self) -> bool:
+        return "{e}" in self.hf_keys[0]
 
 
 def get_path(tree: dict, path: str) -> Any:
@@ -58,44 +76,75 @@ def set_path(tree: dict, path: str, value: Any) -> None:
 
 
 class MappingAdapter:
-    """Applies an Entry table in either direction, handling layer stacking."""
+    """Applies an Entry table in either direction, handling layer/expert stacking."""
 
-    def __init__(self, entries: Iterable[Entry], num_layers: int, scan_layers: bool = True):
+    def __init__(
+        self,
+        entries: Iterable[Entry],
+        num_layers: int,
+        scan_layers: bool = True,
+        num_experts: int = 0,
+    ):
         self.entries = list(entries)
         self.num_layers = num_layers
         self.scan_layers = scan_layers
+        self.num_experts = num_experts
+
+    def _layers(self, e: Entry) -> range:
+        if e.layer_range is not None:
+            return range(*e.layer_range)
+        return range(self.num_layers)
+
+    def _load_one(self, entry: Entry, tensors: Mapping[str, np.ndarray], **fmt) -> np.ndarray | None:
+        arrays = []
+        for tmpl in entry.hf_keys:
+            key = tmpl.format(**fmt)
+            if key not in tensors:
+                if entry.optional:
+                    return None
+                raise KeyError(f"missing tensor {key!r} in checkpoint")
+            arrays.append(np.asarray(tensors[key]))
+        return entry.to_ours(*arrays)
 
     def from_hf(self, tensors: Mapping[str, np.ndarray], dtype=None) -> dict:
-        """HF flat dict -> our nested param tree (layers stacked when scan_layers)."""
+        """HF flat dict -> our nested param tree (layers/experts stacked)."""
         params: dict = {}
         for e in self.entries:
             if e.per_layer:
                 per = []
-                missing = False
-                for i in range(self.num_layers):
-                    key = e.hf.format(i=i)
-                    if key not in tensors:
-                        if e.optional:
-                            missing = True
-                            break
-                        raise KeyError(f"missing tensor {key!r} in checkpoint")
-                    per.append(e.to_ours(np.asarray(tensors[key])))
-                if missing:
-                    continue
-                # models consume the stacked (L, ...) layout whether or not they scan
-                stacked = np.stack(per, axis=0)
-                set_path(params, e.ours, stacked if dtype is None else stacked.astype(dtype))
+                for i in self._layers(e):
+                    if e.per_expert:
+                        experts = [
+                            self._load_one(e, tensors, i=i, e=x) for x in range(self.num_experts)
+                        ]
+                        layer = None if any(a is None for a in experts) else np.stack(experts, axis=0)
+                    else:
+                        layer = self._load_one(e, tensors, i=i)
+                    if layer is None:
+                        break
+                    per.append(layer)
+                else:
+                    # models consume the stacked (L, ...) layout whether or not they scan
+                    stacked = np.stack(per, axis=0)
+                    cast = dtype if not e.keep_dtype else None
+                    set_path(params, e.ours, stacked if cast is None else stacked.astype(cast))
             else:
-                if e.hf not in tensors:
-                    if e.optional:
-                        continue
-                    raise KeyError(f"missing tensor {e.hf!r} in checkpoint")
-                t = e.to_ours(np.asarray(tensors[e.hf]))
-                set_path(params, e.ours, t if dtype is None else t.astype(dtype))
+                t = self._load_one(e, tensors)
+                if t is not None:
+                    cast = dtype if not e.keep_dtype else None
+                    set_path(params, e.ours, t if cast is None else t.astype(cast))
         return params
 
+    def _store_one(self, entry: Entry, value: np.ndarray, out: dict, dtype, **fmt) -> None:
+        results = entry.to_hf(value)
+        if isinstance(results, np.ndarray):
+            results = (results,)
+        cast = dtype if not entry.keep_dtype else None
+        for tmpl, t in zip(entry.hf_keys, results, strict=True):
+            out[tmpl.format(**fmt)] = t if cast is None else t.astype(cast)
+
     def to_hf(self, params: dict, dtype=None) -> dict[str, np.ndarray]:
-        """Our param tree -> HF flat dict (unstacking layers)."""
+        """Our param tree -> HF flat dict (unstacking layers/experts)."""
         out: dict[str, np.ndarray] = {}
         for e in self.entries:
             try:
@@ -106,10 +155,12 @@ class MappingAdapter:
                 raise
             value = np.asarray(value)
             if e.per_layer:
-                for i in range(self.num_layers):
-                    t = e.to_hf(value[i])
-                    out[e.hf.format(i=i)] = t if dtype is None else t.astype(dtype)
+                for li, i in enumerate(self._layers(e)):
+                    if e.per_expert:
+                        for x in range(self.num_experts):
+                            self._store_one(e, value[li, x], out, dtype, i=i, e=x)
+                    else:
+                        self._store_one(e, value[li], out, dtype, i=i)
             else:
-                t = e.to_hf(value)
-                out[e.hf] = t if dtype is None else t.astype(dtype)
+                self._store_one(e, value, out, dtype)
         return out

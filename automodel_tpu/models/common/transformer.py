@@ -54,6 +54,8 @@ class DenseDecoderConfig:
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     attention_bias: bool = False  # qwen2: bias on q/k/v only
+    attention_out_bias: bool = False  # gpt-oss: bias on o_proj too
+    attention_sinks: bool = False  # gpt-oss: per-head sink logits absorbing mass
     qk_norm: bool = False  # qwen3: RMSNorm on per-head q/k
     sliding_window: int | None = None
     layer_types: list[str] | None = None  # "full_attention" | "sliding_attention"
@@ -93,6 +95,10 @@ def _layer_shapes(cfg: DenseDecoderConfig) -> dict[str, tuple[int, ...]]:
     }
     if cfg.attention_bias:
         shapes |= {"bq": (n, h), "bk": (k, h), "bv": (k, h)}
+    if cfg.attention_out_bias:
+        shapes |= {"bo": (d,)}
+    if cfg.attention_sinks:
+        shapes |= {"sinks": (n,)}
     if cfg.qk_norm:
         shapes |= {"q_norm": (h,), "k_norm": (h,)}
     return shapes
@@ -107,6 +113,8 @@ _LAYER_AXES = {
     "bq": ("heads", "head_dim"),
     "bk": ("kv_heads", "head_dim"),
     "bv": ("kv_heads", "head_dim"),
+    "bo": ("embed",),
+    "sinks": ("heads",),
     "q_norm": ("norm",),
     "k_norm": ("norm",),
     "mlp_norm": ("norm",),
@@ -192,9 +200,13 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
         causal=True,
         segment_ids_q=segment_ids,
         sliding_window=sliding,
+        sinks=lp.get("sinks"),
         backend=backend.attention,
     )
-    return jnp.einsum("bsnh,nhd->bsd", out, lp["wo"])
+    o = jnp.einsum("bsnh,nhd->bsd", out, lp["wo"])
+    if cfg.attention_out_bias:
+        o = o + lp["bo"]
+    return o
 
 
 def _mlp_block(lp: dict, x, rules):

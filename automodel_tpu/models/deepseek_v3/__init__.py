@@ -1,0 +1,3 @@
+from automodel_tpu.models.deepseek_v3.model import DeepseekV3Config, DeepseekV3ForCausalLM
+
+__all__ = ["DeepseekV3Config", "DeepseekV3ForCausalLM"]

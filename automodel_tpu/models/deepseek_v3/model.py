@@ -1,0 +1,342 @@
+"""DeepSeek-V3 family — TPU-native (reference models/deepseek_v3/model.py:233,
+layers.py:37 MLA).
+
+Multi-head Latent Attention: queries and key/values factor through low-rank latents
+(q_lora_rank / kv_lora_rank); the rope sub-dimension rides a separate single-head
+stream concatenated onto every head. Interleaved (complex-pair) rope, YaRN mscale^2
+softmax-scale correction. MoE layers use sigmoid noaux-tc routing with group-limited
+selection, shared experts, and the loss-free balancing correction bias; the first
+``first_k_dense_replace`` layers stay dense. Also serves DeepSeek-V2/V2-Lite
+(q_lora_rank None -> direct q projection), Moonlight, and Kimi-K2 configs, which share
+the architecture.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.common.moe_transformer import moe_decoder_forward
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.layers import init_moe_params, moe_logical_axes
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope_interleaved, rope_frequencies
+
+__all__ = ["DeepseekV3Config", "DeepseekV3ForCausalLM"]
+
+
+@dataclasses.dataclass
+class DeepseekV3Config:
+    vocab_size: int = 129280
+    hidden_size: int = 7168
+    intermediate_size: int = 18432
+    num_hidden_layers: int = 61
+    num_attention_heads: int = 128
+    q_lora_rank: int | None = 1536
+    kv_lora_rank: int = 512
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+    first_k_dense_replace: int = 3
+    max_position_embeddings: int = 4096
+    rope_theta: float = 10000.0
+    rope_scaling: dict[str, Any] | None = None
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+    moe: MoEConfig | None = None
+
+    def __post_init__(self):
+        if self.moe is None:
+            raise ValueError("DeepseekV3Config requires a MoEConfig in .moe")
+
+    # moe_decoder_forward duck-type surface (MLA has no sliding-window variants)
+    sliding_window = None
+
+    @property
+    def sliding_flags(self) -> list[bool]:
+        return [False] * self.num_hidden_layers
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def num_moe_layers(self) -> int:
+        return self.num_hidden_layers - self.first_k_dense_replace
+
+    @property
+    def softmax_scale(self) -> float:
+        """qk_head_dim^-0.5 with the YaRN mscale^2 correction
+        (reference layers.py:103-117)."""
+        scale = self.qk_head_dim**-0.5
+        rs = self.rope_scaling
+        if rs and all(k in rs for k in ("factor", "mscale", "original_max_position_embeddings")):
+            mscale = float(rs["mscale"])
+            if self.max_position_embeddings > rs["original_max_position_embeddings"]:
+                factor = float(rs["factor"])
+                if factor > 1:
+                    mscale = 0.1 * mscale * math.log(factor) + 1.0
+            scale = scale * mscale * mscale
+        return scale
+
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "DeepseekV3Config":
+        # V3 scores with sigmoid + noaux-tc correction bias; V2 softmaxes before a
+        # greedy / group-limited-greedy top-k (HF scoring_func / topk_method fields,
+        # absent on V3 configs where noaux_tc is the only mode).
+        scoring = hf.get("scoring_func", "sigmoid")
+        topk_method = hf.get("topk_method", "noaux_tc")
+        moe = MoEConfig(
+            n_routed_experts=hf["n_routed_experts"],
+            n_activated_experts=hf["num_experts_per_tok"],
+            dim=hf["hidden_size"],
+            moe_inter_dim=hf["moe_intermediate_size"],
+            n_shared_experts=hf.get("n_shared_experts", 0),
+            n_expert_groups=max(hf.get("n_group") or 1, 1),
+            n_limited_groups=max(hf.get("topk_group") or 1, 1),
+            gate_bias_update_factor=0.001 if topk_method == "noaux_tc" else 0.0,
+            score_func=scoring,
+            softmax_before_topk=scoring == "softmax",
+            route_scale=hf.get("routed_scaling_factor", 1.0),
+            norm_topk_prob=hf.get("norm_topk_prob", True),
+        )
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_hidden_layers=hf["num_hidden_layers"],
+            num_attention_heads=hf["num_attention_heads"],
+            q_lora_rank=hf.get("q_lora_rank"),
+            kv_lora_rank=hf["kv_lora_rank"],
+            qk_nope_head_dim=hf["qk_nope_head_dim"],
+            qk_rope_head_dim=hf["qk_rope_head_dim"],
+            v_head_dim=hf["v_head_dim"],
+            first_k_dense_replace=hf.get("first_k_dense_replace", 0),
+            max_position_embeddings=hf.get("max_position_embeddings", 4096),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rope_scaling=hf.get("rope_scaling"),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            initializer_range=hf.get("initializer_range", 0.02),
+            moe=moe,
+        )
+
+
+def _mla_shapes(cfg: DeepseekV3Config) -> dict[str, tuple[int, ...]]:
+    d, n = cfg.hidden_size, cfg.num_attention_heads
+    shapes: dict[str, tuple[int, ...]] = {"attn_norm": (d,), "mlp_norm": (d,)}
+    if cfg.q_lora_rank is None:
+        shapes["wq"] = (d, n, cfg.qk_head_dim)
+    else:
+        shapes |= {
+            "wq_a": (d, cfg.q_lora_rank),
+            "q_a_norm": (cfg.q_lora_rank,),
+            "wq_b": (cfg.q_lora_rank, n, cfg.qk_head_dim),
+        }
+    shapes |= {
+        "wkv_a": (d, cfg.kv_lora_rank + cfg.qk_rope_head_dim),
+        "kv_a_norm": (cfg.kv_lora_rank,),
+        "wkv_b": (cfg.kv_lora_rank, n, cfg.qk_nope_head_dim + cfg.v_head_dim),
+        "wo": (n, cfg.v_head_dim, d),
+    }
+    return shapes
+
+
+_MLA_AXES = {
+    "attn_norm": ("norm",),
+    "mlp_norm": ("norm",),
+    "wq": ("embed", "heads", "head_dim"),
+    "wq_a": ("embed", None),
+    "q_a_norm": ("norm",),
+    "wq_b": (None, "heads", "head_dim"),
+    "wkv_a": ("embed", None),
+    "kv_a_norm": ("norm",),
+    "wkv_b": (None, "heads", "head_dim"),
+    "wo": ("heads", "head_dim", "embed"),
+}
+
+_DENSE_MLP_SHAPES = lambda d, i: {"w_gate": (d, i), "w_up": (d, i), "w_down": (i, d)}  # noqa: E731
+_DENSE_MLP_AXES = {"w_gate": ("embed", "mlp"), "w_up": ("embed", "mlp"), "w_down": ("mlp", "embed")}
+
+
+def init_params(cfg: DeepseekV3Config, key: jax.Array, dtype=jnp.float32) -> dict:
+    std = cfg.initializer_range
+    k_embed, k_dense, k_attn, k_moe, k_head = jax.random.split(key, 5)
+
+    def stack(shapes: dict, L: int, key) -> dict:
+        keys = jax.random.split(key, len(shapes))
+        out = {}
+        for idx, (name, shape) in enumerate(shapes.items()):
+            if name.endswith("norm"):
+                out[name] = jnp.ones((L, *shape), dtype)
+            else:
+                out[name] = (jax.random.normal(keys[idx], (L, *shape), jnp.float32) * std).astype(dtype)
+        return out
+
+    params: dict = {
+        "embed": (jax.random.normal(k_embed, (cfg.vocab_size, cfg.hidden_size), jnp.float32) * std).astype(dtype),
+        "final_norm": jnp.ones((cfg.hidden_size,), dtype),
+    }
+    kd = cfg.first_k_dense_replace
+    if kd > 0:
+        params["dense_layers"] = stack(
+            _mla_shapes(cfg) | _DENSE_MLP_SHAPES(cfg.hidden_size, cfg.intermediate_size), kd, k_dense
+        )
+    Lm = cfg.num_moe_layers
+    moe_layers = stack(_mla_shapes(cfg), Lm, k_attn)
+    moe_layers["moe"] = jax.vmap(lambda k: init_moe_params(cfg.moe, k, dtype, std))(
+        jax.random.split(k_moe, Lm)
+    )
+    params["moe_layers"] = moe_layers
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(k_head, (cfg.hidden_size, cfg.vocab_size), jnp.float32) * std
+        ).astype(dtype)
+    return params
+
+
+def logical_axes(cfg: DeepseekV3Config) -> dict:
+    mla = {name: ("layers",) + _MLA_AXES[name] for name in _mla_shapes(cfg)}
+    axes: dict = {
+        "embed": ("vocab", "embed"),
+        "final_norm": ("norm",),
+    }
+    if cfg.first_k_dense_replace > 0:
+        axes["dense_layers"] = mla | {
+            name: ("layers",) + _DENSE_MLP_AXES[name] for name in _DENSE_MLP_AXES
+        }
+    moe_axes = dict(mla)
+    moe_axes["moe"] = jax.tree.map(
+        lambda t: ("layers",) + t,
+        moe_logical_axes(cfg.moe),
+        is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(e, (str, type(None))) for e in x),
+    )
+    axes["moe_layers"] = moe_axes
+    if not cfg.tie_word_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def _constrain(x, rules, names):
+    if rules is None or rules.mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, rules.sharding(names))
+
+
+def _mla_block(cfg: DeepseekV3Config, backend: BackendConfig, lp: dict, x, positions,
+               segment_ids, inv_freq, rules):
+    """MLA attention (reference layers.py:122-198)."""
+    if cfg.q_lora_rank is None:
+        q = jnp.einsum("bsd,dnh->bsnh", x, lp["wq"])
+    else:
+        q_latent = rms_norm(jnp.einsum("bsd,dr->bsr", x, lp["wq_a"]), lp["q_a_norm"], cfg.rms_norm_eps)
+        q = jnp.einsum("bsr,rnh->bsnh", q_latent, lp["wq_b"])
+    q_nope, q_pe = jnp.split(q, [cfg.qk_nope_head_dim], axis=-1)
+
+    kv = jnp.einsum("bsd,dr->bsr", x, lp["wkv_a"])
+    c_kv, k_pe = jnp.split(kv, [cfg.kv_lora_rank], axis=-1)
+    c_kv = rms_norm(c_kv, lp["kv_a_norm"], cfg.rms_norm_eps)
+
+    q_pe = apply_rope_interleaved(q_pe, positions, inv_freq)
+    k_pe = apply_rope_interleaved(k_pe[:, :, None, :], positions, inv_freq)
+
+    q = jnp.concatenate([q_nope, q_pe], axis=-1)
+    kv = jnp.einsum("bsr,rnh->bsnh", c_kv, lp["wkv_b"])
+    k_nope, v = jnp.split(kv, [cfg.qk_nope_head_dim], axis=-1)
+    k = jnp.concatenate(
+        [k_nope, jnp.broadcast_to(k_pe, (*k_nope.shape[:-1], cfg.qk_rope_head_dim))], axis=-1
+    )
+
+    q = _constrain(q, rules, ("batch", "act_attn_seq", "act_heads", None))
+    k = _constrain(k, rules, ("batch", "act_attn_seq", "act_heads", None))
+    out = dot_product_attention(
+        q, k, v,
+        causal=True,
+        segment_ids_q=segment_ids,
+        softmax_scale=cfg.softmax_scale,
+        backend=backend.attention,
+    )
+    return jnp.einsum("bsnh,nhd->bsd", out, lp["wo"])
+
+
+def forward(
+    cfg: DeepseekV3Config,
+    backend: BackendConfig,
+    params: dict,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
+    token_mask: jnp.ndarray | None = None,
+    rules=None,
+    return_hidden: bool = False,
+    training: bool = True,
+):
+    """moe_decoder_forward with the MLA attention hook; returns (out, stats)."""
+    # Reference precompute_freqs_cis applies the YaRN correction only when training
+    # beyond the original context (rope_utils.py:113-117).
+    rs = cfg.rope_scaling
+    use_yarn = bool(
+        rs
+        and all(k in rs for k in ("factor", "beta_fast", "beta_slow", "original_max_position_embeddings"))
+        and cfg.max_position_embeddings > rs["original_max_position_embeddings"]
+    )
+    inv_freq = rope_frequencies(
+        cfg.qk_rope_head_dim, cfg.rope_theta, dict(rs, rope_type="yarn") if use_yarn else None
+    )
+
+    def mla_attention(lp, x, positions, segment_ids, is_sliding, rules):
+        del is_sliding
+        return _mla_block(cfg, backend, lp, x, positions, segment_ids, inv_freq, rules)
+
+    return moe_decoder_forward(
+        cfg, backend, params, input_ids,
+        positions=positions, segment_ids=segment_ids, token_mask=token_mask,
+        rules=rules, return_hidden=return_hidden, training=training,
+        attention_fn=mla_attention,
+    )
+
+
+class DeepseekV3ForCausalLM:
+    """Functional model: holds config + backend, operates on param pytrees."""
+
+    config_class = DeepseekV3Config
+    hf_architectures = ("DeepseekV3ForCausalLM", "DeepseekV2ForCausalLM")
+
+    def __init__(self, config: DeepseekV3Config, backend: BackendConfig | None = None):
+        self.config = config
+        self.backend = backend or BackendConfig()
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> dict:
+        return init_params(self.config, key, dtype)
+
+    def logical_axes(self) -> dict:
+        return logical_axes(self.config)
+
+    def abstract_params(self, dtype=jnp.bfloat16) -> dict:
+        return jax.eval_shape(lambda k: self.init(k, dtype), jax.random.key(0))
+
+    def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
+                 rules=None, return_hidden=False, training=True):
+        return forward(
+            self.config, self.backend, params, input_ids,
+            positions=positions, segment_ids=segment_ids, token_mask=token_mask,
+            rules=rules, return_hidden=return_hidden, training=training,
+        )
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.deepseek_v3.state_dict_adapter import DeepseekV3StateDictAdapter
+
+        return DeepseekV3StateDictAdapter(self.config)
+
+    @classmethod
+    def from_config(cls, config, backend: BackendConfig | None = None):
+        if isinstance(config, dict):
+            config = DeepseekV3Config.from_hf(config)
+        return cls(config, backend)

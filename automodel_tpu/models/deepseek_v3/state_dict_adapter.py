@@ -1,0 +1,93 @@
+"""DeepSeek-V3 HF key/layout mapping (reference models/deepseek_v3/state_dict_adapter.py).
+
+Per-expert HF tensors merge into expert-stacked gate_up/down arrays; the gate's
+``e_score_correction_bias`` maps to our fp32 ``score_correction_bias``; MLA projections
+transpose into latent-major layouts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.deepseek_v3.model import DeepseekV3Config
+from automodel_tpu.models.llama.state_dict_adapter import _o_in, _o_out, _proj_in, _proj_out, _t
+from automodel_tpu.models.qwen3_moe.state_dict_adapter import moe_expert_entries
+
+__all__ = ["DeepseekV3StateDictAdapter"]
+
+
+def _mla_entries(cfg: DeepseekV3Config, ours_prefix: str, layer_range) -> list[Entry]:
+    n = cfg.num_attention_heads
+    pre = "model.layers.{i}"
+    entries = [
+        Entry(f"{pre}.input_layernorm.weight", f"{ours_prefix}.attn_norm", layer_range=layer_range),
+        Entry(f"{pre}.post_attention_layernorm.weight", f"{ours_prefix}.mlp_norm", layer_range=layer_range),
+        Entry(f"{pre}.self_attn.kv_a_proj_with_mqa.weight", f"{ours_prefix}.wkv_a", _t, _t, layer_range=layer_range),
+        Entry(f"{pre}.self_attn.kv_a_layernorm.weight", f"{ours_prefix}.kv_a_norm", layer_range=layer_range),
+        Entry(
+            f"{pre}.self_attn.kv_b_proj.weight", f"{ours_prefix}.wkv_b",
+            _proj_in(n, cfg.qk_nope_head_dim + cfg.v_head_dim),
+            _proj_out(n, cfg.qk_nope_head_dim + cfg.v_head_dim),
+            layer_range=layer_range,
+        ),
+        Entry(
+            f"{pre}.self_attn.o_proj.weight", f"{ours_prefix}.wo",
+            _o_in(n, cfg.v_head_dim), _o_out(n, cfg.v_head_dim), layer_range=layer_range,
+        ),
+    ]
+    if cfg.q_lora_rank is None:
+        entries.append(Entry(
+            f"{pre}.self_attn.q_proj.weight", f"{ours_prefix}.wq",
+            _proj_in(n, cfg.qk_head_dim), _proj_out(n, cfg.qk_head_dim), layer_range=layer_range,
+        ))
+    else:
+        entries += [
+            Entry(f"{pre}.self_attn.q_a_proj.weight", f"{ours_prefix}.wq_a", _t, _t, layer_range=layer_range),
+            Entry(f"{pre}.self_attn.q_a_layernorm.weight", f"{ours_prefix}.q_a_norm", layer_range=layer_range),
+            Entry(
+                f"{pre}.self_attn.q_b_proj.weight", f"{ours_prefix}.wq_b",
+                _proj_in(n, cfg.qk_head_dim), _proj_out(n, cfg.qk_head_dim), layer_range=layer_range,
+            ),
+        ]
+    return entries
+
+
+class DeepseekV3StateDictAdapter(MappingAdapter):
+    def __init__(self, cfg: DeepseekV3Config, scan_layers: bool = True):
+        kd = cfg.first_k_dense_replace
+        L = cfg.num_hidden_layers
+        moe_range = (kd, L)
+        pre = "model.layers.{i}"
+        entries = [
+            Entry("model.embed_tokens.weight", "embed"),
+            Entry("model.norm.weight", "final_norm"),
+            *_mla_entries(cfg, "moe_layers", moe_range),
+            Entry(f"{pre}.mlp.gate.weight", "moe_layers.moe.gate.weight", layer_range=moe_range),
+            Entry(
+                f"{pre}.mlp.gate.e_score_correction_bias",
+                "moe_layers.moe.gate.score_correction_bias",
+                lambda b: b.astype(np.float32),  # routing bias must stay fp32
+                optional=True, keep_dtype=True, layer_range=moe_range,
+            ),
+            *moe_expert_entries(f"{pre}.mlp", "moe_layers.moe", layer_range=moe_range),
+        ]
+        if cfg.moe.n_shared_experts > 0:
+            entries += [
+                Entry(f"{pre}.mlp.shared_experts.gate_proj.weight",
+                      "moe_layers.moe.shared_experts.w_gate", _t, _t, layer_range=moe_range),
+                Entry(f"{pre}.mlp.shared_experts.up_proj.weight",
+                      "moe_layers.moe.shared_experts.w_up", _t, _t, layer_range=moe_range),
+                Entry(f"{pre}.mlp.shared_experts.down_proj.weight",
+                      "moe_layers.moe.shared_experts.w_down", _t, _t, layer_range=moe_range),
+            ]
+        if kd > 0:
+            entries += [
+                *_mla_entries(cfg, "dense_layers", (0, kd)),
+                Entry(f"{pre}.mlp.gate_proj.weight", "dense_layers.w_gate", _t, _t, layer_range=(0, kd)),
+                Entry(f"{pre}.mlp.up_proj.weight", "dense_layers.w_up", _t, _t, layer_range=(0, kd)),
+                Entry(f"{pre}.mlp.down_proj.weight", "dense_layers.w_down", _t, _t, layer_range=(0, kd)),
+            ]
+        if not cfg.tie_word_embeddings:
+            entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
+        super().__init__(entries, L, scan_layers, num_experts=cfg.moe.n_routed_experts)

@@ -1,0 +1,3 @@
+from automodel_tpu.models.gpt_oss.model import GptOssConfig, GptOssForCausalLM
+
+__all__ = ["GptOssConfig", "GptOssForCausalLM"]

@@ -1,0 +1,106 @@
+"""GPT-OSS family — TPU-native (reference models/gpt_oss/model.py).
+
+All-MoE decoder with attention sinks (per-head logit column), alternating
+sliding/full attention layers, attention + expert biases, quick_geglu experts
+(clamped x*sigmoid(1.702x) gate with +1 up offset), softmax-after-topk routing,
+YaRN rope.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.common.moe_transformer import (
+    MoEDecoderConfig,
+    init_moe_decoder_params,
+    moe_decoder_forward,
+    moe_decoder_logical_axes,
+)
+from automodel_tpu.moe.config import MoEConfig
+
+__all__ = ["GptOssConfig", "GptOssForCausalLM"]
+
+
+@dataclasses.dataclass
+class GptOssConfig(MoEDecoderConfig):
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "GptOssConfig":
+        moe = MoEConfig(
+            n_routed_experts=hf["num_local_experts"],
+            n_activated_experts=hf["num_experts_per_tok"],
+            dim=hf["hidden_size"],
+            moe_inter_dim=hf["intermediate_size"],
+            score_func="softmax",
+            norm_topk_prob=hf.get("norm_topk_prob", False),
+            aux_loss_coeff=hf.get("router_aux_loss_coef", 0.0),
+            router_bias=True,
+            expert_bias=True,
+            expert_activation="quick_geglu",
+            activation_alpha=1.702,
+            activation_limit=hf.get("swiglu_limit", 7.0),
+        )
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_hidden_layers=hf["num_hidden_layers"],
+            num_attention_heads=hf["num_attention_heads"],
+            num_key_value_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get("head_dim"),
+            max_position_embeddings=hf.get("max_position_embeddings", 4096),
+            rope_theta=hf.get("rope_theta", 150000.0),
+            rope_scaling=hf.get("rope_scaling"),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            attention_bias=hf.get("attention_bias", True),
+            attention_out_bias=hf.get("attention_bias", True),
+            attention_sinks=True,
+            sliding_window=hf.get("sliding_window"),
+            layer_types=hf.get("layer_types"),
+            initializer_range=hf.get("initializer_range", 0.02),
+            moe=moe,
+        )
+
+
+class GptOssForCausalLM:
+    """Functional model: holds config + backend, operates on param pytrees."""
+
+    config_class = GptOssConfig
+    hf_architectures = ("GptOssForCausalLM",)
+
+    def __init__(self, config: GptOssConfig, backend: BackendConfig | None = None):
+        self.config = config
+        self.backend = backend or BackendConfig()
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> dict:
+        return init_moe_decoder_params(self.config, key, dtype)
+
+    def logical_axes(self) -> dict:
+        return moe_decoder_logical_axes(self.config)
+
+    def abstract_params(self, dtype=jnp.bfloat16) -> dict:
+        return jax.eval_shape(lambda k: self.init(k, dtype), jax.random.key(0))
+
+    def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
+                 rules=None, return_hidden=False, training=True):
+        return moe_decoder_forward(
+            self.config, self.backend, params, input_ids,
+            positions=positions, segment_ids=segment_ids, token_mask=token_mask,
+            rules=rules, return_hidden=return_hidden, training=training,
+        )
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.gpt_oss.state_dict_adapter import GptOssStateDictAdapter
+
+        return GptOssStateDictAdapter(self.config)
+
+    @classmethod
+    def from_config(cls, config, backend: BackendConfig | None = None):
+        if isinstance(config, dict):
+            config = GptOssConfig.from_hf(config)
+        return cls(config, backend)

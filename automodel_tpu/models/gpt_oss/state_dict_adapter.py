@@ -1,0 +1,54 @@
+"""GPT-OSS HF key/layout mapping (reference models/gpt_oss/state_dict_adapter.py).
+
+HF stores experts pre-stacked — ``mlp.experts.gate_up_proj`` (E, D, 2I) with gate/up
+*interleaved* on the last dim (gate at even, up at odd columns, state_dict_adapter.py:171)
+— so expert entries here are plain per-layer tensors de-interleaved into our
+[gate | up] concat layout. The MXFP4 block-quantized release checkpoints
+(`*_blocks`/`*_scales`) are dequantized by the checkpoint loader before adaptation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from automodel_tpu.models.common.moe_transformer import MoEDecoderConfig
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.llama.state_dict_adapter import _t
+from automodel_tpu.models.qwen3_moe.state_dict_adapter import attention_entries
+
+__all__ = ["GptOssStateDictAdapter"]
+
+
+def _deinterleave(w: np.ndarray) -> np.ndarray:
+    """(..., 2I) interleaved -> (..., 2I) [gate | up] concat."""
+    return np.concatenate([w[..., 0::2], w[..., 1::2]], axis=-1)
+
+
+def _interleave(w: np.ndarray) -> np.ndarray:
+    inter = w.shape[-1] // 2
+    out = np.empty_like(w)
+    out[..., 0::2] = w[..., :inter]
+    out[..., 1::2] = w[..., inter:]
+    return out
+
+
+class GptOssStateDictAdapter(MappingAdapter):
+    def __init__(self, cfg: MoEDecoderConfig, scan_layers: bool = True):
+        pre = "model.layers.{i}"
+        entries = [
+            Entry("model.embed_tokens.weight", "embed"),
+            Entry("model.norm.weight", "final_norm"),
+            *attention_entries(cfg, "moe_layers"),
+            Entry(f"{pre}.mlp.router.weight", "moe_layers.moe.gate.weight"),
+            Entry(f"{pre}.mlp.router.bias", "moe_layers.moe.gate.bias"),
+            Entry(f"{pre}.mlp.experts.gate_up_proj", "moe_layers.moe.experts.gate_up_proj",
+                  _deinterleave, _interleave),
+            Entry(f"{pre}.mlp.experts.gate_up_proj_bias", "moe_layers.moe.experts.gate_up_bias",
+                  _deinterleave, _interleave),
+            Entry(f"{pre}.mlp.experts.down_proj", "moe_layers.moe.experts.down_proj"),
+            Entry(f"{pre}.mlp.experts.down_proj_bias", "moe_layers.moe.experts.down_bias"),
+        ]
+        if not cfg.tie_word_embeddings:
+            entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
+        super().__init__(entries, cfg.num_hidden_layers, scan_layers,
+                         num_experts=cfg.moe.n_routed_experts)

@@ -1,0 +1,3 @@
+from automodel_tpu.models.qwen3_moe.model import Qwen3MoeConfig, Qwen3MoeForCausalLM
+
+__all__ = ["Qwen3MoeConfig", "Qwen3MoeForCausalLM"]

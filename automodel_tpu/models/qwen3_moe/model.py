@@ -1,0 +1,111 @@
+"""Qwen3-MoE family — TPU-native (reference models/qwen3_moe/model.py).
+
+Qwen3 dense attention (qk_norm, head_dim override) + softmax-before-topk routing with
+optional top-k renorm, every layer MoE (decoder_sparse_step=1; sparse-step/mlp_only
+patterns other than a dense prefix are rejected — none of the released checkpoints use
+them). Also serves Qwen2-MoE-style configs without shared experts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.common.moe_transformer import (
+    MoEDecoderConfig,
+    init_moe_decoder_params,
+    moe_decoder_forward,
+    moe_decoder_logical_axes,
+)
+from automodel_tpu.moe.config import MoEConfig
+
+__all__ = ["Qwen3MoeConfig", "Qwen3MoeForCausalLM"]
+
+
+@dataclasses.dataclass
+class Qwen3MoeConfig(MoEDecoderConfig):
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "Qwen3MoeConfig":
+        n_layers = hf["num_hidden_layers"]
+        mlp_only = hf.get("mlp_only_layers") or []
+        sparse_step = hf.get("decoder_sparse_step", 1)
+        # Support dense-prefix patterns only (all released Qwen3-MoE ckpts are all-MoE).
+        moe_flags = [
+            (i not in mlp_only) and sparse_step > 0 and ((i + 1) % sparse_step == 0)
+            for i in range(n_layers)
+        ]
+        first_dense = moe_flags.index(True) if any(moe_flags) else n_layers
+        if not all(moe_flags[first_dense:]):
+            raise NotImplementedError("non-prefix dense/MoE interleavings are not supported")
+        moe = MoEConfig(
+            n_routed_experts=hf["num_experts"],
+            n_activated_experts=hf["num_experts_per_tok"],
+            dim=hf["hidden_size"],
+            moe_inter_dim=hf["moe_intermediate_size"],
+            score_func="softmax",
+            softmax_before_topk=True,
+            norm_topk_prob=hf.get("norm_topk_prob", False),
+            aux_loss_coeff=hf.get("router_aux_loss_coef", 0.0),
+        )
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_hidden_layers=n_layers,
+            num_attention_heads=hf["num_attention_heads"],
+            num_key_value_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get("head_dim"),
+            max_position_embeddings=hf.get("max_position_embeddings", 4096),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rope_scaling=hf.get("rope_scaling"),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            attention_bias=hf.get("attention_bias", False),
+            qk_norm=True,
+            initializer_range=hf.get("initializer_range", 0.02),
+            moe=moe,
+            first_k_dense_replace=first_dense,
+        )
+
+
+class Qwen3MoeForCausalLM:
+    """Functional model: holds config + backend, operates on param pytrees."""
+
+    config_class = Qwen3MoeConfig
+    hf_architectures = ("Qwen3MoeForCausalLM",)
+
+    def __init__(self, config: Qwen3MoeConfig, backend: BackendConfig | None = None):
+        self.config = config
+        self.backend = backend or BackendConfig()
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> dict:
+        return init_moe_decoder_params(self.config, key, dtype)
+
+    def logical_axes(self) -> dict:
+        return moe_decoder_logical_axes(self.config)
+
+    def abstract_params(self, dtype=jnp.bfloat16) -> dict:
+        return jax.eval_shape(lambda k: self.init(k, dtype), jax.random.key(0))
+
+    def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
+                 rules=None, return_hidden=False, training=True):
+        return moe_decoder_forward(
+            self.config, self.backend, params, input_ids,
+            positions=positions, segment_ids=segment_ids, token_mask=token_mask,
+            rules=rules, return_hidden=return_hidden, training=training,
+        )
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.qwen3_moe.state_dict_adapter import Qwen3MoeStateDictAdapter
+
+        return Qwen3MoeStateDictAdapter(self.config)
+
+    @classmethod
+    def from_config(cls, config, backend: BackendConfig | None = None):
+        if isinstance(config, dict):
+            config = Qwen3MoeConfig.from_hf(config)
+        return cls(config, backend)

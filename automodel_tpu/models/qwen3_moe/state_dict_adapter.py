@@ -1,0 +1,107 @@
+"""Qwen3-MoE HF key/layout mapping (reference models/qwen3_moe/state_dict_adapter.py).
+
+HF stores one tensor per expert (``mlp.experts.{e}.gate_proj.weight`` etc.); ours are
+expert-stacked with gate|up merged: gate_up_proj (L, E, D, 2I), down_proj (L, E, I, D).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.llama.state_dict_adapter import (
+    _bias_in,
+    _bias_out,
+    _o_in,
+    _o_out,
+    _proj_in,
+    _proj_out,
+    _t,
+)
+from automodel_tpu.models.common.moe_transformer import MoEDecoderConfig
+
+__all__ = ["Qwen3MoeStateDictAdapter", "moe_expert_entries", "attention_entries"]
+
+
+def _gate_up_in(gate: np.ndarray, up: np.ndarray) -> np.ndarray:
+    """HF gate (I, D) + up (I, D) -> ours (D, 2I) with [gate | up] concat."""
+    return np.concatenate([gate.T, up.T], axis=-1)
+
+
+def _gate_up_out(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    inter = w.shape[1] // 2
+    return np.ascontiguousarray(w[:, :inter].T), np.ascontiguousarray(w[:, inter:].T)
+
+
+def moe_expert_entries(prefix: str, ours_prefix: str, layer_range=None) -> list[Entry]:
+    """Per-expert gate/up/down HF tensors -> stacked gate_up/down (DSv3/Qwen3-MoE style)."""
+    return [
+        Entry(
+            (f"{prefix}.experts.{{e}}.gate_proj.weight", f"{prefix}.experts.{{e}}.up_proj.weight"),
+            f"{ours_prefix}.experts.gate_up_proj",
+            _gate_up_in,
+            _gate_up_out,
+            layer_range=layer_range,
+        ),
+        Entry(
+            f"{prefix}.experts.{{e}}.down_proj.weight",
+            f"{ours_prefix}.experts.down_proj",
+            _t,
+            _t,
+            layer_range=layer_range,
+        ),
+    ]
+
+
+def attention_entries(cfg, ours_prefix: str = "layers", layer_range=None) -> list[Entry]:
+    """GQA attention + norms, shared by every non-MLA family."""
+    n, k, h = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    pre = "model.layers.{i}"
+    entries = [
+        Entry(f"{pre}.input_layernorm.weight", f"{ours_prefix}.attn_norm", layer_range=layer_range),
+        Entry(f"{pre}.post_attention_layernorm.weight", f"{ours_prefix}.mlp_norm", layer_range=layer_range),
+        Entry(f"{pre}.self_attn.q_proj.weight", f"{ours_prefix}.wq", _proj_in(n, h), _proj_out(n, h), layer_range=layer_range),
+        Entry(f"{pre}.self_attn.k_proj.weight", f"{ours_prefix}.wk", _proj_in(k, h), _proj_out(k, h), layer_range=layer_range),
+        Entry(f"{pre}.self_attn.v_proj.weight", f"{ours_prefix}.wv", _proj_in(k, h), _proj_out(k, h), layer_range=layer_range),
+        Entry(f"{pre}.self_attn.o_proj.weight", f"{ours_prefix}.wo", _o_in(n, h), _o_out(n, h), layer_range=layer_range),
+    ]
+    if cfg.attention_bias:
+        entries += [
+            Entry(f"{pre}.self_attn.q_proj.bias", f"{ours_prefix}.bq", _bias_in(n, h), _bias_out(n, h), layer_range=layer_range),
+            Entry(f"{pre}.self_attn.k_proj.bias", f"{ours_prefix}.bk", _bias_in(k, h), _bias_out(k, h), layer_range=layer_range),
+            Entry(f"{pre}.self_attn.v_proj.bias", f"{ours_prefix}.bv", _bias_in(k, h), _bias_out(k, h), layer_range=layer_range),
+        ]
+    if getattr(cfg, "attention_out_bias", False):
+        entries.append(Entry(f"{pre}.self_attn.o_proj.bias", f"{ours_prefix}.bo", layer_range=layer_range))
+    if getattr(cfg, "attention_sinks", False):
+        entries.append(Entry(f"{pre}.self_attn.sinks", f"{ours_prefix}.sinks", layer_range=layer_range))
+    if cfg.qk_norm:
+        entries += [
+            Entry(f"{pre}.self_attn.q_norm.weight", f"{ours_prefix}.q_norm", layer_range=layer_range),
+            Entry(f"{pre}.self_attn.k_norm.weight", f"{ours_prefix}.k_norm", layer_range=layer_range),
+        ]
+    return entries
+
+
+class Qwen3MoeStateDictAdapter(MappingAdapter):
+    def __init__(self, cfg: MoEDecoderConfig, scan_layers: bool = True):
+        k = cfg.first_k_dense_replace
+        L = cfg.num_hidden_layers
+        moe_range = (k, L)
+        entries = [
+            Entry("model.embed_tokens.weight", "embed"),
+            Entry("model.norm.weight", "final_norm"),
+            *attention_entries(cfg, "moe_layers", layer_range=moe_range),
+            Entry("model.layers.{i}.mlp.gate.weight", "moe_layers.moe.gate.weight", layer_range=moe_range),
+            *moe_expert_entries("model.layers.{i}.mlp", "moe_layers.moe", layer_range=moe_range),
+        ]
+        if k > 0:
+            entries += [
+                *attention_entries(cfg, "dense_layers", layer_range=(0, k)),
+                Entry("model.layers.{i}.mlp.gate_proj.weight", "dense_layers.w_gate", _t, _t, layer_range=(0, k)),
+                Entry("model.layers.{i}.mlp.up_proj.weight", "dense_layers.w_up", _t, _t, layer_range=(0, k)),
+                Entry("model.layers.{i}.mlp.down_proj.weight", "dense_layers.w_down", _t, _t, layer_range=(0, k)),
+            ]
+        if not cfg.tie_word_embeddings:
+            entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
+        super().__init__(entries, L, scan_layers, num_experts=cfg.moe.n_routed_experts)

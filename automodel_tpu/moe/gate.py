@@ -75,30 +75,31 @@ def route(
         scores = scores + gp["bias"].astype(jnp.float32)
 
     if cfg.score_func == "softmax":
-        if cfg.softmax_before_topk:
-            probs = jax.nn.softmax(scores, axis=-1)
-            original_scores = probs
-            weights, indices = jax.lax.top_k(probs, K)
-        else:
-            original_scores = scores
-            values, indices = jax.lax.top_k(scores, K)
-            weights = jax.nn.softmax(values, axis=-1)
+        original_scores = jax.nn.softmax(scores, axis=-1) if cfg.softmax_before_topk else scores
+        cand = original_scores
     else:  # sigmoid (DeepSeek-V3 noaux-tc)
         original_scores = jax.nn.sigmoid(scores)
         cand = original_scores
         if "score_correction_bias" in gp:
             cand = cand + gp["score_correction_bias"]
-        if cfg.n_expert_groups > 1:
-            grouped = cand.reshape(T, cfg.n_expert_groups, -1)
-            if "score_correction_bias" in gp:
-                group_scores = jax.lax.top_k(grouped, 2)[0].sum(-1)
-            else:
-                group_scores = grouped.max(-1)
-            top_groups = jax.lax.top_k(group_scores, cfg.n_limited_groups)[1]
-            group_mask = jnp.zeros((T, cfg.n_expert_groups), bool)
-            group_mask = group_mask.at[jnp.arange(T)[:, None], top_groups].set(True)
-            cand = jnp.where(group_mask[:, :, None], grouped, 0.0).reshape(T, E)
-        indices = jax.lax.top_k(cand, K)[1]
+
+    # Group-limited (device-limited) selection: DeepSeek-V3 noaux-tc and
+    # DeepSeek-V2 group_limited_greedy both mask all but the top n_limited_groups.
+    if cfg.n_expert_groups > 1:
+        grouped = cand.reshape(T, cfg.n_expert_groups, -1)
+        if "score_correction_bias" in gp:
+            group_scores = jax.lax.top_k(grouped, 2)[0].sum(-1)
+        else:
+            group_scores = grouped.max(-1)
+        top_groups = jax.lax.top_k(group_scores, cfg.n_limited_groups)[1]
+        group_mask = jnp.zeros((T, cfg.n_expert_groups), bool)
+        group_mask = group_mask.at[jnp.arange(T)[:, None], top_groups].set(True)
+        cand = jnp.where(group_mask[:, :, None], grouped, 0.0).reshape(T, E)
+
+    indices = jax.lax.top_k(cand, K)[1]
+    if cfg.score_func == "softmax" and not cfg.softmax_before_topk:
+        weights = jax.nn.softmax(jnp.take_along_axis(scores, indices, axis=-1), axis=-1)
+    else:
         weights = jnp.take_along_axis(original_scores, indices, axis=-1)
 
     if cfg.norm_topk_prob and K > 1:

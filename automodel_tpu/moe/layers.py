@@ -25,7 +25,21 @@ from automodel_tpu.moe.gate import (
     route,
 )
 
-__all__ = ["init_moe_params", "moe_logical_axes", "moe_forward"]
+__all__ = ["init_moe_params", "moe_logical_axes", "moe_forward", "cast_moe_compute_params"]
+
+
+def cast_moe_compute_params(moe_params: dict, dtype) -> dict:
+    """Cast MoE block params to the compute dtype, keeping the routing correction bias
+    fp32 (bf16 rounding flips expert selection, reference layers.py:262-266)."""
+    return {
+        sub: {
+            k: (v if sub == "gate" and k == "score_correction_bias" else v.astype(dtype))
+            for k, v in leaves.items()
+        }
+        if isinstance(leaves, dict)
+        else leaves.astype(dtype)
+        for sub, leaves in moe_params.items()
+    }
 
 
 def init_moe_params(cfg: MoEConfig, key: jax.Array, dtype=jnp.float32, init_std: float = 0.02) -> dict:

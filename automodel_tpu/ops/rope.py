@@ -13,7 +13,7 @@ from typing import Any
 
 import jax.numpy as jnp
 
-__all__ = ["rope_frequencies", "apply_rope"]
+__all__ = ["rope_frequencies", "apply_rope", "apply_rope_interleaved"]
 
 
 def rope_frequencies(
@@ -77,6 +77,25 @@ def rope_attention_scaling(rope_scaling: dict[str, Any] | None) -> float:
             return float(mscale)
         return 0.1 * math.log(factor) + 1.0 if factor > 1 else 1.0
     return 1.0
+
+
+def apply_rope_interleaved(
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    inv_freq: jnp.ndarray,
+    attention_scaling: float = 1.0,
+) -> jnp.ndarray:
+    """Complex-pair rope on ``x (batch, seq, heads, head_dim)``: consecutive element
+    pairs (x0,x1) rotate as x0*cos - x1*sin, x0*sin + x1*cos (DeepSeek MLA convention,
+    reference deepseek_v3/rope_utils.py apply_rotary_emb view_as_complex layout)."""
+    dtype = x.dtype
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (b, s, rot/2)
+    cos = (jnp.cos(angles) * attention_scaling)[:, :, None, :]  # (b, s, 1, rot/2)
+    sin = (jnp.sin(angles) * attention_scaling)[:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x0, x1 = xf[..., 0::2], xf[..., 1::2]
+    out = jnp.stack([x0 * cos - x1 * sin, x0 * sin + x1 * cos], axis=-1)
+    return out.reshape(x.shape).astype(dtype)
 
 
 def apply_rope(

@@ -58,7 +58,7 @@ from automodel_tpu.parallel.init import initialize_distributed
 from automodel_tpu.parallel.mesh import MeshContext, default_sharding_rules
 from automodel_tpu.training.rng import StatefulRNG
 from automodel_tpu.training.step_scheduler import StepScheduler
-from automodel_tpu.training.train_step import make_train_step
+from automodel_tpu.training.train_step import count_label_tokens, make_train_step
 
 logger = logging.getLogger(__name__)
 
@@ -127,6 +127,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
 
         # loss selection (reference build_loss_fn, train_ft.py:345)
         self.loss_name = cfg.get("loss.name", "masked_ce")
+        # MoE load-balance metric logging (reference MoEMetricsConfig, moe/config.py:72)
+        self.moe_metrics_mode = cfg.get(
+            "moe_metrics.mode", "brief" if self._moe_config is not None else None
+        )
+        if not cfg.get("moe_metrics.enabled", True):
+            self.moe_metrics_mode = None
 
         # checkpointing
         ck = (cfg.get("checkpoint") or ConfigNode()).to_dict()
@@ -208,24 +214,63 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             process_count=jax.process_count(),
         )
 
-    def _forward_loss(self, params, batch, num_label_tokens):
+    @property
+    def _moe_config(self):
+        return getattr(self.model.config, "moe", None)
+
+    def _forward_loss(self, params, batch, num_label_tokens, training=True):
+        kwargs = {}
+        if self._moe_config is not None:
+            # segment id 0 marks padding (sft_collate contract): pad tokens must not
+            # count for routing load, aux loss, or the gate-bias update
+            kwargs = {"token_mask": batch["segment_ids"] != 0, "training": training}
+        out = self.model(
+            params, batch["input_ids"], positions=batch["positions"],
+            segment_ids=batch["segment_ids"], rules=self.rules,
+            return_hidden=self.loss_name == "linear_ce", **kwargs,
+        )
+        out, stats = out if isinstance(out, tuple) else (out, None)
         if self.loss_name == "linear_ce":
-            hidden = self.model(
-                params, batch["input_ids"], positions=batch["positions"],
-                segment_ids=batch["segment_ids"], rules=self.rules, return_hidden=True,
-            )
             unembed = params.get("lm_head")
             if unembed is None:
                 unembed = params["embed"].T
-            return linear_cross_entropy(hidden, unembed, batch["labels"], num_label_tokens)
-        logits = self.model(
-            params, batch["input_ids"], positions=batch["positions"],
-            segment_ids=batch["segment_ids"], rules=self.rules,
-        )
-        return masked_cross_entropy(logits, batch["labels"], num_label_tokens)
+            loss = linear_cross_entropy(out, unembed, batch["labels"], num_label_tokens)
+        else:
+            loss = masked_cross_entropy(out, batch["labels"], num_label_tokens)
+        if stats is None:
+            return loss
+        aux = {"expert_load": stats["expert_load"]}
+        if stats["aux_loss"] is not None:
+            # reference scales aux by token count to undo 1/num_label_tokens grad
+            # normalization (layers.py:367-372 MoEAuxLossAutoScaler); additive across
+            # microbatches this weights each microbatch's aux by its token fraction
+            mb_tokens = count_label_tokens(batch["labels"]).astype(jnp.float32)
+            loss = loss + self._moe_config.aux_loss_coeff * stats["aux_loss"] * (
+                mb_tokens / num_label_tokens
+            )
+        return loss, aux
+
+    def _post_update(self):
+        """Gate-bias loss-free-balancing hook (reference update_moe_gate_bias,
+        train_ft.py:1341): pure param update from the accumulated expert load."""
+        moe = self._moe_config
+        if moe is None or moe.gate_bias_update_factor <= 0:
+            return None
+        from automodel_tpu.moe.gate import update_gate_bias
+
+        def post_update(params, aux):
+            gate = params["moe_layers"]["moe"]["gate"]
+            new_bias = jax.vmap(update_gate_bias, in_axes=(0, 0, None))(
+                gate["score_correction_bias"], aux["expert_load"], moe.gate_bias_update_factor
+            )
+            gate = dict(gate, score_correction_bias=new_bias)
+            moe_layers = dict(params["moe_layers"], moe=dict(params["moe_layers"]["moe"], gate=gate))
+            return dict(params, moe_layers=moe_layers)
+
+        return post_update
 
     def _build_train_step(self):
-        step = make_train_step(self._forward_loss, self.optimizer)
+        step = make_train_step(self._forward_loss, self.optimizer, post_update=self._post_update())
         return jax.jit(step, donate_argnums=(0, 1))
 
     def _maybe_resume(self):
@@ -274,6 +319,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     steps_since_log = 0
                     # global tokens per optimizer step (local slice x process count)
                     step_tokens = int(np.prod(stack["input_ids"].shape)) * jax.process_count()
+                    extra = {}
+                    if "expert_load" in metrics and self.moe_metrics_mode:
+                        from automodel_tpu.moe.metrics import compute_load_balance_metrics
+
+                        extra = compute_load_balance_metrics(
+                            np.asarray(metrics["expert_load"]), mode=self.moe_metrics_mode
+                        )
                     self.metric_logger.log(
                         step,
                         loss=loss,
@@ -283,6 +335,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                         step_time_s=round(dt, 4),
                         tps=round(step_tokens / dt, 1),
                         tps_per_chip=round(step_tokens / dt / jax.device_count(), 1),
+                        **extra,
                     )
                     logger.info(
                         "step %d | loss %.4f | gnorm %.3f | %.0f tok/s", step, loss, gnorm, step_tokens / dt
@@ -306,7 +359,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         if self._eval_step is None:
             from automodel_tpu.training.train_step import make_eval_step
 
-            self._eval_step = jax.jit(make_eval_step(self._forward_loss))
+            # training=False: no aux balance term in validation loss, pure CE
+            eval_loss = lambda p, b, n: self._forward_loss(p, b, n, training=False)
+            self._eval_step = jax.jit(make_eval_step(eval_loss))
         losses = []
         for batch in self.val_dataloader:
             n = int((batch["labels"] != -100).sum())

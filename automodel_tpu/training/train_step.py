@@ -34,13 +34,23 @@ def count_label_tokens(labels: jnp.ndarray, ignore_index: int = IGNORE_INDEX) ->
 def make_train_step(
     forward_loss: Callable[..., jnp.ndarray],
     optimizer: optax.GradientTransformation,
+    post_update: Callable[[dict, dict], dict] | None = None,
 ):
     """Build the accumulating train step.
 
-    ``forward_loss(params, batch, num_label_tokens)`` must return the *sum* CE over the
-    microbatch divided by ``num_label_tokens`` (the global count) — i.e. microbatch
-    losses are additive.
+    ``forward_loss(params, batch, num_label_tokens)`` must return either the scalar
+    *sum* CE over the microbatch divided by ``num_label_tokens`` (the global count) —
+    i.e. microbatch losses are additive — or ``(loss, aux_dict)`` where aux arrays
+    (e.g. MoE expert_load) accumulate by summation across microbatches.
+
+    ``post_update(params, aux_acc)`` runs after the optimizer step — the hook for
+    non-gradient param updates like the MoE gate-bias loss-free balancing (reference
+    update_moe_gate_bias, train_ft.py:1341).
     """
+
+    def _call(params, microbatch, num_label_tokens):
+        out = forward_loss(params, microbatch, num_label_tokens)
+        return out if isinstance(out, tuple) else (out, {})
 
     def train_step(params, opt_state, batch_stack):
         """batch_stack: pytree whose leaves are stacked (n_micro, ...) arrays."""
@@ -50,22 +60,31 @@ def make_train_step(
         num_label_tokens = count_label_tokens(batch_stack["labels"])
 
         def micro_step(carry, microbatch):
-            grads_acc, loss_acc = carry
-            loss, grads = jax.value_and_grad(forward_loss)(params, microbatch, num_label_tokens)
+            grads_acc, loss_acc, aux_acc = carry
+            (loss, aux), grads = jax.value_and_grad(_call, has_aux=True)(
+                params, microbatch, num_label_tokens
+            )
             grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
-            return (grads_acc, loss_acc + loss), None
+            aux_acc = jax.tree.map(jnp.add, aux_acc, aux)
+            return (grads_acc, loss_acc + loss, aux_acc), None
 
         zero_grads = jax.tree.map(jnp.zeros_like, params)
-        (grads, loss), _ = jax.lax.scan(
-            micro_step, (zero_grads, jnp.float32(0.0)), batch_stack
+        micro0 = jax.tree.map(lambda x: x[0], batch_stack)
+        aux_shapes = jax.eval_shape(_call, params, micro0, num_label_tokens)[1]
+        zero_aux = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), aux_shapes)
+        (grads, loss, aux), _ = jax.lax.scan(
+            micro_step, (zero_grads, jnp.float32(0.0), zero_aux), batch_stack
         )
         grad_norm = optax.global_norm(grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if post_update is not None:
+            params = post_update(params, aux)
         metrics = {
             "loss": loss,
             "grad_norm": grad_norm,
             "num_label_tokens": num_label_tokens,
+            **aux,
         }
         return params, opt_state, metrics
 
@@ -74,6 +93,7 @@ def make_train_step(
 
 def make_eval_step(forward_loss: Callable[..., jnp.ndarray]):
     def eval_step(params, batch, num_label_tokens):
-        return forward_loss(params, batch, num_label_tokens)
+        out = forward_loss(params, batch, num_label_tokens)
+        return out[0] if isinstance(out, tuple) else out
 
     return eval_step
