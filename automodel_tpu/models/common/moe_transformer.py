@@ -68,9 +68,26 @@ def _attn_only_shapes(cfg: MoEDecoderConfig) -> dict:
     return shapes
 
 
-def init_moe_decoder_params(cfg: MoEDecoderConfig, key: jax.Array, dtype=jnp.float32) -> dict:
+def init_moe_decoder_params(
+    cfg: MoEDecoderConfig,
+    key: jax.Array,
+    dtype=jnp.float32,
+    *,
+    attn_shapes: dict | None = None,  # family override (e.g. MLA projections)
+    dense_mlp_shapes: dict | None = None,
+) -> dict:
+    """Stacked params: [dense_layers] (attn + dense MLP) + moe_layers (attn + moe).
+
+    Families with non-GQA attention (DeepSeek MLA) pass their own per-layer
+    ``attn_shapes``; dense-prefix MLP weights default to w_gate/w_up/w_down.
+    """
     std = cfg.initializer_range
     k_embed, k_dense, k_moe_attn, k_moe, k_head = jax.random.split(key, 5)
+    if attn_shapes is None:
+        attn_shapes = _attn_only_shapes(cfg)
+    if dense_mlp_shapes is None:
+        d, i = cfg.hidden_size, cfg.intermediate_size
+        dense_mlp_shapes = {"w_gate": (d, i), "w_up": (d, i), "w_down": (i, d)}
 
     def init_layer_stack(shapes: dict, L: int, key) -> dict:
         keys = jax.random.split(key, len(shapes))
@@ -89,9 +106,11 @@ def init_moe_decoder_params(cfg: MoEDecoderConfig, key: jax.Array, dtype=jnp.flo
         "final_norm": jnp.ones((cfg.hidden_size,), dtype),
     }
     if cfg.first_k_dense_replace > 0:
-        params["dense_layers"] = init_layer_stack(_layer_shapes(cfg), cfg.first_k_dense_replace, k_dense)
+        params["dense_layers"] = init_layer_stack(
+            attn_shapes | dense_mlp_shapes, cfg.first_k_dense_replace, k_dense
+        )
     Lm = cfg.num_moe_layers
-    moe_layers = init_layer_stack(_attn_only_shapes(cfg), Lm, k_moe_attn)
+    moe_layers = init_layer_stack(attn_shapes, Lm, k_moe_attn)
     moe_layers["moe"] = jax.vmap(
         lambda k: init_moe_params(cfg.moe, k, dtype, std)
     )(jax.random.split(k_moe, Lm))
@@ -103,16 +122,29 @@ def init_moe_decoder_params(cfg: MoEDecoderConfig, key: jax.Array, dtype=jnp.flo
     return params
 
 
-def moe_decoder_logical_axes(cfg: MoEDecoderConfig) -> dict:
+_DENSE_MLP_AXES = {"w_gate": ("embed", "mlp"), "w_up": ("embed", "mlp"), "w_down": ("mlp", "embed")}
+
+
+def moe_decoder_logical_axes(
+    cfg: MoEDecoderConfig,
+    *,
+    attn_axes: dict | None = None,
+    attn_names: "list[str] | None" = None,
+) -> dict:
+    if attn_axes is None:
+        attn_axes = _LAYER_AXES
+    if attn_names is None:
+        attn_names = list(_attn_only_shapes(cfg))
     axes: dict = {
         "embed": ("vocab", "embed"),
         "final_norm": ("norm",),
     }
     if cfg.first_k_dense_replace > 0:
         axes["dense_layers"] = {
-            name: ("layers",) + _LAYER_AXES[name] for name in _layer_shapes(cfg)
+            name: ("layers",) + (attn_axes | _DENSE_MLP_AXES)[name]
+            for name in attn_names + list(_DENSE_MLP_AXES)
         }
-    moe_axes = {name: ("layers",) + _LAYER_AXES[name] for name in _attn_only_shapes(cfg)}
+    moe_axes = {name: ("layers",) + attn_axes[name] for name in attn_names}
     moe_axes["moe"] = jax.tree.map(
         lambda t: ("layers",) + t,
         moe_logical_axes(cfg.moe),

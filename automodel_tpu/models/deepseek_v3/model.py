@@ -21,9 +21,13 @@ import jax
 import jax.numpy as jnp
 
 from automodel_tpu.models.common.backend import BackendConfig
-from automodel_tpu.models.common.moe_transformer import moe_decoder_forward
+from automodel_tpu.models.common.moe_transformer import (
+    init_moe_decoder_params,
+    moe_decoder_forward,
+    moe_decoder_logical_axes,
+)
+from automodel_tpu.models.common.transformer import _constrain
 from automodel_tpu.moe.config import MoEConfig
-from automodel_tpu.moe.layers import init_moe_params, moe_logical_axes
 from automodel_tpu.ops.attention import dot_product_attention
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rope import apply_rope_interleaved, rope_frequencies
@@ -162,72 +166,14 @@ _MLA_AXES = {
     "wo": ("heads", "head_dim", "embed"),
 }
 
-_DENSE_MLP_SHAPES = lambda d, i: {"w_gate": (d, i), "w_up": (d, i), "w_down": (i, d)}  # noqa: E731
-_DENSE_MLP_AXES = {"w_gate": ("embed", "mlp"), "w_up": ("embed", "mlp"), "w_down": ("mlp", "embed")}
-
-
 def init_params(cfg: DeepseekV3Config, key: jax.Array, dtype=jnp.float32) -> dict:
-    std = cfg.initializer_range
-    k_embed, k_dense, k_attn, k_moe, k_head = jax.random.split(key, 5)
-
-    def stack(shapes: dict, L: int, key) -> dict:
-        keys = jax.random.split(key, len(shapes))
-        out = {}
-        for idx, (name, shape) in enumerate(shapes.items()):
-            if name.endswith("norm"):
-                out[name] = jnp.ones((L, *shape), dtype)
-            else:
-                out[name] = (jax.random.normal(keys[idx], (L, *shape), jnp.float32) * std).astype(dtype)
-        return out
-
-    params: dict = {
-        "embed": (jax.random.normal(k_embed, (cfg.vocab_size, cfg.hidden_size), jnp.float32) * std).astype(dtype),
-        "final_norm": jnp.ones((cfg.hidden_size,), dtype),
-    }
-    kd = cfg.first_k_dense_replace
-    if kd > 0:
-        params["dense_layers"] = stack(
-            _mla_shapes(cfg) | _DENSE_MLP_SHAPES(cfg.hidden_size, cfg.intermediate_size), kd, k_dense
-        )
-    Lm = cfg.num_moe_layers
-    moe_layers = stack(_mla_shapes(cfg), Lm, k_attn)
-    moe_layers["moe"] = jax.vmap(lambda k: init_moe_params(cfg.moe, k, dtype, std))(
-        jax.random.split(k_moe, Lm)
-    )
-    params["moe_layers"] = moe_layers
-    if not cfg.tie_word_embeddings:
-        params["lm_head"] = (
-            jax.random.normal(k_head, (cfg.hidden_size, cfg.vocab_size), jnp.float32) * std
-        ).astype(dtype)
-    return params
+    return init_moe_decoder_params(cfg, key, dtype, attn_shapes=_mla_shapes(cfg))
 
 
 def logical_axes(cfg: DeepseekV3Config) -> dict:
-    mla = {name: ("layers",) + _MLA_AXES[name] for name in _mla_shapes(cfg)}
-    axes: dict = {
-        "embed": ("vocab", "embed"),
-        "final_norm": ("norm",),
-    }
-    if cfg.first_k_dense_replace > 0:
-        axes["dense_layers"] = mla | {
-            name: ("layers",) + _DENSE_MLP_AXES[name] for name in _DENSE_MLP_AXES
-        }
-    moe_axes = dict(mla)
-    moe_axes["moe"] = jax.tree.map(
-        lambda t: ("layers",) + t,
-        moe_logical_axes(cfg.moe),
-        is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(e, (str, type(None))) for e in x),
+    return moe_decoder_logical_axes(
+        cfg, attn_axes=_MLA_AXES, attn_names=list(_mla_shapes(cfg))
     )
-    axes["moe_layers"] = moe_axes
-    if not cfg.tie_word_embeddings:
-        axes["lm_head"] = ("embed", "vocab")
-    return axes
-
-
-def _constrain(x, rules, names):
-    if rules is None or rules.mesh is None:
-        return x
-    return jax.lax.with_sharding_constraint(x, rules.sharding(names))
 
 
 def _mla_block(cfg: DeepseekV3Config, backend: BackendConfig, lp: dict, x, positions,

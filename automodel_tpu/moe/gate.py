@@ -75,7 +75,11 @@ def route(
         scores = scores + gp["bias"].astype(jnp.float32)
 
     if cfg.score_func == "softmax":
-        original_scores = jax.nn.softmax(scores, axis=-1) if cfg.softmax_before_topk else scores
+        # Selection and the aux loss always work on softmax *probabilities* (softmax is
+        # monotone, so top-k on probs == top-k on logits; raw logits as P_i would make
+        # the balance loss sign-indefinite, and a 0.0 group-mask fill could outrank
+        # negative logits). softmax_before_topk only changes how WEIGHTS are computed.
+        original_scores = jax.nn.softmax(scores, axis=-1)
         cand = original_scores
     else:  # sigmoid (DeepSeek-V3 noaux-tc)
         original_scores = jax.nn.sigmoid(scores)
@@ -98,6 +102,7 @@ def route(
 
     indices = jax.lax.top_k(cand, K)[1]
     if cfg.score_func == "softmax" and not cfg.softmax_before_topk:
+        # re-normalize over the selected k (gpt-oss / Mixtral convention)
         weights = jax.nn.softmax(jnp.take_along_axis(scores, indices, axis=-1), axis=-1)
     else:
         weights = jnp.take_along_axis(original_scores, indices, axis=-1)

@@ -92,20 +92,23 @@ def dot_product_attention(
         and sinks is None
         and positions_q is None  # flash path masks by absolute index, not positions
         and positions_kv is None
+        # kernel constraints: static window (a traced per-layer window can't close
+        # over a pallas kernel), uniform head_dim, block-divisible seq lengths
+        and isinstance(sliding_window, (int, type(None)))
+        and q.shape[-1] == v.shape[-1]
+        and q.shape[1] % min(128, q.shape[1]) == 0
+        and k.shape[1] % min(128, k.shape[1]) == 0
     ):
-        try:
-            from automodel_tpu.ops.pallas.flash_attention import flash_attention
-        except ImportError:
-            flash_attention = None
-        if flash_attention is not None:
-            return flash_attention(
-                q, k, v,
-                causal=causal,
-                segment_ids_q=segment_ids_q,
-                segment_ids_kv=segment_ids_kv,
-                sliding_window=sliding_window,
-                softmax_scale=softmax_scale,
-            )
+        from automodel_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(
+            q, k, v,
+            causal=causal,
+            segment_ids_q=segment_ids_q,
+            segment_ids_kv=segment_ids_kv,
+            sliding_window=sliding_window,
+            softmax_scale=softmax_scale,
+        )
 
     b, sq, nh, hd = q.shape
     _, skv, nkv, _ = k.shape

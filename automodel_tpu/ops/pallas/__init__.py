@@ -1,0 +1,2 @@
+"""Pallas TPU kernels: the hot-op layer (reference L3 kernel layer — TE fused
+attention, Triton CE/LoRA — rebuilt TPU-native per SURVEY.md §2.1)."""

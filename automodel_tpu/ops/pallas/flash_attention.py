@@ -1,0 +1,426 @@
+"""Blockwise flash attention for TPU (Pallas).
+
+Replaces the reference's TransformerEngine fused attention / flash-attn externals
+(components/attention/utils.py:25, models/common/utils.py:166-171) with a single
+Pallas kernel pair:
+
+- forward: online-softmax over kv blocks; (q, k, v) stream HBM->VMEM block by block,
+  the (block_q, head_dim) accumulator and row stats live in VMEM scratch across the
+  innermost kv grid steps. Emits logsumexp for the backward.
+- backward: recompute-based (flash-attention-2 style): one kernel accumulates dq over
+  kv blocks, one accumulates dk/dv over q blocks; D = rowsum(dO*O) precomputed in XLA.
+
+Masking is composable inside the kernel: causal, sliding window (static), and segment
+ids (sequence packing — the TPU replacement for the reference's THD varlen format,
+distributed/thd_utils.py). GQA reads each kv head once via grid index maps — kv is
+never materialized per q head in the forward.
+
+TPU layout notes: Mosaic requires the last two block dims to be (8k, 128k)-divisible,
+so per-row vectors ride in padded layouts (the same scheme as the in-tree
+jax.experimental.pallas.ops.tpu.flash_attention): q-oriented vectors (q segment ids,
+logsumexp, D) are broadcast across a trailing 128-lane dim; kv-oriented vectors
+(kv segment ids) across an 8-sublane dim.
+
+Layout contract: inputs are (batch, seq, heads, head_dim) like ops.attention; the
+wrapper folds (batch, heads) into the leading grid dim. Sequence lengths must divide
+the block sizes; callers fall back to the XLA path otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+NEG_INF = -1e30
+LANES = 128
+SUBLANES = 8
+
+
+def _block_mask(q_start, kv_start, block_q, block_k, *, causal, window, seg_q, seg_kv):
+    """(bq, bk) bool allowed-mask; seg_q is (bq, 1), seg_kv is (1, bk)."""
+    q_idx = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    kv_idx = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    allowed = None
+
+    def _and(a, b):
+        return b if a is None else jnp.logical_and(a, b)
+
+    if causal:
+        allowed = _and(allowed, q_idx >= kv_idx)
+    if window is not None:
+        allowed = _and(allowed, q_idx - kv_idx < window)
+    if seg_q is not None:
+        allowed = _and(allowed, seg_q == seg_kv)
+    return allowed
+
+
+def _run_block(q_start, kv_start, block_q, block_k, *, causal, window):
+    """Static/cheap predicate: does this (q block, kv block) pair do any work?"""
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= kv_start
+    if window is not None:
+        run = jnp.logical_and(run, q_start - (kv_start + block_k - 1) < window)
+    return run
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, window, block_q, block_k,
+                num_kv, segmented):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start, kv_start = qi * block_q, ki * block_k
+
+    @pl.when(_run_block(q_start, kv_start, block_q, block_k, causal=causal, window=window))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # (bq, d)
+        k = k_ref[0].astype(jnp.float32)  # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (bq, bk)
+
+        allowed = _block_mask(
+            q_start, kv_start, block_q, block_k, causal=causal, window=window,
+            seg_q=sq_ref[0, :, :1] if segmented else None,
+            seg_kv=skv_ref[0, :1, :] if segmented else None,
+        )
+        if allowed is not None:
+            s = jnp.where(allowed, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]  # (bq, 1)
+        m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if allowed is not None:
+            p = jnp.where(allowed, p, 0.0)  # fully-masked rows stay all-zero
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, :1] * alpha + p.sum(-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(
+            p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse = jnp.where(l == 0.0, NEG_INF, m_ref[:, :1] + jnp.log(safe_l))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, acc_ref, *, scale, causal, window, block_q, block_k, num_kv,
+               segmented):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start, kv_start = qi * block_q, ki * block_k
+
+    @pl.when(_run_block(q_start, kv_start, block_q, block_k, causal=causal, window=window))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        allowed = _block_mask(
+            q_start, kv_start, block_q, block_k, causal=causal, window=window,
+            seg_q=sq_ref[0, :, :1] if segmented else None,
+            seg_kv=skv_ref[0, :1, :] if segmented else None,
+        )
+        p = jnp.exp(s - lse_ref[0, :, :1])
+        if allowed is not None:
+            p = jnp.where(allowed, p, 0.0)
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, :, :1])
+        acc_ref[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, window,
+                block_q, block_k, num_q, segmented):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start, kv_start = qi * block_q, ki * block_k
+
+    @pl.when(_run_block(q_start, kv_start, block_q, block_k, causal=causal, window=window))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        allowed = _block_mask(
+            q_start, kv_start, block_q, block_k, causal=causal, window=window,
+            seg_q=sq_ref[0, :, :1] if segmented else None,
+            seg_kv=skv_ref[0, :1, :] if segmented else None,
+        )
+        p = jnp.exp(s - lse_ref[0, :, :1])
+        if allowed is not None:
+            p = jnp.where(allowed, p, 0.0)
+        do = do_ref[0].astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, :, :1])
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _q_lanes(x):
+    """(BN, S) -> (BN, S, LANES) broadcast along a 128-lane trailing dim."""
+    return jax.lax.broadcast_in_dim(x, (*x.shape, LANES), (0, 1))
+
+
+def _kv_sublanes(x):
+    """(BN, S) -> (BN, SUBLANES, S) broadcast along an 8-sublane dim."""
+    return jax.lax.broadcast_in_dim(x, (x.shape[0], SUBLANES, x.shape[1]), (0, 2))
+
+
+def _specs(bn_map, d, block_q, block_k, segmented):
+    """(q, k, v, seg_q, seg_kv) block specs; bn_map maps grid b -> kv row."""
+    return [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (bn_map(b), j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (bn_map(b), j, 0)),
+        pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)) if segmented else None,
+        pl.BlockSpec((1, SUBLANES, block_k), lambda b, i, j: (bn_map(b), 0, j)) if segmented else None,
+    ]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, seg_q, seg_kv, scale, causal, window,
+           block_q, block_k, groups, interpret):
+    o, _ = _flash_fwd_impl(q, k, v, seg_q, seg_kv, scale, causal, window,
+                           block_q, block_k, groups, interpret)
+    return o
+
+
+def _filter_specs(specs, args):
+    keep = [(s, a) for s, a in zip(specs, args) if a is not None]
+    return [s for s, _ in keep], [a for _, a in keep]
+
+
+def _flash_fwd_impl(q, k, v, seg_q, seg_kv, scale, causal, window,
+                    block_q, block_k, groups, interpret):
+    """q: (BN, Sq, D); k/v: (BK, Skv, D) with BN = BK * groups.
+    seg_q: (BN, Sq, LANES) or None; seg_kv: (BK, SUBLANES, Skv) or None."""
+    bn, sq, d = q.shape
+    _, skv, _ = k.shape
+    num_q, num_kv = sq // block_q, skv // block_k
+    segmented = seg_q is not None
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k, num_kv=num_kv, segmented=segmented,
+    )
+    specs, args = _filter_specs(
+        _specs(lambda b: b // groups, d, block_q, block_k, segmented),
+        [q, k, v, seg_q, seg_kv],
+    )
+    o, lse = pl.pallas_call(
+        kernel if segmented else (lambda q, k, v, o, l, *s: kernel(q, k, v, None, None, o, l, *s)),
+        grid=(bn, num_q, num_kv),
+        in_specs=specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bn, sq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+    return o, lse
+
+
+def _flash_fwd(q, k, v, seg_q, seg_kv, scale, causal, window,
+               block_q, block_k, groups, interpret):
+    o, lse = _flash_fwd_impl(q, k, v, seg_q, seg_kv, scale, causal, window,
+                             block_q, block_k, groups, interpret)
+    return o, (q, k, v, seg_q, seg_kv, o, lse)
+
+
+def _flash_bwd(scale, causal, window, block_q, block_k, groups, interpret,
+               residuals, do):
+    q, k, v, seg_q, seg_kv, o, lse = residuals
+    bn, sq, d = q.shape
+    bk_heads, skv, _ = k.shape
+    num_q, num_kv = sq // block_q, skv // block_k
+    segmented = seg_q is not None
+    delta = _q_lanes((o.astype(jnp.float32) * do.astype(jnp.float32)).sum(-1))
+
+    def row_specs(index_q):
+        # do / lse / delta blocks, all q-oriented
+        return [
+            pl.BlockSpec((1, block_q, d), index_q),
+            pl.BlockSpec((1, block_q, LANES), index_q),
+            pl.BlockSpec((1, block_q, LANES), index_q),
+        ]
+
+    dq_kernel = functools.partial(
+        _dq_kernel, scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k, num_kv=num_kv, segmented=segmented,
+    )
+    specs, args = _filter_specs(
+        _specs(lambda b: b // groups, d, block_q, block_k, segmented)
+        + row_specs(lambda b, i, j: (b, i, 0)),
+        [q, k, v, seg_q, seg_kv, do, lse, delta],
+    )
+    dq = pl.pallas_call(
+        dq_kernel if segmented else (
+            lambda q, k, v, do, l, dl, dq, a: dq_kernel(q, k, v, None, None, do, l, dl, dq, a)
+        ),
+        grid=(bn, num_q, num_kv),
+        in_specs=specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+
+    # dk/dv reduce over the GQA group; expand kv per q head, sum groups after.
+    kx = jnp.repeat(k, groups, axis=0) if groups > 1 else k
+    vx = jnp.repeat(v, groups, axis=0) if groups > 1 else v
+    skx = (
+        jnp.repeat(seg_kv, groups, axis=0)
+        if (segmented and groups > 1)
+        else seg_kv
+    )
+    dkv_kernel = functools.partial(
+        _dkv_kernel, scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k, num_q=num_q, segmented=segmented,
+    )
+    # grid order here is (bn, kv, q): q/do/lse/delta index with the LAST grid dim
+    qkv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)) if segmented else None,
+        pl.BlockSpec((1, SUBLANES, block_k), lambda b, j, i: (b, 0, j)) if segmented else None,
+    ]
+    specs, args = _filter_specs(
+        qkv_specs + row_specs(lambda b, j, i: (b, i, 0)),
+        [q, kx, vx, seg_q, skx, do, lse, delta],
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel if segmented else (
+            lambda q, k, v, do, l, dl, dk, dv, ka, va: dkv_kernel(
+                q, k, v, None, None, do, l, dl, dk, dv, ka, va
+            )
+        ),
+        grid=(bn, num_kv, num_q),
+        in_specs=specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(kx.shape, k.dtype),
+            jax.ShapeDtypeStruct(vx.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+    if groups > 1:
+        dk = dk.reshape(bk_heads, groups, skv, d).sum(1).astype(k.dtype)
+        dv = dv.reshape(bk_heads, groups, skv, d).sum(1).astype(v.dtype)
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,  # (B, Sq, N, D)
+    k: jnp.ndarray,  # (B, Skv, K, D)
+    v: jnp.ndarray,  # (B, Skv, K, D)
+    *,
+    causal: bool = True,
+    segment_ids_q: jnp.ndarray | None = None,  # (B, Sq)
+    segment_ids_kv: jnp.ndarray | None = None,  # (B, Skv)
+    sliding_window: int | None = None,
+    softmax_scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flash attention over (batch, seq, heads, head_dim); returns same shape as q."""
+    b, sq, n, d = q.shape
+    _, skv, nk, _ = k.shape
+    if softmax_scale is None:
+        softmax_scale = d**-0.5
+    groups = n // nk
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    if sq % block_q or skv % block_k:
+        raise ValueError(
+            f"flash_attention needs seq lengths divisible by block sizes: "
+            f"sq={sq}%{block_q}, skv={skv}%{block_k}"
+        )
+
+    # (B, S, H, D) -> (B*H, S, D); kv heads stay un-repeated (GQA via index maps)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * n, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * nk, skv, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * nk, skv, d)
+    seg_q = seg_kv = None
+    if segment_ids_q is not None or segment_ids_kv is not None:
+        sq_ids = segment_ids_q if segment_ids_q is not None else segment_ids_kv
+        skv_ids = segment_ids_kv if segment_ids_kv is not None else segment_ids_q
+        seg_q = _q_lanes(jnp.repeat(sq_ids.astype(jnp.int32), n, axis=0))
+        seg_kv = _kv_sublanes(jnp.repeat(skv_ids.astype(jnp.int32), nk, axis=0))
+
+    o = _flash(qf, kf, vf, seg_q, seg_kv, softmax_scale, causal,
+               sliding_window, block_q, block_k, groups, interpret)
+    return o.reshape(b, n, sq, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,153 @@
+"""Ring attention over the ``cp`` mesh axis — long-context context parallelism.
+
+TPU-native replacement for the reference's two CP mechanisms (SURVEY.md §5): torch
+DTensor experimental ``context_parallel`` ring SDPA (distributed/cp_utils.py:68) and
+TransformerEngine p2p ring attention (moe/parallelizer.py:267-285). Here: q/k/v arrive
+sequence-sharded over ``cp``; k/v (+ their positions/segment ids) rotate around the
+ring via ``lax.ppermute`` while each shard accumulates online-softmax partials in
+fp32. ppermute rides ICI neighbor links, and XLA overlaps the permute with the
+current chunk's attention math.
+
+Causality is enforced by *global* positions (each shard's token positions travel with
+it), so any seq-dim layout works — including the load-balanced interleave the
+reference gets from THD round-robin sharding (cp_utils.py:296-321). Differentiable
+end-to-end (ppermute has a transpose rule), so no custom VJP is needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ring_attention_local", "make_ring_attention"]
+
+NEG_INF = -1e30
+
+
+def _partial_attention(q, k, v, allowed, scale):
+    """Unnormalized blockwise attention; returns (acc, m, l) in fp32.
+
+    q (B, Sq, N, D); k/v (B, Sk, K, D); allowed (B, Sq, Sk) bool or None.
+    acc (B, K, G, Sq, D), m/l (B, K, G, Sq).
+    """
+    b, sq, n, d = q.shape
+    kh = k.shape[2]
+    g = n // kh
+    qf = q.astype(jnp.float32).reshape(b, sq, kh, g, d)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qf, k.astype(jnp.float32)) * scale
+    if allowed is not None:
+        logits = jnp.where(allowed[:, None, None], logits, NEG_INF)
+    m = logits.max(-1)  # (b, kh, g, sq)
+    p = jnp.exp(logits - m[..., None])
+    if allowed is not None:
+        # fully-masked rows would otherwise contribute exp(0)=1 per masked entry
+        p = jnp.where(allowed[:, None, None], p, 0.0)
+    l = p.sum(-1)
+    acc = jnp.einsum("bkgqs,bskd->bkgqd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def ring_attention_local(
+    q: jnp.ndarray,  # (B, Sq_local, N, D)
+    k: jnp.ndarray,  # (B, Skv_local, K, D)
+    v: jnp.ndarray,
+    positions_q: jnp.ndarray,  # (B, Sq_local) global positions
+    positions_kv: jnp.ndarray,  # (B, Skv_local)
+    segment_ids_q: jnp.ndarray | None = None,  # (B, Sq_local)
+    segment_ids_kv: jnp.ndarray | None = None,
+    *,
+    axis: str = "cp",
+    causal: bool = True,
+    sliding_window: int | None = None,
+    softmax_scale: float | None = None,
+) -> jnp.ndarray:
+    """The per-shard body — call inside shard_map manual over ``axis``."""
+    cp = jax.lax.axis_size(axis)
+    b, sq, n, d = q.shape
+    kh = k.shape[2]
+    g = n // kh
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+    perm = [(j, (j + 1) % cp) for j in range(cp)]
+
+    acc = jnp.zeros((b, kh, g, sq, d), jnp.float32)
+    m = jnp.full((b, kh, g, sq), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, kh, g, sq), jnp.float32)
+    kv = (k, v, positions_kv, segment_ids_kv)
+
+    for step in range(cp):
+        k_i, v_i, pos_kv, seg_kv = kv
+        allowed = None
+
+        def _and(a, b):
+            return b if a is None else jnp.logical_and(a, b)
+
+        if causal:
+            allowed = _and(allowed, positions_q[:, :, None] >= pos_kv[:, None, :])
+        if sliding_window is not None:
+            allowed = _and(
+                allowed, positions_q[:, :, None] - pos_kv[:, None, :] < sliding_window
+            )
+        if segment_ids_q is not None:
+            allowed = _and(
+                allowed, segment_ids_q[:, :, None] == seg_kv[:, None, :]
+            )
+
+        acc_i, m_i, l_i = _partial_attention(q, k_i, v_i, allowed, scale)
+        m_new = jnp.maximum(m, m_i)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_i - m_new)
+        acc = acc * alpha[..., None] + acc_i * beta[..., None]
+        l = l * alpha + l_i * beta
+        m = m_new
+
+        if step < cp - 1:
+            kv = jax.tree.map(
+                lambda x: jax.lax.ppermute(x, axis, perm) if x is not None else None,
+                kv, is_leaf=lambda x: x is None,
+            )
+
+    out = acc / jnp.where(l == 0.0, 1.0, l)[..., None]  # (b, kh, g, sq, d)
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, n, d).astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    *,
+    cp_axis: str = "cp",
+    causal: bool = True,
+    sliding_window: int | None = None,
+    softmax_scale: float | None = None,
+):
+    """Wrap :func:`ring_attention_local` in a partial-manual shard_map over ``cp``.
+
+    Inputs are global arrays with the seq dim sharded over ``cp`` (other axes stay
+    GSPMD-managed). Returns ``fn(q, k, v, positions, segment_ids=None) -> out``.
+    """
+
+    def fn(q, k, v, positions, segment_ids=None):
+        seq_spec = P(None, cp_axis)
+
+        def body(q, k, v, positions, segment_ids):
+            return ring_attention_local(
+                q, k, v, positions, positions,
+                segment_ids, segment_ids,
+                axis=cp_axis, causal=causal,
+                sliding_window=sliding_window, softmax_scale=softmax_scale,
+            )
+
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                P(None, cp_axis, None, None),
+                P(None, cp_axis, None, None),
+                P(None, cp_axis, None, None),
+                seq_spec,
+                None if segment_ids is None else seq_spec,
+            ),
+            out_specs=P(None, cp_axis, None, None),
+            axis_names={cp_axis},
+        )(q, k, v, positions, segment_ids)
+
+    return fn
