@@ -34,6 +34,8 @@ __all__ = [
     "init_dense_decoder_params",
     "dense_decoder_logical_axes",
     "decoder_forward",
+    "make_layer_body",
+    "apply_layer_stack",
 ]
 
 
@@ -216,6 +218,59 @@ def _mlp_block(lp: dict, x, rules):
     return jnp.einsum("bsi,id->bsd", act, lp["w_down"])
 
 
+def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None):
+    """Scan body over a carried state dict {"h", "positions", ["segment_ids"]}.
+
+    The state-dict form lets the same body serve decoder_forward's layer scan and
+    the pp pipeline (parallel/pipeline.py), where positions/segment ids ride along
+    with the activation between stages.
+    """
+    dtype = backend.jnp_dtype
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    attn_scale = rope_attention_scaling(cfg.rope_scaling)
+    any_sliding = any(cfg.sliding_flags)
+    # wider than any causal q-kv distance -> mask disabled
+    big_window = jnp.int32(2 * cfg.max_position_embeddings)
+    window = jnp.int32(cfg.sliding_window or 0)
+
+    def layer_fn(state, layer_inputs):
+        lp, is_sliding = layer_inputs
+        lp = jax.tree.map(lambda a: a.astype(dtype), lp)
+        h = state["h"]
+        # traced per-layer window (scan-compatible); None disables the mask entirely
+        eff_window = jnp.where(is_sliding > 0, window, big_window) if any_sliding else None
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        h = h + _attention_block(cfg, backend, lp, x, state["positions"],
+                                 state.get("segment_ids"),
+                                 inv_freq, attn_scale, eff_window, rules)
+        h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = h + _mlp_block(lp, x, rules)
+        h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+        return dict(state, h=h), None
+
+    return layer_fn
+
+
+def apply_layer_stack(
+    cfg: DenseDecoderConfig,
+    backend: BackendConfig,
+    lp_stack,  # pytree of (L, ...) stacked layer params
+    sliding_flags: jnp.ndarray,  # (L,) int32
+    state: dict,  # {"h": (B,S,D), "positions": (B,S), ["segment_ids": (B,S)]}
+    rules=None,
+) -> dict:
+    body = backend.layer_remat(make_layer_body(cfg, backend, rules))
+    if backend.scan_layers:
+        state, _ = jax.lax.scan(body, state, (lp_stack, sliding_flags))
+    else:
+        num_layers = jax.tree.leaves(lp_stack)[0].shape[0]
+        for i in range(num_layers):
+            lp = jax.tree.map(lambda a: a[i], lp_stack)
+            state, _ = body(state, (lp, sliding_flags[i]))
+    return state
+
+
 def decoder_forward(
     cfg: DenseDecoderConfig,
     backend: BackendConfig,
@@ -232,37 +287,13 @@ def decoder_forward(
     dtype = backend.jnp_dtype
     h = params["embed"].astype(dtype)[input_ids]
     h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
-    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
-    attn_scale = rope_attention_scaling(cfg.rope_scaling)
 
+    state = {"h": h, "positions": positions}
+    if segment_ids is not None:
+        state["segment_ids"] = segment_ids
     sliding_flags = jnp.asarray(cfg.sliding_flags, dtype=jnp.int32)
-    big_window = jnp.int32(cfg.max_position_embeddings + input_ids.shape[1])
-    window = jnp.int32(cfg.sliding_window or 0)
-
-    any_sliding = any(cfg.sliding_flags)
-
-    def layer_fn(h, layer_inputs):
-        lp, is_sliding = layer_inputs
-        lp = jax.tree.map(lambda a: a.astype(dtype), lp)
-        # traced per-layer window (scan-compatible); None disables the mask entirely
-        eff_window = jnp.where(is_sliding > 0, window, big_window) if any_sliding else None
-        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-        h = h + _attention_block(cfg, backend, lp, x, positions, segment_ids,
-                                 inv_freq, attn_scale, eff_window, rules)
-        h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
-        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp_block(lp, x, rules)
-        h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
-        return h, None
-
-    if backend.scan_layers:
-        body = backend.layer_remat(layer_fn)
-        h, _ = jax.lax.scan(body, h, (params["layers"], sliding_flags))
-    else:
-        body = backend.layer_remat(layer_fn)
-        for i in range(cfg.num_hidden_layers):
-            lp = jax.tree.map(lambda a: a[i], params["layers"])
-            h, _ = body(h, (lp, sliding_flags[i]))
+    state = apply_layer_stack(cfg, backend, params["layers"], sliding_flags, state, rules)
+    h = state["h"]
 
     h = rms_norm(h, params["final_norm"].astype(dtype), cfg.rms_norm_eps)
     if return_hidden:

@@ -253,6 +253,8 @@ def default_sharding_rules(
         (MeshAxis.CP,) if fsdp_over_cp else ()
     )
     rules: dict[str, str | tuple[str, ...] | None] = {
+        # stacked layer dim -> pp: stage slicing is just a sharding (parallel/pipeline.py)
+        "layers": MeshAxis.PP,
         "batch": MeshAxis.DATA,
         "act_seq": (MeshAxis.CP, MeshAxis.TP) if sequence_parallel else (MeshAxis.CP,),
         "act_attn_seq": MeshAxis.CP,

@@ -1,0 +1,186 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (SPMD collective pipelining).
+
+TPU-native replacement for torch.distributed.pipelining (reference AutoPipeline,
+distributed/pipelining/autopipeline.py:46 + functional.py:289,490): instead of
+FQN-slicing a module tree into per-rank stage graphs with explicit P2P send/recv and a
+hand-built 1F1B schedule, the layer-stacked param layout makes stage slicing a
+*sharding*: layer dim -> ``pp`` axis. Every rank runs the same jitted program; a
+``lax.scan`` over pipeline ticks moves activations stage->stage with ``ppermute``
+(neighbor ICI hops). Reverse-mode AD differentiates through the scan + ppermute,
+yielding the mirrored backward pipeline automatically — no schedule code, no shape
+inference, no stage graphs.
+
+Schedule: GPipe-style (all-forward then all-backward per optimizer step) with
+bubble fraction (pp-1)/(n_micro+pp-1); the reference's 1F1B/interleaved/zero-bubble
+schedules trade that bubble for explicit per-microbatch scheduling — a later
+optimization (interleaving = assigning non-contiguous layer blocks per rank, which
+this layout also supports by reshaping the layer dim).
+
+Composition: shard_map is manual over ``pp`` only; FSDP/TP shardings on other mesh
+axes stay GSPMD-managed inside (same partial-manual pattern as moe.dispatch).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipeline_spmd", "make_pipeline_forward"]
+
+
+def pipeline_spmd(
+    stage_params,  # pytree; leaves (L_local, ...) — this rank's layer slice
+    x_stack,  # pytree; leaves (n_micro, ...) — stage-0 inputs (already embedded)
+    layer_apply: Callable,  # (stage_params, x) -> y; runs this rank's layers
+    *,
+    axis: str = "pp",
+):
+    """Run the pipeline; returns an x_stack-like pytree of outputs, valid on the
+    LAST stage (other ranks hold garbage — mask with axis_index == pp-1).
+
+    ``x_stack`` may be a pytree (e.g. {"h": ..., "positions": ..., "segment_ids":
+    ...}) — side inputs like positions ride along with the activation through the
+    ring so each stage sees its microbatch's metadata. Call inside shard_map manual
+    over ``axis``.
+    """
+    pp = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    leaves = jax.tree.leaves(x_stack)
+    n_micro = leaves[0].shape[0]
+    steps = n_micro + pp - 1
+    # stage s -> s+1; the wraparound edge (pp-1 -> 0) carries only garbage, which
+    # stage 0 immediately overwrites with fresh microbatch input.
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def tick(carry, t):
+        outputs, state = carry
+        mb = jnp.clip(t, 0, n_micro - 1)
+        feed = jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, mb, 0, keepdims=False), x_stack
+        )
+        x = jax.tree.map(lambda f, s: jnp.where(idx == 0, f, s), feed, state)
+        y = layer_apply(stage_params, x)
+        # last stage finishes microbatch t-(pp-1) at tick t; earlier ticks write
+        # garbage into slot 0 which the t = pp-1 tick overwrites (writes are in
+        # time order, so the final write per slot is the correct one)
+        out_slot = jnp.clip(t - (pp - 1), 0, n_micro - 1)
+        outputs = jax.tree.map(
+            lambda o, yl: jax.lax.dynamic_update_index_in_dim(o, yl, out_slot, 0),
+            outputs, y,
+        )
+        state = jax.tree.map(lambda yl: jax.lax.ppermute(yl, axis, perm), y)
+        return (outputs, state), None
+
+    # mark the carries pp-varying (the body's ppermute/axis_index make them so)
+    def _vary(x):
+        return jax.lax.pcast(x, (axis,), to="varying")
+
+    outputs = jax.tree.map(lambda a: _vary(jnp.zeros_like(a)), x_stack)
+    state = jax.tree.map(lambda a: _vary(jnp.zeros_like(a[0])), x_stack)
+    (outputs, _), _ = jax.lax.scan(tick, (outputs, state), jnp.arange(steps))
+    return outputs
+
+
+def make_pipeline_forward(
+    mesh: Mesh,
+    *,
+    pp_axis: str = "pp",
+    batch_axes: tuple[str, ...] = ("dp_replicate", "dp_shard", "ep"),
+):
+    """Wrap (embed, layer_apply, head_loss) into a pp-pipelined loss function.
+
+    Returns ``fn(params, batch_stack, embed_fn, layer_apply, head_loss_fn)`` where:
+      - ``embed_fn(params, microbatch) -> x`` (stage-0 work, cheap enough to run
+        everywhere: replicated compute beats a broadcast)
+      - ``layer_apply(stage_layer_params, x) -> y`` scans this rank's layer slice
+      - ``head_loss_fn(params, y, microbatch) -> scalar`` final-norm + head + loss
+        (additive across microbatches)
+
+    Layer params must be stacked (L, ...) with the layer dim sharded over ``pp``
+    (sharding rule "layers" -> pp); all other params replicated over pp.
+    """
+    pp = mesh.shape[pp_axis]
+
+    def fn(layer_params, other_params, batch_stack, embed_fn, layer_apply, head_loss_fn):
+        def body(layer_params, other_params, batch_stack):
+            x_stack = jax.vmap(
+                lambda mb: embed_fn(other_params, mb), in_axes=0
+            )(batch_stack)
+            outs = pipeline_spmd(
+                layer_params, x_stack, layer_apply, axis=pp_axis
+            )
+            is_last = jax.lax.axis_index(pp_axis) == pp - 1
+            losses = jax.vmap(
+                lambda y, mb: head_loss_fn(other_params, y, mb), in_axes=(0, 0)
+            )(outs, batch_stack)
+            loss = jnp.where(is_last, losses.sum(), 0.0)
+            return jax.lax.psum(loss, pp_axis)
+
+        layer_specs = jax.tree.map(lambda _: P(pp_axis), layer_params)
+        other_specs = jax.tree.map(lambda _: P(), other_params)
+        batch_specs = jax.tree.map(lambda _: P(), batch_stack)
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(layer_specs, other_specs, batch_specs),
+            out_specs=P(),
+            axis_names={pp_axis},
+        )(layer_params, other_params, batch_stack)
+
+    return fn
+
+
+def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "masked_ce"):
+    """Pipelined forward+loss for Llama-lineage models (the reference's PP covers HF
+    decoder LMs the same way: embed on first stage, head+loss on last,
+    recipes/llm/train_ft.py:1234-1242).
+
+    Returns ``forward_loss(params, batch_stack, num_label_tokens)`` where
+    ``batch_stack`` leaves are (n_micro, ...) — the pipeline consumes all
+    microbatches in one call (grad accum *is* the pipeline schedule).
+    """
+    from automodel_tpu.models.common.transformer import apply_layer_stack
+    from automodel_tpu.ops.losses import masked_cross_entropy
+    from automodel_tpu.ops.norms import rms_norm
+
+    cfg, backend = model.config, model.backend
+    dtype = backend.jnp_dtype
+    pipeline = make_pipeline_forward(mesh)
+
+    def embed_fn(other, mb):
+        h = other["embed"].astype(dtype)[mb["input_ids"]]
+        return {"h": h, "positions": mb["positions"], "segment_ids": mb["segment_ids"]}
+
+    # NB: no sharding-constraint rules inside the pp-manual region —
+    # with_sharding_constraint over the full mesh clashes with manual pp axes;
+    # GSPMD propagates dp/tp activation shardings from the params instead.
+    del rules
+
+    def layer_apply(stage, x):
+        lp, sliding = stage
+        return apply_layer_stack(cfg, backend, lp, sliding, x, None)
+
+    def head_loss(other, y, mb):
+        h = rms_norm(y["h"], other["final_norm"].astype(dtype), cfg.rms_norm_eps)
+        unembed = other.get("lm_head")
+        if unembed is None:
+            unembed = other["embed"].T
+        logits = jnp.einsum("bsd,dv->bsv", h, jnp.asarray(unembed).astype(dtype))
+        # additive (sum/num) microbatch losses, same contract as make_train_step
+        return masked_cross_entropy(logits, mb["labels"], 1.0)
+
+    if loss_name != "masked_ce":
+        raise NotImplementedError(f"pp loss {loss_name!r} (use masked_ce)")
+
+    def forward_loss(params, batch_stack, num_label_tokens):
+        sliding = jnp.asarray(cfg.sliding_flags, jnp.int32)
+        layer_params = (params["layers"], sliding)
+        other = {k: v for k, v in params.items() if k != "layers"}
+        total = pipeline(layer_params, other, batch_stack,
+                         embed_fn, layer_apply, head_loss)
+        return total / num_label_tokens
+
+    return forward_loss

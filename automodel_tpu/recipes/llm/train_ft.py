@@ -270,7 +270,18 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         return post_update
 
     def _build_train_step(self):
-        step = make_train_step(self._forward_loss, self.optimizer, post_update=self._post_update())
+        if self.mesh_ctx.pp > 1:
+            from automodel_tpu.parallel.pipeline import make_dense_decoder_pp_loss
+            from automodel_tpu.training.train_step import make_pp_train_step
+
+            if self._moe_config is not None:
+                raise NotImplementedError("pp + MoE composition is not wired yet")
+            pp_loss = make_dense_decoder_pp_loss(
+                self.model, self.mesh, self.rules, loss_name=self.loss_name
+            )
+            step = make_pp_train_step(pp_loss, self.optimizer)
+        else:
+            step = make_train_step(self._forward_loss, self.optimizer, post_update=self._post_update())
         return jax.jit(step, donate_argnums=(0, 1))
 
     def _maybe_resume(self):

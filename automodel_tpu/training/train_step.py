@@ -91,6 +91,32 @@ def make_train_step(
     return train_step
 
 
+def make_pp_train_step(
+    forward_loss: Callable[..., jnp.ndarray],
+    optimizer: optax.GradientTransformation,
+):
+    """Train step for pipeline parallelism: ``forward_loss`` consumes the WHOLE
+    (n_micro, ...) batch stack at once — microbatching happens inside the pipeline
+    schedule (parallel/pipeline.py), not an outer grad-accum scan (the reference's
+    PP path does the same: the schedule owns the microbatch loop,
+    recipes/llm/train_ft.py:1234)."""
+
+    def train_step(params, opt_state, batch_stack):
+        num_label_tokens = count_label_tokens(batch_stack["labels"])
+        loss, grads = jax.value_and_grad(forward_loss)(params, batch_stack, num_label_tokens)
+        grad_norm = optax.global_norm(grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "num_label_tokens": num_label_tokens,
+        }
+        return params, opt_state, metrics
+
+    return train_step
+
+
 def make_eval_step(forward_loss: Callable[..., jnp.ndarray]):
     def eval_step(params, batch, num_label_tokens):
         out = forward_loss(params, batch, num_label_tokens)
