@@ -229,14 +229,15 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     attn_scale = rope_attention_scaling(cfg.rope_scaling)
     any_sliding = any(cfg.sliding_flags)
-    # wider than any causal q-kv distance -> mask disabled
-    big_window = jnp.int32(2 * cfg.max_position_embeddings)
     window = jnp.int32(cfg.sliding_window or 0)
 
     def layer_fn(state, layer_inputs):
         lp, is_sliding = layer_inputs
         lp = jax.tree.map(lambda a: a.astype(dtype), lp)
         h = state["h"]
+        # "disabled" window must exceed every causal q-kv distance for the actual
+        # (static at trace time) sequence length, even when S > max_position_embeddings
+        big_window = jnp.int32(cfg.max_position_embeddings + h.shape[1])
         # traced per-layer window (scan-compatible); None disables the mask entirely
         eff_window = jnp.where(is_sliding > 0, window, big_window) if any_sliding else None
         x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)

@@ -84,15 +84,11 @@ def pipeline_spmd(
     return outputs
 
 
-def make_pipeline_forward(
-    mesh: Mesh,
-    *,
-    pp_axis: str = "pp",
-    batch_axes: tuple[str, ...] = ("dp_replicate", "dp_shard", "ep"),
-):
+def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp"):
     """Wrap (embed, layer_apply, head_loss) into a pp-pipelined loss function.
 
-    Returns ``fn(params, batch_stack, embed_fn, layer_apply, head_loss_fn)`` where:
+    Returns ``fn(layer_params, other_params, batch_stack, embed_fn, layer_apply,
+    head_loss_fn)`` where:
       - ``embed_fn(params, microbatch) -> x`` (stage-0 work, cheap enough to run
         everywhere: replicated compute beats a broadcast)
       - ``layer_apply(stage_layer_params, x) -> y`` scans this rank's layer slice
@@ -113,12 +109,25 @@ def make_pipeline_forward(
                 layer_params, x_stack, layer_apply, axis=pp_axis
             )
             is_last = jax.lax.axis_index(pp_axis) == pp - 1
-            losses = jax.vmap(
-                lambda y, mb: head_loss_fn(other_params, y, mb), in_axes=(0, 0)
-            )(outs, batch_stack)
+            # sequential over microbatches: only one microbatch's logits live at a
+            # time (vmap would materialize n_micro full logits tensors at once,
+            # forfeiting exactly the peak-memory win pipelining exists for)
+            losses = jax.lax.map(
+                lambda ymb: head_loss_fn(other_params, ymb[0], ymb[1]),
+                (outs, batch_stack),
+            )
             loss = jnp.where(is_last, losses.sum(), 0.0)
             return jax.lax.psum(loss, pp_axis)
 
+        # Replicate non-layer params (embed/head/final-norm) before entering the
+        # partial-manual region: a gather whose operand carries tp shardings trips
+        # XLA's SpmdPartitioner (ExpandDeviceGroupsWithIota check) when pp is
+        # manual. Embed/head tp-sharding inside the pp loop is a later optimization.
+        from jax.sharding import NamedSharding
+
+        other_params = jax.lax.with_sharding_constraint(
+            other_params, NamedSharding(mesh, P())
+        )
         layer_specs = jax.tree.map(lambda _: P(pp_axis), layer_params)
         other_specs = jax.tree.map(lambda _: P(), other_params)
         batch_specs = jax.tree.map(lambda _: P(), batch_stack)
