@@ -91,7 +91,11 @@ class Checkpointer:
         params: Any,
         opt_state: Any = None,
         client_states: Mapping[str, Any] | None = None,
+        hf_params: Any = None,
     ) -> str:
+        """``hf_params`` overrides what the consolidated HF export writes — used by
+        PEFT to export merged base+adapter weights while ``params`` stays
+        adapter-only (reference checkpoint/addons.py)."""
         if not self.config.enabled:
             return ""
         self.wait()  # finalize any in-flight async save (writes its latest symlink)
@@ -105,7 +109,7 @@ class Checkpointer:
                 json.dump({k: _jsonify(v.state_dict() if hasattr(v, "state_dict") else v)
                            for k, v in client_states.items()}, f)
         if self.config.save_consolidated and self.state_dict_adapter is not None:
-            self.save_hf(os.path.join(d, "hf"), params)
+            self.save_hf(os.path.join(d, "hf"), params if hf_params is None else hf_params)
         # async: the array write may still be in flight — defer the latest symlink
         # to wait() so a crash mid-write can't leave latest -> incomplete step
         self._pending = step

@@ -1,0 +1,17 @@
+from automodel_tpu.peft.lora import (
+    PeftConfig,
+    init_lora_params,
+    lora_logical_axes,
+    match_lora_paths,
+    merge_lora_params,
+    wildcard_match,
+)
+
+__all__ = [
+    "PeftConfig",
+    "init_lora_params",
+    "lora_logical_axes",
+    "match_lora_paths",
+    "merge_lora_params",
+    "wildcard_match",
+]

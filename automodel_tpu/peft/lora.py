@@ -1,0 +1,319 @@
+"""LoRA / DoRA for pure-pytree models (reference components/_peft/lora.py:42,76 and
+module_matcher.py ModuleMatcher).
+
+TPU-native design: instead of wrapping ``nn.Linear`` modules, LoRA is a *second param
+pytree* mirroring the subset of base weights it adapts — each matched leaf ``W``
+becomes ``{"lora_a": (*stack, fan_in, r), "lora_b": (*stack, r, fan_out)}``. The
+forward pass is unchanged: :func:`merge_lora_params` computes
+``W + (alpha/r) * A @ B`` inside jit, XLA fuses the rank-r update into the surrounding
+compute, and under a layer-``scan`` only one layer's delta is ever materialized.
+Freezing the base model is not a flag on modules but simply *which tree you
+differentiate*: the train step takes grads w.r.t. the LoRA tree only, so optimizer
+state is rank-r sized (the reference freezes via requires_grad, lora.py:335).
+
+Weights are matched by dot-joined pytree paths (``layers.wq``, ``moe_layers.moe.
+experts.gate_up_proj``) with the reference's wildcard semantics; HF-style module
+names (``q_proj`` …) are aliased so reference YAML recipes work verbatim.
+
+DoRA (use_dora): ``W' = m * (W + ΔW) / ||W + ΔW||_col`` with the magnitude vector
+``m`` initialized to column norms of ``W`` (reference lora.py:196-200).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "PeftConfig",
+    "wildcard_match",
+    "match_lora_paths",
+    "init_lora_params",
+    "lora_logical_axes",
+    "merge_lora_params",
+    "count_lora_params",
+]
+
+# Reference YAMLs name HF modules (q_proj, ...); map them onto our leaf names so
+# `target_modules: [q_proj, v_proj]` matches `layers.wq` / `layers.wv`.
+_HF_NAME_ALIASES = {
+    "q_proj": "wq",
+    "k_proj": "wk",
+    "v_proj": "wv",
+    "o_proj": "wo",
+    "gate_proj": "w_gate",
+    "up_proj": "w_up",
+    "down_proj": "w_down",
+    "linear_qkv": "wq|wk|wv",
+    "linear_proj": "wo",
+    "linear_fc1": "w_gate|w_up",
+    "linear_fc2": "w_down",
+}
+
+# Logical axes that stack independent weight matrices along a leading dim; LoRA
+# factors apply per stacked element (layer scan dim, expert dim).
+_STACK_AXES = ("layers", "expert")
+
+# Leaves that are never linear projections, whatever their shape.
+_NEVER_MATCH = ("embed",)
+
+
+@dataclasses.dataclass
+class PeftConfig:
+    """Reference PeftConfig (_peft/lora.py:42) minus torch-only knobs."""
+
+    target_modules: list[str] = dataclasses.field(
+        default_factory=lambda: ["*wq", "*wk", "*wv", "*wo", "*w_gate", "*w_up", "*w_down"]
+    )
+    exclude_modules: list[str] = dataclasses.field(default_factory=list)
+    match_all_linear: bool = False
+    dim: int = 8
+    alpha: int = 32
+    use_dora: bool = False
+    dropout: float = 0.0
+    lora_A_init: str = "xavier"  # "xavier" | "uniform" | "gaussian"
+    lora_dtype: str | None = None  # None = base-weight dtype
+
+    def __post_init__(self):
+        if isinstance(self.target_modules, str):
+            self.target_modules = [self.target_modules]
+        if isinstance(self.exclude_modules, str):
+            self.exclude_modules = [self.exclude_modules]
+        if self.dropout:
+            raise NotImplementedError(
+                "lora dropout is not supported in the merged-delta formulation; set dropout=0"
+            )
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.dim
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PeftConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def wildcard_match(pattern: str, key: str | None) -> bool | None:
+    """Reference wildcard_match (module_matcher.py): '*' spans any chars."""
+    if key is None:
+        return None
+    regex = re.compile("^" + re.escape(pattern).replace(r"\*", "(.*)") + "$")
+    return regex.match(key) is not None
+
+
+def _normalize_patterns(patterns: Sequence[str]) -> list[str]:
+    out = []
+    for p in patterns:
+        leafname = p.split(".")[-1]
+        alias = _HF_NAME_ALIASES.get(leafname)
+        if alias is not None:
+            prefix = p[: len(p) - len(leafname)]
+            out.extend(prefix + a for a in alias.split("|"))
+        else:
+            out.append(p)
+        # bare module names ("q_proj") mean "anywhere in the tree"
+    return [p if p.startswith("*") or "." in p else "*" + p for p in out]
+
+
+def _split_point(axes: Sequence[str | None]) -> int:
+    """Index separating fan-in dims from fan-out dims, after stack dims.
+
+    Projections out of the residual stream / rank bottlenecks contract their first
+    dim; attention-output projections contract (heads, head_dim).
+    """
+    return 2 if axes and axes[0] in ("heads", "kv_heads") else 1
+
+
+def _leaf_structure(path: str, axes: tuple) -> tuple[int, int] | None:
+    """(n_stack, split) for a LoRA-able leaf, or None if not a linear weight."""
+    name = path.split(".")[-1]
+    n_stack = 0
+    while n_stack < len(axes) and axes[n_stack] in _STACK_AXES:
+        n_stack += 1
+    body = axes[n_stack:]
+    if len(body) < 2:  # norms, sinks: not matrices
+        return None
+    if name in _NEVER_MATCH:
+        return None
+    if any(a == "norm" for a in body):
+        return None
+    split = _split_point(body)
+    if split >= len(body):
+        # no fan-out dims left: a (heads, head_dim)-shaped *bias* (bq/bk/bv), not a
+        # projection — the reference never adapts biases (module_matcher matches
+        # nn.Linear modules, whose bias rides along unadapted)
+        return None
+    return n_stack, n_stack + split
+
+
+def match_lora_paths(logical_axes: Any, cfg: PeftConfig) -> dict[str, tuple[int, int]]:
+    """Paths eligible for LoRA -> (n_stack_dims, split_index).
+
+    Matching is over dot-joined param paths with the reference's wildcard semantics;
+    ``match_all_linear`` matches every >=2D non-norm weight (reference
+    module_matcher.py _is_linear_module).
+    """
+    targets = _normalize_patterns(cfg.target_modules)
+    excludes = _normalize_patterns(cfg.exclude_modules)
+    flat = _flatten_axes(logical_axes)
+    matched: dict[str, tuple[int, int]] = {}
+    for path, axes in flat:
+        if axes is None:
+            continue
+        struct = _leaf_structure(path, axes)
+        if struct is None:
+            continue
+        if any(wildcard_match(p, path) for p in excludes):
+            continue
+        if cfg.match_all_linear or any(wildcard_match(p, path) for p in targets):
+            matched[path] = struct
+    return matched
+
+
+def _flatten_axes(axes_tree: Any, prefix: str = "") -> list[tuple[str, tuple | None]]:
+    out = []
+    if isinstance(axes_tree, dict):
+        for k, v in axes_tree.items():
+            out.extend(_flatten_axes(v, f"{prefix}{k}."))
+    else:
+        out.append((prefix[:-1], axes_tree))
+    return out
+
+
+def _get_path(tree: Any, path: str):
+    node = tree
+    for part in path.split("."):
+        node = node[part]
+    return node
+
+
+def _set_path(tree: dict, path: str, value: Any) -> dict:
+    """Functional nested-dict update (copies along the path only)."""
+    parts = path.split(".")
+    if len(parts) == 1:
+        return {**tree, parts[0]: value}
+    return {**tree, parts[0]: _set_path(tree[parts[0]], ".".join(parts[1:]), value)}
+
+
+def init_lora_params(
+    params: Any,
+    logical_axes: Any,
+    cfg: PeftConfig,
+    key: jax.Array,
+    dtype=None,
+) -> dict:
+    """Build the LoRA tree: nested dict of {"lora_a", "lora_b"[, "magnitude"]}.
+
+    A is init'd per ``lora_A_init`` (reference init_lora_A, lora.py), B is zeros so
+    step 0 is exactly the base model; DoRA magnitude starts at column norms of W.
+    Adapter dtype: explicit ``dtype`` arg > ``cfg.lora_dtype`` > each base weight's
+    own dtype (reference lora_dtype semantics, _peft/lora.py:53).
+    """
+    if dtype is None and cfg.lora_dtype is not None:
+        dtype = jnp.dtype(cfg.lora_dtype)
+    matched = match_lora_paths(logical_axes, cfg)
+    if not matched:
+        raise ValueError(
+            f"peft matched no params; target_modules={cfg.target_modules} "
+            f"available={list(p for p, _ in _flatten_axes(logical_axes))[:20]}..."
+        )
+    lora: dict = {}
+    keys = jax.random.split(key, len(matched))
+    for k_init, (path, (n_stack, split)) in zip(keys, sorted(matched.items())):
+        w = _get_path(params, path)
+        leaf_dtype = w.dtype if dtype is None else dtype
+        stack, fan_in, fan_out = (
+            w.shape[:n_stack],
+            math.prod(w.shape[n_stack:split]),
+            math.prod(w.shape[split:]),
+        )
+        r = cfg.dim
+        if cfg.lora_A_init == "xavier":
+            limit = math.sqrt(6.0 / (fan_in + r))
+            a = jax.random.uniform(k_init, (*stack, fan_in, r), jnp.float32, -limit, limit)
+        elif cfg.lora_A_init == "uniform":
+            limit = 1.0 / math.sqrt(fan_in)
+            a = jax.random.uniform(k_init, (*stack, fan_in, r), jnp.float32, -limit, limit)
+        else:  # gaussian
+            a = jax.random.normal(k_init, (*stack, fan_in, r), jnp.float32) / math.sqrt(fan_in)
+        leaf = {
+            "lora_a": a.astype(leaf_dtype),
+            "lora_b": jnp.zeros((*stack, r, fan_out), leaf_dtype),
+        }
+        if cfg.use_dora:
+            w2 = w.reshape(*stack, fan_in, fan_out).astype(jnp.float32)
+            leaf["magnitude"] = jnp.linalg.norm(w2, axis=-2).astype(leaf_dtype)  # (*stack, fan_out)
+        _insert_path(lora, path, leaf)
+    return lora
+
+
+def _insert_path(tree: dict, path: str, value: Any) -> dict:
+    parts = path.split(".")
+    node = tree
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+    node[parts[-1]] = value
+    return tree
+
+
+def lora_logical_axes(logical_axes: Any, cfg: PeftConfig) -> dict:
+    """Sharding axes for the LoRA tree: stack dims keep their axes (layers -> pp,
+    expert -> ep); the rank-r factors are tiny and stay replicated."""
+    matched = match_lora_paths(logical_axes, cfg)
+    out: dict = {}
+    for path, (n_stack, _split) in sorted(matched.items()):
+        axes = _get_path(logical_axes, path)
+        stack_axes = tuple(axes[:n_stack])
+        leaf = {
+            "lora_a": stack_axes + (None, None),
+            "lora_b": stack_axes + (None, None),
+        }
+        if cfg.use_dora:
+            leaf["magnitude"] = stack_axes + (None,)
+        _insert_path(out, path, leaf)
+    return out
+
+
+def merge_lora_params(params: Any, lora: Any, cfg: PeftConfig) -> Any:
+    """W -> W + (alpha/r) A@B (DoRA: renormalized + magnitude-scaled), leaving
+    unmatched leaves untouched. Pure; call inside jit so XLA fuses per-layer."""
+    scaling = cfg.scaling
+
+    def merge_one(path: str, leaf: dict, out_params: Any) -> Any:
+        w = _get_path(out_params, path)
+        a, b = leaf["lora_a"], leaf["lora_b"]
+        delta = jnp.einsum("...ir,...ro->...io", a.astype(jnp.float32), b.astype(jnp.float32)) * scaling
+        w_flat = w.reshape(delta.shape).astype(jnp.float32)
+        merged = w_flat + delta
+        if cfg.use_dora:
+            col_norm = jnp.linalg.norm(merged, axis=-2, keepdims=True)
+            merged = leaf["magnitude"].astype(jnp.float32)[..., None, :] * merged / jnp.maximum(col_norm, 1e-6)
+        return _set_path(out_params, path, merged.reshape(w.shape).astype(w.dtype))
+
+    out = params
+    for path, leaf in _flatten_lora(lora):
+        out = merge_one(path, leaf, out)
+    return out
+
+
+def _flatten_lora(lora: Any, prefix: str = "") -> list[tuple[str, dict]]:
+    out = []
+    for k, v in lora.items():
+        if isinstance(v, dict) and "lora_a" in v:
+            out.append((prefix + k, v))
+        elif isinstance(v, dict):
+            out.extend(_flatten_lora(v, prefix + k + "."))
+    return out
+
+
+def count_lora_params(lora: Any) -> int:
+    return sum(int(math.prod(x.shape)) for x in jax.tree.leaves(lora))

@@ -90,6 +90,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         backend_cfg = cfg.get("backend")
         self.backend = BackendConfig(**backend_cfg.to_dict()) if backend_cfg else BackendConfig()
         self._build_model_and_params()
+        self._build_peft()
 
         # tokenizer (optional for mock data)
         self.tokenizer = self._build_tokenizer()
@@ -122,8 +123,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         from automodel_tpu.parallel.sharding_utils import make_sharded_init
 
         with self.mesh:
-            # moments born sharded like their params; scalars replicated
-            self.opt_state = make_sharded_init(self.optimizer, self.params, self.mesh)(self.params)
+            # moments born sharded like their params; scalars replicated. Under PEFT
+            # the optimizer tracks only the rank-r adapter tree (reference freezes the
+            # base via requires_grad, _peft/lora.py:335; here it is simply not an
+            # optimizer argument).
+            self.opt_state = make_sharded_init(self.optimizer, self.train_params, self.mesh)(
+                self.train_params
+            )
 
         # loss selection (reference build_loss_fn, train_ft.py:345)
         self.loss_name = cfg.get("loss.name", "masked_ce")
@@ -177,6 +183,33 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 self.params = init_fn(self.rng.key("model_init"))
         n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
         logger.info("model: %s (%.1fM params)", type(self.model).__name__, n_params / 1e6)
+
+    def _build_peft(self):
+        """LoRA/DoRA adapter tree (reference apply_lora_to_linear_modules,
+        _peft/lora.py:335): self.train_params is what the optimizer and checkpointer
+        see — the adapter under PEFT, the full params otherwise."""
+        peft_cfg = self.cfg.get("peft")
+        self.peft = None
+        self.train_params = self.params
+        if peft_cfg is None:
+            return
+        from automodel_tpu.peft.lora import (
+            PeftConfig, count_lora_params, init_lora_params, lora_logical_axes,
+            merge_lora_params,
+        )
+
+        self.peft = PeftConfig.from_dict(peft_cfg.to_dict())
+        axes = self.model.logical_axes()
+        host_lora = init_lora_params(self.params, axes, self.peft, self.rng.key("lora_init"))
+        shardings = self.rules.tree_sharding(lora_logical_axes(axes, self.peft))
+        self.train_params = jax.tree.map(jax.device_put, host_lora, shardings)
+        # one compiled merge reused by every consolidated save
+        self._merge_lora = jax.jit(lambda base, lora: merge_lora_params(base, lora, self.peft))
+        logger.info(
+            "peft: lora dim=%d alpha=%d dora=%s — %.2fM trainable params",
+            self.peft.dim, self.peft.alpha, self.peft.use_dora,
+            count_lora_params(self.train_params) / 1e6,
+        )
 
     def _build_tokenizer(self):
         tok_cfg = self.cfg.get("tokenizer")
@@ -276,10 +309,23 @@ class TrainFinetuneRecipeForNextTokenPrediction:
 
             if self._moe_config is not None:
                 raise NotImplementedError("pp + MoE composition is not wired yet")
+            if self.peft is not None:
+                raise NotImplementedError("peft + pp composition is not wired yet")
             pp_loss = make_dense_decoder_pp_loss(
                 self.model, self.mesh, self.rules, loss_name=self.loss_name
             )
             step = make_pp_train_step(pp_loss, self.optimizer)
+        elif self.peft is not None:
+            from automodel_tpu.peft.lora import merge_lora_params
+
+            if self._post_update() is not None:
+                logger.warning("moe gate-bias update disabled under peft (base is frozen)")
+
+            def peft_loss(lora, base, batch, num_label_tokens):
+                merged = merge_lora_params(base, lora, self.peft)
+                return self._forward_loss(merged, batch, num_label_tokens)
+
+            step = make_train_step(peft_loss, self.optimizer, with_frozen=True)
         else:
             step = make_train_step(self._forward_loss, self.optimizer, post_update=self._post_update())
         return jax.jit(step, donate_argnums=(0, 1))
@@ -291,9 +337,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         if latest is None:
             return
         logger.info("resuming from step %d", latest)
-        self.params, self.opt_state, client = self.checkpointer.load(
-            self.params, self.opt_state, step=latest
+        self.train_params, self.opt_state, client = self.checkpointer.load(
+            self.train_params, self.opt_state, step=latest
         )
+        if self.peft is None:
+            self.params = self.train_params
         if "rng" in client:
             self.rng.load_state_dict(client["rng"])
         if "step_scheduler" in client:
@@ -315,9 +363,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     )
                     for k, v in stack.items()
                 }
-                self.params, self.opt_state, metrics = self._train_step(
-                    self.params, self.opt_state, stack
+                extra = (self.params,) if self.peft is not None else ()
+                self.train_params, self.opt_state, metrics = self._train_step(
+                    self.train_params, self.opt_state, stack, *extra
                 )
+                if self.peft is None:
+                    self.params = self.train_params
                 step = self.step_scheduler.step
                 steps_since_log += 1
                 if self.step_scheduler.is_log_step:
@@ -371,27 +422,42 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             from automodel_tpu.training.train_step import make_eval_step
 
             # training=False: no aux balance term in validation loss, pure CE
-            eval_loss = lambda p, b, n: self._forward_loss(p, b, n, training=False)
-            self._eval_step = jax.jit(make_eval_step(eval_loss))
+            if self.peft is not None:
+                from automodel_tpu.peft.lora import merge_lora_params
+
+                eval_loss = lambda lora, base, b, n: self._forward_loss(
+                    merge_lora_params(base, lora, self.peft), b, n, training=False
+                )
+                self._eval_step = jax.jit(make_eval_step(eval_loss, with_frozen=True))
+            else:
+                eval_loss = lambda p, b, n: self._forward_loss(p, b, n, training=False)
+                self._eval_step = jax.jit(make_eval_step(eval_loss))
         losses = []
+        extra = (self.params,) if self.peft is not None else ()
         for batch in self.val_dataloader:
             n = int((batch["labels"] != -100).sum())
-            losses.append(float(self._eval_step(self.params, batch, n)))
+            losses.append(float(self._eval_step(self.train_params, batch, n, *extra)))
         if losses:
             val_loss = float(np.mean(losses))
             self.val_metric_logger.log(step, val_loss=val_loss)
             logger.info("validation @ step %d: loss %.4f", step, val_loss)
 
     def _save(self, step: int):
+        """PEFT saves are adapter-only (reference PEFT checkpoint addon,
+        checkpoint/addons.py); consolidated HF export merges the adapter so the
+        output is a plain HF model either way."""
+        client = {
+            "rng": self.rng,
+            "step_scheduler": self.step_scheduler,
+            "dataloader": self.dataloader,
+        }
+        hf_params = None
+        if self.peft is not None:
+            client["peft_config"] = self.peft.to_dict()
+            if self.checkpointer.config.save_consolidated:
+                hf_params = self._merge_lora(self.params, self.train_params)
         self.checkpointer.save(
-            step,
-            self.params,
-            self.opt_state,
-            client_states={
-                "rng": self.rng,
-                "step_scheduler": self.step_scheduler,
-                "dataloader": self.dataloader,
-            },
+            step, self.train_params, self.opt_state, client_states=client, hf_params=hf_params
         )
 
 

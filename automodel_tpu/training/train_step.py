@@ -35,6 +35,7 @@ def make_train_step(
     forward_loss: Callable[..., jnp.ndarray],
     optimizer: optax.GradientTransformation,
     post_update: Callable[[dict, dict], dict] | None = None,
+    with_frozen: bool = False,
 ):
     """Build the accumulating train step.
 
@@ -46,13 +47,22 @@ def make_train_step(
     ``post_update(params, aux_acc)`` runs after the optimizer step — the hook for
     non-gradient param updates like the MoE gate-bias loss-free balancing (reference
     update_moe_gate_bias, train_ft.py:1341).
+
+    ``with_frozen=True`` is the PEFT shape: ``params`` is the small trainable tree
+    (LoRA factors), and a second ``frozen`` pytree (the base model) is passed through
+    untouched and undifferentiated — `forward_loss(trainable, frozen, batch, n)`.
+    Freezing-by-argument replaces the reference's requires_grad ceremony
+    (_peft/lora.py:335) and keeps optimizer state rank-r sized.
     """
 
-    def _call(params, microbatch, num_label_tokens):
-        out = forward_loss(params, microbatch, num_label_tokens)
+    def _call(params, microbatch, num_label_tokens, frozen):
+        if with_frozen:
+            out = forward_loss(params, frozen, microbatch, num_label_tokens)
+        else:
+            out = forward_loss(params, microbatch, num_label_tokens)
         return out if isinstance(out, tuple) else (out, {})
 
-    def train_step(params, opt_state, batch_stack):
+    def train_step(params, opt_state, batch_stack, frozen=None):
         """batch_stack: pytree whose leaves are stacked (n_micro, ...) arrays."""
         # global label-token count: computed inside jit on the sharded labels, so the
         # sum is automatically global across data axes (reference allreduces by hand,
@@ -62,7 +72,7 @@ def make_train_step(
         def micro_step(carry, microbatch):
             grads_acc, loss_acc, aux_acc = carry
             (loss, aux), grads = jax.value_and_grad(_call, has_aux=True)(
-                params, microbatch, num_label_tokens
+                params, microbatch, num_label_tokens, frozen
             )
             grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
             aux_acc = jax.tree.map(jnp.add, aux_acc, aux)
@@ -70,7 +80,7 @@ def make_train_step(
 
         zero_grads = jax.tree.map(jnp.zeros_like, params)
         micro0 = jax.tree.map(lambda x: x[0], batch_stack)
-        aux_shapes = jax.eval_shape(_call, params, micro0, num_label_tokens)[1]
+        aux_shapes = jax.eval_shape(_call, params, micro0, num_label_tokens, frozen)[1]
         zero_aux = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), aux_shapes)
         (grads, loss, aux), _ = jax.lax.scan(
             micro_step, (zero_grads, jnp.float32(0.0), zero_aux), batch_stack
@@ -117,9 +127,12 @@ def make_pp_train_step(
     return train_step
 
 
-def make_eval_step(forward_loss: Callable[..., jnp.ndarray]):
-    def eval_step(params, batch, num_label_tokens):
-        out = forward_loss(params, batch, num_label_tokens)
+def make_eval_step(forward_loss: Callable[..., jnp.ndarray], with_frozen: bool = False):
+    def eval_step(params, batch, num_label_tokens, frozen=None):
+        if with_frozen:
+            out = forward_loss(params, frozen, batch, num_label_tokens)
+        else:
+            out = forward_loss(params, batch, num_label_tokens)
         return out[0] if isinstance(out, tuple) else out
 
     return eval_step

@@ -1,0 +1,158 @@
+"""LoRA/DoRA unit tests (mirror of reference tests/unit_tests/_peft/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.llama.model import LlamaForCausalLM
+from automodel_tpu.peft.lora import (
+    PeftConfig,
+    count_lora_params,
+    init_lora_params,
+    lora_logical_axes,
+    match_lora_paths,
+    merge_lora_params,
+    wildcard_match,
+)
+
+TINY = {
+    "architectures": ["LlamaForCausalLM"],
+    "vocab_size": 64,
+    "hidden_size": 32,
+    "intermediate_size": 64,
+    "num_hidden_layers": 2,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "max_position_embeddings": 64,
+}
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    model = LlamaForCausalLM.from_config(TINY, BackendConfig(dtype="float32"))
+    params = model.init(jax.random.key(0), jnp.float32)
+    return model, params
+
+
+class TestMatching:
+    def test_wildcard_semantics(self):
+        # reference module_matcher.py docstring examples
+        assert wildcard_match("*.layers.0.*.linear_qkv", "decoder.layers.0.self_attention.linear_qkv")
+        assert not wildcard_match("*.layers.0.*.linear_qkv", "decoder.layers.1.self_attention.linear_qkv")
+
+    def test_default_targets_match_all_projections(self, tiny_model):
+        model, _ = tiny_model
+        matched = match_lora_paths(model.logical_axes(), PeftConfig())
+        assert set(matched) == {
+            "layers.wq", "layers.wk", "layers.wv", "layers.wo",
+            "layers.w_gate", "layers.w_up", "layers.w_down",
+        }
+        # wo contracts (heads, head_dim): split after stack dim + 2
+        assert matched["layers.wo"] == (1, 3)
+        assert matched["layers.wq"] == (1, 2)
+
+    def test_hf_alias_and_exclude(self, tiny_model):
+        model, _ = tiny_model
+        cfg = PeftConfig(target_modules=["q_proj", "v_proj"])
+        assert set(match_lora_paths(model.logical_axes(), cfg)) == {"layers.wq", "layers.wv"}
+        cfg = PeftConfig(match_all_linear=True, exclude_modules=["lm_head"])
+        matched = match_lora_paths(model.logical_axes(), cfg)
+        assert "lm_head" not in matched
+        assert "embed" not in matched  # embedding is never a lora target
+        assert "layers.attn_norm" not in matched  # norms are not matrices
+
+    def test_biases_never_matched(self):
+        # qwen2-style attention biases: (L, heads, head_dim) leaves must not become
+        # degenerate fan_out=1 adapters under match_all_linear
+        model = LlamaForCausalLM.from_config(
+            {**TINY, "attention_bias": True}, BackendConfig(dtype="float32")
+        )
+        matched = match_lora_paths(model.logical_axes(), PeftConfig(match_all_linear=True))
+        assert not any(p.startswith("layers.b") for p in matched)
+        assert "layers.wq" in matched
+
+    def test_no_match_raises(self, tiny_model):
+        model, params = tiny_model
+        with pytest.raises(ValueError, match="matched no params"):
+            init_lora_params(params, model.logical_axes(), PeftConfig(target_modules=["nope"]),
+                             jax.random.key(0))
+
+
+class TestInitAndMerge:
+    def test_shapes_and_zero_init_identity(self, tiny_model):
+        model, params = tiny_model
+        cfg = PeftConfig(dim=4, alpha=8)
+        lora = init_lora_params(params, model.logical_axes(), cfg, jax.random.key(1))
+        # wq (L, d, n*h) factorization
+        L, d = 2, 32
+        assert lora["layers"]["wq"]["lora_a"].shape == (L, d, 4)
+        assert lora["layers"]["wq"]["lora_b"].shape == (L, 4, 32)
+        # wo contracts (n, h): fan_in = 4*8
+        assert lora["layers"]["wo"]["lora_a"].shape == (L, 32, 4)
+        # B zero-init -> merged params == base params exactly
+        merged = merge_lora_params(params, lora, cfg)
+        for leaf_m, leaf_p in zip(jax.tree.leaves(merged), jax.tree.leaves(params)):
+            np.testing.assert_array_equal(np.asarray(leaf_m), np.asarray(leaf_p))
+
+    def test_merge_matches_manual_delta(self, tiny_model):
+        model, params = tiny_model
+        cfg = PeftConfig(dim=4, alpha=8, target_modules=["*w_up"])
+        lora = init_lora_params(params, model.logical_axes(), cfg, jax.random.key(1))
+        b = jax.random.normal(jax.random.key(2), lora["layers"]["w_up"]["lora_b"].shape)
+        lora["layers"]["w_up"]["lora_b"] = b
+        merged = merge_lora_params(params, lora, cfg)
+        a = lora["layers"]["w_up"]["lora_a"]
+        expect = np.asarray(params["layers"]["w_up"]) + 2.0 * np.einsum("lir,lro->lio", a, b)
+        np.testing.assert_allclose(np.asarray(merged["layers"]["w_up"]), expect, rtol=1e-4, atol=1e-6)
+        # untouched leaves are the same objects
+        assert merged["layers"]["wq"] is params["layers"]["wq"]
+
+    def test_dora_magnitude_init_and_renorm(self, tiny_model):
+        model, params = tiny_model
+        cfg = PeftConfig(dim=4, alpha=4, use_dora=True, target_modules=["*w_gate"])
+        lora = init_lora_params(params, model.logical_axes(), cfg, jax.random.key(1))
+        w = np.asarray(params["layers"]["w_gate"], np.float32)
+        # magnitude starts at column norms of W (reference lora.py:196-200)
+        np.testing.assert_allclose(
+            np.asarray(lora["layers"]["w_gate"]["magnitude"]),
+            np.linalg.norm(w, axis=-2), rtol=1e-6,
+        )
+        # with B=0: ||W|| / ||W|| * m == W -> identity at init too
+        merged = merge_lora_params(params, lora, cfg)
+        np.testing.assert_allclose(np.asarray(merged["layers"]["w_gate"]), w, rtol=1e-5)
+
+    def test_gradients_flow_only_through_lora(self, tiny_model):
+        model, params = tiny_model
+        cfg = PeftConfig(dim=4, alpha=8)
+        lora = init_lora_params(params, model.logical_axes(), cfg, jax.random.key(1))
+        ids = jnp.arange(8).reshape(1, 8) % 64
+
+        def loss_fn(lora_tree):
+            merged = merge_lora_params(params, lora_tree, cfg)
+            logits = model(merged, ids)
+            return (logits**2).mean()
+
+        grads = jax.grad(loss_fn)(lora)
+        ga = np.asarray(grads["layers"]["wq"]["lora_b"])
+        assert np.abs(ga).max() > 0  # b gets gradient through a@b even though b=0...
+        # a's grad is zero at init (d/dA of A@B with B=0), b's is not
+        assert np.abs(np.asarray(grads["layers"]["wq"]["lora_a"])).max() == 0
+
+    def test_lora_logical_axes_mirror(self, tiny_model):
+        model, _ = tiny_model
+        cfg = PeftConfig(dim=4)
+        axes = lora_logical_axes(model.logical_axes(), cfg)
+        assert axes["layers"]["wq"]["lora_a"] == ("layers", None, None)
+        lora = init_lora_params(
+            model.init(jax.random.key(0), jnp.float32), model.logical_axes(), cfg, jax.random.key(1)
+        )
+        # same nested paths: every lora leaf has a matching axes entry of equal rank
+        flat_lora = jax.tree_util.tree_flatten_with_path(lora)[0]
+        for path, leaf in flat_lora:
+            node = axes
+            for p in path:
+                node = node[p.key]
+            assert len(node) == leaf.ndim, (path, node, leaf.shape)
+        assert count_lora_params(lora) > 0
