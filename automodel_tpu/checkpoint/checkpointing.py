@@ -47,6 +47,8 @@ class Checkpointer:
 
     def __init__(self, config: CheckpointingConfig, state_dict_adapter=None, hf_config: dict | None = None):
         self.config = config
+        # orbax requires absolute paths; make relative dirs cwd-anchored up front
+        self.config.checkpoint_dir = os.path.abspath(config.checkpoint_dir)
         self.state_dict_adapter = state_dict_adapter  # for consolidated HF export
         self.hf_config = hf_config
         self._ckptr = None

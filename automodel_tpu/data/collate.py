@@ -18,9 +18,27 @@ from typing import Any, Mapping, Sequence
 
 import numpy as np
 
-__all__ = ["sft_collate", "stack_batches", "IGNORE_INDEX"]
+__all__ = ["sft_collate", "shift_example", "stack_batches", "IGNORE_INDEX"]
 
 IGNORE_INDEX = -100
+
+
+def shift_example(ex: Mapping[str, Any], answer_only_loss: bool = True) -> tuple[np.ndarray, np.ndarray]:
+    """Per-example next-token shift -> (input_ids, labels), prompt span masked.
+
+    The single source of truth for the shift/masking arithmetic — sft_collate and
+    pack_dataset both build on it.
+    """
+    ids = np.asarray(ex["input_ids"], dtype=np.int32)
+    if "labels" in ex and ex["labels"] is not None:
+        tgt_full = np.asarray(ex["labels"], dtype=np.int32)
+        return ids[:-1], tgt_full[1:]
+    inp, tgt = ids[:-1], ids[1:].copy()
+    if answer_only_loss and "prompt_len" in ex:
+        # target index t predicts token t+1, so prompt_len-1 targets are masked
+        cut = max(int(ex["prompt_len"]) - 1, 0)
+        tgt[:cut] = IGNORE_INDEX
+    return inp, tgt
 
 
 def sft_collate(
@@ -36,18 +54,8 @@ def sft_collate(
     positions = np.zeros((b, seq_len), dtype=np.int32)
 
     for row, ex in enumerate(examples):
-        ids = np.asarray(ex["input_ids"], dtype=np.int32)[: seq_len + 1]
-        # next-token shift: inputs are ids[:-1], targets ids[1:]
-        if "labels" in ex and ex["labels"] is not None:
-            tgt_full = np.asarray(ex["labels"], dtype=np.int32)[: seq_len + 1]
-            inp, tgt = ids[:-1], tgt_full[1:]
-        else:
-            inp, tgt = ids[:-1], ids[1:].copy()
-            if answer_only_loss and "prompt_len" in ex:
-                # mask targets that belong to the prompt (target index t predicts
-                # token t+1, so prompt_len-1 targets are masked)
-                cut = max(int(ex["prompt_len"]) - 1, 0)
-                tgt[:cut] = IGNORE_INDEX
+        inp, tgt = shift_example(ex, answer_only_loss)
+        inp, tgt = inp[:seq_len], tgt[:seq_len]  # truncation commutes with the shift
         n = len(inp)
         input_ids[row, :n] = inp
         labels[row, :n] = tgt

@@ -1,0 +1,133 @@
+"""Sequence packing (reference datasets/llm/packed_sequence.py:202 pack_dataset).
+
+The reference carries packed batches in THD layout with ``seq_lens``/``seq_lens_padded``
+metadata threaded through a custom collater and TE varlen attention
+(distributed/thd_utils.py). TPU-native, the whole apparatus reduces to *segment ids*:
+each pack is a fixed-length row whose tokens carry the 1-based index of the sequence
+they came from (0 = padding), attention masks across segment boundaries
+(ops/attention.py), RoPE positions restart per sequence, and every shape stays static
+for jit. No variable-length metadata survives past the data loader.
+
+Per-sample processing matches ``sft_collate``: the next-token shift happens *within*
+each sample before concatenation, so the last token of one sample never predicts the
+first token of the next (the reference gets the same guarantee from label padding).
+
+The reference pads each sequence to a multiple of ``2 * cp_size`` for TE's THD ring
+chunking (packed_sequence.py:269). Here that padding is *unnecessary*: ring attention
+masks by traveling positions/segment ids (parallel/ring_attention.py), so segment
+boundaries need no chunk alignment — only the pack length itself must divide the cp
+shard count, which the recipe validates. Packs are materialized up front, the same
+contract as the reference's pack_dataset (it also builds the full pack list in
+memory); bound working set with ``max_packs`` for huge corpora.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Iterable, Mapping, Sequence
+
+import numpy as np
+
+from automodel_tpu.data.collate import IGNORE_INDEX, shift_example
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["PackedDataset", "pack_dataset", "packed_collate"]
+
+
+class PackedDataset:
+    """Materialized list of fixed-length packs, each a collate-ready example dict."""
+
+    def __init__(self, packs: list[dict[str, np.ndarray]], packed_sequence_size: int):
+        self.packs = packs
+        self.packed_sequence_size = packed_sequence_size
+
+    def __len__(self) -> int:
+        return len(self.packs)
+
+    def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
+        return self.packs[idx]
+
+
+def pack_dataset(
+    dataset: Iterable[Mapping[str, Any]],
+    packed_sequence_size: int,
+    pad_token_id: int = 0,
+    max_packs: int | None = None,
+    drop_long_samples: bool = False,
+    answer_only_loss: bool = True,
+) -> PackedDataset:
+    """Greedy first-fit packing: fill each pack until the next sample won't fit.
+
+    Mirrors the reference's buffer loop (packed_sequence.py:202) with the same knobs;
+    sequences longer than ``packed_sequence_size`` raise unless ``drop_long_samples``.
+    """
+    if packed_sequence_size <= 0:
+        raise ValueError(f"packed_sequence_size must be positive, got {packed_sequence_size}")
+
+    packs: list[dict[str, np.ndarray]] = []
+    buf_ids: list[np.ndarray] = []
+    buf_labels: list[np.ndarray] = []
+    buf_pos: list[np.ndarray] = []
+    buf_seg: list[np.ndarray] = []
+    used = 0
+    n_dropped = 0
+
+    def flush():
+        nonlocal used
+        if not buf_ids or (max_packs is not None and len(packs) >= max_packs):
+            return
+        ids = np.concatenate(buf_ids)
+        tail = packed_sequence_size - len(ids)
+        pack = {
+            "input_ids": np.concatenate([ids, np.full(tail, pad_token_id, np.int32)]),
+            "labels": np.concatenate([np.concatenate(buf_labels), np.full(tail, IGNORE_INDEX, np.int32)]),
+            "positions": np.concatenate([np.concatenate(buf_pos), np.zeros(tail, np.int32)]),
+            "segment_ids": np.concatenate([np.concatenate(buf_seg), np.zeros(tail, np.int32)]),
+        }
+        packs.append(pack)
+        buf_ids.clear(); buf_labels.clear(); buf_pos.clear(); buf_seg.clear()
+        used = 0
+
+    # map-style datasets may index modulo their length (mock datasets do); iterate
+    # exactly len() items rather than relying on IndexError termination
+    if hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__"):
+        sample_iter = (dataset[i] for i in range(len(dataset)))
+    else:
+        sample_iter = iter(dataset)
+    for ex in sample_iter:
+        if max_packs is not None and len(packs) >= max_packs:
+            break
+        inp, tgt = shift_example(ex, answer_only_loss)
+        n = len(inp)
+        if n == 0:
+            continue
+        if n > packed_sequence_size:
+            if drop_long_samples:
+                n_dropped += 1
+                continue
+            raise ValueError(
+                f"sample is too long ({n} > packed_sequence_size {packed_sequence_size}); "
+                "increase packed_sequence_size or set drop_long_samples"
+            )
+        if used + n > packed_sequence_size:
+            flush()
+        seg = len(buf_ids) + 1
+        buf_ids.append(np.asarray(inp, np.int32))
+        buf_labels.append(np.asarray(tgt, np.int32))
+        buf_pos.append(np.arange(n, dtype=np.int32))
+        buf_seg.append(np.full(n, seg, np.int32))
+        used += n
+
+    flush()
+    if n_dropped:
+        logger.warning("pack_dataset dropped %d over-length samples", n_dropped)
+    if not packs:
+        raise ValueError("pack_dataset produced no packs (empty dataset?)")
+    return PackedDataset(packs, packed_sequence_size)
+
+
+def packed_collate(examples: Sequence[Mapping[str, np.ndarray]], **_ignored) -> dict[str, np.ndarray]:
+    """Packs are pre-collated rows; a batch is just a stack."""
+    keys = examples[0].keys()
+    return {k: np.stack([np.asarray(e[k]) for e in examples]) for k in keys}

@@ -236,7 +236,27 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         pad_id = 0
         if self.tokenizer is not None and getattr(self.tokenizer, "pad_token_id", None) is not None:
             pad_id = self.tokenizer.pad_token_id
-        collate = lambda exs: sft_collate(exs, seq_len=self.seq_len, pad_token_id=pad_id)
+        # sequence packing (reference packed_sequence section, train_ft.py:402): each
+        # example becomes a fixed-size pack, segment ids carry the boundaries
+        pack_size = int(self.cfg.get("packed_sequence.packed_sequence_size", 0))
+        if pack_size > 0:
+            from automodel_tpu.data.llm.packed import pack_dataset, packed_collate
+
+            if pack_size % self.mesh_ctx.cp != 0:
+                raise ValueError(
+                    f"packed_sequence_size {pack_size} must divide by cp={self.mesh_ctx.cp}"
+                )
+            dataset = pack_dataset(
+                dataset,
+                pack_size,
+                pad_token_id=pad_id,
+                max_packs=self.cfg.get("packed_sequence.max_packs"),
+                drop_long_samples=bool(self.cfg.get("packed_sequence.drop_long_samples", False)),
+            )
+            self.seq_len = pack_size
+            collate = packed_collate
+        else:
+            collate = lambda exs: sft_collate(exs, seq_len=self.seq_len, pad_token_id=pad_id)
         return DataLoader(
             dataset,
             batch_size=self.micro_batch_size * jax.process_count(),

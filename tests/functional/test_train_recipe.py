@@ -117,6 +117,34 @@ class TestTrainRecipeE2E:
         wq = recipe.params["layers"]["wq"]
         assert wq.sharding.shard_shape(wq.shape)[0] == 2
 
+    def test_packed_sequence_loss_decreases(self, tmp_path, cpu_devices):
+        extra = textwrap.dedent("""\
+        packed_sequence:
+          packed_sequence_size: 64
+        """).replace("\n", "\n    ")
+        cfg = load_config(_write_cfg(tmp_path, extra=extra))
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        assert recipe.seq_len == 64  # packs override seq_len
+        recipe.run_train_validation_loop()
+        rows = _read_jsonl(tmp_path / "out" / "training.jsonl")
+        losses = [r["loss"] for r in rows]
+        assert losses[0] > 4.0
+        assert losses[-1] < losses[0] - 0.3
+
+    def test_packed_sequence_with_cp(self, tmp_path, cpu_devices):
+        extra = textwrap.dedent("""\
+        packed_sequence:
+          packed_sequence_size: 64
+        """).replace("\n", "\n    ")
+        cfg = load_config(_write_cfg(tmp_path, extra=extra, dp_shard=2, tp=2, max_steps=3))
+        cfg.set_by_path("distributed.cp", 2)
+        cfg.set_by_path("distributed.tp", 1)
+        cfg.set_by_path("distributed.dp_shard", 4)
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        recipe.run_train_validation_loop()
+        rows = _read_jsonl(tmp_path / "out" / "training.jsonl")
+        assert all(np.isfinite(r["loss"]) for r in rows)
+
     def test_linear_ce_loss_matches(self, tmp_path, cpu_devices):
         cfg = load_config(_write_cfg(tmp_path, extra="loss:\n      name: linear_ce", max_steps=2))
         recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
