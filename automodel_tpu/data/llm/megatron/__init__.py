@@ -1,0 +1,17 @@
+from automodel_tpu.data.llm.megatron.blended import BlendedDataset, normalize_weights, parse_blend
+from automodel_tpu.data.llm.megatron.gpt_dataset import GPTDataset
+from automodel_tpu.data.llm.megatron.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+)
+from automodel_tpu.data.llm.megatron.megatron_dataset import MegatronPretraining
+
+__all__ = [
+    "BlendedDataset",
+    "GPTDataset",
+    "MMapIndexedDataset",
+    "MMapIndexedDatasetBuilder",
+    "MegatronPretraining",
+    "normalize_weights",
+    "parse_blend",
+]

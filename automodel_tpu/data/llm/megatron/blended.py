@@ -1,0 +1,73 @@
+"""Blended multi-corpus dataset (reference megatron/builder.py BlendedMegatronDatasetBuilder
++ helpers.cpp blending indices).
+
+Given component datasets and weights, interleaves samples so every prefix of the
+stream tracks the weights as closely as possible (error-feedback rule, no RNG) —
+the property pretraining needs for loss-curve comparability when resuming mid-epoch.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from automodel_tpu.data.llm.megatron.helpers import (
+    build_blending_indices,
+    build_exhaustive_blending_indices,
+)
+
+__all__ = ["BlendedDataset", "normalize_weights", "parse_blend"]
+
+
+def normalize_weights(weights: Sequence[float]) -> np.ndarray:
+    w = np.asarray(weights, dtype=np.float64)
+    if (w < 0).any() or w.sum() <= 0:
+        raise ValueError(f"invalid blend weights {weights}")
+    return w / w.sum()
+
+
+def parse_blend(blend: Sequence) -> tuple[list[float], list[str]]:
+    """Megatron CLI convention: [w0, prefix0, w1, prefix1, ...] or just [prefix...]."""
+    if all(isinstance(b, str) for b in blend):
+        return [1.0] * len(blend), list(blend)
+    weights = [float(b) for b in blend[0::2]]
+    prefixes = [str(b) for b in blend[1::2]]
+    if len(weights) != len(prefixes):
+        raise ValueError(f"unpaired blend spec {blend}")
+    return weights, prefixes
+
+
+class BlendedDataset:
+    """Weighted interleave of component datasets, deterministic and resumable."""
+
+    def __init__(
+        self,
+        datasets: Sequence,
+        weights: Sequence[float] | None = None,
+        size: int | None = None,
+    ):
+        if not datasets:
+            raise ValueError("BlendedDataset needs at least one component")
+        self.datasets = list(datasets)
+        if weights is None:
+            # exhaustive mode: consume every component exactly once
+            sizes = np.asarray([len(d) for d in self.datasets], dtype=np.int64)
+            self.dataset_index, self.dataset_sample_index = build_exhaustive_blending_indices(sizes)
+        else:
+            if len(weights) != len(datasets):
+                raise ValueError("weights/datasets length mismatch")
+            if size is None:
+                raise ValueError("weighted blending requires an explicit size")
+            w = normalize_weights(weights)
+            self.dataset_index, self.dataset_sample_index = build_blending_indices(w, size)
+            # components wrap modulo their own length if oversampled
+        self._sizes = [len(d) for d in self.datasets]
+
+    def __len__(self) -> int:
+        return len(self.dataset_index)
+
+    def __getitem__(self, idx: int):
+        d = int(self.dataset_index[idx])
+        s = int(self.dataset_sample_index[idx]) % self._sizes[d]
+        return self.datasets[d][s]

@@ -1,0 +1,160 @@
+"""GPT pretraining dataset over an MMapIndexedDataset
+(reference megatron/gpt_dataset.py:257 GPTDataset).
+
+Three deterministic index arrays turn a document corpus into a stream of fixed-length
+training samples (the Megatron recipe, rebuilt):
+
+- ``document_index``: document ids repeated per epoch, each epoch shuffled
+  independently (last partial epoch shuffled separately, gpt_dataset.py:715);
+- ``sample_index``: (num_samples+1, 2) [doc position, token offset] built by the C++
+  helper — sample i spans tokens sample_index[i] .. sample_index[i+1] inclusive;
+- ``shuffle_index``: a shuffle over samples (first full-epoch span and trailing span
+  shuffled separately, gpt_dataset.py:748).
+
+Samples are ``seq_length+1`` raw tokens; the collate layer applies the next-token
+shift, and every token carries loss (pretraining: ``labels=input_ids``).
+Index arrays are cached on disk keyed by a config hash, so rank-parallel and
+re-run builds are instant (reference path_to_cache behavior).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+
+import numpy as np
+
+from automodel_tpu.data.llm.megatron.helpers import build_sample_idx
+from automodel_tpu.data.llm.megatron.indexed_dataset import MMapIndexedDataset
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["GPTDataset"]
+
+
+def _build_document_index(num_docs: int, num_epochs: int, rng: np.random.RandomState,
+                          separate_final_epoch: bool) -> np.ndarray:
+    if not separate_final_epoch or num_epochs == 1:
+        doc_idx = np.mgrid[0:num_epochs, 0:num_docs][1].reshape(-1).astype(np.int64)
+        rng.shuffle(doc_idx)
+        return doc_idx
+    first = _build_document_index(num_docs, num_epochs - 1, rng, False)
+    last = _build_document_index(num_docs, 1, rng, False)
+    return np.concatenate([first, last])
+
+
+def _build_shuffle_index(num_samples: int, total_size: int, rng: np.random.RandomState) -> np.ndarray:
+    dtype = np.int64 if total_size >= np.iinfo(np.int32).max - 1 else np.int32
+    first = np.arange(num_samples, dtype=dtype)
+    rng.shuffle(first)
+    if num_samples == total_size:
+        return first
+    last = np.arange(num_samples, total_size, dtype=dtype)
+    rng.shuffle(last)
+    return np.concatenate([first, last])
+
+
+class GPTDataset:
+    """Deterministic, resumable GPT pretraining sample stream."""
+
+    def __init__(
+        self,
+        indexed_dataset: MMapIndexedDataset | str,
+        seq_length: int,
+        num_samples: int | None = None,
+        seed: int = 1234,
+        cache_dir: str | None = None,
+        documents: np.ndarray | None = None,  # restrict to a doc-id subset (splits)
+    ):
+        if isinstance(indexed_dataset, str):
+            indexed_dataset = MMapIndexedDataset(indexed_dataset)
+        self.indexed = indexed_dataset
+        self.seq_length = seq_length
+        self.seed = seed
+        if documents is None:
+            documents = np.arange(len(indexed_dataset), dtype=np.int64)
+        self.documents = documents
+
+        tokens_per_epoch = int(self.indexed.sizes[documents].sum())
+        samples_per_epoch = max((tokens_per_epoch - 1) // seq_length, 1)
+        if num_samples is None:
+            num_samples = samples_per_epoch
+        self.num_samples = num_samples
+        num_epochs = max(-(-(num_samples * seq_length + 1) // tokens_per_epoch), 1)
+
+        # separate-final-epoch rule (gpt_dataset.py:505): when the last epoch is
+        # only partially consumed, shuffle it apart so early training never sees
+        # a skewed tail distribution
+        separate_final = False
+        if num_epochs > 1:
+            samples_sans_final = ((num_epochs - 1) * tokens_per_epoch - 1) // seq_length
+            final_frac = (num_samples - samples_sans_final) / max(samples_per_epoch, 1)
+            separate_final = final_frac < 0.80
+
+        self._load_or_build(num_epochs, separate_final, cache_dir)
+
+    # -- index construction --------------------------------------------------
+    def _cache_key(self, num_epochs: int, separate_final: bool) -> str:
+        h = hashlib.md5()
+        h.update(
+            f"{self.indexed.path_prefix}|{self.seq_length}|{self.num_samples}|"
+            f"{self.seed}|{num_epochs}|{separate_final}|{len(self.documents)}".encode()
+        )
+        return h.hexdigest()[:16]
+
+    def _load_or_build(self, num_epochs: int, separate_final: bool, cache_dir: str | None):
+        key = self._cache_key(num_epochs, separate_final)
+        if cache_dir:
+            paths = {n: os.path.join(cache_dir, f"gpt_{key}_{n}.npy")
+                     for n in ("doc", "sample", "shuffle")}
+            if all(os.path.exists(p) for p in paths.values()):
+                self.document_index = np.load(paths["doc"], mmap_mode="r")
+                self.sample_index = np.load(paths["sample"], mmap_mode="r")
+                self.shuffle_index = np.load(paths["shuffle"], mmap_mode="r")
+                return
+        rng = np.random.RandomState(self.seed)
+        doc_index = _build_document_index(len(self.documents), num_epochs, rng, separate_final)
+        # map positions in the (possibly restricted) documents array to real doc ids
+        real_doc_index = self.documents[doc_index]
+        sample_index = build_sample_idx(
+            self.indexed.sizes, real_doc_index, self.seq_length, self.num_samples
+        )
+        n_avail = len(sample_index) - 1
+        shuffle_index = _build_shuffle_index(min(self.num_samples, n_avail), n_avail, rng)
+        self.document_index = real_doc_index
+        self.sample_index = sample_index
+        self.shuffle_index = shuffle_index
+        if cache_dir:
+            os.makedirs(cache_dir, exist_ok=True)
+            np.save(paths["doc"], real_doc_index)
+            np.save(paths["sample"], sample_index)
+            np.save(paths["shuffle"], shuffle_index)
+            logger.info("cached gpt indices under %s (%s)", cache_dir, key)
+
+    # -- access --------------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.shuffle_index)
+
+    def __getitem__(self, idx: int) -> dict[str, np.ndarray]:
+        sample = self.shuffle_index[idx % len(self.shuffle_index)]
+        doc_pos_start, offset_start = self.sample_index[sample]
+        doc_pos_end, offset_end = self.sample_index[sample + 1]
+        parts = []
+        if doc_pos_start == doc_pos_end:
+            parts.append(
+                self.indexed.get(
+                    int(self.document_index[doc_pos_start]),
+                    offset=int(offset_start),
+                    length=int(offset_end) - int(offset_start) + 1,
+                )
+            )
+        else:
+            parts.append(self.indexed.get(int(self.document_index[doc_pos_start]), offset=int(offset_start)))
+            for p in range(int(doc_pos_start) + 1, int(doc_pos_end)):
+                parts.append(self.indexed.get(int(self.document_index[p])))
+            parts.append(self.indexed.get(int(self.document_index[doc_pos_end]), length=int(offset_end) + 1))
+        tokens = np.concatenate(parts).astype(np.int64)
+        assert len(tokens) == self.seq_length + 1, (len(tokens), self.seq_length)
+        # pretraining: every position carries loss; collate shifts labels=ids
+        return {"input_ids": tokens}

@@ -1,0 +1,123 @@
+// Fast index builders for Megatron-style GPT pretraining datasets.
+//
+// Native counterpart of the reference's pybind11 extension
+// (components/datasets/llm/megatron/helpers.cpp): building the sample index walks
+// every document boundary of a multi-billion-token corpus, which is minutes of
+// pure-Python but milliseconds in C++. Exposed as a plain extern "C" ABI and loaded
+// with ctypes (this image has no pybind11); all arrays are caller-allocated numpy
+// buffers, so there is no Python object traffic in the hot loops.
+//
+// Build: g++ -O3 -shared -fPIC -o libindex_helpers.so index_helpers.cpp
+// (automated by helpers.py, cached next to this file).
+
+#include <cstdint>
+#include <cmath>
+#include <vector>
+
+extern "C" {
+
+// Build the (num_samples+1, 2) sample index for GPT pretraining: row i holds
+// [position in doc_idx, token offset within that document] where sample i starts.
+// Each sample spans seq_length+1 tokens (input+shifted target overlap), crossing
+// document boundaries by walking doc_idx. Returns the number of rows written.
+//
+// sizes:   per-document token counts, indexed by document id
+// doc_idx: epoch-shuffled document ids, length doc_idx_len
+int64_t build_sample_idx(const int32_t* sizes,
+                         const int64_t* doc_idx,
+                         int64_t doc_idx_len,
+                         int32_t seq_length,
+                         int64_t num_samples,
+                         int64_t* out /* (num_samples+1)*2 */) {
+  int64_t doc_pos = 0;     // index into doc_idx
+  int32_t doc_offset = 0;  // token offset inside current document
+  int64_t row = 0;
+
+  out[0] = 0;
+  out[1] = 0;
+  ++row;
+
+  while (row <= num_samples && doc_pos < doc_idx_len) {
+    // consume seq_length+1 tokens; the next sample re-reads the boundary token
+    // (the -1 below), the same overlap convention as Megatron
+    int64_t remaining = static_cast<int64_t>(seq_length) + 1;
+    while (remaining > 0 && doc_pos < doc_idx_len) {
+      int32_t doc_len = sizes[doc_idx[doc_pos]] - doc_offset;
+      if (doc_len >= remaining) {
+        doc_offset += static_cast<int32_t>(remaining) - 1;
+        remaining = 0;
+      } else {
+        remaining -= doc_len;
+        ++doc_pos;
+        doc_offset = 0;
+      }
+    }
+    if (remaining > 0) break;  // ran out of corpus mid-sample: drop partial row
+    out[row * 2] = doc_pos;
+    out[row * 2 + 1] = doc_offset;
+    ++row;
+  }
+  return row;  // rows written (num_samples+1 when the corpus sufficed)
+}
+
+// Error-feedback proportional interleave of datasets (reference
+// build_blending_indices): at step i pick the dataset whose realized sample count
+// most lags weight*i. Deterministic, no RNG.
+void build_blending_indices(int16_t* dataset_index,
+                            int64_t* dataset_sample_index,
+                            const double* weights,
+                            int32_t num_datasets,
+                            int64_t size) {
+  std::vector<int64_t> counts(num_datasets, 0);
+  for (int64_t i = 0; i < size; ++i) {
+    double step = static_cast<double>(i < 1 ? 1 : i);
+    int32_t argmax = 0;
+    double err_max = -1.0e300;
+    for (int32_t d = 0; d < num_datasets; ++d) {
+      double err = weights[d] * step - static_cast<double>(counts[d]);
+      if (err > err_max) {
+        err_max = err;
+        argmax = d;
+      }
+    }
+    dataset_index[i] = static_cast<int16_t>(argmax);
+    dataset_sample_index[i] = counts[argmax];
+    ++counts[argmax];
+  }
+}
+
+// Exhaustive variant: draw exactly sizes[d] samples from dataset d, interleaved
+// proportionally; datasets drop out as they exhaust (reference
+// build_exhaustive_blending_indices).
+void build_exhaustive_blending_indices(int16_t* dataset_index,
+                                       int64_t* dataset_sample_index,
+                                       const int64_t* sizes,
+                                       int32_t num_datasets) {
+  int64_t total = 0;
+  for (int32_t d = 0; d < num_datasets; ++d) total += sizes[d];
+
+  std::vector<int64_t> counts(num_datasets, 0);
+  std::vector<bool> live(num_datasets, true);
+  std::vector<double> weights(num_datasets);
+  for (int32_t d = 0; d < num_datasets; ++d)
+    weights[d] = static_cast<double>(sizes[d]) / static_cast<double>(total);
+
+  for (int64_t i = 0; i < total; ++i) {
+    double step = static_cast<double>(i < 1 ? 1 : i);
+    int32_t argmax = -1;
+    double err_max = -1.0e300;
+    for (int32_t d = 0; d < num_datasets; ++d) {
+      if (!live[d]) continue;
+      double err = weights[d] * step - static_cast<double>(counts[d]);
+      if (err > err_max) {
+        err_max = err;
+        argmax = d;
+      }
+    }
+    dataset_index[i] = static_cast<int16_t>(argmax);
+    dataset_sample_index[i] = counts[argmax];
+    if (++counts[argmax] == sizes[argmax]) live[argmax] = false;
+  }
+}
+
+}  // extern "C"

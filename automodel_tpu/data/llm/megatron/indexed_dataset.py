@@ -1,0 +1,121 @@
+"""Megatron MMapIndexedDataset format — reader and writer
+(reference megatron/indexed_dataset.py).
+
+The on-disk format is the public Megatron-LM layout, kept bit-compatible so corpora
+tokenized for GPU training load here unchanged (the same day-0 interop argument as HF
+safetensors):
+
+``.idx``: magic ``MMIDIDX\\x00\\x00`` | u64 version=1 | u8 dtype code |
+          u64 sequence_count | u64 document_count |
+          i32 sizes[sequence_count] | i64 pointers[sequence_count] |
+          i64 doc_idx[document_count+1]
+``.bin``: raw token values, row-major.
+
+dtype codes follow Megatron: 1=u8 2=i8 3=i16 4=i32 5=i64 6=f32 7=f64 8=u16.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["MMapIndexedDataset", "MMapIndexedDatasetBuilder", "DTYPE_CODES"]
+
+_MAGIC = b"MMIDIDX\x00\x00"
+DTYPE_CODES = {
+    1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+    5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16,
+}
+_CODE_FOR = {np.dtype(v): k for k, v in DTYPE_CODES.items()}
+
+
+def _idx_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+def _bin_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+class MMapIndexedDataset:
+    """Zero-copy reader: tokens stay in the OS page cache via np.memmap."""
+
+    def __init__(self, path_prefix: str):
+        self.path_prefix = path_prefix
+        with open(_idx_path(path_prefix), "rb") as f:
+            magic = f.read(9)
+            if magic != _MAGIC:
+                raise ValueError(f"{_idx_path(path_prefix)}: bad magic {magic!r}")
+            (version,) = struct.unpack("<Q", f.read(8))
+            if version != 1:
+                raise ValueError(f"unsupported index version {version}")
+            (code,) = struct.unpack("<B", f.read(1))
+            self.dtype = np.dtype(DTYPE_CODES[code])
+            (seq_count,) = struct.unpack("<Q", f.read(8))
+            (doc_count,) = struct.unpack("<Q", f.read(8))
+            offset = f.tell()
+        idx_buffer = np.memmap(_idx_path(path_prefix), mode="r", order="C")
+        self.sizes = np.frombuffer(idx_buffer, np.int32, count=seq_count, offset=offset)
+        offset += seq_count * 4
+        self.pointers = np.frombuffer(idx_buffer, np.int64, count=seq_count, offset=offset)
+        offset += seq_count * 8
+        self.document_indices = np.frombuffer(idx_buffer, np.int64, count=doc_count + 1, offset=offset)
+        self._bin = np.memmap(_bin_path(path_prefix), mode="r", order="C")
+
+    def __len__(self) -> int:
+        return len(self.sizes)
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        return self.get(idx)
+
+    def get(self, idx: int, offset: int = 0, length: int | None = None) -> np.ndarray:
+        """Tokens of sequence ``idx`` starting at ``offset`` (in tokens)."""
+        size = int(self.sizes[idx]) - offset
+        if length is not None:
+            size = min(size, length)
+        byte_start = int(self.pointers[idx]) + offset * self.dtype.itemsize
+        return np.frombuffer(self._bin, self.dtype, count=size, offset=byte_start)
+
+    @property
+    def num_tokens(self) -> int:
+        return int(self.sizes.sum())
+
+    @staticmethod
+    def exists(path_prefix: str) -> bool:
+        return os.path.exists(_idx_path(path_prefix)) and os.path.exists(_bin_path(path_prefix))
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer; ``add_document`` per tokenized doc, then ``finalize``."""
+
+    def __init__(self, path_prefix: str, dtype=np.int32):
+        self.path_prefix = path_prefix
+        self.dtype = np.dtype(dtype)
+        self._bin = open(_bin_path(path_prefix), "wb")
+        self.sizes: list[int] = []
+        self.doc_indices: list[int] = [0]
+        self._offset = 0
+
+    def add_document(self, tokens: np.ndarray) -> None:
+        arr = np.ascontiguousarray(tokens, dtype=self.dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self.sizes.append(len(arr))
+        self.doc_indices.append(len(self.sizes))
+        self._offset += arr.nbytes
+
+    def finalize(self) -> None:
+        self._bin.close()
+        sizes = np.asarray(self.sizes, np.int32)
+        pointers = np.zeros(len(sizes), np.int64)
+        np.cumsum(sizes[:-1].astype(np.int64) * self.dtype.itemsize, out=pointers[1:])
+        with open(_idx_path(self.path_prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<B", _CODE_FOR[self.dtype]))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(self.doc_indices) - 1))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(np.asarray(self.doc_indices, np.int64).tobytes(order="C"))

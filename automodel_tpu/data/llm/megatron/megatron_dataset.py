@@ -1,0 +1,113 @@
+"""YAML-facing Megatron pretraining dataset (reference megatron_dataset.py:33
+MegatronPretraining).
+
+One `_target_` wires blended indexed corpora into the recipe:
+
+.. code-block:: yaml
+
+    dataset:
+      _target_: automodel_tpu.data.llm.megatron.MegatronPretraining
+      paths: [0.7, /data/corpusA, 0.3, /data/corpusB]   # or [prefix, ...]
+      seq_length: 4096
+      split: "900,50,50"
+      num_samples: 1000000
+      index_mapping_dir: /data/idx_cache
+
+Splits are document-range partitions of each corpus (Megatron convention): the
+split string "900,50,50" assigns document fractions to train/valid/test, and the
+requested ``split_name`` selects which partition this instance serves.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from automodel_tpu.data.llm.megatron.blended import BlendedDataset, normalize_weights, parse_blend
+from automodel_tpu.data.llm.megatron.gpt_dataset import GPTDataset
+from automodel_tpu.data.llm.megatron.indexed_dataset import MMapIndexedDataset
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["MegatronPretraining", "parse_split"]
+
+_SPLIT_NAMES = ("train", "validation", "test")
+
+
+def parse_split(split: str | list) -> list[float]:
+    """"900,50,50" -> [0.9, 0.05, 0.05] (reference parse_and_normalize_split)."""
+    if isinstance(split, str):
+        parts = [float(s) for s in split.split(",") if s.strip()]
+    else:
+        parts = [float(s) for s in split]
+    parts = (parts + [0.0] * 3)[:3]
+    if sum(parts) <= 0 or any(p < 0 for p in parts):
+        raise ValueError(f"invalid split {split!r}")
+    total = sum(parts)
+    return [p / total for p in parts]
+
+
+class MegatronPretraining:
+    """Map-style dataset over blended document-split GPT corpora."""
+
+    def __init__(
+        self,
+        paths: list,
+        seq_length: int,
+        split: str = "900,50,50",
+        split_name: str = "train",
+        num_samples: int | None = None,
+        seed: int = 1234,
+        index_mapping_dir: str | None = None,
+    ):
+        if split_name not in _SPLIT_NAMES:
+            raise ValueError(f"split_name must be one of {_SPLIT_NAMES}, got {split_name!r}")
+        weights, prefixes = parse_blend(paths)
+        fractions = parse_split(split)
+        split_i = _SPLIT_NAMES.index(split_name)
+
+        components: list[GPTDataset] = []
+        for prefix in prefixes:
+            indexed = MMapIndexedDataset(prefix)
+            n_docs = len(indexed)
+            bounds = np.cumsum([0.0] + fractions)
+            lo = int(round(bounds[split_i] * n_docs))
+            hi = int(round(bounds[split_i + 1] * n_docs))
+            if hi <= lo:
+                raise ValueError(
+                    f"{prefix}: split {split_name} selects no documents "
+                    f"({n_docs} docs, fractions {fractions})"
+                )
+            docs = np.arange(lo, hi, dtype=np.int64)
+            # per-component sample budget proportional to its weight
+            comp_samples = None
+            if num_samples is not None:
+                w = normalize_weights(weights)
+                comp_samples = max(int(np.ceil(num_samples * w[len(components)])), 1)
+            components.append(
+                GPTDataset(
+                    indexed, seq_length,
+                    num_samples=comp_samples,
+                    seed=seed + split_i,  # distinct index streams per split
+                    cache_dir=index_mapping_dir,
+                    documents=docs,
+                )
+            )
+
+        if len(components) == 1:
+            self.dataset = components[0]
+        elif num_samples is not None:
+            self.dataset = BlendedDataset(components, weights=weights, size=num_samples)
+        else:
+            self.dataset = BlendedDataset(components)  # exhaustive
+        logger.info(
+            "megatron pretraining: %d corpora, split=%s, %d samples",
+            len(components), split_name, len(self.dataset),
+        )
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def __getitem__(self, idx: int):
+        return self.dataset[idx]

@@ -28,7 +28,7 @@ from typing import Any, Iterable, Mapping, Sequence
 
 import numpy as np
 
-from automodel_tpu.data.collate import IGNORE_INDEX, shift_example
+from automodel_tpu.data.collate import IGNORE_INDEX, shift_example, stack_batches
 
 logger = logging.getLogger(__name__)
 
@@ -127,7 +127,6 @@ def pack_dataset(
     return PackedDataset(packs, packed_sequence_size)
 
 
-def packed_collate(examples: Sequence[Mapping[str, np.ndarray]], **_ignored) -> dict[str, np.ndarray]:
+def packed_collate(examples: Sequence[Mapping[str, np.ndarray]]) -> dict[str, np.ndarray]:
     """Packs are pre-collated rows; a batch is just a stack."""
-    keys = examples[0].keys()
-    return {k: np.stack([np.asarray(e[k]) for e in examples]) for k in keys}
+    return stack_batches(examples)

@@ -1,0 +1,82 @@
+"""Megatron-data pretraining through the full recipe (reference llm_pretrain
+functional scenario): build a real .bin/.idx corpus, train via the YAML path,
+loss must fall."""
+
+import json
+import textwrap
+
+import numpy as np
+
+from automodel_tpu.config.loader import load_config
+from automodel_tpu.data.llm.megatron.indexed_dataset import MMapIndexedDatasetBuilder
+from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+
+
+def _build_corpus(tmp_path, vocab=128, n_docs=200, seed=0):
+    """Learnable synthetic corpus: token t+1 = (t*3+1) mod vocab within a doc."""
+    prefix = str(tmp_path / "corpus")
+    rng = np.random.default_rng(seed)
+    builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    for _ in range(n_docs):
+        n = int(rng.integers(20, 60))
+        start = int(rng.integers(0, vocab))
+        doc = np.empty(n, np.int32)
+        doc[0] = start
+        for i in range(1, n):
+            doc[i] = (doc[i - 1] * 3 + 1) % vocab
+        builder.add_document(doc)
+    builder.finalize()
+    return prefix
+
+
+def test_megatron_pretrain_loss_decreases(tmp_path, cpu_devices):
+    prefix = _build_corpus(tmp_path)
+    cfg_text = f"""
+    seed: 7
+    output_dir: {tmp_path}/out
+    model:
+      config:
+        architectures: [LlamaForCausalLM]
+        vocab_size: 128
+        hidden_size: 64
+        intermediate_size: 128
+        num_hidden_layers: 2
+        num_attention_heads: 4
+        num_key_value_heads: 2
+        max_position_embeddings: 128
+    distributed:
+      dp_shard: 8
+    backend:
+      dtype: float32
+    dataset:
+      _target_: automodel_tpu.data.llm.megatron.MegatronPretraining
+      paths: [{prefix}]
+      seq_length: 32
+      split: "80,10,10"
+      split_name: train
+      num_samples: 512
+      index_mapping_dir: {tmp_path}/idx
+    micro_batch_size: 8
+    seq_len: 32
+    step_scheduler:
+      grad_acc_steps: 1
+      max_steps: 12
+      num_epochs: 4
+      handle_sigterm: false
+    optimizer:
+      lr: 3.0e-2
+      max_grad_norm: 1.0
+    lr_scheduler:
+      lr_warmup_steps: 2
+    checkpoint:
+      enabled: false
+    """
+    p = tmp_path / "cfg.yaml"
+    p.write_text(textwrap.dedent(cfg_text))
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(load_config(p)).setup()
+    recipe.run_train_validation_loop()
+    rows = [json.loads(line) for line in open(tmp_path / "out" / "training.jsonl")]
+    losses = [r["loss"] for r in rows]
+    assert losses[0] > 4.0
+    # the corpus is a deterministic affine map: a 2-layer model learns it fast
+    assert losses[-1] < losses[0] - 1.0

@@ -1,0 +1,201 @@
+"""Megatron pretraining data stack tests (reference tests for megatron/ + nanogpt).
+
+Covers: .bin/.idx roundtrip, C++-vs-NumPy index builder parity, GPT sample
+construction invariants, blending proportionality, split partitioning, nanogpt
+shard streaming."""
+
+import numpy as np
+import pytest
+
+from automodel_tpu.data.llm.megatron.blended import BlendedDataset, parse_blend
+from automodel_tpu.data.llm.megatron.gpt_dataset import GPTDataset
+from automodel_tpu.data.llm.megatron.helpers import (
+    _sample_idx_numpy,
+    build_blending_indices,
+    build_exhaustive_blending_indices,
+    build_sample_idx,
+    native_available,
+)
+from automodel_tpu.data.llm.megatron.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+)
+from automodel_tpu.data.llm.megatron.megatron_dataset import MegatronPretraining, parse_split
+from automodel_tpu.data.llm.nanogpt_dataset import NanogptDataset, peek_num_tokens, write_shard
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    """20 documents of varying lengths, tokens encode (doc_id, position)."""
+    prefix = str(tmp_path / "corpus")
+    builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    rng = np.random.default_rng(0)
+    docs = []
+    for d in range(20):
+        n = int(rng.integers(5, 40))
+        doc = (d * 1000 + np.arange(n)).astype(np.int32)
+        docs.append(doc)
+        builder.add_document(doc)
+    builder.finalize()
+    return prefix, docs
+
+
+class TestIndexedDataset:
+    def test_roundtrip(self, corpus):
+        prefix, docs = corpus
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == len(docs)
+        for i in (0, 7, 19):
+            np.testing.assert_array_equal(ds[i], docs[i])
+        np.testing.assert_array_equal(ds.get(3, offset=2, length=4), docs[3][2:6])
+        assert ds.num_tokens == sum(len(d) for d in docs)
+        assert MMapIndexedDataset.exists(prefix)
+
+    def test_bad_magic(self, tmp_path):
+        p = tmp_path / "x.idx"
+        p.write_bytes(b"NOTMAGIC!!")
+        (tmp_path / "x.bin").write_bytes(b"")
+        with pytest.raises(ValueError, match="bad magic"):
+            MMapIndexedDataset(str(tmp_path / "x"))
+
+
+class TestIndexHelpers:
+    def test_native_builds(self):
+        assert native_available(), "g++ should be present in this image"
+
+    def test_sample_idx_native_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        sizes = rng.integers(3, 50, size=30).astype(np.int32)
+        doc_idx = rng.permutation(np.repeat(np.arange(30, dtype=np.int64), 3))
+        got = build_sample_idx(sizes, doc_idx, seq_length=16, num_samples=40)
+        want = _sample_idx_numpy(sizes, doc_idx, 16, 40)
+        np.testing.assert_array_equal(got, want)
+
+    def test_sample_idx_spans_cover_seq_length(self):
+        sizes = np.asarray([10, 7, 25, 13], np.int32)
+        doc_idx = np.asarray([2, 0, 3, 1, 2, 0], np.int64)
+        seq = 8
+        idx = build_sample_idx(sizes, doc_idx, seq, 5)
+        # each consecutive pair spans exactly seq tokens (token-position arithmetic)
+        cum = np.cumsum([0] + [int(sizes[d]) for d in doc_idx])
+        for i in range(len(idx) - 1):
+            t0 = cum[idx[i][0]] + idx[i][1]
+            t1 = cum[idx[i + 1][0]] + idx[i + 1][1]
+            assert t1 - t0 == seq
+
+    def test_blending_tracks_weights(self):
+        w = np.asarray([0.5, 0.3, 0.2])
+        d_idx, s_idx = build_blending_indices(w, 1000)
+        counts = np.bincount(d_idx, minlength=3)
+        np.testing.assert_allclose(counts / 1000, w, atol=0.01)
+        # sample indices are per-dataset sequential
+        for d in range(3):
+            np.testing.assert_array_equal(np.sort(s_idx[d_idx == d]), np.arange(counts[d]))
+
+    def test_exhaustive_blending_exact(self):
+        sizes = np.asarray([10, 5, 3], np.int64)
+        d_idx, s_idx = build_exhaustive_blending_indices(sizes)
+        assert len(d_idx) == 18
+        np.testing.assert_array_equal(np.bincount(d_idx, minlength=3), sizes)
+
+
+class TestGPTDataset:
+    def test_sample_shapes_and_determinism(self, corpus, tmp_path):
+        prefix, _ = corpus
+        ds1 = GPTDataset(prefix, seq_length=32, num_samples=50, seed=7)
+        ds2 = GPTDataset(prefix, seq_length=32, num_samples=50, seed=7)
+        assert len(ds1) >= 1
+        for i in (0, len(ds1) - 1):
+            s1, s2 = ds1[i], ds2[i]
+            assert s1["input_ids"].shape == (33,)
+            np.testing.assert_array_equal(s1["input_ids"], s2["input_ids"])
+        ds3 = GPTDataset(prefix, seq_length=32, num_samples=50, seed=8)
+        assert any(
+            not np.array_equal(ds1[i]["input_ids"], ds3[i]["input_ids"]) for i in range(5)
+        )
+
+    def test_samples_are_contiguous_token_stream(self, corpus):
+        """Tokens inside one sample follow document order: within a document the
+        (doc*1000+pos) encoding increments by 1."""
+        prefix, _ = corpus
+        ds = GPTDataset(prefix, seq_length=16, num_samples=30, seed=3)
+        s = ds[0]["input_ids"]
+        diffs = np.diff(s)
+        # either +1 (same doc) or a jump (document boundary)
+        assert ((diffs == 1) | (np.abs(diffs) > 1)).all()
+        assert (diffs == 1).sum() >= len(diffs) // 2  # mostly contiguous
+
+    def test_index_cache(self, corpus, tmp_path):
+        prefix, _ = corpus
+        cache = str(tmp_path / "idxcache")
+        ds1 = GPTDataset(prefix, seq_length=16, num_samples=20, seed=5, cache_dir=cache)
+        ds2 = GPTDataset(prefix, seq_length=16, num_samples=20, seed=5, cache_dir=cache)
+        np.testing.assert_array_equal(ds1[3]["input_ids"], ds2[3]["input_ids"])
+
+    def test_document_subset(self, corpus):
+        prefix, _ = corpus
+        docs = np.arange(0, 5, dtype=np.int64)
+        ds = GPTDataset(prefix, seq_length=8, num_samples=10, documents=docs)
+        for i in range(len(ds)):
+            assert (ds[i]["input_ids"] < 5000).all()  # doc ids 0-4 encode < 5000
+
+
+class TestBlendedAndSplits:
+    def test_parse_blend(self):
+        assert parse_blend(["/a", "/b"]) == ([1.0, 1.0], ["/a", "/b"])
+        assert parse_blend([0.7, "/a", 0.3, "/b"]) == ([0.7, 0.3], ["/a", "/b"])
+
+    def test_parse_split(self):
+        assert parse_split("900,50,50") == [0.9, 0.05, 0.05]
+        with pytest.raises(ValueError):
+            parse_split("0,0,0")
+
+    def test_blended_dataset(self, corpus, tmp_path):
+        prefix, _ = corpus
+        a = GPTDataset(prefix, seq_length=8, num_samples=20, seed=1)
+        b = GPTDataset(prefix, seq_length=8, num_samples=20, seed=2)
+        blend = BlendedDataset([a, b], weights=[0.75, 0.25], size=40)
+        assert len(blend) == 40
+        counts = np.bincount(blend.dataset_index, minlength=2)
+        assert counts[0] > counts[1]
+        assert blend[0]["input_ids"].shape == (9,)
+
+    def test_megatron_pretraining_splits_disjoint(self, corpus, tmp_path):
+        prefix, _ = corpus
+        train = MegatronPretraining([prefix], seq_length=8, split="50,25,25",
+                                    split_name="train", num_samples=20)
+        val = MegatronPretraining([prefix], seq_length=8, split="50,25,25",
+                                  split_name="validation", num_samples=10)
+        train_docs = {int(t) // 1000 for i in range(len(train)) for t in train[i]["input_ids"]}
+        val_docs = {int(t) // 1000 for i in range(len(val)) for t in val[i]["input_ids"]}
+        assert train_docs.isdisjoint(val_docs)
+
+
+class TestNanogpt:
+    def test_shard_roundtrip_and_sampling(self, tmp_path):
+        tokens = np.arange(1000, dtype=np.uint16)
+        shard1 = str(tmp_path / "a_000.bin")
+        shard2 = str(tmp_path / "a_001.bin")
+        write_shard(shard1, tokens[:600])
+        write_shard(shard2, tokens[600:])
+        assert peek_num_tokens(shard1) == 600
+        ds = NanogptDataset(str(tmp_path / "a_*.bin"), seq_len=64)
+        assert len(ds) == (1000 - 1) // 64
+        s0 = ds[0]["input_ids"]
+        np.testing.assert_array_equal(s0, np.arange(65))
+        # sample crossing the shard boundary reads both shards
+        cross = ds[9]["input_ids"]  # tokens 576..640
+        np.testing.assert_array_equal(cross, np.arange(9 * 64, 9 * 64 + 65))
+
+    def test_bos_alignment(self, tmp_path):
+        bos = 999
+        toks = []
+        for start in (0, 40, 100, 170):
+            toks.append([bos])
+            toks.append(list(range(1, 30)))
+        flat = np.asarray([t for chunk in toks for t in chunk], np.uint16)
+        shard = str(tmp_path / "b_000.bin")
+        write_shard(shard, flat)
+        ds = NanogptDataset(shard, seq_len=16, align_to_bos=True, bos_token=bos)
+        s = ds[1]["input_ids"]
+        assert s[0] == bos  # window snapped to a document start
