@@ -1,0 +1,57 @@
+"""OpenAI-format chat dataset (reference datasets/llm/chat_dataset.py ChatDataset).
+
+Rows hold a ``messages`` list (`[{"role": ..., "content": ...}, ...]`); tokenization
+goes through the tokenizer's chat template with loss restricted to assistant spans
+(data/llm/formatting.py). Accepts local json/jsonl files or HF dataset ids.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+from automodel_tpu.data.llm.column_mapped import _load_rows
+from automodel_tpu.data.llm.formatting import format_chat_messages
+
+__all__ = ["ChatDataset"]
+
+_VALID_ROLES = {"system", "user", "assistant", "tool"}
+
+
+def _normalize_messages(messages: Sequence[Mapping[str, Any]]) -> list[dict[str, Any]]:
+    out = []
+    for m in messages:
+        role = m.get("role")
+        if role not in _VALID_ROLES:
+            raise ValueError(f"invalid chat role {role!r}")
+        msg = dict(m)
+        if role in ("system", "user", "assistant") and not isinstance(m.get("content"), str):
+            msg["content"] = "" if m.get("content") is None else str(m["content"])
+        out.append(msg)
+    return out
+
+
+class ChatDataset:
+    def __init__(
+        self,
+        path_or_dataset_id: str,
+        tokenizer=None,
+        split: str | None = None,
+        messages_column: str = "messages",
+        limit_dataset_samples: int | None = None,
+        answer_only_loss: bool = True,
+    ):
+        self.rows = _load_rows(path_or_dataset_id, split)
+        if limit_dataset_samples:
+            self.rows = self.rows[:limit_dataset_samples]
+        self.tokenizer = tokenizer
+        self.messages_column = messages_column
+        self.answer_only = answer_only_loss
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __getitem__(self, i: int) -> dict[str, Any]:
+        if self.tokenizer is None:
+            raise ValueError("tokenizer required to materialize chat examples")
+        messages = _normalize_messages(self.rows[i][self.messages_column])
+        return format_chat_messages(self.tokenizer, messages, self.answer_only)

@@ -15,7 +15,7 @@ from typing import Any, Mapping
 __all__ = ["ColumnMappedTextInstructionDataset"]
 
 
-def _load_rows(path_or_name: str, split: str | None) -> list[dict]:
+def _load_rows(path_or_name: str, split: str | None, config_name: str | None = None) -> list[dict]:
     if os.path.exists(path_or_name):
         rows = []
         with open(path_or_name) as f:
@@ -28,10 +28,12 @@ def _load_rows(path_or_name: str, split: str | None) -> list[dict]:
                 data = json.load(f)
                 rows = data if isinstance(data, list) else data["data"]
         return rows
-    # fall back to HF datasets hub (needs network or local cache)
+    # fall back to HF datasets hub (needs network or local cache); config_name is
+    # the hub subset name (e.g. load_dataset("nyu-mll/glue", "mrpc"))
     import datasets as hf_datasets
 
-    ds = hf_datasets.load_dataset(path_or_name, split=split or "train")
+    args = (path_or_name, config_name) if config_name else (path_or_name,)
+    ds = hf_datasets.load_dataset(*args, split=split or "train")
     return list(ds)
 
 

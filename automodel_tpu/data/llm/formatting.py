@@ -1,0 +1,104 @@
+"""Shared SFT formatting/tokenization (reference datasets/llm/formatting_utils.py).
+
+Two entry shapes, both returning our collate contract
+``{"input_ids", "labels" | "prompt_len"}``:
+
+- :func:`format_prompt_completion` — plain prompt+answer with prompt-span masking;
+- :func:`format_chat_messages` — OpenAI-style ``messages`` through the tokenizer's
+  chat template, with loss restricted to assistant spans via incremental prefix
+  tokenization (the reference computes the same spans by re-tokenizing truncated
+  message lists, formatting_utils.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Mapping, Sequence
+
+logger = logging.getLogger(__name__)
+
+IGNORE_INDEX = -100
+
+__all__ = ["format_prompt_completion", "format_chat_messages", "IGNORE_INDEX"]
+
+
+def format_prompt_completion(
+    tokenizer,
+    prompt: str,
+    answer: str,
+    add_eos: bool = True,
+    answer_only_loss: bool = True,
+) -> dict[str, Any]:
+    """Tokenize prompt+answer; ``prompt_len`` marks the masked span for collate."""
+    prompt_ids = tokenizer.encode(prompt)
+    full_ids = tokenizer.encode(prompt + answer)
+    eos = getattr(tokenizer, "eos_token_id", None)
+    if add_eos and eos is not None and (not full_ids or full_ids[-1] != eos):
+        full_ids = full_ids + [eos]
+    if full_ids[: len(prompt_ids)] != prompt_ids:
+        # tokenizer merged across the boundary; recompute the prompt span by the
+        # longest common prefix so masking never leaks answer tokens into the loss
+        n = 0
+        for a, b in zip(prompt_ids, full_ids):
+            if a != b:
+                break
+            n += 1
+        prompt_len = n
+    else:
+        prompt_len = len(prompt_ids)
+    ex: dict[str, Any] = {"input_ids": full_ids}
+    if answer_only_loss:
+        ex["prompt_len"] = prompt_len
+    return ex
+
+
+def _apply_chat_template(tokenizer, messages: Sequence[Mapping[str, Any]], **kw) -> list[int]:
+    return list(tokenizer.apply_chat_template(messages, tokenize=True, **kw))
+
+
+def format_chat_messages(
+    tokenizer,
+    messages: Sequence[Mapping[str, Any]],
+    answer_only_loss: bool = True,
+) -> dict[str, Any]:
+    """messages -> {"input_ids", "labels"} with loss on assistant spans only.
+
+    Works for any number of turns: for each assistant message i, the tokens between
+    template(messages[:i]+generation prompt) and template(messages[:i+1]) carry loss.
+    """
+    if not hasattr(tokenizer, "apply_chat_template") or tokenizer.chat_template is None:
+        # no template: fall back to role-prefixed text with loss on assistant turns
+        text_parts, spans, pos = [], [], 0
+        for m in messages:
+            part = f"{m['role']}: {m['content']}\n"
+            ids = tokenizer.encode(part) if pos == 0 else tokenizer.encode(part, add_special_tokens=False)
+            if m["role"] == "assistant":
+                spans.append((pos, pos + len(ids)))
+            text_parts.extend(ids)
+            pos += len(ids)
+        labels = [IGNORE_INDEX] * len(text_parts)
+        for lo, hi in spans:
+            labels[lo:hi] = text_parts[lo:hi]
+        return {"input_ids": text_parts, "labels": labels}
+
+    full_ids = _apply_chat_template(tokenizer, messages)
+    labels = [IGNORE_INDEX] * len(full_ids)
+    if not answer_only_loss:
+        return {"input_ids": full_ids, "labels": list(full_ids)}
+    for i, m in enumerate(messages):
+        if m.get("role") != "assistant":
+            continue
+        # prefix WITH generation prompt marks where the assistant span starts;
+        # prefix including message i marks where it ends
+        try:
+            start_ids = _apply_chat_template(
+                tokenizer, list(messages[:i]), add_generation_prompt=True
+            )
+        except Exception:
+            start_ids = _apply_chat_template(tokenizer, list(messages[:i]))
+        end_ids = _apply_chat_template(tokenizer, list(messages[: i + 1]))
+        lo, hi = len(start_ids), len(end_ids)
+        # templates may append a trailing newline/eos after the turn; clamp to range
+        lo, hi = min(lo, len(full_ids)), min(hi, len(full_ids))
+        labels[lo:hi] = full_ids[lo:hi]
+    return {"input_ids": full_ids, "labels": labels}

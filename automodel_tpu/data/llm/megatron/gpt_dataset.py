@@ -99,8 +99,11 @@ class GPTDataset:
         h = hashlib.md5()
         h.update(
             f"{self.indexed.path_prefix}|{self.seq_length}|{self.num_samples}|"
-            f"{self.seed}|{num_epochs}|{separate_final}|{len(self.documents)}".encode()
+            f"{self.seed}|{num_epochs}|{separate_final}|".encode()
         )
+        # hash the document-id content, not just its length: different splits of
+        # equal size must never collide (silent train/eval contamination otherwise)
+        h.update(np.ascontiguousarray(self.documents).tobytes())
         return h.hexdigest()[:16]
 
     def _load_or_build(self, num_epochs: int, separate_final: bool, cache_dir: str | None):
@@ -127,9 +130,13 @@ class GPTDataset:
         self.shuffle_index = shuffle_index
         if cache_dir:
             os.makedirs(cache_dir, exist_ok=True)
-            np.save(paths["doc"], real_doc_index)
-            np.save(paths["sample"], sample_index)
-            np.save(paths["shuffle"], shuffle_index)
+            # atomic publish: rank-parallel builders may race on the same key; a
+            # reader must never see a torn .npy (write-to-temp + rename)
+            for name, arr in (("doc", real_doc_index), ("sample", sample_index),
+                              ("shuffle", shuffle_index)):
+                tmp = paths[name] + f".tmp{os.getpid()}.npy"  # .npy: np.save appends otherwise
+                np.save(tmp, arr)
+                os.replace(tmp, paths[name])
             logger.info("cached gpt indices under %s (%s)", cache_dir, key)
 
     # -- access --------------------------------------------------------------

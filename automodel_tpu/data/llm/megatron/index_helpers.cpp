@@ -97,10 +97,12 @@ void build_exhaustive_blending_indices(int16_t* dataset_index,
   for (int32_t d = 0; d < num_datasets; ++d) total += sizes[d];
 
   std::vector<int64_t> counts(num_datasets, 0);
-  std::vector<bool> live(num_datasets, true);
+  std::vector<bool> live(num_datasets);
   std::vector<double> weights(num_datasets);
-  for (int32_t d = 0; d < num_datasets; ++d)
+  for (int32_t d = 0; d < num_datasets; ++d) {
+    live[d] = sizes[d] > 0;  // empty components never receive samples
     weights[d] = static_cast<double>(sizes[d]) / static_cast<double>(total);
+  }
 
   for (int64_t i = 0; i < total; ++i) {
     double step = static_cast<double>(i < 1 ? 1 : i);

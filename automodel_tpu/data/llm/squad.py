@@ -1,0 +1,63 @@
+"""SQuAD QA finetuning dataset (reference datasets/llm/squad.py make_squad_dataset).
+
+Same prompt format as the reference (``Context: .. Question: .. Answer:``), loadable
+from the HF hub or a local json/jsonl file with SQuAD-shaped rows; optional
+chat-template formatting when the tokenizer carries one.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from automodel_tpu.data.llm.column_mapped import _load_rows
+from automodel_tpu.data.llm.formatting import format_chat_messages, format_prompt_completion
+
+__all__ = ["SquadDataset", "make_squad_dataset"]
+
+
+def _row_answer(row: dict) -> str:
+    ans = row.get("answers")
+    if isinstance(ans, dict):
+        texts = ans.get("text") or []
+        return str(texts[0]).strip() if texts else ""
+    return str(ans or "").strip()
+
+
+class SquadDataset:
+    def __init__(
+        self,
+        tokenizer,
+        path_or_dataset_id: str = "squad",
+        split: str = "train",
+        limit_dataset_samples: int | None = None,
+        use_chat_template: bool = False,
+        answer_only_loss: bool = True,
+    ):
+        self.rows = _load_rows(path_or_dataset_id, split)
+        if limit_dataset_samples:
+            self.rows = self.rows[:limit_dataset_samples]
+        self.tokenizer = tokenizer
+        self.use_chat_template = use_chat_template
+        self.answer_only = answer_only_loss
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __getitem__(self, i: int) -> dict[str, Any]:
+        row = self.rows[i]
+        prompt = f"Context: {row.get('context', '')} Question: {row.get('question', '')} Answer: "
+        answer = _row_answer(row)
+        if self.use_chat_template:
+            return format_chat_messages(
+                self.tokenizer,
+                [{"role": "user", "content": prompt}, {"role": "assistant", "content": answer}],
+                answer_only_loss=self.answer_only,
+            )
+        return format_prompt_completion(
+            self.tokenizer, prompt, answer, answer_only_loss=self.answer_only
+        )
+
+
+def make_squad_dataset(tokenizer, **kwargs) -> SquadDataset:
+    """Factory matching the reference's callable-style YAML usage."""
+    return SquadDataset(tokenizer, **kwargs)

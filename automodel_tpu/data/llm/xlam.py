@@ -1,0 +1,109 @@
+"""xLAM function-calling dataset (reference datasets/llm/xlam.py make_xlam_dataset).
+
+Rows carry ``query`` / ``answers`` (tool calls) / ``tools`` (schemas), possibly as
+JSON strings. Tools convert to OpenAI function schemas fed to the chat template; the
+assistant turn carries the tool calls, and only it takes loss.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from automodel_tpu.data.llm.column_mapped import _load_rows
+from automodel_tpu.data.llm.formatting import IGNORE_INDEX, format_chat_messages
+
+__all__ = ["XlamDataset", "make_xlam_dataset"]
+
+
+def _json_load_if_str(v):
+    return json.loads(v) if isinstance(v, str) else v
+
+
+def convert_tools(raw_tools: list[dict]) -> list[dict]:
+    """Dataset tool specs -> OpenAI function schema (reference _convert_tools)."""
+    tools = []
+    for tool in raw_tools or []:
+        params_raw = _json_load_if_str(tool.get("parameters")) or {}
+        properties = {}
+        for name, p in params_raw.items():
+            p = p or {}
+            properties[name] = {
+                "type": p.get("type", "string"),
+                "description": p.get("description", ""),
+            }
+        tools.append(
+            {
+                "type": "function",
+                "function": {
+                    "name": tool.get("name", ""),
+                    "description": tool.get("description", ""),
+                    "parameters": {"type": "object", "properties": properties},
+                },
+            }
+        )
+    return tools
+
+
+def convert_tool_calls(raw_calls: list[dict]) -> list[dict]:
+    """answers -> OpenAI tool_calls with JSON-string arguments."""
+    calls = []
+    for i, call in enumerate(raw_calls or []):
+        args = call.get("arguments", {})
+        calls.append(
+            {
+                "id": f"call_{i}",
+                "type": "function",
+                "function": {
+                    "name": call.get("name", ""),
+                    "arguments": args if isinstance(args, str) else json.dumps(args),
+                },
+            }
+        )
+    return calls
+
+
+class XlamDataset:
+    def __init__(
+        self,
+        tokenizer,
+        path_or_dataset_id: str = "Salesforce/xlam-function-calling-60k",
+        split: str = "train",
+        limit_dataset_samples: int | None = None,
+    ):
+        self.rows = _load_rows(path_or_dataset_id, split)
+        if limit_dataset_samples:
+            self.rows = self.rows[:limit_dataset_samples]
+        self.tokenizer = tokenizer
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __getitem__(self, i: int) -> dict[str, Any]:
+        row = self.rows[i]
+        tools = convert_tools(_json_load_if_str(row.get("tools")))
+        calls = convert_tool_calls(_json_load_if_str(row.get("answers")))
+        messages = [
+            {"role": "user", "content": str(row.get("query", ""))},
+            {"role": "assistant", "content": "", "tool_calls": calls},
+        ]
+        if hasattr(self.tokenizer, "apply_chat_template") and self.tokenizer.chat_template:
+            full = list(
+                self.tokenizer.apply_chat_template(messages, tools=tools, tokenize=True)
+            )
+            prefix = list(
+                self.tokenizer.apply_chat_template(
+                    messages[:1], tools=tools, tokenize=True, add_generation_prompt=True
+                )
+            )
+            labels = [IGNORE_INDEX] * len(full)
+            lo = min(len(prefix), len(full))
+            labels[lo:] = full[lo:]
+            return {"input_ids": full, "labels": labels}
+        # templateless fallback: serialize calls as JSON in the assistant turn
+        messages[-1] = {"role": "assistant", "content": json.dumps(calls)}
+        return format_chat_messages(self.tokenizer, messages)
+
+
+def make_xlam_dataset(tokenizer, **kwargs) -> XlamDataset:
+    return XlamDataset(tokenizer, **kwargs)

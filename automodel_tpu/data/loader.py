@@ -30,6 +30,11 @@ class DataLoader:
     ):
         if batch_size % process_count != 0:
             raise ValueError(f"batch_size {batch_size} not divisible by process_count {process_count}")
+        if drop_last and hasattr(dataset, "__len__") and len(dataset) < batch_size:
+            raise ValueError(
+                f"dataset has {len(dataset)} examples < batch_size {batch_size}: "
+                "every batch would be dropped (drop_last) and training would no-op"
+            )
         self.dataset = dataset
         self.batch_size = batch_size
         self.local_batch_size = batch_size // process_count

@@ -1,0 +1,114 @@
+"""Sequence classification on top of any causal family
+(reference NeMoAutoModelForSequenceClassification, _transformers/auto_model.py:650).
+
+Wraps a registered decoder: drop the LM head, add a ``score`` projection
+(hidden -> num_labels), pool the *last real token* per row (HF
+``LlamaForSequenceClassification`` convention) using segment ids.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.auto import AutoModelForCausalLM, load_hf_config
+from automodel_tpu.models.common.backend import BackendConfig
+
+__all__ = ["AutoModelForSequenceClassification", "SequenceClassifier"]
+
+
+class SequenceClassifier:
+    def __init__(self, base_model, num_labels: int):
+        self.base = base_model
+        self.config = base_model.config
+        self.num_labels = num_labels
+
+    # -- params -------------------------------------------------------------
+    def init(self, key: jax.Array, dtype=jnp.float32) -> dict:
+        k_base, k_head = jax.random.split(key)
+        params = self.base.init(k_base, dtype)
+        params.pop("lm_head", None)
+        params["score"] = (
+            jax.random.normal(k_head, (self.config.hidden_size, self.num_labels), jnp.float32)
+            * self.config.initializer_range
+        ).astype(dtype)
+        return params
+
+    def logical_axes(self) -> dict:
+        axes = self.base.logical_axes()
+        axes.pop("lm_head", None)
+        axes["score"] = ("embed", None)
+        return axes
+
+    # -- forward ------------------------------------------------------------
+    def __call__(self, params, input_ids, positions=None, segment_ids=None, rules=None):
+        base_params = {k: v for k, v in params.items() if k != "score"}
+        hidden = self.base(
+            params=base_params, input_ids=input_ids, positions=positions,
+            segment_ids=segment_ids, rules=rules, return_hidden=True,
+        )
+        if segment_ids is not None:
+            # last real token per row (HF pools the last non-pad token)
+            last = jnp.maximum((segment_ids != 0).sum(axis=1) - 1, 0)
+        else:
+            last = jnp.full((input_ids.shape[0],), input_ids.shape[1] - 1)
+        pooled = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
+        return pooled @ params["score"].astype(pooled.dtype)
+
+    # -- HF interop ---------------------------------------------------------
+    def state_dict_adapter(self):
+        return _SeqClsAdapter(self.base.state_dict_adapter())
+
+
+class _SeqClsAdapter:
+    """Base adapter + the ``score.weight`` head (HF seq-cls checkpoints)."""
+
+    def __init__(self, base_adapter):
+        self.base = base_adapter
+
+    def from_hf(self, tensors: dict, dtype=None):
+        score = tensors.pop("score.weight", None)
+        params = self.base.from_hf(tensors, dtype=dtype)
+        params.pop("lm_head", None)
+        if score is not None:
+            params["score"] = score.T.astype(dtype) if dtype else score.T
+        return params
+
+    def to_hf(self, params: dict) -> dict:
+        score = params.get("score")
+        tensors = self.base.to_hf({k: v for k, v in params.items() if k != "score"})
+        tensors.pop("lm_head.weight", None)
+        if score is not None:
+            tensors["score.weight"] = score.T
+        return tensors
+
+
+class AutoModelForSequenceClassification:
+    @classmethod
+    def from_config(cls, config: dict, num_labels: int | None = None,
+                    backend: BackendConfig | None = None) -> SequenceClassifier:
+        base = AutoModelForCausalLM.from_config(config, backend)
+        n = num_labels or int(config.get("num_labels", 2))
+        return SequenceClassifier(base, n)
+
+    @classmethod
+    def from_pretrained(cls, path: str, num_labels: int | None = None,
+                        backend: BackendConfig | None = None, dtype=jnp.bfloat16, rules=None):
+        from automodel_tpu.checkpoint.safetensors_io import load_safetensors
+        from automodel_tpu.models.auto import _np_dtype, _place
+
+        config = load_hf_config(path)
+        model = cls.from_config(config, num_labels, backend)
+        adapter = model.state_dict_adapter()
+        host = adapter.from_hf(load_safetensors(path), dtype=_np_dtype(dtype))
+        if "score" not in host:
+            # base checkpoint without a head: fresh-init the score matrix
+            import numpy as np
+
+            host["score"] = (
+                np.random.default_rng(0).normal(
+                    0, model.config.initializer_range,
+                    (model.config.hidden_size, model.num_labels),
+                ).astype(_np_dtype(dtype) or np.float32)
+            )
+        return model, _place(host, model, rules)

@@ -1,0 +1,117 @@
+"""Knowledge-distillation recipe (reference KnowledgeDistillationRecipeForNextTokenPrediction,
+recipes/llm/kd.py:145).
+
+A teacher model runs forward-only next to the student; the loss blends hard-label CE
+with forward-KL to the teacher's temperature-softened distribution:
+
+    loss = (1 - kd_ratio) * CE(student, labels) + kd_ratio * KL(teacher || student)
+
+The teacher rides through the jitted step as a *frozen* pytree argument (the same
+``with_frozen`` path PEFT uses) — no gradients, no optimizer state, donated nothing.
+
+YAML adds two sections to the finetune contract:
+
+.. code-block:: yaml
+
+    teacher_model:
+      pretrained_model_name_or_path: /path/to/teacher   # or config: {...}
+    kd: {temperature: 1.0, kd_ratio: 0.5}
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.config.cli_overrides import parse_args_and_load_config
+from automodel_tpu.models.auto import AutoModelForCausalLM, load_hf_config
+from automodel_tpu.ops.losses import kd_loss, masked_cross_entropy
+from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+from automodel_tpu.training.train_step import make_train_step
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["KnowledgeDistillationRecipe", "main"]
+
+
+class KnowledgeDistillationRecipe(TrainFinetuneRecipeForNextTokenPrediction):
+    def setup(self):
+        super().setup()
+        if self.peft is not None:
+            raise NotImplementedError("kd + peft composition is not wired yet")
+        return self
+
+    def _build_teacher(self):
+        cfg = self.cfg
+        t_cfg = cfg.get("teacher_model")
+        if t_cfg is None:
+            raise ValueError("kd recipe needs a teacher_model section")
+        pretrained = t_cfg.get("pretrained_model_name_or_path")
+        with self.mesh:
+            if pretrained:
+                self.teacher, self.teacher_params = AutoModelForCausalLM.from_pretrained(
+                    pretrained, backend=self.backend, dtype=jnp.float32, rules=self.rules
+                )
+            else:
+                model_cfg = t_cfg.get("config")
+                if model_cfg is None:
+                    raise ValueError("teacher_model needs pretrained_model_name_or_path or config")
+                hf = model_cfg.to_dict() if isinstance(model_cfg, ConfigNode) else dict(model_cfg)
+                self.teacher = AutoModelForCausalLM.from_config(hf, backend=self.backend)
+                shardings = self.rules.tree_sharding(self.teacher.logical_axes())
+                init_fn = jax.jit(lambda k: self.teacher.init(k, jnp.float32), out_shardings=shardings)
+                self.teacher_params = init_fn(self.rng.key("teacher_init"))
+        n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.teacher_params))
+        logger.info("teacher: %s (%.1fM params)", type(self.teacher).__name__, n / 1e6)
+
+    def _build_train_step(self):
+        if self.mesh_ctx.pp > 1:
+            raise NotImplementedError("kd + pp composition is not wired yet")
+        self._build_teacher()
+        temperature = float(self.cfg.get("kd.temperature", 1.0))
+        kd_ratio = float(self.cfg.get("kd.kd_ratio", 0.5))
+
+        def kd_forward(params, teacher_params, batch, num_label_tokens):
+            student_logits = self.model(
+                params, batch["input_ids"], positions=batch["positions"],
+                segment_ids=batch["segment_ids"], rules=self.rules,
+            )
+            teacher_logits = jax.lax.stop_gradient(
+                self.teacher(
+                    teacher_params, batch["input_ids"], positions=batch["positions"],
+                    segment_ids=batch["segment_ids"], rules=self.rules,
+                )
+            )
+            ce = masked_cross_entropy(student_logits, batch["labels"], num_label_tokens)
+            kd = kd_loss(
+                student_logits, teacher_logits, batch["labels"],
+                temperature=temperature, num_label_tokens=num_label_tokens,
+            )
+            return (1.0 - kd_ratio) * ce + kd_ratio * kd
+
+        step = make_train_step(kd_forward, self.optimizer, with_frozen=True)
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def run_train_validation_loop(self):
+        # thread the teacher through as the frozen tree (the same slot PEFT uses
+        # for the base model; mutually exclusive by the setup() guard)
+        jitted = self._train_step
+        self._train_step = lambda p, o, stack: jitted(p, o, stack, self.teacher_params)
+        super().run_train_validation_loop()
+
+
+def main(cfg: ConfigNode | None = None, argv=None):
+    if cfg is None:
+        cfg = parse_args_and_load_config(argv)
+    recipe = KnowledgeDistillationRecipe(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+    return recipe
+
+
+if __name__ == "__main__":
+    main()

@@ -98,6 +98,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         # data
         self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
         self.seq_len = int(cfg.get("seq_len", 1024))
+        global_batch = self.micro_batch_size * jax.process_count()
+        if global_batch % self.mesh_ctx.dp_size != 0:
+            raise ValueError(
+                f"micro_batch_size*processes = {global_batch} must divide by the data-"
+                f"parallel degree dp_replicate*dp_shard*ep = {self.mesh_ctx.dp_size}"
+            )
         self.dataloader = self._build_dataloader(cfg.get("dataset"), is_train=True)
         val_cfg = cfg.get("validation_dataset")
         self.val_dataloader = self._build_dataloader(val_cfg, is_train=False) if val_cfg else None
@@ -236,6 +242,20 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         pad_id = 0
         if self.tokenizer is not None and getattr(self.tokenizer, "pad_token_id", None) is not None:
             pad_id = self.tokenizer.pad_token_id
+        dataset, collate = self._wrap_dataset_and_collate(dataset, pad_id)
+        return DataLoader(
+            dataset,
+            batch_size=self.micro_batch_size * jax.process_count(),
+            collate_fn=collate,
+            seed=int(self.cfg.get("seed", 42)),
+            shuffle=is_train,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        )
+
+    def _wrap_dataset_and_collate(self, dataset, pad_id: int):
+        """Hook: per-recipe dataset wrapping + collate choice (seq-cls overrides
+        this to swap in class-label collation; the base handles packing)."""
         # sequence packing (reference packed_sequence section, train_ft.py:402): each
         # example becomes a fixed-size pack, segment ids carry the boundaries
         pack_size = int(self.cfg.get("packed_sequence.packed_sequence_size", 0))
@@ -254,18 +274,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 drop_long_samples=bool(self.cfg.get("packed_sequence.drop_long_samples", False)),
             )
             self.seq_len = pack_size
-            collate = packed_collate
-        else:
-            collate = lambda exs: sft_collate(exs, seq_len=self.seq_len, pad_token_id=pad_id)
-        return DataLoader(
-            dataset,
-            batch_size=self.micro_batch_size * jax.process_count(),
-            collate_fn=collate,
-            seed=int(self.cfg.get("seed", 42)),
-            shuffle=is_train,
-            process_index=jax.process_index(),
-            process_count=jax.process_count(),
-        )
+            return dataset, packed_collate
+        return dataset, (lambda exs: sft_collate(exs, seq_len=self.seq_len, pad_token_id=pad_id))
 
     @property
     def _moe_config(self):

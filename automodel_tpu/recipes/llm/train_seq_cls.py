@@ -1,0 +1,82 @@
+"""Sequence-classification finetune recipe (reference recipes/llm/train_seq_cls.py).
+
+Subclasses the next-token recipe: same mesh/optimizer/checkpoint/step machinery,
+with a classification head model, class-label collation, and softmax CE over
+``num_labels`` (per-example loss, normalized by global example count — the direct
+analogue of the token-count normalization contract).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax.numpy as jnp
+import jax
+
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.config.cli_overrides import parse_args_and_load_config
+from automodel_tpu.data.llm.seq_cls import seq_cls_collate
+from automodel_tpu.models.seq_cls import AutoModelForSequenceClassification
+from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["TrainSeqClsRecipe", "main"]
+
+
+class TrainSeqClsRecipe(TrainFinetuneRecipeForNextTokenPrediction):
+    def _build_model_and_params(self):
+        cfg = self.cfg
+        num_labels = int(cfg.get("model.num_labels", 2))
+        pretrained = cfg.get("model.pretrained_model_name_or_path")
+        with self.mesh:
+            if pretrained:
+                from automodel_tpu.models.auto import load_hf_config
+
+                self.hf_config = load_hf_config(pretrained)
+                self.model, self.params = AutoModelForSequenceClassification.from_pretrained(
+                    pretrained, num_labels=num_labels, backend=self.backend,
+                    dtype=jnp.float32, rules=self.rules,
+                )
+            else:
+                model_cfg = cfg.get("model.config")
+                if model_cfg is None:
+                    raise ValueError("config needs model.pretrained_model_name_or_path or model.config")
+                self.hf_config = model_cfg.to_dict() if isinstance(model_cfg, ConfigNode) else dict(model_cfg)
+                self.model = AutoModelForSequenceClassification.from_config(
+                    self.hf_config, num_labels=num_labels, backend=self.backend
+                )
+                axes = self.model.logical_axes()
+                shardings = self.rules.tree_sharding(axes)
+                init_fn = jax.jit(lambda k: self.model.init(k, jnp.float32), out_shardings=shardings)
+                self.params = init_fn(self.rng.key("model_init"))
+
+    def _wrap_dataset_and_collate(self, dataset, pad_id: int):
+        return dataset, (
+            lambda exs: seq_cls_collate(exs, seq_len=self.seq_len, pad_token_id=pad_id)
+        )
+
+    def _forward_loss(self, params, batch, num_label_tokens, training=True):
+        logits = self.model(
+            params, batch["input_ids"], positions=batch["positions"],
+            segment_ids=batch["segment_ids"], rules=self.rules,
+        )
+        labels = batch["labels"]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        # num_label_tokens is the global example count here (labels are class ids,
+        # one per row, never IGNORE) — same additive-microbatch contract
+        return nll.sum() / jnp.maximum(num_label_tokens, 1).astype(jnp.float32)
+
+
+def main(cfg: ConfigNode | None = None, argv=None):
+    if cfg is None:
+        cfg = parse_args_and_load_config(argv)
+    recipe = TrainSeqClsRecipe(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+    return recipe
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""Dataset/formatting tests (reference tests/unit_tests/datasets/llm/)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from automodel_tpu.data.llm.chat import ChatDataset, _normalize_messages
+from automodel_tpu.data.llm.formatting import (
+    IGNORE_INDEX,
+    format_chat_messages,
+    format_prompt_completion,
+)
+from automodel_tpu.data.llm.seq_cls import SeqClsDataset, seq_cls_collate
+from automodel_tpu.data.llm.squad import SquadDataset
+from automodel_tpu.data.llm.xlam import XlamDataset, convert_tool_calls, convert_tools
+
+
+class WordTokenizer:
+    """Deterministic whitespace tokenizer for tests; no chat template."""
+
+    eos_token_id = 1
+    bos_token_id = 0
+    pad_token_id = 2
+    sep_token = None
+    chat_template = None
+
+    def encode(self, text, add_special_tokens=True):
+        return [hash(w) % 1000 + 10 for w in text.split()]
+
+
+class TestFormatting:
+    def test_prompt_completion_masks_prompt(self):
+        tok = WordTokenizer()
+        ex = format_prompt_completion(tok, "the question is ", "answer here")
+        assert ex["prompt_len"] == 3
+        assert len(ex["input_ids"]) == 6  # 5 words + eos
+
+    def test_prompt_boundary_merge_fallback(self):
+        # "c"+"d" merge into one token at the boundary: the merged token carries
+        # answer content, so the LCP rule keeps it OUT of the masked prompt span
+        tok = WordTokenizer()
+        ex = format_prompt_completion(tok, "a b c", "d")
+        assert ex["prompt_len"] == 2
+
+    def test_chat_fallback_masks_non_assistant(self):
+        tok = WordTokenizer()
+        msgs = [
+            {"role": "user", "content": "hi there"},
+            {"role": "assistant", "content": "hello friend"},
+            {"role": "user", "content": "more question"},
+            {"role": "assistant", "content": "final answer"},
+        ]
+        ex = format_chat_messages(tok, msgs)
+        ids, labels = ex["input_ids"], ex["labels"]
+        assert len(ids) == len(labels)
+        # assistant spans carry their own ids; user spans are IGNORE
+        n_loss = sum(1 for l in labels if l != IGNORE_INDEX)
+        assert n_loss == 6  # "assistant: hello friend" + "assistant: final answer"
+
+
+class TestChatDataset:
+    def test_roles_validated(self):
+        with pytest.raises(ValueError, match="invalid chat role"):
+            _normalize_messages([{"role": "wizard", "content": "x"}])
+
+    def test_jsonl_loading(self, tmp_path):
+        p = tmp_path / "chat.jsonl"
+        rows = [
+            {"messages": [{"role": "user", "content": "q one"}, {"role": "assistant", "content": "a one"}]},
+            {"messages": [{"role": "user", "content": "q two"}, {"role": "assistant", "content": "a two"}]},
+        ]
+        p.write_text("\n".join(json.dumps(r) for r in rows))
+        ds = ChatDataset(str(p), tokenizer=WordTokenizer())
+        assert len(ds) == 2
+        ex = ds[0]
+        assert "input_ids" in ex and "labels" in ex
+
+
+class TestSquad:
+    def test_local_rows(self, tmp_path):
+        p = tmp_path / "sq.json"
+        rows = [
+            {"context": "Paris is in France", "question": "Where is Paris", "answers": {"text": ["France"]}},
+        ]
+        p.write_text(json.dumps(rows))
+        ds = SquadDataset(WordTokenizer(), str(p))
+        ex = ds[0]
+        assert ex["prompt_len"] > 0
+        assert len(ex["input_ids"]) > ex["prompt_len"]
+
+
+class TestXlam:
+    def test_tool_conversion(self):
+        tools = convert_tools([
+            {"name": "get_weather", "description": "weather", "parameters": json.dumps(
+                {"city": {"type": "string", "description": "the city"}})},
+        ])
+        assert tools[0]["function"]["name"] == "get_weather"
+        assert "city" in tools[0]["function"]["parameters"]["properties"]
+        calls = convert_tool_calls([{"name": "get_weather", "arguments": {"city": "sf"}}])
+        assert json.loads(calls[0]["function"]["arguments"]) == {"city": "sf"}
+
+    def test_dataset_fallback_path(self, tmp_path):
+        p = tmp_path / "xlam.jsonl"
+        row = {
+            "query": "weather in sf",
+            "answers": json.dumps([{"name": "get_weather", "arguments": {"city": "sf"}}]),
+            "tools": json.dumps([{"name": "get_weather", "description": "w", "parameters": {}}]),
+        }
+        p.write_text(json.dumps(row))
+        ds = XlamDataset(WordTokenizer(), str(p))
+        ex = ds[0]
+        assert any(l != IGNORE_INDEX for l in ex["labels"])
+
+
+class TestSeqCls:
+    def test_dataset_and_collate(self, tmp_path):
+        p = tmp_path / "cls.jsonl"
+        rows = [
+            {"text": "good movie really", "label": 1},
+            {"text": "bad", "label": 0},
+        ]
+        p.write_text("\n".join(json.dumps(r) for r in rows))
+        ds = SeqClsDataset(WordTokenizer(), str(p))
+        batch = seq_cls_collate([ds[0], ds[1]], seq_len=8, pad_token_id=2)
+        assert batch["input_ids"].shape == (2, 8)
+        np.testing.assert_array_equal(batch["labels"], [1, 0])
+        assert batch["segment_ids"][0].sum() == 3
+        assert batch["segment_ids"][1].sum() == 1

@@ -98,6 +98,12 @@ class TestIndexHelpers:
         assert len(d_idx) == 18
         np.testing.assert_array_equal(np.bincount(d_idx, minlength=3), sizes)
 
+    def test_exhaustive_blending_skips_empty_components(self):
+        # native and numpy paths must agree: empty datasets receive zero samples
+        sizes = np.asarray([0, 5], np.int64)
+        d_idx, _ = build_exhaustive_blending_indices(sizes)
+        np.testing.assert_array_equal(d_idx, np.ones(5, np.int16))
+
 
 class TestGPTDataset:
     def test_sample_shapes_and_determinism(self, corpus, tmp_path):
@@ -131,6 +137,18 @@ class TestGPTDataset:
         ds1 = GPTDataset(prefix, seq_length=16, num_samples=20, seed=5, cache_dir=cache)
         ds2 = GPTDataset(prefix, seq_length=16, num_samples=20, seed=5, cache_dir=cache)
         np.testing.assert_array_equal(ds1[3]["input_ids"], ds2[3]["input_ids"])
+
+    def test_cache_key_distinguishes_document_subsets(self, corpus, tmp_path):
+        """Equal-length but different doc subsets must not share a cache entry
+        (otherwise changed split strings silently serve stale documents)."""
+        prefix, _ = corpus
+        cache = str(tmp_path / "idxcache2")
+        lo = GPTDataset(prefix, seq_length=8, num_samples=10, seed=5, cache_dir=cache,
+                        documents=np.arange(0, 5, dtype=np.int64))
+        hi = GPTDataset(prefix, seq_length=8, num_samples=10, seed=5, cache_dir=cache,
+                        documents=np.arange(5, 10, dtype=np.int64))
+        assert (hi[0]["input_ids"] >= 5000).all()  # docs 5+ encode tokens >= 5000
+        assert (lo[0]["input_ids"] < 5000).all()
 
     def test_document_subset(self, corpus):
         prefix, _ = corpus
