@@ -34,6 +34,9 @@ class BackendConfig:
     """
 
     attention: str = "xla"
+    # "default" (einsum) | "fp8" (e4m3/e5m2 dynamic scaling). fp8 covers the dense
+    # attention/MLP projections; MoE expert GEMMs keep their own experts_backend.
+    linear: str = "default"
     remat_policy: str = "none"
     scan_layers: bool = True
     dtype: str = "bfloat16"
@@ -42,6 +45,10 @@ class BackendConfig:
     dispatcher: str = "dense"  # "dense" (one-hot matmul) | "a2a" (EP all_to_all)
     fake_balanced_gate: bool = False  # benchmark mode: uniform routing, no gate math
     fake_gate_noise: float = 0.0
+
+    def __post_init__(self):
+        if self.linear not in ("default", "fp8"):
+            raise ValueError(f"unknown linear backend {self.linear!r} (default | fp8)")
 
     @property
     def jnp_dtype(self):

@@ -207,7 +207,7 @@ def moe_decoder_forward(
         lp = jax.tree.map(lambda a: a.astype(dtype), lp)
         h = attn(h, lp, is_sliding)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp_block(lp, x, rules)
+        h = h + _mlp_block(backend, lp, x, rules)
         return _constrain(h, rules, ("batch", "act_seq", "act_embed")), None
 
     def moe_layer_fn(h, layer_inputs):

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from automodel_tpu.models.common.backend import BackendConfig
 from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.fp8 import project
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rope import apply_rope, rope_attention_scaling, rope_frequencies
 
@@ -183,9 +184,10 @@ def _constrain(x, rules, names):
 
 def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, x, positions,
                      segment_ids, inv_freq, attn_scale, sliding, rules):
-    q = jnp.einsum("bsd,dnh->bsnh", x, lp["wq"])
-    k = jnp.einsum("bsd,dkh->bskh", x, lp["wk"])
-    v = jnp.einsum("bsd,dkh->bskh", x, lp["wv"])
+    lin = backend.linear
+    q = project(x, lp["wq"], 1, lin)
+    k = project(x, lp["wk"], 1, lin)
+    v = project(x, lp["wv"], 1, lin)
     if cfg.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -205,17 +207,18 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
         sinks=lp.get("sinks"),
         backend=backend.attention,
     )
-    o = jnp.einsum("bsnh,nhd->bsd", out, lp["wo"])
+    o = project(out, lp["wo"], 2, lin)
     if cfg.attention_out_bias:
         o = o + lp["bo"]
     return o
 
 
-def _mlp_block(lp: dict, x, rules):
-    gate = jnp.einsum("bsd,di->bsi", x, lp["w_gate"])
-    up = jnp.einsum("bsd,di->bsi", x, lp["w_up"])
+def _mlp_block(backend: BackendConfig, lp: dict, x, rules):
+    lin = backend.linear
+    gate = project(x, lp["w_gate"], 1, lin)
+    up = project(x, lp["w_up"], 1, lin)
     act = _constrain(jax.nn.silu(gate) * up, rules, ("batch", "act_attn_seq", "act_mlp"))
-    return jnp.einsum("bsi,id->bsd", act, lp["w_down"])
+    return project(act, lp["w_down"], 1, lin)
 
 
 def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None):
@@ -246,7 +249,7 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
                                  inv_freq, attn_scale, eff_window, rules)
         h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp_block(lp, x, rules)
+        h = h + _mlp_block(backend, lp, x, rules)
         h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
         return dict(state, h=h), None
 

@@ -1,0 +1,80 @@
+"""FP8 matmul with dynamic tensorwise scaling (reference components/quantization/fp8.py,
+which delegates to torchao Float8Linear; here it is a ~60-line custom_vjp over XLA's
+native fp8 dot support).
+
+Recipe (the standard "tensorwise dynamic" float8 training scheme):
+- forward: x, w quantized to e4m3 with per-tensor amax scaling; accumulate in fp32
+- backward: the incoming gradient is quantized to e5m2 (wider range, less precision —
+  gradients tolerate it), weights/activations reuse e4m3
+
+On TPU the MXU consumes fp8 pairs natively; off-TPU XLA emulates, so tests run
+anywhere. The first/last layers (embed, lm_head) stay high-precision, matching the
+reference's filter_fqns default.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fp8_matmul", "project"]
+
+_E4M3_MAX = 448.0
+_E5M2_MAX = 57344.0
+
+
+def _quant(x: jnp.ndarray, dtype, fmax: float) -> tuple[jnp.ndarray, jnp.ndarray]:
+    amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    scale = jnp.maximum(amax, 1e-12) / fmax
+    q = jnp.clip(x.astype(jnp.float32) / scale, -fmax, fmax).astype(dtype)
+    return q, scale
+
+
+@jax.custom_vjp
+def fp8_matmul(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """x (..., K) @ w (K, N) in e4m3 with fp32 accumulation."""
+    out, _ = _fp8_fwd(x, w)
+    return out
+
+
+def _fp8_fwd(x, w):
+    xq, sx = _quant(x, jnp.float8_e4m3fn, _E4M3_MAX)
+    wq, sw = _quant(w, jnp.float8_e4m3fn, _E4M3_MAX)
+    out = jnp.matmul(xq, wq, preferred_element_type=jnp.float32) * (sx * sw)
+    return out.astype(x.dtype), (xq, sx, wq, sw)
+
+
+def _fp8_bwd(res, g):
+    xq, sx, wq, sw = res
+    gq, sg = _quant(g, jnp.float8_e5m2, _E5M2_MAX)
+    # dx = g @ w.T ; dw = x.T @ g — both fp8 x fp8 -> fp32; g carries x's dtype
+    # (it is the cotangent of the output, which was cast to x.dtype)
+    dx = jnp.matmul(gq, wq.T, preferred_element_type=jnp.float32) * (sg * sw)
+    xq2 = xq.reshape(-1, xq.shape[-1])
+    gq2 = gq.reshape(-1, gq.shape[-1])
+    dw = jnp.matmul(xq2.T, gq2, preferred_element_type=jnp.float32) * (sx * sg)
+    return dx.astype(g.dtype), dw.astype(g.dtype)
+
+
+fp8_matmul.defvjp(_fp8_fwd, _fp8_bwd)
+
+
+def project(x: jnp.ndarray, w: jnp.ndarray, n_in: int, linear_backend: str = "default") -> jnp.ndarray:
+    """Contract x's trailing dims with w's first ``n_in`` dims (the generic form of
+    every transformer projection: wq (d,n,h) n_in=1, wo (n,h,d) n_in=2, ...).
+
+    ``linear_backend="fp8"`` routes the flattened 2-D matmul through
+    :func:`fp8_matmul`; "default" is a plain einsum XLA fuses as usual.
+    """
+    in_shape = w.shape[:n_in]
+    out_shape = w.shape[n_in:]
+    k = 1
+    for s in in_shape:
+        k *= s
+    x2 = x.reshape(*x.shape[: x.ndim - n_in], k) if n_in > 1 else x
+    w2 = w.reshape(k, -1)
+    if linear_backend == "fp8":
+        out = fp8_matmul(x2, w2)
+    else:
+        out = jnp.matmul(x2, w2)
+    return out.reshape(*x2.shape[:-1], *out_shape)

@@ -285,11 +285,25 @@ def lora_logical_axes(logical_axes: Any, cfg: PeftConfig) -> dict:
 
 def merge_lora_params(params: Any, lora: Any, cfg: PeftConfig) -> Any:
     """W -> W + (alpha/r) A@B (DoRA: renormalized + magnitude-scaled), leaving
-    unmatched leaves untouched. Pure; call inside jit so XLA fuses per-layer."""
+    unmatched leaves untouched. Pure; call inside jit so XLA fuses per-layer.
+
+    QLoRA: quantized base leaves (quantization.qlora.QuantizedTensor) are
+    dequantized on the fly — matched ones before adding the delta, unmatched ones
+    by the final :func:`dequantize_params` sweep — so the model always sees dense
+    weights while the resident base stays int8/nf4.
+    """
+    from automodel_tpu.quantization.qlora import (
+        dequantize_leaf, dequantize_params, is_quantized_leaf,
+    )
+
     scaling = cfg.scaling
+    any_quant = any(is_quantized_leaf(x) for x in jax.tree.leaves(
+        params, is_leaf=is_quantized_leaf))
 
     def merge_one(path: str, leaf: dict, out_params: Any) -> Any:
         w = _get_path(out_params, path)
+        if is_quantized_leaf(w):
+            w = dequantize_leaf(w, jnp.float32)
         a, b = leaf["lora_a"], leaf["lora_b"]
         delta = jnp.einsum("...ir,...ro->...io", a.astype(jnp.float32), b.astype(jnp.float32)) * scaling
         w_flat = w.reshape(delta.shape).astype(jnp.float32)
@@ -302,6 +316,8 @@ def merge_lora_params(params: Any, lora: Any, cfg: PeftConfig) -> Any:
     out = params
     for path, leaf in _flatten_lora(lora):
         out = merge_one(path, leaf, out)
+    if any_quant:
+        out = dequantize_params(out)
     return out
 
 

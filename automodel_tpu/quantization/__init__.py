@@ -1,0 +1,16 @@
+from automodel_tpu.quantization.qlora import (
+    dequantize_leaf,
+    is_quantized_leaf,
+    quantize_leaf,
+    quantize_params,
+)
+from automodel_tpu.quantization.qat import QATConfig, fake_quant
+
+__all__ = [
+    "QATConfig",
+    "dequantize_leaf",
+    "fake_quant",
+    "is_quantized_leaf",
+    "quantize_leaf",
+    "quantize_params",
+]

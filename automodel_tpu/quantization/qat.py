@@ -1,0 +1,65 @@
+"""Quantization-aware training (reference quantization/qat.py, which wraps torchao
+Int8DynActInt4WeightQATQuantizer; here: straight-through fake quantization).
+
+``fake_quant`` simulates int-N rounding in the forward pass while passing gradients
+straight through (STE), so the trained weights become robust to post-training
+quantization. The recipe applies it to matched param leaves after an optional
+delay (reference fake_quant_after_n_steps).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["QATConfig", "fake_quant", "fake_quant_params"]
+
+
+@dataclasses.dataclass
+class QATConfig:
+    enabled: bool = True
+    weight_bits: int = 4
+    group_size: int = 32  # per-group absmax scaling along the last dim
+    fake_quant_after_n_steps: int | None = None  # None = from step 0
+    target_modules: list[str] = dataclasses.field(default_factory=lambda: ["*"])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def fake_quant(w: jnp.ndarray, bits: int = 4, group_size: int = 32) -> jnp.ndarray:
+    return _fake_quant_fwd(w, bits, group_size)[0]
+
+
+def _fake_quant_fwd(w, bits, group_size):
+    orig_shape = w.shape
+    wf = (
+        w.astype(jnp.float32).reshape(-1, group_size)
+        if w.size % group_size == 0
+        else w.astype(jnp.float32).reshape(1, -1)
+    )
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = jnp.maximum(jnp.abs(wf).max(axis=-1, keepdims=True), 1e-12) / qmax
+    q = jnp.clip(jnp.round(wf / scale), -qmax - 1, qmax)
+    out = (q * scale).reshape(orig_shape).astype(w.dtype)
+    return out, None
+
+
+def _fake_quant_bwd(bits, group_size, _res, g):
+    # straight-through: d(fake_quant)/dw ~= identity (g already has w's dtype)
+    return (g,)
+
+
+fake_quant.defvjp(_fake_quant_fwd, _fake_quant_bwd)
+
+
+def fake_quant_params(params, paths: list[str], cfg: QATConfig):
+    """Apply fake quantization to the listed leaves (inside jit, pre-forward)."""
+    from automodel_tpu.peft.lora import _get_path, _set_path
+
+    out = params
+    for path in paths:
+        w = _get_path(out, path)
+        out = _set_path(out, path, fake_quant(w, cfg.weight_bits, cfg.group_size))
+    return out

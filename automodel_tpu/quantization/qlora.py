@@ -1,0 +1,154 @@
+"""Weight-only quantization for QLoRA base models (reference quantization/qlora.py,
+which wraps bitsandbytes NF4/int8; here: pure-jnp blockwise quantization with
+dequant-on-use, no CUDA kernels needed).
+
+A quantized leaf is a :class:`QuantizedTensor` — a registered pytree node whose
+children are the code/scale arrays (so jit/device_put/checkpoint traverse them) and
+whose scheme/shape ride as static aux data. The base model stays quantized in HBM;
+:func:`dequantize_params` reconstructs dense weights inside the jitted step right
+before use (the PEFT merge), so the dense copy is a transient of the step, not a
+resident.
+
+Schemes:
+- ``int8``: per-output-channel absmax symmetric int8;
+- ``nf4``: 4-bit NormalFloat — blockwise absmax scaling + a 16-entry codebook of
+  normal-distribution quantiles (the QLoRA paper's data type), two codes per byte.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "NF4_CODEBOOK",
+    "QuantizedTensor",
+    "quantize_leaf",
+    "dequantize_leaf",
+    "is_quantized_leaf",
+    "quantize_params",
+    "dequantize_params",
+    "tree_nbytes",
+]
+
+# 16 code values for 4-bit NormalFloat: quantiles of N(0,1) rescaled to [-1, 1]
+# with an exact zero (QLoRA paper §3; values recomputed from scipy quantiles).
+NF4_CODEBOOK = np.asarray(
+    [-1.0, -0.6961928009986877, -0.5250730514526367, -0.39491748809814453,
+     -0.28444138169288635, -0.18477343022823334, -0.09105003625154495, 0.0,
+     0.07958029955625534, 0.16093020141124725, 0.24611230194568634, 0.33791524171829224,
+     0.44070982933044434, 0.5626170039176941, 0.7229568362236023, 1.0],
+    dtype=np.float32,
+)
+
+_NF4_BLOCK = 64
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """Codes + scales as pytree children; (scheme, shape) static."""
+
+    def __init__(self, q, scale, scheme: str, shape: tuple):
+        self.q = q
+        self.scale = scale
+        self.scheme = scheme
+        self.shape = tuple(shape)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.scheme, self.shape)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+    @property
+    def nbytes(self) -> int:
+        return self.q.size * self.q.dtype.itemsize + self.scale.size * self.scale.dtype.itemsize
+
+    def __repr__(self):
+        return f"QuantizedTensor({self.scheme}, shape={self.shape})"
+
+
+def is_quantized_leaf(x) -> bool:
+    return isinstance(x, QuantizedTensor)
+
+
+def quantize_leaf(w, scheme: str = "int8", n_stack: int = 0) -> QuantizedTensor:
+    """Quantize one weight array.
+
+    ``n_stack`` leading dims are independent stacked weights (scan layers, experts):
+    scales are computed *per stack element* so one outlier layer cannot crush the
+    precision of the others.
+
+    int8 uses jnp math end-to-end — on sharded inputs the codes inherit the
+    weight's layout (no host gather, pod-safe). nf4's blockwise bit-packing
+    reshapes the full tensor and is host-side; use it for single-host finetuning.
+    """
+    if scheme == "int8":
+        wj = jnp.asarray(w, jnp.float32) if not isinstance(w, jax.Array) else w.astype(jnp.float32)
+        reduce_axes = tuple(range(n_stack, wj.ndim - 1))
+        amax = jnp.abs(wj).max(axis=reduce_axes, keepdims=True) if reduce_axes else jnp.abs(wj)
+        scale = jnp.maximum(amax, 1e-12) / 127.0
+        q = jnp.clip(jnp.round(wj / scale), -127, 127).astype(jnp.int8)
+        return QuantizedTensor(q, scale, "int8", wj.shape)
+    if scheme == "nf4":
+        w = np.asarray(w, np.float32)
+        flat = w.reshape(-1)
+        pad = (-len(flat)) % _NF4_BLOCK
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+        blocks = flat.reshape(-1, _NF4_BLOCK)
+        scale = np.maximum(np.abs(blocks).max(axis=1, keepdims=True), 1e-12)
+        normed = blocks / scale  # in [-1, 1]
+        codes = np.abs(normed[..., None] - NF4_CODEBOOK).argmin(-1).astype(np.uint8)
+        packed = (codes[:, 0::2] << 4) | codes[:, 1::2]  # two 4-bit codes per byte
+        return QuantizedTensor(
+            jnp.asarray(packed), jnp.asarray(scale[:, 0]), "nf4", w.shape
+        )
+    raise ValueError(f"unknown qlora scheme {scheme!r} (int8 | nf4)")
+
+
+def dequantize_leaf(leaf: QuantizedTensor, dtype=jnp.float32) -> jnp.ndarray:
+    if leaf.scheme == "int8":
+        return (leaf.q.astype(jnp.float32) * leaf.scale).astype(dtype)
+    if leaf.scheme == "nf4":
+        packed = leaf.q
+        hi = (packed >> 4).astype(jnp.int32)
+        lo = (packed & 0x0F).astype(jnp.int32)
+        codes = jnp.stack([hi, lo], axis=-1).reshape(packed.shape[0], -1)
+        blocks = jnp.asarray(NF4_CODEBOOK)[codes] * leaf.scale[:, None]
+        n = int(np.prod(leaf.shape))
+        return blocks.reshape(-1)[:n].reshape(leaf.shape).astype(dtype)
+    raise ValueError(f"unknown qlora scheme {leaf.scheme!r}")
+
+
+def quantize_params(params, paths: list[str] | dict, scheme: str = "int8"):
+    """Quantize the listed dot-joined paths in a param pytree (at load time).
+
+    ``paths`` may be a dict path -> (n_stack, split) as produced by
+    peft.lora.match_lora_paths, in which case per-stack-element scales are used.
+    """
+    from automodel_tpu.peft.lora import _get_path, _set_path
+
+    stacks = paths if isinstance(paths, dict) else {p: (0, None) for p in paths}
+    out = params
+    for path, (n_stack, _split) in stacks.items():
+        out = _set_path(out, path, quantize_leaf(_get_path(out, path), scheme, n_stack))
+    return out
+
+
+def dequantize_params(params, dtype=jnp.float32):
+    """Dense view of a (partially) quantized tree — call inside jit at point of use."""
+    return jax.tree.map(
+        lambda x: dequantize_leaf(x, dtype) if is_quantized_leaf(x) else x,
+        params,
+        is_leaf=is_quantized_leaf,
+    )
+
+
+def tree_nbytes(tree) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += leaf.size * leaf.dtype.itemsize
+    return total

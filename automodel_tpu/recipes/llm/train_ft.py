@@ -209,6 +209,21 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         host_lora = init_lora_params(self.params, axes, self.peft, self.rng.key("lora_init"))
         shardings = self.rules.tree_sharding(lora_logical_axes(axes, self.peft))
         self.train_params = jax.tree.map(jax.device_put, host_lora, shardings)
+        # QLoRA (reference quantization/qlora.py): store the adapted base weights
+        # int8/nf4 at rest; merge dequantizes transiently inside the step. Must run
+        # AFTER lora init (DoRA magnitudes need the dense weights).
+        qlora_scheme = peft_cfg.get("qlora")
+        if qlora_scheme:
+            from automodel_tpu.peft.lora import match_lora_paths
+            from automodel_tpu.quantization.qlora import quantize_params, tree_nbytes
+
+            matched = match_lora_paths(axes, self.peft)  # path -> (n_stack, split)
+            before = tree_nbytes(self.params)
+            self.params = quantize_params(self.params, matched, qlora_scheme)
+            logger.info(
+                "qlora(%s): base %.1fMB -> %.1fMB (%d tensors quantized)",
+                qlora_scheme, before / 2**20, tree_nbytes(self.params) / 2**20, len(matched),
+            )
         # one compiled merge reused by every consolidated save
         self._merge_lora = jax.jit(lambda base, lora: merge_lora_params(base, lora, self.peft))
         logger.info(
@@ -348,6 +363,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         elif self.peft is not None:
             from automodel_tpu.peft.lora import merge_lora_params
 
+            if self.cfg.get("qat") is not None:
+                raise NotImplementedError("qat + peft composition is not wired yet")
             if self._post_update() is not None:
                 logger.warning("moe gate-bias update disabled under peft (base is frozen)")
 
@@ -357,8 +374,35 @@ class TrainFinetuneRecipeForNextTokenPrediction:
 
             step = make_train_step(peft_loss, self.optimizer, with_frozen=True)
         else:
-            step = make_train_step(self._forward_loss, self.optimizer, post_update=self._post_update())
+            forward = self._qat_wrap(self._forward_loss)
+            step = make_train_step(forward, self.optimizer, post_update=self._post_update())
         return jax.jit(step, donate_argnums=(0, 1))
+
+    def _qat_wrap(self, forward):
+        """QAT (reference quantization/qat.py + train_ft.py:1092): fake-quantize
+        matched weights in the forward so training sees post-quantization rounding;
+        gradients pass straight through."""
+        qat_cfg = self.cfg.get("qat")
+        if qat_cfg is None or not qat_cfg.get("enabled", True):
+            return forward
+        import dataclasses
+
+        from automodel_tpu.peft.lora import PeftConfig as _MatchCfg, match_lora_paths
+        from automodel_tpu.quantization.qat import QATConfig, fake_quant_params
+
+        known = {f.name for f in dataclasses.fields(QATConfig)}
+        qat = QATConfig(**{k: v for k, v in qat_cfg.to_dict().items() if k in known})
+        if qat.fake_quant_after_n_steps:
+            logger.warning("qat.fake_quant_after_n_steps is not supported yet; quantizing from step 0")
+        matcher = _MatchCfg(target_modules=qat.target_modules,
+                            match_all_linear=qat.target_modules == ["*"])
+        paths = sorted(match_lora_paths(self.model.logical_axes(), matcher))
+        logger.info("qat: int%d fake-quant on %d weight tensors", qat.weight_bits, len(paths))
+
+        def qat_forward(params, batch, num_label_tokens):
+            return forward(fake_quant_params(params, paths, qat), batch, num_label_tokens)
+
+        return qat_forward
 
     def _maybe_resume(self):
         if not self.checkpointer.config.enabled:

@@ -122,6 +122,36 @@ class TestPeftRecipeE2E:
         for s in (4, 5, 6):
             assert l2[s] == pytest.approx(l1[s], rel=1e-5), f"step {s} diverged"
 
+    def test_qlora_int8_runs_and_base_stays_quantized(self, tmp_path, cpu_devices):
+        cfg = load_config(_write_cfg(tmp_path, peft_extra="qlora: int8", max_steps=4))
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        from automodel_tpu.quantization.qlora import is_quantized_leaf
+
+        assert is_quantized_leaf(recipe.params["layers"]["wq"])
+        recipe.run_train_validation_loop()
+        rows = _read_jsonl(tmp_path / "out" / "training.jsonl")
+        assert all(np.isfinite(r["loss"]) for r in rows)
+        assert is_quantized_leaf(recipe.params["layers"]["wq"])  # still int8 at rest
+
+    def test_qat_fake_quant_runs(self, tmp_path, cpu_devices):
+        # QAT without peft: fake-quantize weights in the forward, full finetune
+        cfg_path = _write_cfg(tmp_path, max_steps=4)
+        import re
+
+        text = re.sub(
+            r"peft:\n((?:  .*)?\n)+?(?=\S)",
+            "qat:\n  enabled: true\n  weight_bits: 8\n  group_size: 16\n",
+            cfg_path.read_text(),
+        )
+        cfg_path.write_text(text)
+        cfg = load_config(cfg_path)
+        assert cfg.get("peft") is None
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        recipe.run_train_validation_loop()
+        rows = _read_jsonl(tmp_path / "out" / "training.jsonl")
+        assert all(np.isfinite(r["loss"]) for r in rows)
+        assert rows[-1]["loss"] < rows[0]["loss"] + 0.1  # training not destabilized
+
     def test_dora_runs(self, tmp_path, cpu_devices):
         cfg = load_config(_write_cfg(tmp_path, peft_extra="use_dora: true", max_steps=3))
         recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()

@@ -1,0 +1,172 @@
+"""Quantization tests: fp8 matmul, int8/nf4 weight-only, QAT fake-quant
+(reference tests/unit_tests/quantization/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.ops.fp8 import fp8_matmul, project
+from automodel_tpu.quantization.qat import QATConfig, fake_quant, fake_quant_params
+from automodel_tpu.quantization.qlora import (
+    QuantizedTensor,
+    dequantize_leaf,
+    dequantize_params,
+    is_quantized_leaf,
+    quantize_leaf,
+    quantize_params,
+    tree_nbytes,
+)
+
+
+class TestFp8:
+    def test_matmul_close_to_fp32(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 64)).astype(np.float32)
+        w = rng.normal(size=(64, 32)).astype(np.float32)
+        got = np.asarray(fp8_matmul(jnp.asarray(x), jnp.asarray(w)))
+        want = x @ w
+        # e4m3 has ~2 decimal digits; relative error on a dot of 64 terms stays small
+        rel = np.abs(got - want) / (np.abs(want) + 1e-3)
+        assert np.median(rel) < 0.08
+        assert rel.mean() < 0.25
+
+    def test_gradients_flow(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(4, 16)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+
+        def loss(w_):
+            return (fp8_matmul(x, w_) ** 2).sum()
+
+        g_fp8 = np.asarray(jax.grad(loss)(w))
+        g_ref = np.asarray(jax.grad(lambda w_: ((x @ w_) ** 2).sum())(w))
+        cos = (g_fp8 * g_ref).sum() / (np.linalg.norm(g_fp8) * np.linalg.norm(g_ref))
+        assert cos > 0.99
+
+    def test_project_shapes(self):
+        x = jnp.ones((2, 5, 16))
+        wq = jnp.ones((16, 4, 8))  # n_in=1: (d -> n,h)
+        assert project(x, wq, 1).shape == (2, 5, 4, 8)
+        wo = jnp.ones((4, 8, 16))  # n_in=2: (n,h -> d)
+        assert project(jnp.ones((2, 5, 4, 8)), wo, 2).shape == (2, 5, 16)
+
+    def test_fp8_model_forward_runs(self):
+        from automodel_tpu.models.common.backend import BackendConfig
+        from automodel_tpu.models.llama.model import LlamaForCausalLM
+
+        cfg = {
+            "architectures": ["LlamaForCausalLM"], "vocab_size": 64, "hidden_size": 32,
+            "intermediate_size": 64, "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "max_position_embeddings": 64,
+        }
+        model = LlamaForCausalLM.from_config(cfg, BackendConfig(dtype="float32", linear="fp8"))
+        params = model.init(jax.random.key(0), jnp.float32)
+        logits = model(params, jnp.arange(8).reshape(1, 8))
+        assert np.isfinite(np.asarray(logits)).all()
+        # fp8 path stays close to the exact path
+        exact = LlamaForCausalLM.from_config(cfg, BackendConfig(dtype="float32"))(
+            params, jnp.arange(8).reshape(1, 8)
+        )
+        corr = np.corrcoef(np.asarray(logits).ravel(), np.asarray(exact).ravel())[0, 1]
+        assert corr > 0.98
+
+
+class TestQlora:
+    def test_int8_roundtrip_error(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(64, 32)).astype(np.float32) * 0.02
+        q = quantize_leaf(w, "int8")
+        assert q.q.dtype == jnp.int8
+        deq = np.asarray(dequantize_leaf(q))
+        assert np.abs(deq - w).max() < 0.02 / 127 * 2  # within one quant step
+        assert q.nbytes < w.nbytes / 3
+
+    def test_nf4_roundtrip_error(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(64, 32)).astype(np.float32) * 0.02
+        q = quantize_leaf(w, "nf4")
+        deq = np.asarray(dequantize_leaf(q))
+        # 4-bit: coarse but unbiased; error bounded by half the largest code gap
+        assert np.abs(deq - w).max() < np.abs(w).max() * 0.2
+        assert q.nbytes < w.nbytes / 6  # ~4.5 bits/param incl scales
+
+    def test_int8_per_stack_scales(self):
+        # one huge layer must not crush the quantization of the others
+        w = np.ones((2, 8, 4), np.float32) * 0.01
+        w[1] *= 1000.0
+        q_stacked = quantize_leaf(w, "int8", n_stack=1)
+        assert q_stacked.scale.shape == (2, 1, 4)
+        deq = np.asarray(dequantize_leaf(q_stacked))
+        np.testing.assert_allclose(deq[0], 0.01, rtol=0.02)  # layer 0 keeps precision
+        q_global = quantize_leaf(w, "int8", n_stack=0)
+        bad = np.asarray(dequantize_leaf(q_global))
+        assert np.abs(bad[0] - 0.01).max() > 0.005  # global scale destroys layer 0
+
+    def test_quantized_tensor_is_pytree(self):
+        w = np.ones((8, 4), np.float32)
+        q = quantize_leaf(w, "int8")
+        leaves = jax.tree.leaves(q)
+        assert len(leaves) == 2  # codes + scales only; meta is static
+        q2 = jax.tree.map(lambda x: x, q)
+        assert isinstance(q2, QuantizedTensor) and q2.scheme == "int8"
+
+    def test_quantize_params_and_dequantize(self):
+        params = {"layers": {"wq": jnp.ones((2, 8, 4)) * 0.5, "norm": jnp.ones((4,))}}
+        qp = quantize_params(params, ["layers.wq"], "int8")
+        assert is_quantized_leaf(qp["layers"]["wq"])
+        assert not is_quantized_leaf(qp["layers"]["norm"])
+        dense = dequantize_params(qp)
+        np.testing.assert_allclose(np.asarray(dense["layers"]["wq"]), 0.5, atol=0.01)
+        assert tree_nbytes(qp) < tree_nbytes(params)
+
+    def test_lora_merge_with_quantized_base(self):
+        from automodel_tpu.models.common.backend import BackendConfig
+        from automodel_tpu.models.llama.model import LlamaForCausalLM
+        from automodel_tpu.peft.lora import (
+            PeftConfig, init_lora_params, match_lora_paths, merge_lora_params,
+        )
+
+        cfg = {
+            "architectures": ["LlamaForCausalLM"], "vocab_size": 64, "hidden_size": 32,
+            "intermediate_size": 64, "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "max_position_embeddings": 64,
+        }
+        model = LlamaForCausalLM.from_config(cfg, BackendConfig(dtype="float32"))
+        params = model.init(jax.random.key(0), jnp.float32)
+        pcfg = PeftConfig(dim=4)
+        lora = init_lora_params(params, model.logical_axes(), pcfg, jax.random.key(1))
+        paths = sorted(match_lora_paths(model.logical_axes(), pcfg))
+        qparams = quantize_params(params, paths, "int8")
+        merged = merge_lora_params(qparams, lora, pcfg)
+        # every leaf dense again; values close to the original (b=0 -> pure dequant)
+        assert not any(is_quantized_leaf(x) for x in jax.tree.leaves(
+            merged, is_leaf=is_quantized_leaf))
+        w0 = np.asarray(params["layers"]["wq"])
+        w1 = np.asarray(merged["layers"]["wq"])
+        assert np.abs(w0 - w1).max() < np.abs(w0).max() * 0.02
+        # model runs on the merged tree
+        logits = model(merged, jnp.arange(8).reshape(1, 8))
+        assert np.isfinite(np.asarray(logits)).all()
+
+
+class TestQat:
+    def test_fake_quant_values_on_grid(self):
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(size=(4, 32)).astype(np.float32))
+        out = np.asarray(fake_quant(w, 4, 32))
+        # 4-bit: at most 16 distinct values per group
+        for row in out.reshape(-1, 32):
+            assert len(np.unique(row)) <= 16
+        assert np.abs(out - np.asarray(w)).max() < np.abs(w).max() * 0.2
+
+    def test_straight_through_gradient(self):
+        w = jnp.asarray(np.random.default_rng(0).normal(size=(4, 32)).astype(np.float32))
+        g = jax.grad(lambda w_: (fake_quant(w_, 4, 32) * 2.0).sum())(w)
+        np.testing.assert_allclose(np.asarray(g), 2.0)
+
+    def test_fake_quant_params_paths(self):
+        params = {"layers": {"wq": jnp.ones((2, 8, 4)), "norm": jnp.ones((4,))}}
+        out = fake_quant_params(params, ["layers.wq"], QATConfig(weight_bits=8, group_size=4))
+        assert out["layers"]["norm"] is params["layers"]["norm"]
+        assert np.isfinite(np.asarray(out["layers"]["wq"])).all()
