@@ -186,7 +186,10 @@ def moe_decoder_forward(
     emit_aux = cfg.moe.aux_loss_coeff > 0 and training and not backend.fake_balanced_gate
 
     if attention_fn is None:
-        inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+        inv_freq = rope_frequencies(
+            cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
+            partial_rotary_factor=getattr(cfg, "partial_rotary_factor", 1.0),
+        )
         attn_scale = rope_attention_scaling(cfg.rope_scaling)
         big_window = jnp.int32(cfg.max_position_embeddings + input_ids.shape[1])
         window = jnp.int32(cfg.sliding_window or 0)

@@ -54,6 +54,7 @@ class DenseDecoderConfig:
     max_position_embeddings: int = 4096
     rope_theta: float = 10000.0
     rope_scaling: dict[str, Any] | None = None
+    partial_rotary_factor: float = 1.0  # glm4/minimax: rope only the first fraction of head_dim
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     attention_bias: bool = False  # qwen2: bias on q/k/v only
@@ -63,6 +64,7 @@ class DenseDecoderConfig:
     sliding_window: int | None = None
     layer_types: list[str] | None = None  # "full_attention" | "sliding_attention"
     initializer_range: float = 0.02
+    causal: bool = True  # False: bidirectional encoder (llama_bidirectional)
 
     def __post_init__(self):
         if self.head_dim is None:
@@ -201,7 +203,7 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
     k = _constrain(k, rules, ("batch", "act_attn_seq", "act_heads", None))
     out = dot_product_attention(
         q, k, v,
-        causal=True,
+        causal=cfg.causal,
         segment_ids_q=segment_ids,
         sliding_window=sliding,
         sinks=lp.get("sinks"),
@@ -229,7 +231,10 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
     with the activation between stages.
     """
     dtype = backend.jnp_dtype
-    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    inv_freq = rope_frequencies(
+        cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
+        partial_rotary_factor=cfg.partial_rotary_factor,
+    )
     attn_scale = rope_attention_scaling(cfg.rope_scaling)
     any_sliding = any(cfg.sliding_flags)
     window = jnp.int32(cfg.sliding_window or 0)

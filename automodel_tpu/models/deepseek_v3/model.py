@@ -122,7 +122,7 @@ class DeepseekV3Config:
             qk_nope_head_dim=hf["qk_nope_head_dim"],
             qk_rope_head_dim=hf["qk_rope_head_dim"],
             v_head_dim=hf["v_head_dim"],
-            first_k_dense_replace=hf.get("first_k_dense_replace", 0),
+            first_k_dense_replace=_first_k_dense(hf),
             max_position_embeddings=hf.get("max_position_embeddings", 4096),
             rope_theta=hf.get("rope_theta", 10000.0),
             rope_scaling=hf.get("rope_scaling"),
@@ -131,6 +131,19 @@ class DeepseekV3Config:
             initializer_range=hf.get("initializer_range", 0.02),
             moe=moe,
         )
+
+
+def _first_k_dense(hf: dict[str, Any]) -> int:
+    """first_k_dense_replace, or a GLM4-MoE-Lite style mlp_layer_types prefix
+    (["dense", "sparse", ...] — only dense-prefix patterns are supported)."""
+    layer_types = hf.get("mlp_layer_types")
+    if layer_types:
+        flags = [t == "sparse" for t in layer_types]
+        first = flags.index(True) if any(flags) else len(flags)
+        if not all(flags[first:]):
+            raise NotImplementedError("non-prefix dense/sparse interleavings are not supported")
+        return first
+    return hf.get("first_k_dense_replace", 0)
 
 
 def _mla_shapes(cfg: DeepseekV3Config) -> dict[str, tuple[int, ...]]:

@@ -1,0 +1,3 @@
+from automodel_tpu.models.glm4_moe.model import Glm4MoeConfig, Glm4MoeForCausalLM
+
+__all__ = ["Glm4MoeConfig", "Glm4MoeForCausalLM"]

@@ -1,0 +1,111 @@
+"""GLM4-MoE family (GLM-4.5/4.6) — TPU-native (reference models/glm4_moe/model.py).
+
+Dense GQA attention with optional per-head qk RMSNorm, partial rotary (GLM ropes only
+the first half of head_dim), attention bias; DeepSeek-style sigmoid gating with
+group-limited routing, e_score_correction_bias, routed scaling, one shared expert,
+and a dense layer prefix (reference model.py:38,98-118).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.common.moe_transformer import (
+    MoEDecoderConfig,
+    init_moe_decoder_params,
+    moe_decoder_forward,
+    moe_decoder_logical_axes,
+)
+from automodel_tpu.moe.config import MoEConfig
+
+__all__ = ["Glm4MoeConfig", "Glm4MoeForCausalLM"]
+
+
+@dataclasses.dataclass
+class Glm4MoeConfig(MoEDecoderConfig):
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "Glm4MoeConfig":
+        rope_params = hf.get("rope_parameters") or {}
+        # new-style rope_parameters can carry the scaling spec (rope_type/factor)
+        rope_scaling = hf.get("rope_scaling") or (
+            rope_params if rope_params.get("rope_type") not in (None, "default") else None
+        )
+        moe = MoEConfig(
+            n_routed_experts=hf["n_routed_experts"],
+            n_activated_experts=hf["num_experts_per_tok"],
+            dim=hf["hidden_size"],
+            moe_inter_dim=hf["moe_intermediate_size"],
+            n_shared_experts=hf.get("n_shared_experts", 1),
+            n_expert_groups=max(hf.get("n_group") or 1, 1),
+            n_limited_groups=max(hf.get("topk_group") or 1, 1),
+            gate_bias_update_factor=0.001,  # noaux-tc loss-free balancing
+            score_func="sigmoid",
+            route_scale=hf.get("routed_scaling_factor", 1.0),
+            norm_topk_prob=hf.get("norm_topk_prob", True),
+        )
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_hidden_layers=hf["num_hidden_layers"],
+            num_attention_heads=hf["num_attention_heads"],
+            num_key_value_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get("head_dim"),
+            max_position_embeddings=hf.get("max_position_embeddings", 4096),
+            rope_theta=rope_params.get("rope_theta", hf.get("rope_theta", 10000.0)),
+            rope_scaling=rope_scaling,
+            partial_rotary_factor=rope_params.get(
+                "partial_rotary_factor", hf.get("partial_rotary_factor", 0.5)
+            ),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            attention_bias=hf.get("attention_bias", True),
+            qk_norm=hf.get("use_qk_norm", True),
+            initializer_range=hf.get("initializer_range", 0.02),
+            moe=moe,
+            first_k_dense_replace=hf.get("first_k_dense_replace", 1),
+        )
+
+
+class Glm4MoeForCausalLM:
+    """Functional model: holds config + backend, operates on param pytrees."""
+
+    config_class = Glm4MoeConfig
+    hf_architectures = ("Glm4MoeForCausalLM",)
+
+    def __init__(self, config: Glm4MoeConfig, backend: BackendConfig | None = None):
+        self.config = config
+        self.backend = backend or BackendConfig()
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> dict:
+        return init_moe_decoder_params(self.config, key, dtype)
+
+    def logical_axes(self) -> dict:
+        return moe_decoder_logical_axes(self.config)
+
+    def abstract_params(self, dtype=jnp.bfloat16) -> dict:
+        return jax.eval_shape(lambda k: self.init(k, dtype), jax.random.key(0))
+
+    def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
+                 rules=None, return_hidden=False, training=True):
+        return moe_decoder_forward(
+            self.config, self.backend, params, input_ids,
+            positions=positions, segment_ids=segment_ids, token_mask=token_mask,
+            rules=rules, return_hidden=return_hidden, training=training,
+        )
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.glm4_moe.state_dict_adapter import Glm4MoeStateDictAdapter
+
+        return Glm4MoeStateDictAdapter(self.config)
+
+    @classmethod
+    def from_config(cls, config, backend: BackendConfig | None = None):
+        if isinstance(config, dict):
+            config = Glm4MoeConfig.from_hf(config)
+        return cls(config, backend)

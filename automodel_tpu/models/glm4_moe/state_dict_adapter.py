@@ -1,0 +1,55 @@
+"""GLM4-MoE HF key/layout mapping (reference models/glm4_moe/state_dict_adapter.py).
+
+Qwen3-MoE-style per-expert tensors plus the DeepSeek-style extras: the gate's
+``e_score_correction_bias`` and one ``shared_experts`` MLP per MoE layer.
+"""
+
+from __future__ import annotations
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.llama.state_dict_adapter import _t
+from automodel_tpu.models.qwen3_moe.state_dict_adapter import (
+    attention_entries,
+    moe_expert_entries,
+)
+
+__all__ = ["Glm4MoeStateDictAdapter"]
+
+
+def shared_expert_entries(moe_range) -> list[Entry]:
+    pre = "model.layers.{i}.mlp.shared_experts"
+    ours = "moe_layers.moe.shared_experts"
+    return [
+        Entry(f"{pre}.gate_proj.weight", f"{ours}.w_gate", _t, _t, layer_range=moe_range),
+        Entry(f"{pre}.up_proj.weight", f"{ours}.w_up", _t, _t, layer_range=moe_range),
+        Entry(f"{pre}.down_proj.weight", f"{ours}.w_down", _t, _t, layer_range=moe_range),
+    ]
+
+
+class Glm4MoeStateDictAdapter(MappingAdapter):
+    def __init__(self, cfg, scan_layers: bool = True):
+        k = cfg.first_k_dense_replace
+        L = cfg.num_hidden_layers
+        moe_range = (k, L)
+        entries = [
+            Entry("model.embed_tokens.weight", "embed"),
+            Entry("model.norm.weight", "final_norm"),
+            *attention_entries(cfg, "moe_layers", layer_range=moe_range),
+            Entry("model.layers.{i}.mlp.gate.weight", "moe_layers.moe.gate.weight",
+                  layer_range=moe_range),
+            Entry("model.layers.{i}.mlp.gate.e_score_correction_bias",
+                  "moe_layers.moe.gate.score_correction_bias", layer_range=moe_range),
+            *moe_expert_entries("model.layers.{i}.mlp", "moe_layers.moe", layer_range=moe_range),
+        ]
+        if cfg.moe.n_shared_experts > 0:
+            entries += shared_expert_entries(moe_range)
+        if k > 0:
+            entries += [
+                *attention_entries(cfg, "dense_layers", layer_range=(0, k)),
+                Entry("model.layers.{i}.mlp.gate_proj.weight", "dense_layers.w_gate", _t, _t, layer_range=(0, k)),
+                Entry("model.layers.{i}.mlp.up_proj.weight", "dense_layers.w_up", _t, _t, layer_range=(0, k)),
+                Entry("model.layers.{i}.mlp.down_proj.weight", "dense_layers.w_down", _t, _t, layer_range=(0, k)),
+            ]
+        if not cfg.tie_word_embeddings:
+            entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
+        super().__init__(entries, L, scan_layers, num_experts=cfg.moe.n_routed_experts)

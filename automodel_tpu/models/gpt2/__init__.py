@@ -1,0 +1,3 @@
+from automodel_tpu.models.gpt2.model import GPT2Config, GPT2LMHeadModel
+
+__all__ = ["GPT2Config", "GPT2LMHeadModel"]

@@ -1,0 +1,33 @@
+"""GPT-2 HF key mapping. HF Conv1D weights are stored (in, out) — our orientation —
+so transforms are identity; only the tied lm_head and the ``transformer.`` prefix
+need handling."""
+
+from __future__ import annotations
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+
+__all__ = ["GPT2StateDictAdapter"]
+
+
+class GPT2StateDictAdapter(MappingAdapter):
+    def __init__(self, cfg, scan_layers: bool = True):
+        pre = "transformer.h.{i}"
+        entries = [
+            Entry("transformer.wte.weight", "wte"),
+            Entry("transformer.wpe.weight", "wpe"),
+            Entry("transformer.ln_f.weight", "lnf_w"),
+            Entry("transformer.ln_f.bias", "lnf_b"),
+            Entry(f"{pre}.ln_1.weight", "layers.ln1_w"),
+            Entry(f"{pre}.ln_1.bias", "layers.ln1_b"),
+            Entry(f"{pre}.attn.c_attn.weight", "layers.c_attn"),
+            Entry(f"{pre}.attn.c_attn.bias", "layers.c_attn_b"),
+            Entry(f"{pre}.attn.c_proj.weight", "layers.c_proj"),
+            Entry(f"{pre}.attn.c_proj.bias", "layers.c_proj_b"),
+            Entry(f"{pre}.ln_2.weight", "layers.ln2_w"),
+            Entry(f"{pre}.ln_2.bias", "layers.ln2_b"),
+            Entry(f"{pre}.mlp.c_fc.weight", "layers.c_fc"),
+            Entry(f"{pre}.mlp.c_fc.bias", "layers.c_fc_b"),
+            Entry(f"{pre}.mlp.c_proj.weight", "layers.c_proj2"),
+            Entry(f"{pre}.mlp.c_proj.bias", "layers.c_proj2_b"),
+        ]
+        super().__init__(entries, cfg.n_layer, scan_layers)

@@ -1,0 +1,6 @@
+from automodel_tpu.models.llama_bidirectional.model import (
+    LlamaBidirectionalConfig,
+    LlamaBidirectionalModel,
+)
+
+__all__ = ["LlamaBidirectionalConfig", "LlamaBidirectionalModel"]

@@ -1,0 +1,104 @@
+"""Bidirectional Llama encoder for retrieval/embedding
+(reference models/llama_bidirectional/model.py:46,75,162).
+
+A Llama trunk with the causal mask off and a pooling head — the embedding tower the
+biencoder recipe trains. Pooling strategies mirror the reference ``_pool``:
+``avg`` (mask-weighted mean), ``cls`` (first token), ``last`` (last valid token).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.llama.model import LlamaConfig
+from automodel_tpu.models.common.transformer import (
+    decoder_forward,
+    dense_decoder_logical_axes,
+    init_dense_decoder_params,
+)
+
+__all__ = ["LlamaBidirectionalConfig", "LlamaBidirectionalModel", "pool_hidden"]
+
+
+def pool_hidden(hidden: jnp.ndarray, mask: jnp.ndarray, pooling: str) -> jnp.ndarray:
+    """(B, S, D), (B, S) -> (B, D) (reference _pool, model.py:162)."""
+    maskf = mask.astype(hidden.dtype)
+    if pooling == "avg":
+        s = (hidden * maskf[..., None]).sum(axis=1)
+        return s / jnp.maximum(maskf.sum(axis=1), 1.0)[..., None]
+    if pooling == "cls":
+        return hidden[:, 0]
+    if pooling == "last":
+        last = jnp.maximum(mask.sum(axis=1) - 1, 0)
+        return jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
+    raise ValueError(f"unknown pooling {pooling!r} (avg | cls | last)")
+
+
+@dataclasses.dataclass
+class LlamaBidirectionalConfig(LlamaConfig):
+    pooling: str = "avg"
+    temperature: float = 1.0
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.causal = False
+
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "LlamaBidirectionalConfig":
+        base = LlamaConfig.from_hf(hf)
+        kwargs = {f.name: getattr(base, f.name) for f in dataclasses.fields(LlamaConfig)}
+        kwargs["tie_word_embeddings"] = True  # encoder: no lm_head
+        return cls(**kwargs, pooling=hf.get("pooling", "avg"),
+                   temperature=hf.get("temperature", 1.0))
+
+
+class LlamaBidirectionalModel:
+    """Functional encoder: __call__ returns pooled embeddings (B, D)."""
+
+    config_class = LlamaBidirectionalConfig
+    hf_architectures = ("LlamaBidirectionalModel",)
+
+    def __init__(self, config: LlamaBidirectionalConfig, backend: BackendConfig | None = None):
+        self.config = config
+        self.backend = backend or BackendConfig()
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> dict:
+        params = init_dense_decoder_params(self.config, key, dtype, self.backend.scan_layers)
+        params.pop("lm_head", None)
+        return params
+
+    def logical_axes(self) -> dict:
+        axes = dense_decoder_logical_axes(self.config, self.backend.scan_layers)
+        axes.pop("lm_head", None)
+        return axes
+
+    def abstract_params(self, dtype=jnp.bfloat16) -> dict:
+        return jax.eval_shape(lambda k: self.init(k, dtype), jax.random.key(0))
+
+    def __call__(self, params, input_ids, positions=None, segment_ids=None, rules=None,
+                 pooled: bool = True):
+        hidden = decoder_forward(
+            self.config, self.backend, params, input_ids,
+            positions=positions, segment_ids=segment_ids, rules=rules,
+            return_hidden=True,
+        )
+        if not pooled:
+            return hidden
+        mask = (segment_ids != 0) if segment_ids is not None else jnp.ones(input_ids.shape, bool)
+        return pool_hidden(hidden, mask, self.config.pooling)
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.llama.state_dict_adapter import LlamaStateDictAdapter
+
+        return LlamaStateDictAdapter(self.config, self.backend.scan_layers)
+
+    @classmethod
+    def from_config(cls, config, backend: BackendConfig | None = None):
+        if isinstance(config, dict):
+            config = LlamaBidirectionalConfig.from_hf(config)
+        return cls(config, backend)

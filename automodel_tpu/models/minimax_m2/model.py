@@ -1,0 +1,108 @@
+"""MiniMax-M2 family — TPU-native (reference models/minimax_m2/model.py).
+
+Dense GQA attention with partial rotary (rope_parameters.partial_rotary_factor),
+no qk-norm; every layer MoE with sigmoid scoring, e_score_correction_bias (present
+in checkpoints even without noaux-tc balancing — reference
+force_e_score_correction_bias=True, model.py:106), no shared experts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.common.moe_transformer import (
+    MoEDecoderConfig,
+    init_moe_decoder_params,
+    moe_decoder_forward,
+    moe_decoder_logical_axes,
+)
+from automodel_tpu.moe.config import MoEConfig
+
+__all__ = ["MiniMaxM2Config", "MiniMaxM2ForCausalLM"]
+
+
+@dataclasses.dataclass
+class MiniMaxM2Config(MoEDecoderConfig):
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "MiniMaxM2Config":
+        rope_params = hf.get("rope_parameters") or {}
+        rope_scaling = hf.get("rope_scaling") or (
+            rope_params if rope_params.get("rope_type") not in (None, "default") else None
+        )
+        moe = MoEConfig(
+            n_routed_experts=hf.get("num_local_experts", hf.get("num_experts")),
+            n_activated_experts=hf["num_experts_per_tok"],
+            dim=hf["hidden_size"],
+            moe_inter_dim=hf.get("moe_intermediate_size", hf["intermediate_size"]),
+            score_func=hf.get("scoring_func", "sigmoid"),
+            route_scale=hf.get("routed_scaling_factor", 1.0),
+            norm_topk_prob=hf.get("norm_topk_prob", True),
+            force_score_correction_bias=True,
+            aux_loss_coeff=hf.get("router_aux_loss_coef", 0.0),
+        )
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_hidden_layers=hf["num_hidden_layers"],
+            num_attention_heads=hf["num_attention_heads"],
+            num_key_value_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get("head_dim"),
+            max_position_embeddings=hf.get("max_position_embeddings", 4096),
+            rope_theta=rope_params.get("rope_theta", hf.get("rope_theta", 10000.0)),
+            rope_scaling=rope_scaling,
+            partial_rotary_factor=rope_params.get(
+                "partial_rotary_factor", hf.get("partial_rotary_factor", 1.0)
+            ),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            attention_bias=hf.get("attention_bias", False),
+            qk_norm=hf.get("use_qk_norm", False),
+            initializer_range=hf.get("initializer_range", 0.02),
+            moe=moe,
+            first_k_dense_replace=0,
+        )
+
+
+class MiniMaxM2ForCausalLM:
+    """Functional model: holds config + backend, operates on param pytrees."""
+
+    config_class = MiniMaxM2Config
+    hf_architectures = ("MiniMaxM2ForCausalLM",)
+
+    def __init__(self, config: MiniMaxM2Config, backend: BackendConfig | None = None):
+        self.config = config
+        self.backend = backend or BackendConfig()
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> dict:
+        return init_moe_decoder_params(self.config, key, dtype)
+
+    def logical_axes(self) -> dict:
+        return moe_decoder_logical_axes(self.config)
+
+    def abstract_params(self, dtype=jnp.bfloat16) -> dict:
+        return jax.eval_shape(lambda k: self.init(k, dtype), jax.random.key(0))
+
+    def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
+                 rules=None, return_hidden=False, training=True):
+        return moe_decoder_forward(
+            self.config, self.backend, params, input_ids,
+            positions=positions, segment_ids=segment_ids, token_mask=token_mask,
+            rules=rules, return_hidden=return_hidden, training=training,
+        )
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.minimax_m2.state_dict_adapter import MiniMaxM2StateDictAdapter
+
+        return MiniMaxM2StateDictAdapter(self.config)
+
+    @classmethod
+    def from_config(cls, config, backend: BackendConfig | None = None):
+        if isinstance(config, dict):
+            config = MiniMaxM2Config.from_hf(config)
+        return cls(config, backend)

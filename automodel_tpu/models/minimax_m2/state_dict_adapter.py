@@ -1,0 +1,30 @@
+"""MiniMax-M2 HF key mapping (reference models/minimax_m2/state_dict_adapter.py):
+Qwen3-MoE expert layout + the gate's e_score_correction_bias; no dense prefix."""
+
+from __future__ import annotations
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.llama.state_dict_adapter import _t
+from automodel_tpu.models.qwen3_moe.state_dict_adapter import (
+    attention_entries,
+    moe_expert_entries,
+)
+
+__all__ = ["MiniMaxM2StateDictAdapter"]
+
+
+class MiniMaxM2StateDictAdapter(MappingAdapter):
+    def __init__(self, cfg, scan_layers: bool = True):
+        L = cfg.num_hidden_layers
+        entries = [
+            Entry("model.embed_tokens.weight", "embed"),
+            Entry("model.norm.weight", "final_norm"),
+            *attention_entries(cfg, "moe_layers"),
+            Entry("model.layers.{i}.mlp.gate.weight", "moe_layers.moe.gate.weight"),
+            Entry("model.layers.{i}.mlp.gate.e_score_correction_bias",
+                  "moe_layers.moe.gate.score_correction_bias"),
+            *moe_expert_entries("model.layers.{i}.mlp", "moe_layers.moe"),
+        ]
+        if not cfg.tie_word_embeddings:
+            entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
+        super().__init__(entries, L, scan_layers, num_experts=cfg.moe.n_routed_experts)

@@ -20,6 +20,14 @@ MODEL_REGISTRY: dict[str, str] = {
     "GptOssForCausalLM": "automodel_tpu.models.gpt_oss.model:GptOssForCausalLM",
     "DeepseekV3ForCausalLM": "automodel_tpu.models.deepseek_v3.model:DeepseekV3ForCausalLM",
     "DeepseekV2ForCausalLM": "automodel_tpu.models.deepseek_v3.model:DeepseekV3ForCausalLM",
+    # Kimi-K2 ships DeepseekV3 architecture in its config.json (reference kimi support)
+    "KimiK2ForCausalLM": "automodel_tpu.models.deepseek_v3.model:DeepseekV3ForCausalLM",
+    # GLM4-MoE-Lite is MLA attention + GLM gating — same param/weight surface as DSv3
+    "Glm4MoeLiteForCausalLM": "automodel_tpu.models.deepseek_v3.model:DeepseekV3ForCausalLM",
+    "Glm4MoeForCausalLM": "automodel_tpu.models.glm4_moe.model:Glm4MoeForCausalLM",
+    "MiniMaxM2ForCausalLM": "automodel_tpu.models.minimax_m2.model:MiniMaxM2ForCausalLM",
+    "GPT2LMHeadModel": "automodel_tpu.models.gpt2.model:GPT2LMHeadModel",
+    "LlamaBidirectionalModel": "automodel_tpu.models.llama_bidirectional.model:LlamaBidirectionalModel",
 }
 
 

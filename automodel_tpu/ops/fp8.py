@@ -41,19 +41,21 @@ def _fp8_fwd(x, w):
     xq, sx = _quant(x, jnp.float8_e4m3fn, _E4M3_MAX)
     wq, sw = _quant(w, jnp.float8_e4m3fn, _E4M3_MAX)
     out = jnp.matmul(xq, wq, preferred_element_type=jnp.float32) * (sx * sw)
-    return out.astype(x.dtype), (xq, sx, wq, sw)
+    # zero-size dtype markers: custom_vjp residuals must be arrays, and the
+    # cotangents must land in each primal's own dtype (x and w may differ)
+    markers = (jnp.zeros((0,), x.dtype), jnp.zeros((0,), w.dtype))
+    return out.astype(x.dtype), (xq, sx, wq, sw, markers)
 
 
 def _fp8_bwd(res, g):
-    xq, sx, wq, sw = res
+    xq, sx, wq, sw, (xm, wm) = res
     gq, sg = _quant(g, jnp.float8_e5m2, _E5M2_MAX)
-    # dx = g @ w.T ; dw = x.T @ g — both fp8 x fp8 -> fp32; g carries x's dtype
-    # (it is the cotangent of the output, which was cast to x.dtype)
+    # dx = g @ w.T ; dw = x.T @ g — both fp8 x fp8 -> fp32
     dx = jnp.matmul(gq, wq.T, preferred_element_type=jnp.float32) * (sg * sw)
     xq2 = xq.reshape(-1, xq.shape[-1])
     gq2 = gq.reshape(-1, gq.shape[-1])
     dw = jnp.matmul(xq2.T, gq2, preferred_element_type=jnp.float32) * (sx * sg)
-    return dx.astype(g.dtype), dw.astype(g.dtype)
+    return dx.astype(xm.dtype), dw.astype(wm.dtype)
 
 
 fp8_matmul.defvjp(_fp8_fwd, _fp8_bwd)

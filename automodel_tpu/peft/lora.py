@@ -303,7 +303,7 @@ def merge_lora_params(params: Any, lora: Any, cfg: PeftConfig) -> Any:
     def merge_one(path: str, leaf: dict, out_params: Any) -> Any:
         w = _get_path(out_params, path)
         if is_quantized_leaf(w):
-            w = dequantize_leaf(w, jnp.float32)
+            w = dequantize_leaf(w)  # back to the base dtype, fp32 math below
         a, b = leaf["lora_a"], leaf["lora_b"]
         delta = jnp.einsum("...ir,...ro->...io", a.astype(jnp.float32), b.astype(jnp.float32)) * scaling
         w_flat = w.reshape(delta.shape).astype(jnp.float32)

@@ -47,20 +47,21 @@ _NF4_BLOCK = 64
 
 @jax.tree_util.register_pytree_node_class
 class QuantizedTensor:
-    """Codes + scales as pytree children; (scheme, shape) static."""
+    """Codes + scales as pytree children; (scheme, shape, orig_dtype) static."""
 
-    def __init__(self, q, scale, scheme: str, shape: tuple):
+    def __init__(self, q, scale, scheme: str, shape: tuple, orig_dtype: str = "float32"):
         self.q = q
         self.scale = scale
         self.scheme = scheme
         self.shape = tuple(shape)
+        self.orig_dtype = str(orig_dtype)
 
     def tree_flatten(self):
-        return (self.q, self.scale), (self.scheme, self.shape)
+        return (self.q, self.scale), (self.scheme, self.shape, self.orig_dtype)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux[0], aux[1])
+        return cls(children[0], children[1], *aux)
 
     @property
     def nbytes(self) -> int:
@@ -85,13 +86,14 @@ def quantize_leaf(w, scheme: str = "int8", n_stack: int = 0) -> QuantizedTensor:
     weight's layout (no host gather, pod-safe). nf4's blockwise bit-packing
     reshapes the full tensor and is host-side; use it for single-host finetuning.
     """
+    orig_dtype = str(getattr(w, "dtype", "float32"))
     if scheme == "int8":
         wj = jnp.asarray(w, jnp.float32) if not isinstance(w, jax.Array) else w.astype(jnp.float32)
         reduce_axes = tuple(range(n_stack, wj.ndim - 1))
         amax = jnp.abs(wj).max(axis=reduce_axes, keepdims=True) if reduce_axes else jnp.abs(wj)
         scale = jnp.maximum(amax, 1e-12) / 127.0
         q = jnp.clip(jnp.round(wj / scale), -127, 127).astype(jnp.int8)
-        return QuantizedTensor(q, scale, "int8", wj.shape)
+        return QuantizedTensor(q, scale, "int8", wj.shape, orig_dtype)
     if scheme == "nf4":
         w = np.asarray(w, np.float32)
         flat = w.reshape(-1)
@@ -104,12 +106,17 @@ def quantize_leaf(w, scheme: str = "int8", n_stack: int = 0) -> QuantizedTensor:
         codes = np.abs(normed[..., None] - NF4_CODEBOOK).argmin(-1).astype(np.uint8)
         packed = (codes[:, 0::2] << 4) | codes[:, 1::2]  # two 4-bit codes per byte
         return QuantizedTensor(
-            jnp.asarray(packed), jnp.asarray(scale[:, 0]), "nf4", w.shape
+            jnp.asarray(packed), jnp.asarray(scale[:, 0]), "nf4", w.shape, orig_dtype
         )
     raise ValueError(f"unknown qlora scheme {scheme!r} (int8 | nf4)")
 
 
-def dequantize_leaf(leaf: QuantizedTensor, dtype=jnp.float32) -> jnp.ndarray:
+def dequantize_leaf(leaf: QuantizedTensor, dtype=None) -> jnp.ndarray:
+    """Dense view in ``dtype`` (default: the weight's pre-quantization dtype, so a
+    bf16 base merges back to bf16 — transient footprint and consolidated saves keep
+    the base precision)."""
+    if dtype is None:
+        dtype = jnp.dtype(leaf.orig_dtype)
     if leaf.scheme == "int8":
         return (leaf.q.astype(jnp.float32) * leaf.scale).astype(dtype)
     if leaf.scheme == "nf4":
@@ -138,7 +145,7 @@ def quantize_params(params, paths: list[str] | dict, scheme: str = "int8"):
     return out
 
 
-def dequantize_params(params, dtype=jnp.float32):
+def dequantize_params(params, dtype=None):
     """Dense view of a (partially) quantized tree — call inside jit at point of use."""
     return jax.tree.map(
         lambda x: dequantize_leaf(x, dtype) if is_quantized_leaf(x) else x,

@@ -356,6 +356,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 raise NotImplementedError("pp + MoE composition is not wired yet")
             if self.peft is not None:
                 raise NotImplementedError("peft + pp composition is not wired yet")
+            if self.cfg.get("qat") is not None:
+                raise NotImplementedError("qat + pp composition is not wired yet")
             pp_loss = make_dense_decoder_pp_loss(
                 self.model, self.mesh, self.rules, loss_name=self.loss_name
             )
@@ -504,7 +506,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 )
                 self._eval_step = jax.jit(make_eval_step(eval_loss, with_frozen=True))
             else:
-                eval_loss = lambda p, b, n: self._forward_loss(p, b, n, training=False)
+                # QAT: validate with the same fake-quantized weights training sees
+                eval_loss = self._qat_wrap(
+                    lambda p, b, n: self._forward_loss(p, b, n, training=False)
+                )
                 self._eval_step = jax.jit(make_eval_step(eval_loss))
         losses = []
         extra = (self.params,) if self.peft is not None else ()
