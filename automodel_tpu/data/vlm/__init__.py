@@ -1,0 +1,3 @@
+from automodel_tpu.data.vlm.collate import preprocess_images, vlm_collate
+
+__all__ = ["preprocess_images", "vlm_collate"]

@@ -1,0 +1,120 @@
+"""VLM collation (reference datasets/vlm/collate_fns.py).
+
+The reference dispatches per-processor collate functions (qwen2.5/kimi/phi4);
+here one collator covers the LLaVA composition: examples carry a prompt/answer (or
+``messages``) plus an image; the ``<image>`` placeholder expands to the model's
+``num_image_tokens`` image-token ids, label building masks everything except the
+answer span (reference build_labels, collate_fns.py:86), and images are resized +
+CLIP-normalized in numpy — no torch, no PIL dependency in the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from automodel_tpu.data.collate import IGNORE_INDEX
+
+__all__ = ["preprocess_images", "vlm_collate", "IMAGE_PLACEHOLDER"]
+
+IMAGE_PLACEHOLDER = "<image>"
+
+# CLIP normalization constants (openai/clip-vit defaults)
+_MEAN = np.asarray([0.48145466, 0.4578275, 0.40821073], np.float32)
+_STD = np.asarray([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+def _resize_bilinear(img: np.ndarray, size: int) -> np.ndarray:
+    """(H, W, C) -> (size, size, C) bilinear, pure numpy."""
+    h, w, c = img.shape
+    if h == size and w == size:
+        return img.astype(np.float32)
+    ys = (np.arange(size) + 0.5) * h / size - 0.5
+    xs = (np.arange(size) + 0.5) * w / size - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    img = img.astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def preprocess_images(images: Sequence[np.ndarray], image_size: int) -> np.ndarray:
+    """uint8/float (H, W, 3) images -> (B, 3, S, S) CLIP-normalized float32."""
+    out = np.empty((len(images), 3, image_size, image_size), np.float32)
+    for i, img in enumerate(images):
+        img = np.asarray(img)
+        if img.dtype == np.uint8:
+            img = img.astype(np.float32) / 255.0
+        img = _resize_bilinear(img, image_size)
+        img = (img - _MEAN) / _STD
+        out[i] = np.transpose(img, (2, 0, 1))
+    return out
+
+
+def vlm_collate(
+    examples: Sequence[Mapping[str, Any]],
+    tokenizer,
+    seq_len: int,
+    image_token_id: int,
+    num_image_tokens: int,
+    image_size: int,
+    pad_token_id: int = 0,
+    answer_only_loss: bool = True,
+) -> dict[str, np.ndarray]:
+    """Examples: {"prompt": str (may contain <image>), "answer": str, "image": array}.
+
+    Output adds ``pixel_values`` to the standard collate contract; image-token
+    labels are always IGNORE (reference build_labels masks non-assistant spans).
+    """
+    b = len(examples)
+    input_ids = np.full((b, seq_len), pad_token_id, np.int32)
+    labels = np.full((b, seq_len), IGNORE_INDEX, np.int32)
+    segment_ids = np.zeros((b, seq_len), np.int32)
+    positions = np.zeros((b, seq_len), np.int32)
+    images = []
+
+    for row, ex in enumerate(examples):
+        prompt = ex["prompt"]
+        if IMAGE_PLACEHOLDER not in prompt:
+            prompt = IMAGE_PLACEHOLDER + "\n" + prompt
+        pre, post = prompt.split(IMAGE_PLACEHOLDER, 1)
+        pre_ids = tokenizer.encode(pre) if pre else []
+        post_ids = tokenizer.encode(post, add_special_tokens=False) if post else []
+        answer_ids = tokenizer.encode(str(ex["answer"]), add_special_tokens=False)
+        eos = getattr(tokenizer, "eos_token_id", None)
+        if eos is not None:
+            answer_ids = answer_ids + [eos]
+        ids = np.asarray(
+            pre_ids + [image_token_id] * num_image_tokens + post_ids + answer_ids,
+            np.int32,
+        )
+        prompt_len = len(pre_ids) + num_image_tokens + len(post_ids)
+        # next-token shift within the sample (collate contract)
+        inp, tgt = ids[:-1], ids[1:].copy()
+        if answer_only_loss:
+            tgt[: max(prompt_len - 1, 0)] = IGNORE_INDEX
+        n = min(len(inp), seq_len)
+        if len(pre_ids) + num_image_tokens > seq_len:
+            raise ValueError(
+                f"seq_len {seq_len} too small for {num_image_tokens} image tokens + prompt"
+            )
+        input_ids[row, :n] = inp[:n]
+        labels[row, :n] = tgt[:n]
+        segment_ids[row, :n] = 1
+        positions[row, :n] = np.arange(n)
+        images.append(ex["image"])
+
+    labels[segment_ids == 0] = IGNORE_INDEX
+    return {
+        "input_ids": input_ids,
+        "labels": labels,
+        "positions": positions,
+        "segment_ids": segment_ids,
+        "pixel_values": preprocess_images(images, image_size),
+    }

@@ -1,0 +1,36 @@
+"""Mock VLM dataset: the answer token is determined by image brightness, so a
+working vision path is *required* to fit it (text-only models plateau)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MockVLMDataset"]
+
+
+class MockVLMDataset:
+    def __init__(self, num_samples: int = 128, image_hw: int = 28, num_classes: int = 4,
+                 vocab_size: int = 128, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.rows = []
+        for _ in range(num_samples):
+            cls = int(rng.integers(0, num_classes))
+            # brightness encodes the class; noise keeps it non-trivial
+            base = (cls + 0.5) / num_classes
+            img = np.clip(
+                base + rng.normal(0, 0.05, size=(image_hw, image_hw, 3)), 0, 1
+            ).astype(np.float32)
+            self.rows.append(
+                {
+                    "prompt": "what class",
+                    "answer": f"class{cls}",
+                    "image": img,
+                    "label": cls,
+                }
+            )
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __getitem__(self, i: int):
+        return self.rows[i]

@@ -24,7 +24,7 @@ from automodel_tpu.models.registry import resolve_model_class
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["AutoModelForCausalLM", "load_hf_config"]
+__all__ = ["AutoModelForCausalLM", "AutoModelForImageTextToText", "load_hf_config"]
 
 
 def load_hf_config(path: str) -> dict:
@@ -66,6 +66,20 @@ class AutoModelForCausalLM:
         host_params = adapter.from_hf(tensors, dtype=_np_dtype(dtype))
         params = _place(host_params, model, rules)
         return model, params
+
+
+class AutoModelForImageTextToText(AutoModelForCausalLM):
+    """VLM factory (reference NeMoAutoModelForImageTextToText, auto_model.py:614).
+
+    Same registry/load machinery — VLM architectures (LLaVA, ...) register next to
+    the causal families; the default architecture fallback differs.
+    """
+
+    @classmethod
+    def from_config(cls, config: dict, backend: BackendConfig | None = None):
+        arch = (config.get("architectures") or ["LlavaForConditionalGeneration"])[0]
+        model_cls = resolve_model_class(arch)
+        return model_cls.from_config(config, backend)
 
 
 def _np_dtype(dtype):

@@ -188,7 +188,7 @@ def moe_decoder_forward(
     if attention_fn is None:
         inv_freq = rope_frequencies(
             cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
-            partial_rotary_factor=getattr(cfg, "partial_rotary_factor", 1.0),
+            partial_rotary_factor=cfg.partial_rotary_factor,
         )
         attn_scale = rope_attention_scaling(cfg.rope_scaling)
         big_window = jnp.int32(cfg.max_position_embeddings + input_ids.shape[1])

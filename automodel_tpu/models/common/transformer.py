@@ -289,12 +289,13 @@ def decoder_forward(
     segment_ids: jnp.ndarray | None = None,
     rules=None,
     return_hidden: bool = False,
+    inputs_embeds: jnp.ndarray | None = None,  # VLM path: pre-merged embeddings
 ):
     """Forward pass -> logits (B, S, V), or final hidden states for fused linear-CE."""
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
     dtype = backend.jnp_dtype
-    h = params["embed"].astype(dtype)[input_ids]
+    h = inputs_embeds if inputs_embeds is not None else params["embed"].astype(dtype)[input_ids]
     h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
 
     state = {"h": h, "positions": positions}

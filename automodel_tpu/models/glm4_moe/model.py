@@ -64,8 +64,10 @@ class Glm4MoeConfig(MoEDecoderConfig):
             ),
             rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
             tie_word_embeddings=hf.get("tie_word_embeddings", False),
-            attention_bias=hf.get("attention_bias", True),
-            qk_norm=hf.get("use_qk_norm", True),
+            # HF Glm4MoeConfig defaults both to False; GLM-4.5/4.6 checkpoints set
+            # them explicitly in config.json
+            attention_bias=hf.get("attention_bias", False),
+            qk_norm=hf.get("use_qk_norm", False),
             initializer_range=hf.get("initializer_range", 0.02),
             moe=moe,
             first_k_dense_replace=hf.get("first_k_dense_replace", 1),

@@ -1,0 +1,3 @@
+from automodel_tpu.models.llava.model import LlavaConfig, LlavaForConditionalGeneration
+
+__all__ = ["LlavaConfig", "LlavaForConditionalGeneration"]

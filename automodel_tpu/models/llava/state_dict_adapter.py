@@ -1,0 +1,81 @@
+"""LLaVA HF key mapping: language entries are the Llama adapter's with prefixes
+rewritten (``model.`` -> ``model.language_model.``, params under ``language_model.``),
+plus CLIP vision tower and projector entries."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.llama.state_dict_adapter import LlamaStateDictAdapter, _t
+
+__all__ = ["LlavaStateDictAdapter"]
+
+_V = "vision_tower.vision_model"
+
+
+def _conv_in(w: np.ndarray) -> np.ndarray:
+    """HF OIHW conv -> HWIO."""
+    return np.ascontiguousarray(np.transpose(w, (2, 3, 1, 0)))
+
+
+def _conv_out(w: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(np.transpose(w, (3, 2, 0, 1)))
+
+
+def _vision_entries(num_layers: int) -> list[Entry]:
+    pre = f"{_V}.encoder.layers.{{i}}"
+    ours = "vision_tower.layers"
+    pairs = [
+        ("layer_norm1.weight", "ln1_w", None), ("layer_norm1.bias", "ln1_b", None),
+        ("self_attn.q_proj.weight", "wq", _t), ("self_attn.q_proj.bias", "bq", None),
+        ("self_attn.k_proj.weight", "wk", _t), ("self_attn.k_proj.bias", "bk", None),
+        ("self_attn.v_proj.weight", "wv", _t), ("self_attn.v_proj.bias", "bv", None),
+        ("self_attn.out_proj.weight", "wo", _t), ("self_attn.out_proj.bias", "bo", None),
+        ("layer_norm2.weight", "ln2_w", None), ("layer_norm2.bias", "ln2_b", None),
+        ("mlp.fc1.weight", "fc1", _t), ("mlp.fc1.bias", "fc1_b", None),
+        ("mlp.fc2.weight", "fc2", _t), ("mlp.fc2.bias", "fc2_b", None),
+    ]
+    entries = []
+    rng = (0, num_layers)  # vision depth differs from the text stack
+    for hf_key, our_key, tf in pairs:
+        if tf is None:
+            entries.append(Entry(f"{pre}.{hf_key}", f"{ours}.{our_key}", layer_range=rng))
+        else:
+            entries.append(Entry(f"{pre}.{hf_key}", f"{ours}.{our_key}", tf, tf, layer_range=rng))
+    entries += [
+        Entry(f"{_V}.embeddings.class_embedding", "vision_tower.class_embed"),
+        Entry(f"{_V}.embeddings.patch_embedding.weight", "vision_tower.patch_embed", _conv_in, _conv_out),
+        Entry(f"{_V}.embeddings.position_embedding.weight", "vision_tower.pos_embed"),
+        Entry(f"{_V}.pre_layrnorm.weight", "vision_tower.pre_ln_w"),  # (sic, HF typo)
+        Entry(f"{_V}.pre_layrnorm.bias", "vision_tower.pre_ln_b"),
+        Entry(f"{_V}.post_layernorm.weight", "vision_tower.post_ln_w"),
+        Entry(f"{_V}.post_layernorm.bias", "vision_tower.post_ln_b"),
+    ]
+    return entries
+
+
+class LlavaStateDictAdapter(MappingAdapter):
+    def __init__(self, cfg, scan_layers: bool = True):
+        # safetensors layout nests the text model as language_model.model.* with
+        # lm_head at language_model.lm_head (HF save_pretrained serialization)
+        text_adapter = LlamaStateDictAdapter(cfg.text, scan_layers)
+        text_entries = []
+        for e in text_adapter.entries:
+            hf_keys = tuple(f"language_model.{k}" for k in e.hf_keys)
+            text_entries.append(
+                dataclasses.replace(
+                    e,
+                    hf=hf_keys if len(hf_keys) > 1 else hf_keys[0],
+                    ours=f"language_model.{e.ours}",
+                )
+            )
+        entries = text_entries + _vision_entries(cfg.vision.num_hidden_layers) + [
+            Entry("multi_modal_projector.linear_1.weight", "projector.linear_1", _t, _t),
+            Entry("multi_modal_projector.linear_1.bias", "projector.linear_1_b"),
+            Entry("multi_modal_projector.linear_2.weight", "projector.linear_2", _t, _t),
+            Entry("multi_modal_projector.linear_2.bias", "projector.linear_2_b"),
+        ]
+        super().__init__(entries, cfg.text.num_hidden_layers, scan_layers)

@@ -56,6 +56,9 @@ class MiniMaxM2Config(MoEDecoderConfig):
             max_position_embeddings=hf.get("max_position_embeddings", 4096),
             rope_theta=rope_params.get("rope_theta", hf.get("rope_theta", 10000.0)),
             rope_scaling=rope_scaling,
+            # resolved the way HF does — rope_parameters/partial_rotary_factor only;
+            # config.rotary_dim is NOT consulted by HF's rope init (reference
+            # minimax_m2/model.py:125-130 documents the same)
             partial_rotary_factor=rope_params.get(
                 "partial_rotary_factor", hf.get("partial_rotary_factor", 1.0)
             ),

@@ -27,6 +27,7 @@ MODEL_REGISTRY: dict[str, str] = {
     "Glm4MoeForCausalLM": "automodel_tpu.models.glm4_moe.model:Glm4MoeForCausalLM",
     "MiniMaxM2ForCausalLM": "automodel_tpu.models.minimax_m2.model:MiniMaxM2ForCausalLM",
     "GPT2LMHeadModel": "automodel_tpu.models.gpt2.model:GPT2LMHeadModel",
+    "LlavaForConditionalGeneration": "automodel_tpu.models.llava.model:LlavaForConditionalGeneration",
     "LlamaBidirectionalModel": "automodel_tpu.models.llama_bidirectional.model:LlamaBidirectionalModel",
 }
 

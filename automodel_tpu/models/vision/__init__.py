@@ -1,0 +1,3 @@
+from automodel_tpu.models.vision.clip_vit import CLIPVisionConfig, CLIPVisionTower
+
+__all__ = ["CLIPVisionConfig", "CLIPVisionTower"]

@@ -311,7 +311,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         if self.loss_name == "linear_ce":
             unembed = params.get("lm_head")
             if unembed is None:
-                unembed = params["embed"].T
+                # tied embeddings; gpt2 names its table wte
+                table = params.get("embed", params.get("wte"))
+                if table is None:
+                    raise ValueError("linear_ce: model has neither lm_head nor a tied embedding table")
+                unembed = table.T
             loss = linear_cross_entropy(out, unembed, batch["labels"], num_label_tokens)
         else:
             loss = masked_cross_entropy(out, batch["labels"], num_label_tokens)
@@ -430,9 +434,21 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         mesh = self.mesh
         t_last = time.perf_counter()
         steps_since_log = 0
+        checked_vocab = False
         with mesh:
             for batches in self.step_scheduler:
                 stack = stack_batches(batches)
+                if not checked_vocab:
+                    # tokenizer/model vocab mismatch shows up as NaN loss deep in
+                    # training; fail loudly on the first batch instead
+                    vocab = getattr(getattr(self.model.config, "text", self.model.config),
+                                    "vocab_size", None)
+                    if vocab is not None and int(stack["input_ids"].max()) >= vocab:
+                        raise ValueError(
+                            f"batch contains token id {int(stack['input_ids'].max())} "
+                            f">= model vocab_size {vocab}: tokenizer/model mismatch"
+                        )
+                    checked_vocab = True
                 stack = {
                     k: jax.device_put(
                         v, self.rules.sharding((None, "batch", None))

@@ -1,0 +1,165 @@
+"""VLM finetune recipe (reference FinetuneRecipeForVLM, recipes/vlm/finetune.py:469).
+
+Subclasses the LLM finetune recipe: image-text model factory, VLM collation with
+image-token expansion, and a ``freeze`` section (reference freeze_config) that
+splits params into trainable/frozen *subtrees* — frozen parts ride through the
+jitted step as a non-differentiated argument (the same mechanism PEFT uses), so
+optimizer state only covers what trains.
+
+.. code-block:: yaml
+
+    model:
+      pretrained_model_name_or_path: /path/to/llava   # or config: {...}
+    freeze:
+      freeze_vision_tower: true      # reference default
+      freeze_language_model: false
+      freeze_projector: false
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.config.cli_overrides import parse_args_and_load_config
+from automodel_tpu.data.vlm.collate import vlm_collate
+from automodel_tpu.models.auto import AutoModelForImageTextToText, load_hf_config
+from automodel_tpu.ops.losses import masked_cross_entropy
+from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+from automodel_tpu.training.train_step import make_train_step
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["FinetuneRecipeForVLM", "main"]
+
+_FREEZE_KEYS = {
+    "freeze_vision_tower": "vision_tower",
+    "freeze_language_model": "language_model",
+    "freeze_projector": "projector",
+}
+
+
+class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
+    # -- model --------------------------------------------------------------
+    def _build_model_and_params(self):
+        cfg = self.cfg
+        pretrained = cfg.get("model.pretrained_model_name_or_path")
+        with self.mesh:
+            if pretrained:
+                self.hf_config = load_hf_config(pretrained)
+                self.model, self.params = AutoModelForImageTextToText.from_pretrained(
+                    pretrained, backend=self.backend, dtype=jnp.float32, rules=self.rules
+                )
+            else:
+                model_cfg = cfg.get("model.config")
+                if model_cfg is None:
+                    raise ValueError("config needs model.pretrained_model_name_or_path or model.config")
+                self.hf_config = model_cfg.to_dict() if isinstance(model_cfg, ConfigNode) else dict(model_cfg)
+                self.model = AutoModelForImageTextToText.from_config(self.hf_config, backend=self.backend)
+                shardings = self.rules.tree_sharding(self.model.logical_axes())
+                init_fn = jax.jit(lambda k: self.model.init(k, jnp.float32), out_shardings=shardings)
+                self.params = init_fn(self.rng.key("model_init"))
+        n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
+        logger.info("model: %s (%.1fM params)", type(self.model).__name__, n_params / 1e6)
+
+    def _build_peft(self):
+        if self.cfg.get("peft") is not None:
+            raise NotImplementedError("peft + vlm composition is not wired yet")
+        self.peft = None
+        # freeze split (reference freeze_config, vlm/finetune.py:86-113)
+        freeze_cfg = self.cfg.get("freeze") or ConfigNode({"freeze_vision_tower": True})
+        frozen_keys = [
+            tree_key for cfg_key, tree_key in _FREEZE_KEYS.items()
+            if freeze_cfg.get(cfg_key, cfg_key == "freeze_vision_tower")
+        ]
+        self.frozen_keys = [k for k in frozen_keys if k in self.params]
+        if len(self.frozen_keys) == len(self.params):
+            raise ValueError("freeze config freezes every submodule; nothing to train")
+        self.frozen_params = {k: self.params[k] for k in self.frozen_keys}
+        self.train_params = {k: v for k, v in self.params.items() if k not in self.frozen_keys}
+        logger.info("vlm freeze: frozen=%s trainable=%s", self.frozen_keys, list(self.train_params))
+
+    # -- data ---------------------------------------------------------------
+    def _wrap_dataset_and_collate(self, dataset, pad_id: int):
+        mcfg = self.model.config
+        return dataset, (
+            lambda exs: vlm_collate(
+                exs,
+                tokenizer=self.tokenizer,
+                seq_len=self.seq_len,
+                image_token_id=mcfg.image_token_index,
+                num_image_tokens=mcfg.num_image_tokens,
+                image_size=mcfg.vision.image_size,
+                pad_token_id=pad_id,
+            )
+        )
+
+    # -- step ---------------------------------------------------------------
+    def _forward_loss(self, params, batch, num_label_tokens, training=True):
+        logits = self.model(
+            params, batch["input_ids"], pixel_values=batch["pixel_values"],
+            positions=batch["positions"], segment_ids=batch["segment_ids"],
+            rules=self.rules,
+        )
+        return masked_cross_entropy(logits, batch["labels"], num_label_tokens)
+
+    def _build_train_step(self):
+        if self.mesh_ctx.pp > 1:
+            raise NotImplementedError("vlm + pp composition is not wired yet")
+
+        def split_loss(trainable, frozen, batch, num_label_tokens):
+            return self._forward_loss({**frozen, **trainable}, batch, num_label_tokens)
+
+        step = make_train_step(split_loss, self.optimizer, with_frozen=True)
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def run_train_validation_loop(self):
+        jitted = self._train_step
+        self._train_step = lambda p, o, stack: jitted(p, o, stack, self.frozen_params)
+        super().run_train_validation_loop()
+        # reassemble the full tree for saves/consumers
+        self.params = {**self.frozen_params, **self.train_params}
+
+    def _run_validation(self, step: int):
+        if self._eval_step is None:
+            from automodel_tpu.training.train_step import make_eval_step
+
+            eval_loss = lambda t, f, b, n: self._forward_loss({**f, **t}, b, n, training=False)
+            self._eval_step = jax.jit(make_eval_step(eval_loss, with_frozen=True))
+        losses = []
+        for batch in self.val_dataloader:
+            n = int((batch["labels"] != -100).sum())
+            losses.append(float(self._eval_step(self.train_params, batch, n, self.frozen_params)))
+        if losses:
+            val_loss = float(np.mean(losses))
+            self.val_metric_logger.log(step, val_loss=val_loss)
+            logger.info("validation @ step %d: loss %.4f", step, val_loss)
+
+    def _save(self, step: int):
+        client = {
+            "rng": self.rng,
+            "step_scheduler": self.step_scheduler,
+            "dataloader": self.dataloader,
+            "frozen_keys": list(self.frozen_keys),
+        }
+        full = {**self.frozen_params, **self.train_params}
+        self.checkpointer.save(
+            step, self.train_params, self.opt_state, client_states=client, hf_params=full
+        )
+
+
+def main(cfg: ConfigNode | None = None, argv=None):
+    if cfg is None:
+        cfg = parse_args_and_load_config(argv)
+    recipe = FinetuneRecipeForVLM(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+    return recipe
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""LLaVA VLM logit parity vs transformers (tiny CLIP + tiny Llama, offline)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.auto import AutoModelForImageTextToText
+from automodel_tpu.models.common.backend import BackendConfig
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+IMAGE_TOKEN = 120
+
+
+def tiny_llava(tmp_path):
+    cfg = transformers.LlavaConfig(
+        vision_config=transformers.CLIPVisionConfig(
+            hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, image_size=28, patch_size=14,
+        ),
+        text_config=transformers.LlamaConfig(
+            vocab_size=128, hidden_size=48, intermediate_size=96, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        ),
+        image_token_index=IMAGE_TOKEN,
+        vision_feature_layer=-2,
+        vision_feature_select_strategy="default",
+    )
+    hf_model = transformers.LlavaForConditionalGeneration(cfg).eval()
+    d = str(tmp_path / "hf")
+    hf_model.save_pretrained(d, safe_serialization=True)
+    return hf_model, d
+
+
+class TestLlavaParity:
+    def test_logits_match_hf(self, tmp_path):
+        hf_model, d = tiny_llava(tmp_path)
+        model, params = AutoModelForImageTextToText.from_pretrained(
+            d, dtype=jnp.float32, backend=BackendConfig(dtype="float32")
+        )
+        # 28/14 -> 2x2 patches = 4 image tokens per image
+        assert model.config.num_image_tokens == 4
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 100, (2, 12))
+        ids[:, 2:6] = IMAGE_TOKEN
+        pixels = rng.randn(2, 3, 28, 28).astype(np.float32)
+        ours = np.asarray(model(params, jnp.asarray(ids), pixel_values=jnp.asarray(pixels)))
+        with torch.no_grad():
+            theirs = hf_model(
+                input_ids=torch.tensor(ids), pixel_values=torch.tensor(pixels)
+            ).logits.float().numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-3, rtol=1e-3)
+
+    def test_text_only_forward(self, tmp_path):
+        _, d = tiny_llava(tmp_path)
+        model, params = AutoModelForImageTextToText.from_pretrained(
+            d, dtype=jnp.float32, backend=BackendConfig(dtype="float32")
+        )
+        ids = jnp.arange(10).reshape(1, 10) % 100
+        logits = model(params, ids)
+        assert logits.shape == (1, 10, 128)
+
+    def test_adapter_roundtrip(self, tmp_path):
+        _, d = tiny_llava(tmp_path)
+        model, params = AutoModelForImageTextToText.from_pretrained(
+            d, dtype=jnp.float32, backend=BackendConfig(dtype="float32")
+        )
+        adapter = model.state_dict_adapter()
+        tensors = adapter.to_hf(jax.tree.map(np.asarray, params))
+        assert "vision_tower.vision_model.embeddings.patch_embedding.weight" in tensors
+        params2 = adapter.from_hf(tensors, dtype=np.float32)
+        ids = jnp.arange(8).reshape(1, 8) % 100
+        np.testing.assert_allclose(
+            np.asarray(model(params, ids)), np.asarray(model(jax.tree.map(jnp.asarray, params2), ids)),
+            atol=1e-5,
+        )
