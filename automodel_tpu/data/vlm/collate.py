@@ -95,10 +95,11 @@ def vlm_collate(
             np.int32,
         )
         prompt_len = len(pre_ids) + num_image_tokens + len(post_ids)
-        # next-token shift within the sample (collate contract)
-        inp, tgt = ids[:-1], ids[1:].copy()
-        if answer_only_loss:
-            tgt[: max(prompt_len - 1, 0)] = IGNORE_INDEX
+        from automodel_tpu.data.collate import shift_example
+
+        inp, tgt = shift_example(
+            {"input_ids": ids, "prompt_len": prompt_len}, answer_only_loss
+        )
         n = min(len(inp), seq_len)
         if len(pre_ids) + num_image_tokens > seq_len:
             raise ValueError(

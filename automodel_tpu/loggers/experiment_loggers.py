@@ -1,0 +1,86 @@
+"""Gated wandb / MLflow experiment tracking (reference loggers/wandb_utils.py,
+mlflow_utils.py). Both are optional dependencies: absent packages degrade to a
+warning, never an import error — only rank 0 reports.
+
+YAML:
+
+.. code-block:: yaml
+
+    wandb: {project: my-proj, name: run-1, mode: offline}
+    mlflow: {tracking_uri: file:/tmp/mlruns, experiment_name: exp, run_name: r1}
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["WandbLogger", "MLflowLogger", "build_experiment_loggers"]
+
+
+class WandbLogger:
+    def __init__(self, **init_kwargs: Any):
+        self._run = None
+        if jax.process_index() != 0:
+            return
+        try:
+            import wandb
+        except ImportError:
+            logger.warning("wandb section configured but wandb is not installed; skipping")
+            return
+        self._run = wandb.init(**init_kwargs)
+
+    def log(self, step: int, **metrics: Any) -> None:
+        if self._run is not None:
+            self._run.log(metrics, step=step)
+
+    def close(self) -> None:
+        if self._run is not None:
+            self._run.finish()
+            self._run = None
+
+
+class MLflowLogger:
+    def __init__(self, tracking_uri: str | None = None, experiment_name: str | None = None,
+                 run_name: str | None = None, **_ignored: Any):
+        self._mlflow = None
+        if jax.process_index() != 0:
+            return
+        try:
+            import mlflow
+        except ImportError:
+            logger.warning("mlflow section configured but mlflow is not installed; skipping")
+            return
+        if tracking_uri:
+            mlflow.set_tracking_uri(tracking_uri)
+        if experiment_name:
+            mlflow.set_experiment(experiment_name)
+        mlflow.start_run(run_name=run_name)
+        self._mlflow = mlflow
+
+    def log(self, step: int, **metrics: Any) -> None:
+        if self._mlflow is not None:
+            numeric = {k: float(v) for k, v in metrics.items()
+                       if isinstance(v, (int, float)) and k != "step"}
+            self._mlflow.log_metrics(numeric, step=step)
+
+    def close(self) -> None:
+        if self._mlflow is not None:
+            self._mlflow.end_run()
+            self._mlflow = None
+
+
+def build_experiment_loggers(cfg) -> list:
+    """Recipe hook: one tracker per configured section (train_ft.py wandb/mlflow)."""
+    out = []
+    wandb_cfg = cfg.get("wandb")
+    if wandb_cfg is not None:
+        out.append(WandbLogger(**wandb_cfg.to_dict()))
+    mlflow_cfg = cfg.get("mlflow")
+    if mlflow_cfg is not None:
+        out.append(MLflowLogger(**mlflow_cfg.to_dict()))
+    return out

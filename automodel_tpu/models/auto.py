@@ -35,9 +35,11 @@ def load_hf_config(path: str) -> dict:
 class AutoModelForCausalLM:
     """Build a model (+ params) from an HF checkpoint directory or config dict."""
 
+    _default_architecture = "LlamaForCausalLM"
+
     @classmethod
     def from_config(cls, config: dict, backend: BackendConfig | None = None):
-        arch = (config.get("architectures") or ["LlamaForCausalLM"])[0]
+        arch = (config.get("architectures") or [cls._default_architecture])[0]
         model_cls = resolve_model_class(arch)
         return model_cls.from_config(config, backend)
 
@@ -72,14 +74,10 @@ class AutoModelForImageTextToText(AutoModelForCausalLM):
     """VLM factory (reference NeMoAutoModelForImageTextToText, auto_model.py:614).
 
     Same registry/load machinery — VLM architectures (LLaVA, ...) register next to
-    the causal families; the default architecture fallback differs.
+    the causal families; only the default architecture fallback differs.
     """
 
-    @classmethod
-    def from_config(cls, config: dict, backend: BackendConfig | None = None):
-        arch = (config.get("architectures") or ["LlavaForConditionalGeneration"])[0]
-        model_cls = resolve_model_class(arch)
-        return model_cls.from_config(config, backend)
+    _default_architecture = "LlavaForConditionalGeneration"
 
 
 def _np_dtype(dtype):

@@ -132,11 +132,13 @@ class CLIPVisionTower:
         }
 
     # -- forward ------------------------------------------------------------
-    def __call__(self, params, pixel_values: jnp.ndarray, feature_layer: int = -1):
+    def __call__(self, params, pixel_values: jnp.ndarray, feature_layer: int | None = None):
         """pixel_values (B, 3, H, W) -> features (B, 1+P, D).
 
-        ``feature_layer``: -1 = final layer output after post-LN; -2 etc. = that
-        encoder layer's raw output (HF hidden_states[layer] semantics, no post-LN).
+        ``feature_layer`` follows HF ``hidden_states`` indexing: index k (or L+1+k
+        for negative k) = output after k encoder layers, never post-layernormed —
+        LLaVA reads hidden_states[-2]. ``None`` = the full tower's pooled-style
+        output: all layers + post-LN (HF last_hidden_state).
         """
         cfg = self.config
         dtype = self.backend.jnp_dtype
@@ -154,7 +156,14 @@ class CLIPVisionTower:
         h = _ln(h, params["pre_ln_w"], params["pre_ln_b"], eps)
 
         L = cfg.num_hidden_layers
-        stop_at = L if feature_layer == -1 else L + 1 + feature_layer
+        if feature_layer is None:
+            stop_at = L
+        else:
+            stop_at = L + 1 + feature_layer if feature_layer < 0 else feature_layer
+            if not 0 <= stop_at <= L:
+                raise ValueError(
+                    f"vision_feature_layer {feature_layer} out of range for {L}-layer tower"
+                )
 
         def layer_fn(h, lp):
             lp = jax.tree.map(lambda a: a.astype(dtype), lp)
@@ -174,6 +183,6 @@ class CLIPVisionTower:
         for li in range(stop_at):
             lp = jax.tree.map(lambda a: a[li], params["layers"])
             h = layer_fn(h, lp)
-        if feature_layer == -1:
+        if feature_layer is None:
             h = _ln(h, params["post_ln_w"], params["post_ln_b"], eps)
         return h

@@ -155,11 +155,15 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         )
         self._maybe_resume()
 
-        # metrics
+        # metrics: JSONL always on; wandb/mlflow when configured (reference
+        # train_ft.py:694,1024-1034)
         out_dir = cfg.get("output_dir", ".")
         os.makedirs(out_dir, exist_ok=True)
         self.metric_logger = MetricLogger(os.path.join(out_dir, "training.jsonl"))
         self.val_metric_logger = MetricLogger(os.path.join(out_dir, "validation.jsonl"))
+        from automodel_tpu.loggers.experiment_loggers import build_experiment_loggers
+
+        self.experiment_loggers = build_experiment_loggers(cfg)
 
         # the jitted step
         self._train_step = self._build_train_step()
@@ -480,8 +484,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                         extra = compute_load_balance_metrics(
                             np.asarray(metrics["expert_load"]), mode=self.moe_metrics_mode
                         )
-                    self.metric_logger.log(
-                        step,
+                    row = dict(
                         loss=loss,
                         grad_norm=gnorm,
                         lr=float(self.lr_schedule(step)),
@@ -491,6 +494,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                         tps_per_chip=round(step_tokens / dt / jax.device_count(), 1),
                         **extra,
                     )
+                    self.metric_logger.log(step, **row)
+                    for lg in self.experiment_loggers:
+                        lg.log(step, **row)
                     logger.info(
                         "step %d | loss %.4f | gnorm %.3f | %.0f tok/s", step, loss, gnorm, step_tokens / dt
                     )
@@ -508,6 +514,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             self.checkpointer.wait()
         self.metric_logger.close()
         self.val_metric_logger.close()
+        for lg in self.experiment_loggers:
+            lg.close()
 
     def _run_validation(self, step: int):
         if self._eval_step is None:
@@ -535,6 +543,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         if losses:
             val_loss = float(np.mean(losses))
             self.val_metric_logger.log(step, val_loss=val_loss)
+            for lg in self.experiment_loggers:
+                lg.log(step, val_loss=val_loss)
             logger.info("validation @ step %d: loss %.4f", step, val_loss)
 
     def _save(self, step: int):

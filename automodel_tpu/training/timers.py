@@ -1,0 +1,76 @@
+"""Named wall-clock timers (reference training/timers.py:19 Timers).
+
+``sync=True`` blocks on device work before reading the clock — the jax analogue of
+the reference's optional barrier — so timed spans measure compute, not dispatch.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+
+__all__ = ["Timer", "Timers"]
+
+
+class Timer:
+    def __init__(self, name: str, sync: bool = False):
+        self.name = name
+        self.sync = sync
+        self.elapsed_total = 0.0
+        self.count = 0
+        self._start: float | None = None
+
+    def start(self) -> "Timer":
+        if self._start is not None:
+            raise RuntimeError(f"timer {self.name!r} already started")
+        self._start = time.perf_counter()
+        return self
+
+    def stop(self, result: Any = None) -> float:
+        """``result``: optional device value to block on before stopping."""
+        if self._start is None:
+            raise RuntimeError(f"timer {self.name!r} not started")
+        if self.sync and result is not None:
+            jax.block_until_ready(result)
+        dt = time.perf_counter() - self._start
+        self._start = None
+        self.elapsed_total += dt
+        self.count += 1
+        return dt
+
+    def __enter__(self) -> "Timer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def mean(self) -> float:
+        return self.elapsed_total / max(self.count, 1)
+
+    def reset(self) -> None:
+        self.elapsed_total = 0.0
+        self.count = 0
+        self._start = None
+
+
+class Timers:
+    """Registry of named timers: ``with timers("fwd"): ...``; ``timers.summary()``."""
+
+    def __init__(self, sync: bool = False):
+        self.sync = sync
+        self._timers: dict[str, Timer] = {}
+
+    def __call__(self, name: str) -> Timer:
+        if name not in self._timers:
+            self._timers[name] = Timer(name, self.sync)
+        return self._timers[name]
+
+    def summary(self, reset: bool = False) -> dict[str, float]:
+        out = {name: round(t.mean, 6) for name, t in self._timers.items() if t.count}
+        if reset:
+            for t in self._timers.values():
+                t.reset()
+        return out

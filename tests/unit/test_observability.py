@@ -1,0 +1,71 @@
+"""Timers + experiment-logger tests (reference tests for training/timers.py and
+loggers/)."""
+
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from automodel_tpu.loggers.experiment_loggers import (
+    MLflowLogger,
+    WandbLogger,
+    build_experiment_loggers,
+)
+from automodel_tpu.training.timers import Timer, Timers
+
+
+class TestTimers:
+    def test_basic_timing(self):
+        timers = Timers()
+        with timers("work"):
+            time.sleep(0.01)
+        s = timers.summary()
+        assert 0.005 < s["work"] < 1.0
+
+    def test_mean_over_calls(self):
+        timers = Timers()
+        for _ in range(3):
+            with timers("x"):
+                time.sleep(0.002)
+        assert timers("x").count == 3
+        assert timers("x").mean < timers("x").elapsed_total
+
+    def test_sync_blocks_on_result(self):
+        t = Timer("d", sync=True)
+        t.start()
+        out = jnp.ones((256, 256)) @ jnp.ones((256, 256))
+        dt = t.stop(out)
+        assert dt > 0
+
+    def test_double_start_raises(self):
+        t = Timer("x")
+        t.start()
+        with pytest.raises(RuntimeError, match="already started"):
+            t.start()
+
+    def test_summary_reset(self):
+        timers = Timers()
+        with timers("a"):
+            pass
+        timers.summary(reset=True)
+        assert timers.summary() == {}
+
+
+class TestExperimentLoggers:
+    def test_missing_packages_degrade_gracefully(self):
+        # wandb/mlflow are not installed in this image: loggers become no-ops
+        w = WandbLogger(project="x", mode="offline")
+        w.log(1, loss=1.0)
+        w.close()
+        m = MLflowLogger(tracking_uri="file:/tmp/nope")
+        m.log(1, loss=1.0)
+        m.close()
+
+    def test_build_from_config(self):
+        from automodel_tpu.config.loader import ConfigNode
+
+        cfg = ConfigNode({"wandb": {"project": "p", "mode": "offline"}})
+        loggers = build_experiment_loggers(cfg)
+        assert len(loggers) == 1
+        cfg2 = ConfigNode({})
+        assert build_experiment_loggers(cfg2) == []
