@@ -14,7 +14,7 @@ from typing import Any, Mapping, Sequence
 
 import numpy as np
 
-from automodel_tpu.data.collate import IGNORE_INDEX
+from automodel_tpu.data.collate import IGNORE_INDEX, shift_example
 
 __all__ = ["preprocess_images", "vlm_collate", "IMAGE_PLACEHOLDER"]
 
@@ -95,8 +95,6 @@ def vlm_collate(
             np.int32,
         )
         prompt_len = len(pre_ids) + num_image_tokens + len(post_ids)
-        from automodel_tpu.data.collate import shift_example
-
         inp, tgt = shift_example(
             {"input_ids": ids, "prompt_len": prompt_len}, answer_only_loss
         )

@@ -140,8 +140,10 @@ def moe_decoder_logical_axes(
         "final_norm": ("norm",),
     }
     if cfg.first_k_dense_replace > 0:
+        # distinct logical axis: the short dense prefix replicates across pp (it
+        # runs on every pipeline rank) while moe "layers" shard over pp
         axes["dense_layers"] = {
-            name: ("layers",) + (attn_axes | _DENSE_MLP_AXES)[name]
+            name: ("dense_layers",) + (attn_axes | _DENSE_MLP_AXES)[name]
             for name in attn_names + list(_DENSE_MLP_AXES)
         }
     moe_axes = {name: ("layers",) + attn_axes[name] for name in attn_names}
@@ -154,6 +156,79 @@ def moe_decoder_logical_axes(
     if not cfg.tie_word_embeddings:
         axes["lm_head"] = ("embed", "vocab")
     return axes
+
+
+def make_moe_layer_fns(
+    cfg: MoEDecoderConfig,
+    backend: BackendConfig,
+    rules=None,
+    attention_fn=None,
+    training: bool = True,
+    seq_len_hint: int = 0,
+):
+    """State-dict layer bodies shared by moe_decoder_forward and the pp pipeline.
+
+    Returns ``(dense_layer_fn, moe_layer_fn)`` over a carried state
+    ``{"h", "positions", ["segment_ids"], ["token_mask"]}``:
+    ``dense_layer_fn(state, (lp, is_sliding)) -> (state, None)``;
+    ``moe_layer_fn(state, (lp, is_sliding)) -> (state, (aux, load))``.
+
+    ``attention_fn(lp, x, positions, segment_ids, is_sliding, rules) -> attn_out``
+    overrides the default GQA block — the hook MLA-style families plug into (so the
+    scan / aux / dense-prefix machinery here is the single copy).
+    """
+    dtype = backend.jnp_dtype
+    emit_aux = cfg.moe.aux_loss_coeff > 0 and training and not backend.fake_balanced_gate
+
+    if attention_fn is None:
+        inv_freq = rope_frequencies(
+            cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
+            partial_rotary_factor=cfg.partial_rotary_factor,
+        )
+        attn_scale = rope_attention_scaling(cfg.rope_scaling)
+        big_window = jnp.int32(cfg.max_position_embeddings + seq_len_hint)
+        window = jnp.int32(cfg.sliding_window or 0)
+        any_sliding = any(cfg.sliding_flags)
+
+        def attention_fn(lp, x, positions, segment_ids, is_sliding, rules):
+            eff_window = jnp.where(is_sliding > 0, window, big_window) if any_sliding else None
+            return _attention_block(cfg, backend, lp, x, positions, segment_ids,
+                                    inv_freq, attn_scale, eff_window, rules)
+
+    def attn(state, lp, is_sliding):
+        h = state["h"]
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        h = h + attention_fn(lp, x, state["positions"], state.get("segment_ids"),
+                             is_sliding, rules)
+        return _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+
+    def dense_layer_fn(state, layer_inputs):
+        lp, is_sliding = layer_inputs
+        lp = jax.tree.map(lambda a: a.astype(dtype), lp)
+        h = attn(state, lp, is_sliding)
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = h + _mlp_block(backend, lp, x, rules)
+        return dict(state, h=_constrain(h, rules, ("batch", "act_seq", "act_embed"))), None
+
+    def moe_layer_fn(state, layer_inputs):
+        lp, is_sliding = layer_inputs
+        moe_params = lp["moe"]
+        lp = jax.tree.map(lambda a: a.astype(dtype), {k: v for k, v in lp.items() if k != "moe"})
+        h = attn(state, lp, is_sliding)
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        moe_params = cast_moe_compute_params(moe_params, dtype)
+        y, aux, load = moe_forward(
+            cfg.moe, moe_params, x, state.get("token_mask"),
+            training=training,
+            dispatcher="capacity" if backend.experts_backend == "dense" else "ragged",
+            fake_balanced_gate=backend.fake_balanced_gate,
+            fake_gate_noise=backend.fake_gate_noise,
+        )
+        h = h + y
+        h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+        return dict(state, h=h), (aux if emit_aux else jnp.float32(0), load)
+
+    return dense_layer_fn, moe_layer_fn
 
 
 def moe_decoder_forward(
@@ -170,12 +245,7 @@ def moe_decoder_forward(
     attention_fn=None,
 ) -> tuple[jnp.ndarray, dict[str, Any]]:
     """Returns ``(logits_or_hidden, stats)``; stats has ``aux_loss`` (scalar or None)
-    and ``expert_load`` (num_moe_layers, E).
-
-    ``attention_fn(lp, x, positions, segment_ids, is_sliding, rules) -> attn_out``
-    overrides the default GQA block — the hook MLA-style families plug into (so the
-    scan / aux / dense-prefix machinery here is the single copy).
-    """
+    and ``expert_load`` (num_moe_layers, E)."""
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
     dtype = backend.jnp_dtype
@@ -185,71 +255,34 @@ def moe_decoder_forward(
     sliding_flags = jnp.asarray(cfg.sliding_flags, dtype=jnp.int32)
     emit_aux = cfg.moe.aux_loss_coeff > 0 and training and not backend.fake_balanced_gate
 
-    if attention_fn is None:
-        inv_freq = rope_frequencies(
-            cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
-            partial_rotary_factor=cfg.partial_rotary_factor,
-        )
-        attn_scale = rope_attention_scaling(cfg.rope_scaling)
-        big_window = jnp.int32(cfg.max_position_embeddings + input_ids.shape[1])
-        window = jnp.int32(cfg.sliding_window or 0)
-        any_sliding = any(cfg.sliding_flags)
-
-        def attention_fn(lp, x, positions, segment_ids, is_sliding, rules):
-            eff_window = jnp.where(is_sliding > 0, window, big_window) if any_sliding else None
-            return _attention_block(cfg, backend, lp, x, positions, segment_ids,
-                                    inv_freq, attn_scale, eff_window, rules)
-
-    def attn(h, lp, is_sliding):
-        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-        h = h + attention_fn(lp, x, positions, segment_ids, is_sliding, rules)
-        return _constrain(h, rules, ("batch", "act_seq", "act_embed"))
-
-    def dense_layer_fn(h, layer_inputs):
-        lp, is_sliding = layer_inputs
-        lp = jax.tree.map(lambda a: a.astype(dtype), lp)
-        h = attn(h, lp, is_sliding)
-        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp_block(backend, lp, x, rules)
-        return _constrain(h, rules, ("batch", "act_seq", "act_embed")), None
-
-    def moe_layer_fn(h, layer_inputs):
-        lp, is_sliding = layer_inputs
-        moe_params = lp["moe"]
-        lp = jax.tree.map(lambda a: a.astype(dtype), {k: v for k, v in lp.items() if k != "moe"})
-        h = attn(h, lp, is_sliding)
-        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        moe_params = cast_moe_compute_params(moe_params, dtype)
-        y, aux, load = moe_forward(
-            cfg.moe, moe_params, x, token_mask,
-            training=training,
-            dispatcher="capacity" if backend.experts_backend == "dense" else "ragged",
-            fake_balanced_gate=backend.fake_balanced_gate,
-            fake_gate_noise=backend.fake_gate_noise,
-        )
-        h = h + y
-        h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
-        return h, (aux if emit_aux else jnp.float32(0), load)
+    state = {"h": h, "positions": positions}
+    if segment_ids is not None:
+        state["segment_ids"] = segment_ids
+    if token_mask is not None:
+        state["token_mask"] = token_mask
+    dense_layer_fn, moe_layer_fn = make_moe_layer_fns(
+        cfg, backend, rules, attention_fn, training, seq_len_hint=input_ids.shape[1]
+    )
 
     k_dense = cfg.first_k_dense_replace
     if k_dense > 0:
         body = backend.layer_remat(dense_layer_fn)
         if backend.scan_layers:
-            h, _ = jax.lax.scan(body, h, (params["dense_layers"], sliding_flags[:k_dense]))
+            state, _ = jax.lax.scan(body, state, (params["dense_layers"], sliding_flags[:k_dense]))
         else:
             for i in range(k_dense):
                 lp = jax.tree.map(lambda a: a[i], params["dense_layers"])
-                h, _ = body(h, (lp, sliding_flags[i]))
+                state, _ = body(state, (lp, sliding_flags[i]))
 
     moe_sliding = sliding_flags[k_dense:]
     body = backend.layer_remat(moe_layer_fn)
     if backend.scan_layers:
-        h, (auxs, loads) = jax.lax.scan(body, h, (params["moe_layers"], moe_sliding))
+        state, (auxs, loads) = jax.lax.scan(body, state, (params["moe_layers"], moe_sliding))
     else:
         auxs, loads = [], []
         for i in range(cfg.num_moe_layers):
             lp = jax.tree.map(lambda a: a[i], params["moe_layers"])
-            h, (aux, load) = body(h, (lp, moe_sliding[i]))
+            state, (aux, load) = body(state, (lp, moe_sliding[i]))
             auxs.append(aux)
             loads.append(load)
         auxs = jnp.stack(auxs)
@@ -260,7 +293,7 @@ def moe_decoder_forward(
         "expert_load": loads,
     }
 
-    h = rms_norm(h, params["final_norm"].astype(dtype), cfg.rms_norm_eps)
+    h = rms_norm(state["h"], params["final_norm"].astype(dtype), cfg.rms_norm_eps)
     if return_hidden:
         return h, stats
     unembed = params.get("lm_head")

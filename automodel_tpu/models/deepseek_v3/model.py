@@ -238,8 +238,20 @@ def forward(
     training: bool = True,
 ):
     """moe_decoder_forward with the MLA attention hook; returns (out, stats)."""
-    # Reference precompute_freqs_cis applies the YaRN correction only when training
-    # beyond the original context (rope_utils.py:113-117).
+    return moe_decoder_forward(
+        cfg, backend, params, input_ids,
+        positions=positions, segment_ids=segment_ids, token_mask=token_mask,
+        rules=rules, return_hidden=return_hidden, training=training,
+        attention_fn=make_mla_attention_fn(cfg, backend),
+    )
+
+
+def make_mla_attention_fn(cfg: DeepseekV3Config, backend: BackendConfig):
+    """MLA attention hook for moe_decoder_forward / the pp pipeline.
+
+    Reference precompute_freqs_cis applies the YaRN correction only when training
+    beyond the original context (rope_utils.py:113-117).
+    """
     rs = cfg.rope_scaling
     use_yarn = bool(
         rs
@@ -254,12 +266,7 @@ def forward(
         del is_sliding
         return _mla_block(cfg, backend, lp, x, positions, segment_ids, inv_freq, rules)
 
-    return moe_decoder_forward(
-        cfg, backend, params, input_ids,
-        positions=positions, segment_ids=segment_ids, token_mask=token_mask,
-        rules=rules, return_hidden=return_hidden, training=training,
-        attention_fn=mla_attention,
-    )
+    return mla_attention
 
 
 class DeepseekV3ForCausalLM:
@@ -280,6 +287,10 @@ class DeepseekV3ForCausalLM:
 
     def abstract_params(self, dtype=jnp.bfloat16) -> dict:
         return jax.eval_shape(lambda k: self.init(k, dtype), jax.random.key(0))
+
+    def make_attention_fn(self):
+        """Hook the pp pipeline uses to build the MLA block (parallel/pipeline.py)."""
+        return make_mla_attention_fn(self.config, self.backend)
 
     def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
                  rules=None, return_hidden=False, training=True):

@@ -255,6 +255,8 @@ def default_sharding_rules(
     rules: dict[str, str | tuple[str, ...] | None] = {
         # stacked layer dim -> pp: stage slicing is just a sharding (parallel/pipeline.py)
         "layers": MeshAxis.PP,
+        # MoE dense-prefix stack: replicated over pp (runs on every stage rank)
+        "dense_layers": None,
         "batch": MeshAxis.DATA,
         "act_seq": (MeshAxis.CP, MeshAxis.TP) if sequence_parallel else (MeshAxis.CP,),
         "act_attn_seq": MeshAxis.CP,

@@ -28,15 +28,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline_spmd", "make_pipeline_forward"]
+__all__ = ["pipeline_spmd", "make_pipeline_forward", "make_dense_decoder_pp_loss", "make_moe_pp_loss"]
 
 
 def pipeline_spmd(
     stage_params,  # pytree; leaves (L_local, ...) — this rank's layer slice
     x_stack,  # pytree; leaves (n_micro, ...) — stage-0 inputs (already embedded)
-    layer_apply: Callable,  # (stage_params, x) -> y; runs this rank's layers
+    layer_apply: Callable,  # (stage_params, x) -> y  or -> (y, aux) with with_aux
     *,
     axis: str = "pp",
+    with_aux: bool = False,
 ):
     """Run the pipeline; returns an x_stack-like pytree of outputs, valid on the
     LAST stage (other ranks hold garbage — mask with axis_index == pp-1).
@@ -45,6 +46,11 @@ def pipeline_spmd(
     ...}) — side inputs like positions ride along with the activation through the
     ring so each stage sees its microbatch's metadata. Call inside shard_map manual
     over ``axis``.
+
+    ``with_aux``: ``layer_apply`` returns ``(y, aux_tree)``; aux is *summed* over
+    the ticks where this stage held a real microbatch (warmup/drain ticks carry
+    garbage activations and are masked out) — the per-stage accumulation MoE
+    expert-load/aux-loss stats need. Returns ``(outputs, aux_sum)``.
     """
     pp = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
@@ -55,14 +61,21 @@ def pipeline_spmd(
     # stage 0 immediately overwrites with fresh microbatch input.
     perm = [(i, (i + 1) % pp) for i in range(pp)]
 
+    def _apply(x):
+        out = layer_apply(stage_params, x)
+        return out if with_aux else (out, {})
+
     def tick(carry, t):
-        outputs, state = carry
+        outputs, state, aux_acc = carry
         mb = jnp.clip(t, 0, n_micro - 1)
         feed = jax.tree.map(
             lambda a: jax.lax.dynamic_index_in_dim(a, mb, 0, keepdims=False), x_stack
         )
         x = jax.tree.map(lambda f, s: jnp.where(idx == 0, f, s), feed, state)
-        y = layer_apply(stage_params, x)
+        y, aux = _apply(x)
+        # stage idx holds microbatch t-idx at tick t: real iff 0 <= t-idx < n_micro
+        valid = ((t >= idx) & (t - idx < n_micro)).astype(jnp.float32)
+        aux_acc = jax.tree.map(lambda acc, a: acc + a * valid, aux_acc, aux)
         # last stage finishes microbatch t-(pp-1) at tick t; earlier ticks write
         # garbage into slot 0 which the t = pp-1 tick overwrites (writes are in
         # time order, so the final write per slot is the correct one)
@@ -72,7 +85,7 @@ def pipeline_spmd(
             outputs, y,
         )
         state = jax.tree.map(lambda yl: jax.lax.ppermute(yl, axis, perm), y)
-        return (outputs, state), None
+        return (outputs, state, aux_acc), None
 
     # mark the carries pp-varying (the body's ppermute/axis_index make them so)
     def _vary(x):
@@ -80,11 +93,19 @@ def pipeline_spmd(
 
     outputs = jax.tree.map(lambda a: _vary(jnp.zeros_like(a)), x_stack)
     state = jax.tree.map(lambda a: _vary(jnp.zeros_like(a[0])), x_stack)
-    (outputs, _), _ = jax.lax.scan(tick, (outputs, state), jnp.arange(steps))
+    x0 = jax.tree.map(lambda a: a[0], x_stack)
+    # probe with pp-varying inputs: stage params are varying inside the manual
+    # region, so layer_apply's internal scans require varying carries
+    aux_shapes = jax.eval_shape(lambda x: _apply(jax.tree.map(_vary, x))[1], x0)
+    zero_aux = jax.tree.map(lambda s: _vary(jnp.zeros(s.shape, s.dtype)), aux_shapes)
+    (outputs, _, aux_sum), _ = jax.lax.scan(tick, (outputs, state, zero_aux), jnp.arange(steps))
+    if with_aux:
+        return outputs, aux_sum
     return outputs
 
 
-def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp"):
+def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp", with_aux: bool = False,
+                          aux_out_specs=None):
     """Wrap (embed, layer_apply, head_loss) into a pp-pipelined loss function.
 
     Returns ``fn(layer_params, other_params, batch_stack, embed_fn, layer_apply,
@@ -92,6 +113,9 @@ def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp"):
       - ``embed_fn(params, microbatch) -> x`` (stage-0 work, cheap enough to run
         everywhere: replicated compute beats a broadcast)
       - ``layer_apply(stage_layer_params, x) -> y`` scans this rank's layer slice
+        (``-> (y, aux)`` with ``with_aux``: aux sums over valid ticks per stage;
+        ``aux_out_specs`` — a pytree of PartitionSpecs matching aux, typically
+        ``P(pp_axis)`` so per-stage layer stats reassemble in layer order)
       - ``head_loss_fn(params, y, microbatch) -> scalar`` final-norm + head + loss
         (additive across microbatches)
 
@@ -106,8 +130,9 @@ def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp"):
                 lambda mb: embed_fn(other_params, mb), in_axes=0
             )(batch_stack)
             outs = pipeline_spmd(
-                layer_params, x_stack, layer_apply, axis=pp_axis
+                layer_params, x_stack, layer_apply, axis=pp_axis, with_aux=with_aux
             )
+            outs, aux = outs if with_aux else (outs, None)
             is_last = jax.lax.axis_index(pp_axis) == pp - 1
             # sequential over microbatches: only one microbatch's logits live at a
             # time (vmap would materialize n_micro full logits tensors at once,
@@ -116,8 +141,8 @@ def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp"):
                 lambda ymb: head_loss_fn(other_params, ymb[0], ymb[1]),
                 (outs, batch_stack),
             )
-            loss = jnp.where(is_last, losses.sum(), 0.0)
-            return jax.lax.psum(loss, pp_axis)
+            loss = jax.lax.psum(jnp.where(is_last, losses.sum(), 0.0), pp_axis)
+            return (loss, aux) if with_aux else loss
 
         # Replicate non-layer params (embed/head/final-norm) before entering the
         # partial-manual region: a gather whose operand carries tp shardings trips
@@ -131,15 +156,33 @@ def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp"):
         layer_specs = jax.tree.map(lambda _: P(pp_axis), layer_params)
         other_specs = jax.tree.map(lambda _: P(), other_params)
         batch_specs = jax.tree.map(lambda _: P(), batch_stack)
+        out_specs = (P(), aux_out_specs) if with_aux else P()
         return jax.shard_map(
             body,
             mesh=mesh,
             in_specs=(layer_specs, other_specs, batch_specs),
-            out_specs=P(),
+            out_specs=out_specs,
             axis_names={pp_axis},
         )(layer_params, other_params, batch_stack)
 
     return fn
+
+
+def _make_head_loss(cfg, dtype):
+    """Final-norm + unembed + additive masked CE, shared by both pp loss builders."""
+    from automodel_tpu.ops.losses import masked_cross_entropy
+    from automodel_tpu.ops.norms import rms_norm
+
+    def head_loss(other, y, mb):
+        h = rms_norm(y["h"], other["final_norm"].astype(dtype), cfg.rms_norm_eps)
+        unembed = other.get("lm_head")
+        if unembed is None:
+            unembed = other["embed"].T
+        logits = jnp.einsum("bsd,dv->bsv", h, jnp.asarray(unembed).astype(dtype))
+        # additive (sum/num) microbatch losses, same contract as make_train_step
+        return masked_cross_entropy(logits, mb["labels"], 1.0)
+
+    return head_loss
 
 
 def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "masked_ce"):
@@ -152,8 +195,6 @@ def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "
     microbatches in one call (grad accum *is* the pipeline schedule).
     """
     from automodel_tpu.models.common.transformer import apply_layer_stack
-    from automodel_tpu.ops.losses import masked_cross_entropy
-    from automodel_tpu.ops.norms import rms_norm
 
     cfg, backend = model.config, model.backend
     dtype = backend.jnp_dtype
@@ -172,14 +213,7 @@ def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "
         lp, sliding = stage
         return apply_layer_stack(cfg, backend, lp, sliding, x, None)
 
-    def head_loss(other, y, mb):
-        h = rms_norm(y["h"], other["final_norm"].astype(dtype), cfg.rms_norm_eps)
-        unembed = other.get("lm_head")
-        if unembed is None:
-            unembed = other["embed"].T
-        logits = jnp.einsum("bsd,dv->bsv", h, jnp.asarray(unembed).astype(dtype))
-        # additive (sum/num) microbatch losses, same contract as make_train_step
-        return masked_cross_entropy(logits, mb["labels"], 1.0)
+    head_loss = _make_head_loss(cfg, dtype)
 
     if loss_name != "masked_ce":
         raise NotImplementedError(f"pp loss {loss_name!r} (use masked_ce)")
@@ -191,5 +225,74 @@ def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "
         total = pipeline(layer_params, other, batch_stack,
                          embed_fn, layer_apply, head_loss)
         return total / num_label_tokens
+
+    return forward_loss
+
+
+def make_moe_pp_loss(model, mesh: Mesh, *, pp_axis: str = "pp", loss_name: str = "masked_ce",
+                     seq_len_hint: int = 0):
+    """Pipelined forward+loss for MoE decoders: the dense prefix + embedding run
+    replicated on every rank (cheap, avoids a ragged first stage), the MoE layer
+    stack pipelines over ``pp``, and expert-load stats accumulate per stage with
+    warmup/drain ticks masked (reference composes PP with EP/FSDP inside each stage,
+    infrastructure.py:107 -> autopipeline; here the ep/fsdp axes stay GSPMD-managed
+    inside the pp-manual region).
+
+    Returns ``forward_loss(params, batch_stack, num_label_tokens) ->
+    (loss, {"expert_load": (num_moe_layers, E)})`` matching the MoE train-step
+    contract (gate-bias balancing consumes expert_load). ``seq_len_hint``: the
+    training sequence length, needed for the sliding-window disable bound.
+    """
+    from automodel_tpu.models.common.moe_transformer import make_moe_layer_fns
+
+    cfg, backend = model.config, model.backend
+    if cfg.moe.aux_loss_coeff > 0:
+        raise NotImplementedError(
+            "pp + aux-loss balancing is not wired; use gate-bias (loss-free) balancing"
+        )
+    if loss_name != "masked_ce":
+        raise NotImplementedError(f"pp loss {loss_name!r} (use masked_ce)")
+    dtype = backend.jnp_dtype
+    attention_fn = model.make_attention_fn() if hasattr(model, "make_attention_fn") else None
+    dense_layer_fn, moe_layer_fn = make_moe_layer_fns(
+        cfg, backend, rules=None, attention_fn=attention_fn, training=True,
+        seq_len_hint=seq_len_hint,
+    )
+    k_dense = cfg.first_k_dense_replace
+    pipeline = make_pipeline_forward(
+        mesh, pp_axis=pp_axis, with_aux=True, aux_out_specs={"load": P(pp_axis)}
+    )
+
+    def embed_fn(other, mb):
+        h = other["embed"].astype(dtype)[mb["input_ids"]]
+        state = {
+            "h": h,
+            "positions": mb["positions"],
+            "segment_ids": mb["segment_ids"],
+            "token_mask": mb["segment_ids"] != 0,
+        }
+        if k_dense > 0:
+            sliding = jnp.asarray(cfg.sliding_flags[:k_dense], jnp.int32)
+            state, _ = jax.lax.scan(
+                backend.layer_remat(dense_layer_fn), state, (other["dense_layers"], sliding)
+            )
+        return state
+
+    def layer_apply(stage, state):
+        lp_stack, sliding = stage
+        state, (_auxs, loads) = jax.lax.scan(
+            backend.layer_remat(moe_layer_fn), state, (lp_stack, sliding)
+        )
+        return state, {"load": loads}
+
+    head_loss = _make_head_loss(cfg, dtype)
+
+    def forward_loss(params, batch_stack, num_label_tokens):
+        moe_sliding = jnp.asarray(cfg.sliding_flags[k_dense:], jnp.int32)
+        layer_params = (params["moe_layers"], moe_sliding)
+        other = {k: v for k, v in params.items() if k != "moe_layers"}
+        loss, aux = pipeline(layer_params, other, batch_stack,
+                             embed_fn, layer_apply, head_loss)
+        return loss / num_label_tokens, {"expert_load": aux["load"]}
 
     return forward_loss

@@ -357,19 +357,28 @@ class TrainFinetuneRecipeForNextTokenPrediction:
 
     def _build_train_step(self):
         if self.mesh_ctx.pp > 1:
-            from automodel_tpu.parallel.pipeline import make_dense_decoder_pp_loss
+            from automodel_tpu.parallel.pipeline import (
+                make_dense_decoder_pp_loss,
+                make_moe_pp_loss,
+            )
             from automodel_tpu.training.train_step import make_pp_train_step
 
-            if self._moe_config is not None:
-                raise NotImplementedError("pp + MoE composition is not wired yet")
             if self.peft is not None:
                 raise NotImplementedError("peft + pp composition is not wired yet")
             if self.cfg.get("qat") is not None:
                 raise NotImplementedError("qat + pp composition is not wired yet")
-            pp_loss = make_dense_decoder_pp_loss(
-                self.model, self.mesh, self.rules, loss_name=self.loss_name
-            )
-            step = make_pp_train_step(pp_loss, self.optimizer)
+            if self._moe_config is not None:
+                pp_loss = make_moe_pp_loss(
+                    self.model, self.mesh, loss_name=self.loss_name,
+                    seq_len_hint=self.seq_len,
+                )
+                step = make_pp_train_step(pp_loss, self.optimizer,
+                                          post_update=self._post_update())
+            else:
+                pp_loss = make_dense_decoder_pp_loss(
+                    self.model, self.mesh, self.rules, loss_name=self.loss_name
+                )
+                step = make_pp_train_step(pp_loss, self.optimizer)
         elif self.peft is not None:
             from automodel_tpu.peft.lora import merge_lora_params
 

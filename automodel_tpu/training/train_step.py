@@ -104,23 +104,34 @@ def make_train_step(
 def make_pp_train_step(
     forward_loss: Callable[..., jnp.ndarray],
     optimizer: optax.GradientTransformation,
+    post_update: Callable[[dict, dict], dict] | None = None,
 ):
     """Train step for pipeline parallelism: ``forward_loss`` consumes the WHOLE
     (n_micro, ...) batch stack at once — microbatching happens inside the pipeline
     schedule (parallel/pipeline.py), not an outer grad-accum scan (the reference's
     PP path does the same: the schedule owns the microbatch loop,
-    recipes/llm/train_ft.py:1234)."""
+    recipes/llm/train_ft.py:1234). ``forward_loss`` may return ``(loss, aux)``
+    (MoE expert-load stats); ``post_update`` then runs after the optimizer step."""
+
+    def _call(params, batch_stack, num_label_tokens):
+        out = forward_loss(params, batch_stack, num_label_tokens)
+        return out if isinstance(out, tuple) else (out, {})
 
     def train_step(params, opt_state, batch_stack):
         num_label_tokens = count_label_tokens(batch_stack["labels"])
-        loss, grads = jax.value_and_grad(forward_loss)(params, batch_stack, num_label_tokens)
+        (loss, aux), grads = jax.value_and_grad(_call, has_aux=True)(
+            params, batch_stack, num_label_tokens
+        )
         grad_norm = optax.global_norm(grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if post_update is not None:
+            params = post_update(params, aux)
         metrics = {
             "loss": loss,
             "grad_norm": grad_norm,
             "num_label_tokens": num_label_tokens,
+            **aux,
         }
         return params, opt_state, metrics
 
