@@ -28,6 +28,8 @@ RECIPES: dict[tuple[str, str], str] = {
     ("kd", "llm"): "automodel_tpu.recipes.llm.kd:main",
     ("finetune", "seq_cls"): "automodel_tpu.recipes.llm.train_seq_cls:main",
     ("finetune", "vlm"): "automodel_tpu.recipes.vlm.finetune:main",
+    ("finetune", "biencoder"): "automodel_tpu.recipes.biencoder.train_biencoder:main",
+    ("mine", "biencoder"): "automodel_tpu.recipes.biencoder.mine_hard_negatives:main",
 }
 
 

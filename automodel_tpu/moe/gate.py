@@ -22,6 +22,7 @@ __all__ = [
     "route",
     "fake_balanced_route",
     "update_gate_bias",
+    "make_gate_bias_post_update",
 ]
 
 
@@ -176,3 +177,20 @@ def update_gate_bias(
     load = cumulative_expert_load.astype(jnp.float32)
     bias_update = jnp.sign(load.mean() - load)
     return score_correction_bias + bias_update * update_factor
+
+
+def make_gate_bias_post_update(update_factor: float):
+    """Train-step ``post_update`` hook applying :func:`update_gate_bias` per layer
+    from the accumulated ``expert_load`` aux (single copy shared by the recipe and
+    the driver dryrun)."""
+
+    def post_update(params, aux):
+        gate = params["moe_layers"]["moe"]["gate"]
+        new_bias = jax.vmap(update_gate_bias, in_axes=(0, 0, None))(
+            gate["score_correction_bias"], aux["expert_load"], update_factor
+        )
+        gate = dict(gate, score_correction_bias=new_bias)
+        moe_layers = dict(params["moe_layers"], moe=dict(params["moe_layers"]["moe"], gate=gate))
+        return dict(params, moe_layers=moe_layers)
+
+    return post_update

@@ -1,0 +1,79 @@
+"""Hard-negative mining (reference recipes/biencoder/mine_hard_negatives.py).
+
+Embeds the corpus and the queries with a (possibly freshly initialized or trained)
+biencoder tower, then for each query keeps the top-scoring non-positive passages as
+hard negatives, written back as retrieval-jsonl for the training recipe.
+
+YAML: the biencoder training config plus
+
+.. code-block:: yaml
+
+    mine:
+      input: /data/pairs.jsonl        # rows {"query", "pos_doc"}
+      output: /data/mined.jsonl
+      num_negatives: 4
+      margin: 0.95   # skip candidates scoring > margin * positive (likely dupes)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import numpy as np
+
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.config.cli_overrides import parse_args_and_load_config
+from automodel_tpu.data.llm.column_mapped import _load_rows
+from automodel_tpu.data.llm.retrieval import write_retrieval_jsonl
+from automodel_tpu.recipes.biencoder.train_biencoder import TrainBiencoderRecipe
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["mine_hard_negatives", "main"]
+
+
+def mine_hard_negatives(recipe: TrainBiencoderRecipe, rows: list[dict],
+                        num_negatives: int = 4, margin: float = 0.95) -> list[dict]:
+    """rows: {"query", "pos_doc"} -> rows + {"neg_doc": [...]} via dense retrieval."""
+    corpus = sorted({str(r["pos_doc"]) for r in rows})
+    doc_row = {d: i for i, d in enumerate(corpus)}
+    doc_emb = recipe.encode(corpus)  # (N, D) normalized
+    q_emb = recipe.encode([str(r["query"]) for r in rows])
+    scores = q_emb @ doc_emb.T  # (Q, N)
+
+    mined = []
+    for i, r in enumerate(rows):
+        pos_idx = doc_row[str(r["pos_doc"])]
+        s = scores[i].copy()
+        pos_score = s[pos_idx]
+        s[pos_idx] = -np.inf
+        # drop near-duplicates of the positive (reference margin heuristic)
+        s[s > margin * pos_score] = -np.inf
+        top = np.argsort(-s)[:num_negatives]
+        negs = [corpus[j] for j in top if np.isfinite(s[j])]
+        mined.append({**r, "neg_doc": negs})
+    return mined
+
+
+def main(cfg: ConfigNode | None = None, argv=None):
+    if cfg is None:
+        cfg = parse_args_and_load_config(argv)
+    mine_cfg = cfg.get("mine")
+    if mine_cfg is None:
+        raise ValueError("config needs a mine: section (input/output)")
+    recipe = TrainBiencoderRecipe(cfg)
+    recipe.setup()
+    rows = _load_rows(mine_cfg["input"], None)
+    mined = mine_hard_negatives(
+        recipe, rows,
+        num_negatives=int(mine_cfg.get("num_negatives", 4)),
+        margin=float(mine_cfg.get("margin", 0.95)),
+    )
+    write_retrieval_jsonl(mined, mine_cfg["output"])
+    logger.info("mined %d rows -> %s", len(mined), mine_cfg["output"])
+    return mined
+
+
+if __name__ == "__main__":
+    main()

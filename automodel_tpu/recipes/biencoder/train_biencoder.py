@@ -1,0 +1,123 @@
+"""Biencoder (retrieval embedding) training recipe (reference
+recipes/biencoder/train_biencoder.py:137 TrainBiencoderRecipe).
+
+One shared bidirectional tower embeds queries and passages; the loss is contrastive
+CE over ``q @ p.T / temperature`` with each query's positive at a known row
+(reference contrastive_scores_and_labels, train_biencoder.py:50). In-batch
+negatives on by default; L2-normalized embeddings on by default (E5-style).
+
+YAML contract adds:
+
+.. code-block:: yaml
+
+    model:
+      config: {architectures: [LlamaBidirectionalModel], ...}
+    biencoder:
+      temperature: 0.02
+      normalize: true
+      in_batch_negatives: true
+      query_seq_len: 64
+      passage_seq_len: 128
+    dataset:
+      _target_: automodel_tpu.data.llm.retrieval.RetrievalDataset
+      path_or_dataset_id: /data/mined.jsonl
+      num_hard_negatives: 1
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.config.cli_overrides import parse_args_and_load_config
+from automodel_tpu.data.llm.retrieval import retrieval_collate
+from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["TrainBiencoderRecipe", "main"]
+
+
+class TrainBiencoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
+    def _wrap_dataset_and_collate(self, dataset, pad_id: int):
+        bc = self.cfg.get("biencoder") or ConfigNode()
+        q_len = int(bc.get("query_seq_len", self.seq_len))
+        p_len = int(bc.get("passage_seq_len", self.seq_len))
+        return dataset, (
+            lambda exs: retrieval_collate(
+                exs, tokenizer=self.tokenizer,
+                query_seq_len=q_len, passage_seq_len=p_len, pad_token_id=pad_id,
+            )
+        )
+
+    def _forward_loss(self, params, batch, num_label_tokens, training=True):
+        bc = self.cfg.get("biencoder") or ConfigNode()
+        temperature = float(bc.get("temperature", 0.02))
+        normalize = bool(bc.get("normalize", True))
+        in_batch = bool(bc.get("in_batch_negatives", True))
+
+        q = self.model(params, batch["q_ids"], positions=batch["q_pos"],
+                       segment_ids=batch["q_seg"], rules=self.rules)  # (B, D)
+        p = self.model(params, batch["p_ids"], positions=batch["p_pos"],
+                       segment_ids=batch["p_seg"], rules=self.rules)  # (B*G, D)
+        if normalize:
+            q = q / jnp.linalg.norm(q, axis=-1, keepdims=True)
+            p = p / jnp.linalg.norm(p, axis=-1, keepdims=True)
+        # positives derived from the GLOBAL batch shape inside jit: collate-time
+        # labels would be process-local rows, mislabeling every process but 0 on
+        # multi-host runs (batch["labels"] is only used for the query count)
+        b = q.shape[0]
+        group = p.shape[0] // b
+        labels = jnp.arange(b) * group
+        scores = (q @ p.T).astype(jnp.float32) / temperature  # (B, B*G)
+        if not in_batch:
+            # restrict each query to its own passage group (reference
+            # contrastive_scores_and_labels "without in-batch negatives")
+            cols = jnp.arange(b * group)[None, :]
+            own = (cols // group) == jnp.arange(b)[:, None]
+            scores = jnp.where(own, scores, -jnp.inf)
+        logp = jax.nn.log_softmax(scores, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        # num_label_tokens = global query count (labels are all valid)
+        return nll.sum() / jnp.maximum(num_label_tokens, 1).astype(jnp.float32)
+
+    def encode(self, texts: list[str], batch_size: int = 32, seq_len: int | None = None):
+        """Embed texts with the current tower (mine_hard_negatives uses this)."""
+        import numpy as np
+
+        bc = self.cfg.get("biencoder") or ConfigNode()
+        seq_len = seq_len or int(bc.get("passage_seq_len", self.seq_len))
+        normalize = bool(bc.get("normalize", True))
+        out = []
+        for i in range(0, len(texts), batch_size):
+            chunk = texts[i:i + batch_size]
+            ids = np.zeros((len(chunk), seq_len), np.int32)
+            seg = np.zeros((len(chunk), seq_len), np.int32)
+            pos = np.zeros((len(chunk), seq_len), np.int32)
+            for r, t in enumerate(chunk):
+                toks = np.asarray(self.tokenizer.encode(t), np.int32)[:seq_len]
+                ids[r, :len(toks)] = toks
+                seg[r, :len(toks)] = 1
+                pos[r, :len(toks)] = np.arange(len(toks))
+            emb = self.model(self.params, jnp.asarray(ids), positions=jnp.asarray(pos),
+                             segment_ids=jnp.asarray(seg), rules=self.rules)
+            if normalize:
+                emb = emb / jnp.linalg.norm(emb, axis=-1, keepdims=True)
+            out.append(np.asarray(emb))
+        return np.concatenate(out)
+
+
+def main(cfg: ConfigNode | None = None, argv=None):
+    if cfg is None:
+        cfg = parse_args_and_load_config(argv)
+    recipe = TrainBiencoderRecipe(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+    return recipe
+
+
+if __name__ == "__main__":
+    main()

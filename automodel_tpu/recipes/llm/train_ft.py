@@ -342,18 +342,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         moe = self._moe_config
         if moe is None or moe.gate_bias_update_factor <= 0:
             return None
-        from automodel_tpu.moe.gate import update_gate_bias
+        from automodel_tpu.moe.gate import make_gate_bias_post_update
 
-        def post_update(params, aux):
-            gate = params["moe_layers"]["moe"]["gate"]
-            new_bias = jax.vmap(update_gate_bias, in_axes=(0, 0, None))(
-                gate["score_correction_bias"], aux["expert_load"], moe.gate_bias_update_factor
-            )
-            gate = dict(gate, score_correction_bias=new_bias)
-            moe_layers = dict(params["moe_layers"], moe=dict(params["moe_layers"]["moe"], gate=gate))
-            return dict(params, moe_layers=moe_layers)
-
-        return post_update
+        return make_gate_bias_post_update(moe.gate_bias_update_factor)
 
     def _build_train_step(self):
         if self.mesh_ctx.pp > 1:
@@ -456,11 +447,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     # training; fail loudly on the first batch instead
                     vocab = getattr(getattr(self.model.config, "text", self.model.config),
                                     "vocab_size", None)
-                    if vocab is not None and int(stack["input_ids"].max()) >= vocab:
-                        raise ValueError(
-                            f"batch contains token id {int(stack['input_ids'].max())} "
-                            f">= model vocab_size {vocab}: tokenizer/model mismatch"
-                        )
+                    if vocab is not None:
+                        for key in ("input_ids", "q_ids", "p_ids"):
+                            if key in stack and int(stack[key].max()) >= vocab:
+                                raise ValueError(
+                                    f"batch {key} contains token id {int(stack[key].max())} "
+                                    f">= model vocab_size {vocab}: tokenizer/model mismatch"
+                                )
                     checked_vocab = True
                 stack = {
                     k: jax.device_put(
@@ -484,8 +477,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     dt = (now - t_last) / steps_since_log  # per-step time
                     t_last = now
                     steps_since_log = 0
-                    # global tokens per optimizer step (local slice x process count)
-                    step_tokens = int(np.prod(stack["input_ids"].shape)) * jax.process_count()
+                    # global tokens per optimizer step (local slice x process count);
+                    # biencoder batches carry q_ids/p_ids instead of input_ids
+                    step_tokens = sum(
+                        int(np.prod(stack[k].shape))
+                        for k in ("input_ids", "q_ids", "p_ids") if k in stack
+                    ) * jax.process_count()
                     extra = {}
                     if "expert_load" in metrics and self.moe_metrics_mode:
                         from automodel_tpu.moe.metrics import compute_load_balance_metrics
