@@ -34,25 +34,34 @@ __all__ = ["mine_hard_negatives", "main"]
 
 
 def mine_hard_negatives(recipe: TrainBiencoderRecipe, rows: list[dict],
-                        num_negatives: int = 4, margin: float = 0.95) -> list[dict]:
-    """rows: {"query", "pos_doc"} -> rows + {"neg_doc": [...]} via dense retrieval."""
+                        num_negatives: int = 4, margin: float = 0.95,
+                        query_chunk: int = 1024) -> list[dict]:
+    """rows: {"query", "pos_doc"} -> rows + {"neg_doc": [...]} via dense retrieval.
+
+    Queries are processed in chunks so memory stays O(chunk x corpus), never the
+    full (Q, N) matrix. The near-duplicate filter drops candidates scoring above
+    ``margin * pos_score`` — only meaningful for positive scores, so with an
+    untrained tower (cosines can be <= 0) it degrades to "above the positive".
+    """
     corpus = sorted({str(r["pos_doc"]) for r in rows})
     doc_row = {d: i for i, d in enumerate(corpus)}
     doc_emb = recipe.encode(corpus)  # (N, D) normalized
-    q_emb = recipe.encode([str(r["query"]) for r in rows])
-    scores = q_emb @ doc_emb.T  # (Q, N)
 
     mined = []
-    for i, r in enumerate(rows):
-        pos_idx = doc_row[str(r["pos_doc"])]
-        s = scores[i].copy()
-        pos_score = s[pos_idx]
-        s[pos_idx] = -np.inf
-        # drop near-duplicates of the positive (reference margin heuristic)
-        s[s > margin * pos_score] = -np.inf
-        top = np.argsort(-s)[:num_negatives]
-        negs = [corpus[j] for j in top if np.isfinite(s[j])]
-        mined.append({**r, "neg_doc": negs})
+    for lo in range(0, len(rows), query_chunk):
+        chunk = rows[lo:lo + query_chunk]
+        q_emb = recipe.encode([str(r["query"]) for r in chunk])
+        scores = q_emb @ doc_emb.T  # (chunk, N)
+        for i, r in enumerate(chunk):
+            pos_idx = doc_row[str(r["pos_doc"])]
+            s = scores[i].copy()
+            pos_score = s[pos_idx]
+            s[pos_idx] = -np.inf
+            cut = margin * pos_score if pos_score > 0 else pos_score
+            s[s > cut] = -np.inf
+            top = np.argsort(-s)[:num_negatives]
+            negs = [corpus[j] for j in top if np.isfinite(s[j])]
+            mined.append({**r, "neg_doc": negs})
     return mined
 
 
