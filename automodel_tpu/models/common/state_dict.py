@@ -46,6 +46,9 @@ class Entry:
     optional: bool = False
     layer_range: tuple[int, int] | None = None  # [start, stop) HF layer indices
     keep_dtype: bool = False  # exempt from the load-time cast (e.g. fp32 routing bias)
+    # explicit HF layer indices for strided stacking (hybrid models whose layer streams
+    # interleave, e.g. Qwen3-Next linear/full attention); overrides layer_range
+    layer_indices: tuple[int, ...] | None = None
 
     @property
     def hf_keys(self) -> tuple[str, ...]:
@@ -90,7 +93,9 @@ class MappingAdapter:
         self.scan_layers = scan_layers
         self.num_experts = num_experts
 
-    def _layers(self, e: Entry) -> range:
+    def _layers(self, e: Entry):
+        if e.layer_indices is not None:
+            return e.layer_indices
         if e.layer_range is not None:
             return range(*e.layer_range)
         return range(self.num_layers)

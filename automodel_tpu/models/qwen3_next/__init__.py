@@ -1,0 +1,3 @@
+from automodel_tpu.models.qwen3_next.model import Qwen3NextConfig, Qwen3NextForCausalLM
+
+__all__ = ["Qwen3NextConfig", "Qwen3NextForCausalLM"]

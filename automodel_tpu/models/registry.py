@@ -26,6 +26,7 @@ MODEL_REGISTRY: dict[str, str] = {
     "Glm4MoeLiteForCausalLM": "automodel_tpu.models.deepseek_v3.model:DeepseekV3ForCausalLM",
     "Glm4MoeForCausalLM": "automodel_tpu.models.glm4_moe.model:Glm4MoeForCausalLM",
     "MiniMaxM2ForCausalLM": "automodel_tpu.models.minimax_m2.model:MiniMaxM2ForCausalLM",
+    "Qwen3NextForCausalLM": "automodel_tpu.models.qwen3_next.model:Qwen3NextForCausalLM",
     "GPT2LMHeadModel": "automodel_tpu.models.gpt2.model:GPT2LMHeadModel",
     "LlavaForConditionalGeneration": "automodel_tpu.models.llava.model:LlavaForConditionalGeneration",
     "LlamaBidirectionalModel": "automodel_tpu.models.llama_bidirectional.model:LlamaBidirectionalModel",
