@@ -190,8 +190,11 @@ def logical_axes(cfg: DeepseekV3Config) -> dict:
 
 
 def _mla_block(cfg: DeepseekV3Config, backend: BackendConfig, lp: dict, x, positions,
-               segment_ids, inv_freq, rules):
-    """MLA attention (reference layers.py:122-198)."""
+               segment_ids, inv_freq, rules, bias_fn=None):
+    """MLA attention (reference layers.py:122-198). ``bias_fn(lp, x, q_latent,
+    positions, segment_ids) -> (B, S, S) additive logit bias`` is the V3.2 sparse
+    indexer hook (reference deepseek_v32/layers.py:430-500)."""
+    q_latent = None
     if cfg.q_lora_rank is None:
         q = jnp.einsum("bsd,dnh->bsnh", x, lp["wq"])
     else:
@@ -215,11 +218,15 @@ def _mla_block(cfg: DeepseekV3Config, backend: BackendConfig, lp: dict, x, posit
 
     q = _constrain(q, rules, ("batch", "act_attn_seq", "act_heads", None))
     k = _constrain(k, rules, ("batch", "act_attn_seq", "act_heads", None))
+    extra_bias = None
+    if bias_fn is not None:
+        extra_bias = bias_fn(lp, x, q_latent, positions, segment_ids)
     out = dot_product_attention(
         q, k, v,
         causal=True,
         segment_ids_q=segment_ids,
         softmax_scale=cfg.softmax_scale,
+        extra_bias=extra_bias,
         backend=backend.attention,
     )
     return jnp.einsum("bsnh,nhd->bsd", out, lp["wo"])
@@ -246,25 +253,29 @@ def forward(
     )
 
 
-def make_mla_attention_fn(cfg: DeepseekV3Config, backend: BackendConfig):
-    """MLA attention hook for moe_decoder_forward / the pp pipeline.
-
-    Reference precompute_freqs_cis applies the YaRN correction only when training
-    beyond the original context (rope_utils.py:113-117).
-    """
+def mla_inv_freq(cfg: DeepseekV3Config) -> jnp.ndarray:
+    """Rope frequencies for the MLA rope sub-dim; the reference applies the YaRN
+    correction only when training beyond the original context
+    (rope_utils.py:113-117). V3.2's indexer shares these frequencies."""
     rs = cfg.rope_scaling
     use_yarn = bool(
         rs
         and all(k in rs for k in ("factor", "beta_fast", "beta_slow", "original_max_position_embeddings"))
         and cfg.max_position_embeddings > rs["original_max_position_embeddings"]
     )
-    inv_freq = rope_frequencies(
+    return rope_frequencies(
         cfg.qk_rope_head_dim, cfg.rope_theta, dict(rs, rope_type="yarn") if use_yarn else None
     )
 
+
+def make_mla_attention_fn(cfg: DeepseekV3Config, backend: BackendConfig, bias_fn=None):
+    """MLA attention hook for moe_decoder_forward / the pp pipeline."""
+    inv_freq = mla_inv_freq(cfg)
+
     def mla_attention(lp, x, positions, segment_ids, is_sliding, rules):
         del is_sliding
-        return _mla_block(cfg, backend, lp, x, positions, segment_ids, inv_freq, rules)
+        return _mla_block(cfg, backend, lp, x, positions, segment_ids, inv_freq, rules,
+                          bias_fn=bias_fn)
 
     return mla_attention
 

@@ -1,0 +1,3 @@
+from automodel_tpu.models.deepseek_v32.model import DeepseekV32Config, DeepseekV32ForCausalLM
+
+__all__ = ["DeepseekV32Config", "DeepseekV32ForCausalLM"]

@@ -1,0 +1,189 @@
+"""DeepSeek-V3.2 — DSv3 MLA plus the top-k sparse-attention "lightning indexer"
+(reference models/deepseek_v32/model.py:39, layers.py:96-265).
+
+The indexer scores every (query, key) pair with a small multi-head ReLU attention
+over Hadamard-rotated features, keeps each query's top-k keys, and feeds the
+resulting additive mask into standard MLA attention. Training-mode semantics match
+the reference: scores are dense (B, S, S) and sparsity enters as a bias — the win is
+model parity with DSA checkpoints, not FLOPs (the reference's training path builds
+the same dense mask, layers.py:358-425).
+
+TPU-first details: the Hadamard rotation is the O(n log n) butterfly as n=2^m
+reshape/concat steps (XLA fuses it; no torch fallback loop), and the top-k mask is a
+>=k-th-score threshold comparison instead of a scatter — same selection, no gather.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.deepseek_v3.model import (
+    DeepseekV3Config,
+    DeepseekV3ForCausalLM,
+    _mla_shapes,
+    _MLA_AXES,
+    make_mla_attention_fn,
+    mla_inv_freq,
+)
+from automodel_tpu.models.common.moe_transformer import (
+    init_moe_decoder_params,
+    moe_decoder_forward,
+    moe_decoder_logical_axes,
+)
+from automodel_tpu.ops.norms import layer_norm
+from automodel_tpu.ops.rope import apply_rope_interleaved
+
+__all__ = ["DeepseekV32Config", "DeepseekV32ForCausalLM", "hadamard_transform"]
+
+
+def hadamard_transform(x: jnp.ndarray, scale: float) -> jnp.ndarray:
+    """H_n @ x over the last dim (n must be a power of 2), scaled.
+
+    Butterfly form of the reference's rotate_activation (deepseek_v32/layers.py:35-57):
+    log2(n) add/sub rounds, each a reshape + concat XLA fuses into one kernel.
+    """
+    n = x.shape[-1]
+    m = n.bit_length() - 1
+    if 1 << m != n:
+        raise ValueError(f"hadamard_transform needs a power-of-2 dim, got {n}")
+    shape = x.shape
+    y = x[..., None]  # (..., n, 1)
+    for _ in range(m):
+        even, odd = y[..., 0::2, :], y[..., 1::2, :]
+        y = jnp.concatenate([even + odd, even - odd], axis=-1)
+    return (y.reshape(shape) * scale).astype(x.dtype)
+
+
+@dataclasses.dataclass
+class DeepseekV32Config(DeepseekV3Config):
+    index_n_heads: int = 64
+    index_head_dim: int = 128
+    index_topk: int = 2048
+
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "DeepseekV32Config":
+        base = DeepseekV3Config.from_hf(hf)
+        if base.q_lora_rank is None:
+            raise ValueError("DeepSeek-V3.2 requires q_lora_rank (indexer reads the q latent)")
+        return cls(
+            **dataclasses.asdict(base) | {"moe": base.moe},
+            index_n_heads=hf.get("index_n_heads", 64),
+            index_head_dim=hf.get("index_head_dim", 128),
+            index_topk=hf.get("index_topk", 2048),
+        )
+
+
+def _indexer_shapes(cfg: DeepseekV32Config) -> dict[str, tuple[int, ...]]:
+    d, hi, di = cfg.hidden_size, cfg.index_n_heads, cfg.index_head_dim
+    return {
+        "idx_wq_b": (cfg.q_lora_rank, hi, di),
+        "idx_wk": (d, di),
+        # official indexer normalizes k with LayerNorm, not RMSNorm. Shared init
+        # rules: *norm -> ones (scale), b* -> zeros (bias)
+        "idx_k_norm": (di,),
+        "b_idx_k": (di,),
+        "idx_weights": (d, hi),
+    }
+
+
+_INDEXER_AXES = {
+    "idx_wq_b": (None, "heads", "head_dim"),
+    "idx_wk": ("embed", None),
+    "idx_k_norm": ("norm",),
+    "b_idx_k": ("norm",),
+    "idx_weights": ("embed", "heads"),
+}
+
+
+def make_indexer_bias_fn(cfg: DeepseekV32Config):
+    """Sparse top-k additive bias (reference DeepseekV32Indexer.forward,
+    layers.py:150-265 + _build_sparse_mask :358-425).
+
+    Causal / segment masking applies to the scores *before* top-k so selection never
+    wastes slots on disallowed positions; the attention's own mask still applies.
+    """
+    nope = cfg.index_head_dim - cfg.qk_rope_head_dim
+    inv_freq = mla_inv_freq(cfg)  # indexer shares MLA's (possibly YaRN) frequencies
+    scale = cfg.index_n_heads**-0.5 * cfg.index_head_dim**-0.5
+
+    def bias_fn(lp, x, q_latent, positions, segment_ids):
+        B, S, _ = x.shape
+        q = jnp.einsum("bsr,rhk->bshk", q_latent, lp["idx_wq_b"])  # (B,S,Hi,di)
+        k = layer_norm(jnp.einsum("bsd,dk->bsk", x, lp["idx_wk"]), lp["idx_k_norm"], lp["b_idx_k"])
+
+        q_nope, q_pe = jnp.split(q, [nope], axis=-1)
+        k_nope, k_pe = jnp.split(k[:, :, None, :], [nope], axis=-1)
+        q_pe = apply_rope_interleaved(q_pe, positions, inv_freq)
+        k_pe = apply_rope_interleaved(k_pe, positions, inv_freq)
+        q = jnp.concatenate([q_nope, q_pe], axis=-1)
+        k = jnp.concatenate([k_nope, k_pe], axis=-1)[:, :, 0]
+
+        q = hadamard_transform(q, cfg.index_head_dim**-0.5)
+        k = hadamard_transform(k, cfg.index_head_dim**-0.5)
+
+        weights = jnp.einsum("bsd,dh->bsh", x, lp["idx_weights"]).astype(jnp.float32) * scale
+        scores = jax.nn.relu(
+            jnp.einsum("bqhd,btd->bhqt", q.astype(jnp.float32), k.astype(jnp.float32))
+        )  # (B,Hi,S,S)
+        scores = jnp.einsum("bhqt,bqh->bqt", scores, weights)  # (B,S,S)
+
+        # mask disallowed positions before selecting top-k
+        neg = jnp.finfo(jnp.float32).min
+        allowed = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        allowed = jnp.broadcast_to(allowed[None], (B, S, S))
+        if segment_ids is not None:
+            allowed = allowed & (segment_ids[:, :, None] == segment_ids[:, None, :])
+        scores = jnp.where(allowed, scores, neg)
+
+        k_sel = min(cfg.index_topk, S)
+        kth = jax.lax.top_k(scores, k_sel)[0][..., -1:]
+        return jnp.where(scores >= kth, 0.0, neg)
+
+    return bias_fn
+
+
+class DeepseekV32ForCausalLM(DeepseekV3ForCausalLM):
+    """DSv3 with the sparse indexer threaded into every MLA block."""
+
+    config_class = DeepseekV32Config
+    hf_architectures = ("DeepseekV32ForCausalLM",)
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> dict:
+        shapes = _mla_shapes(self.config) | _indexer_shapes(self.config)
+        return init_moe_decoder_params(self.config, key, dtype, attn_shapes=shapes)
+
+    def logical_axes(self) -> dict:
+        shapes = _mla_shapes(self.config) | _indexer_shapes(self.config)
+        return moe_decoder_logical_axes(
+            self.config, attn_axes=_MLA_AXES | _INDEXER_AXES, attn_names=list(shapes)
+        )
+
+    def make_attention_fn(self):
+        return make_mla_attention_fn(
+            self.config, self.backend, bias_fn=make_indexer_bias_fn(self.config)
+        )
+
+    def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
+                 rules=None, return_hidden=False, training=True):
+        return moe_decoder_forward(
+            self.config, self.backend, params, input_ids,
+            positions=positions, segment_ids=segment_ids, token_mask=token_mask,
+            rules=rules, return_hidden=return_hidden, training=training,
+            attention_fn=self.make_attention_fn(),
+        )
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.deepseek_v32.state_dict_adapter import DeepseekV32StateDictAdapter
+
+        return DeepseekV32StateDictAdapter(self.config)
+
+    @classmethod
+    def from_config(cls, config, backend: BackendConfig | None = None):
+        if isinstance(config, dict):
+            config = DeepseekV32Config.from_hf(config)
+        return cls(config, backend)

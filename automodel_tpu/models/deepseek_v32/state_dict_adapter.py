@@ -1,0 +1,35 @@
+"""DeepSeek-V3.2 HF mapping = DSv3's plus per-layer indexer tensors
+(reference models/deepseek_v32/state_dict_adapter.py; indexer keys live under
+``model.layers.{i}.self_attn.indexer.*``)."""
+
+from __future__ import annotations
+
+from automodel_tpu.models.common.state_dict import Entry
+from automodel_tpu.models.deepseek_v3.state_dict_adapter import DeepseekV3StateDictAdapter
+from automodel_tpu.models.llama.state_dict_adapter import _proj_in, _proj_out, _t
+
+__all__ = ["DeepseekV32StateDictAdapter"]
+
+
+def _indexer_entries(cfg, ours_prefix: str, layer_range) -> list[Entry]:
+    pre = "model.layers.{i}.self_attn.indexer"
+    hi = cfg.index_n_heads
+    return [
+        Entry(f"{pre}.wq_b.weight", f"{ours_prefix}.idx_wq_b",
+              _proj_in(hi, cfg.index_head_dim), _proj_out(hi, cfg.index_head_dim),
+              layer_range=layer_range),
+        Entry(f"{pre}.wk.weight", f"{ours_prefix}.idx_wk", _t, _t, layer_range=layer_range),
+        Entry(f"{pre}.k_norm.weight", f"{ours_prefix}.idx_k_norm", layer_range=layer_range),
+        Entry(f"{pre}.k_norm.bias", f"{ours_prefix}.b_idx_k", layer_range=layer_range),
+        Entry(f"{pre}.weights_proj.weight", f"{ours_prefix}.idx_weights", _t, _t,
+              layer_range=layer_range),
+    ]
+
+
+class DeepseekV32StateDictAdapter(DeepseekV3StateDictAdapter):
+    def __init__(self, cfg, scan_layers: bool = True):
+        super().__init__(cfg, scan_layers)
+        kd = cfg.first_k_dense_replace
+        self.entries += _indexer_entries(cfg, "moe_layers", (kd, cfg.num_hidden_layers))
+        if kd > 0:
+            self.entries += _indexer_entries(cfg, "dense_layers", (0, kd))

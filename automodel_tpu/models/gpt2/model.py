@@ -14,6 +14,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from automodel_tpu.ops.norms import layer_norm
+
 from automodel_tpu.models.common.backend import BackendConfig
 from automodel_tpu.ops.attention import dot_product_attention
 
@@ -45,13 +47,6 @@ class GPT2Config:
     @property
     def head_dim(self) -> int:
         return self.n_embd // self.n_head
-
-
-def _layer_norm(x, w, b, eps):
-    xf = x.astype(jnp.float32)
-    mu = xf.mean(-1, keepdims=True)
-    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
-    return ((xf - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
 
 
 class GPT2LMHeadModel:
@@ -132,7 +127,7 @@ class GPT2LMHeadModel:
 
         def layer_fn(h, lp):
             lp = jax.tree.map(lambda a: a.astype(dtype), lp)
-            x = _layer_norm(h, lp["ln1_w"], lp["ln1_b"], eps)
+            x = layer_norm(h, lp["ln1_w"], lp["ln1_b"], eps)
             qkv = x @ lp["c_attn"] + lp["c_attn_b"]
             q, k, v = jnp.split(qkv, 3, axis=-1)
             b, s, d = q.shape
@@ -142,7 +137,7 @@ class GPT2LMHeadModel:
                 causal=True, segment_ids_q=segment_ids, backend=backend.attention,
             )
             h = h + (out.reshape(b, s, d) @ lp["c_proj"] + lp["c_proj_b"])
-            x = _layer_norm(h, lp["ln2_w"], lp["ln2_b"], eps)
+            x = layer_norm(h, lp["ln2_w"], lp["ln2_b"], eps)
             act = jax.nn.gelu(x @ lp["c_fc"] + lp["c_fc_b"], approximate=True)
             h = h + (act @ lp["c_proj2"] + lp["c_proj2_b"])
             return h, None
@@ -154,7 +149,7 @@ class GPT2LMHeadModel:
             for i in range(cfg.n_layer):
                 lp = jax.tree.map(lambda a: a[i], params["layers"])
                 h, _ = body(h, lp)
-        h = _layer_norm(h, params["lnf_w"].astype(dtype), params["lnf_b"].astype(dtype), eps)
+        h = layer_norm(h, params["lnf_w"].astype(dtype), params["lnf_b"].astype(dtype), eps)
         if return_hidden:
             return h
         return jnp.einsum("bsd,vd->bsv", h, params["wte"].astype(dtype))

@@ -20,6 +20,7 @@ MODEL_REGISTRY: dict[str, str] = {
     "GptOssForCausalLM": "automodel_tpu.models.gpt_oss.model:GptOssForCausalLM",
     "DeepseekV3ForCausalLM": "automodel_tpu.models.deepseek_v3.model:DeepseekV3ForCausalLM",
     "DeepseekV2ForCausalLM": "automodel_tpu.models.deepseek_v3.model:DeepseekV3ForCausalLM",
+    "DeepseekV32ForCausalLM": "automodel_tpu.models.deepseek_v32.model:DeepseekV32ForCausalLM",
     # Kimi-K2 ships DeepseekV3 architecture in its config.json (reference kimi support)
     "KimiK2ForCausalLM": "automodel_tpu.models.deepseek_v3.model:DeepseekV3ForCausalLM",
     # GLM4-MoE-Lite is MLA attention + GLM gating — same param/weight surface as DSv3

@@ -16,6 +16,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from automodel_tpu.ops.norms import layer_norm
+
 from automodel_tpu.models.common.backend import BackendConfig
 from automodel_tpu.ops.attention import dot_product_attention
 
@@ -67,13 +69,6 @@ def _act(name: str, x):
     if name in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
         return jax.nn.gelu(x, approximate=name != "gelu")
     raise ValueError(f"unknown activation {name!r}")
-
-
-def _ln(x, w, b, eps):
-    xf = x.astype(jnp.float32)
-    mu = xf.mean(-1, keepdims=True)
-    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
-    return ((xf - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
 
 
 class CLIPVisionTower:
@@ -153,7 +148,7 @@ class CLIPVisionTower:
         patches = patches.reshape(b, -1, cfg.hidden_size)
         cls_tok = jnp.broadcast_to(params["class_embed"].astype(dtype), (b, 1, cfg.hidden_size))
         h = jnp.concatenate([cls_tok, patches], axis=1) + params["pos_embed"].astype(dtype)
-        h = _ln(h, params["pre_ln_w"], params["pre_ln_b"], eps)
+        h = layer_norm(h, params["pre_ln_w"], params["pre_ln_b"], eps)
 
         L = cfg.num_hidden_layers
         if feature_layer is None:
@@ -167,14 +162,14 @@ class CLIPVisionTower:
 
         def layer_fn(h, lp):
             lp = jax.tree.map(lambda a: a.astype(dtype), lp)
-            x = _ln(h, lp["ln1_w"], lp["ln1_b"], eps)
+            x = layer_norm(h, lp["ln1_w"], lp["ln1_b"], eps)
             shape = (b, x.shape[1], cfg.num_attention_heads, cfg.head_dim)
             q = (x @ lp["wq"] + lp["bq"]).reshape(shape)
             k = (x @ lp["wk"] + lp["bk"]).reshape(shape)
             v = (x @ lp["wv"] + lp["bv"]).reshape(shape)
             out = dot_product_attention(q, k, v, causal=False, backend=self.backend.attention)
             h = h + (out.reshape(b, x.shape[1], -1) @ lp["wo"] + lp["bo"])
-            x = _ln(h, lp["ln2_w"], lp["ln2_b"], eps)
+            x = layer_norm(h, lp["ln2_w"], lp["ln2_b"], eps)
             h = h + (_act(cfg.hidden_act, x @ lp["fc1"] + lp["fc1_b"]) @ lp["fc2"] + lp["fc2_b"])
             return h
 
@@ -184,5 +179,5 @@ class CLIPVisionTower:
             lp = jax.tree.map(lambda a: a[li], params["layers"])
             h = layer_fn(h, lp)
         if feature_layer is None:
-            h = _ln(h, params["post_ln_w"], params["post_ln_b"], eps)
+            h = layer_norm(h, params["post_ln_w"], params["post_ln_b"], eps)
         return h

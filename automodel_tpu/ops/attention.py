@@ -82,11 +82,13 @@ def dot_product_attention(
     softmax_scale: float | None = None,
     logit_soft_cap: float | None = None,
     sinks: jnp.ndarray | None = None,  # (n_heads,) attention sink logits (gpt-oss)
+    extra_bias: jnp.ndarray | None = None,  # (b, sq, skv) additive logit bias (DSv3.2 sparse mask)
     backend: Backend = "xla",
 ) -> jnp.ndarray:
     """Multi-head attention with GQA, packing segments, sliding window, soft-cap, sinks."""
     if (
         backend == "flash"
+        and extra_bias is None
         and jax.default_backend() == "tpu"
         and logit_soft_cap is None
         and sinks is None
@@ -133,6 +135,8 @@ def dot_product_attention(
     )
     if bias is not None:
         logits = logits + bias[:, :, None]  # broadcast over the GQA group dim
+    if extra_bias is not None:
+        logits = logits + extra_bias[:, None, None].astype(jnp.float32)
     if sinks is not None:
         # gpt-oss attention sinks: an extra per-head logit column that absorbs mass.
         sink = jnp.broadcast_to(sinks.reshape(1, nkv, groups, 1, 1), (b, nkv, groups, sq, 1)).astype(jnp.float32)
