@@ -65,6 +65,10 @@ class DenseDecoderConfig:
     layer_types: list[str] | None = None  # "full_attention" | "sliding_attention"
     initializer_range: float = 0.02
     causal: bool = True  # False: bidirectional encoder (llama_bidirectional)
+    # Ministral-3 llama-4-style long-context q scaling: q *= 1 + beta*log(1 + pos//orig)
+    # (reference mistral3/model.py:282-284)
+    llama4_attn_scale_beta: float | None = None
+    original_max_position_embeddings: int | None = None
 
     def __post_init__(self):
         if self.head_dim is None:
@@ -199,6 +203,12 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     q = apply_rope(q, positions, inv_freq, attn_scale)
     k = apply_rope(k, positions, inv_freq, attn_scale)
+    if cfg.llama4_attn_scale_beta is not None:
+        orig = cfg.original_max_position_embeddings or cfg.max_position_embeddings
+        scale = 1.0 + cfg.llama4_attn_scale_beta * jnp.log1p(
+            jnp.floor(positions.astype(jnp.float32) / orig)
+        )
+        q = q * scale[..., None, None].astype(q.dtype)
     q = _constrain(q, rules, ("batch", "act_attn_seq", "act_heads", None))
     k = _constrain(k, rules, ("batch", "act_attn_seq", "act_heads", None))
     out = dot_product_attention(

@@ -65,11 +65,14 @@ class DeepseekV32Config(DeepseekV3Config):
     index_head_dim: int = 128
     index_topk: int = 2048
 
+    def __post_init__(self):
+        super().__post_init__()
+        if self.q_lora_rank is None:
+            raise ValueError("DeepSeek-V3.2 requires q_lora_rank (indexer reads the q latent)")
+
     @classmethod
     def from_hf(cls, hf: dict[str, Any]) -> "DeepseekV32Config":
         base = DeepseekV3Config.from_hf(hf)
-        if base.q_lora_rank is None:
-            raise ValueError("DeepSeek-V3.2 requires q_lora_rank (indexer reads the q latent)")
         return cls(
             **dataclasses.asdict(base) | {"moe": base.moe},
             index_n_heads=hf.get("index_n_heads", 64),

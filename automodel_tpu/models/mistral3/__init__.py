@@ -1,0 +1,3 @@
+from automodel_tpu.models.mistral3.model import Ministral3Config, Ministral3ForCausalLM
+
+__all__ = ["Ministral3Config", "Ministral3ForCausalLM"]

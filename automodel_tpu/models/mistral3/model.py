@@ -1,0 +1,50 @@
+"""Ministral-3 — TPU-native (reference models/mistral3/model.py:507).
+
+A Llama-lineage GQA decoder whose distinctives all live in config translation:
+``rope_parameters`` carries YaRN scaling (mscale/mscale_all_dim/truncate,
+reference model.py:58-81) plus the llama-4-style long-context query scaling
+``llama_4_scaling_beta`` (q *= 1 + beta*log(1 + pos//original_max), model.py:282-284).
+The compute path is the shared dense decoder; weights use standard Llama keys
+(the reference registers its class over HF's AutoModelForCausalLM, model.py:610).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from automodel_tpu.models.llama.model import LlamaConfig, LlamaForCausalLM
+
+__all__ = ["Ministral3Config", "Ministral3ForCausalLM"]
+
+
+@dataclasses.dataclass
+class Ministral3Config(LlamaConfig):
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "Ministral3Config":
+        rope = hf.get("rope_parameters") or {}
+        base = LlamaConfig.from_hf(hf)
+        kwargs = dataclasses.asdict(base)
+        kwargs["rope_theta"] = rope.get("rope_theta", kwargs["rope_theta"])
+        rope_type = rope.get("rope_type") or rope.get("type", "default")
+        if rope_type != "default":
+            # rope_parameters doubles as the scaling dict (yarn for Ministral-3)
+            kwargs["rope_scaling"] = {"rope_type": rope_type, **rope}
+        beta = rope.get("llama_4_scaling_beta")
+        if beta is not None:
+            kwargs["llama4_attn_scale_beta"] = float(beta)
+            kwargs["original_max_position_embeddings"] = rope.get(
+                "original_max_position_embeddings", kwargs["max_position_embeddings"]
+            )
+        return cls(**kwargs)
+
+
+class Ministral3ForCausalLM(LlamaForCausalLM):
+    config_class = Ministral3Config
+    hf_architectures = ("Ministral3ForCausalLM",)
+
+    @classmethod
+    def from_config(cls, config, backend=None):
+        if isinstance(config, dict):
+            config = Ministral3Config.from_hf(config)
+        return cls(config, backend)

@@ -16,6 +16,7 @@ MODEL_REGISTRY: dict[str, str] = {
     "Qwen2ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
     "Qwen3ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
     "MistralForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
+    "Ministral3ForCausalLM": "automodel_tpu.models.mistral3.model:Ministral3ForCausalLM",
     "Qwen3MoeForCausalLM": "automodel_tpu.models.qwen3_moe.model:Qwen3MoeForCausalLM",
     "GptOssForCausalLM": "automodel_tpu.models.gpt_oss.model:GptOssForCausalLM",
     "DeepseekV3ForCausalLM": "automodel_tpu.models.deepseek_v3.model:DeepseekV3ForCausalLM",

@@ -57,8 +57,11 @@ def rope_frequencies(
         def find_dim(num_rot: float) -> float:
             return (rotary_dim * math.log(orig_len / (num_rot * 2 * math.pi))) / (2 * math.log(theta))
 
-        low = max(math.floor(find_dim(beta_fast)), 0)
-        high = min(math.ceil(find_dim(beta_slow)), rotary_dim - 1)
+        low, high = find_dim(beta_fast), find_dim(beta_slow)
+        if rope_scaling.get("truncate", True):
+            low, high = math.floor(low), math.ceil(high)
+        low = max(low, 0)
+        high = min(high, rotary_dim - 1)
         ramp = jnp.clip((jnp.arange(rotary_dim // 2, dtype=jnp.float32) - low) / max(high - low, 1e-3), 0, 1)
         mask = 1.0 - ramp
         return inv_freq / factor * (1 - mask) + inv_freq * mask
@@ -72,10 +75,20 @@ def rope_attention_scaling(rope_scaling: dict[str, Any] | None) -> float:
     rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
     if rope_type == "yarn":
         factor = float(rope_scaling["factor"])
-        mscale = rope_scaling.get("attention_factor")
-        if mscale is not None:
-            return float(mscale)
-        return 0.1 * math.log(factor) + 1.0 if factor > 1 else 1.0
+        attention_factor = rope_scaling.get("attention_factor")
+        if attention_factor is not None:
+            return float(attention_factor)
+
+        def get_mscale(scale: float, mscale: float = 1.0) -> float:
+            return 0.1 * mscale * math.log(scale) + 1.0 if scale > 1 else 1.0
+
+        # transformers _compute_yarn_parameters: truthiness, not key presence —
+        # mscale_all_dim=0 falls through to the default
+        mscale = rope_scaling.get("mscale")
+        mscale_all_dim = rope_scaling.get("mscale_all_dim")
+        if mscale and mscale_all_dim:
+            return get_mscale(factor, float(mscale)) / get_mscale(factor, float(mscale_all_dim))
+        return get_mscale(factor)
     return 1.0
 
 

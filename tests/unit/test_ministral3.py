@@ -1,0 +1,97 @@
+"""Ministral-3: yarn mscale-pair attention factor, llama-4 long-context q scaling.
+(No HF implementation in this transformers version; reference mistral3/model.py is
+the spec, so checks are semantic self-consistency against the plain Llama path.)"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.llama.model import LlamaForCausalLM
+from automodel_tpu.models.mistral3.model import Ministral3Config, Ministral3ForCausalLM
+from automodel_tpu.ops.rope import rope_attention_scaling
+
+
+def _hf_cfg(**kw):
+    base = dict(
+        architectures=["Ministral3ForCausalLM"], vocab_size=128, hidden_size=64,
+        intermediate_size=96, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, max_position_embeddings=32,
+        rope_parameters=dict(
+            rope_type="yarn", rope_theta=1e6, factor=16.0, beta_fast=32.0, beta_slow=1.0,
+            mscale=1.0, mscale_all_dim=1.0, original_max_position_embeddings=8,
+            llama_4_scaling_beta=0.1, truncate=True,
+        ),
+    )
+    base.update(kw)
+    return base
+
+
+def _fp32_backend():
+    return BackendConfig(dtype="float32", remat_policy="full")
+
+
+class TestYarnAttentionFactor:
+    def test_mscale_pair_cancels(self):
+        # transformers _compute_yarn_parameters: mscale == mscale_all_dim -> factor 1.0
+        rs = dict(rope_type="yarn", factor=16.0, mscale=1.0, mscale_all_dim=1.0)
+        assert rope_attention_scaling(rs) == 1.0
+
+    def test_mscale_default_when_absent(self):
+        rs = dict(rope_type="yarn", factor=16.0)
+        expected = 0.1 * np.log(16.0) + 1.0
+        assert abs(rope_attention_scaling(rs) - expected) < 1e-9
+
+    def test_explicit_attention_factor_wins(self):
+        rs = dict(rope_type="yarn", factor=16.0, attention_factor=1.25, mscale=2.0, mscale_all_dim=1.0)
+        assert rope_attention_scaling(rs) == 1.25
+
+
+class TestMinistral3:
+    def test_config_mapping(self):
+        cfg = Ministral3Config.from_hf(_hf_cfg())
+        assert cfg.rope_theta == 1e6
+        assert cfg.rope_scaling["rope_type"] == "yarn"
+        assert cfg.llama4_attn_scale_beta == 0.1
+        assert cfg.original_max_position_embeddings == 8
+
+    def test_llama4_scale_only_affects_long_positions(self):
+        """Positions < original_max have floor(pos/orig)=0 -> scale 1, so logits there
+        must match a model with the scaling disabled; later positions must differ."""
+        cfg = Ministral3Config.from_hf(_hf_cfg())
+        model = Ministral3ForCausalLM(cfg, _fp32_backend())
+        params = model.init(jax.random.key(0), jnp.float32)
+
+        import dataclasses
+        cfg_off = dataclasses.replace(cfg, llama4_attn_scale_beta=None)
+        model_off = Ministral3ForCausalLM(cfg_off, _fp32_backend())
+
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (1, 16)))
+        on = np.asarray(model(params, ids))
+        off = np.asarray(model_off(params, ids))
+        np.testing.assert_allclose(on[0, :8], off[0, :8], atol=1e-5)
+        assert np.abs(on[0, 8:] - off[0, 8:]).max() > 1e-5
+
+    def test_matches_llama_without_rope_params(self):
+        hf = _hf_cfg()
+        hf.pop("rope_parameters")
+        cfg = Ministral3Config.from_hf(hf)
+        model = Ministral3ForCausalLM(cfg, _fp32_backend())
+        params = model.init(jax.random.key(1), jnp.float32)
+        llama = LlamaForCausalLM(cfg, _fp32_backend())
+        ids = jnp.asarray(np.random.RandomState(1).randint(0, 128, (2, 12)))
+        np.testing.assert_allclose(
+            np.asarray(model(params, ids)), np.asarray(llama(params, ids)), atol=1e-6
+        )
+
+    def test_adapter_roundtrip(self):
+        cfg = Ministral3Config.from_hf(_hf_cfg())
+        model = Ministral3ForCausalLM(cfg, _fp32_backend())
+        params = model.init(jax.random.key(2), jnp.float32)
+        adapter = model.state_dict_adapter()
+        hf = adapter.to_hf(params)
+        assert "model.layers.0.self_attn.q_proj.weight" in hf
+        back = adapter.from_hf(hf)
+        for k in ("embed", "final_norm"):
+            np.testing.assert_allclose(np.asarray(params[k]), np.asarray(back[k]), atol=1e-6)
