@@ -1,0 +1,6 @@
+from automodel_tpu.models.qwen3_vl_moe.model import (
+    Qwen3VLMoeConfig,
+    Qwen3VLMoeForConditionalGeneration,
+)
+
+__all__ = ["Qwen3VLMoeConfig", "Qwen3VLMoeForConditionalGeneration"]

@@ -1,0 +1,99 @@
+"""Qwen3-VL-MoE HF mapping (reference models/qwen3_vl_moe/state_dict_adapter.py).
+
+Text keys live under ``model.language_model.*`` with experts already packed
+(gate_up_proj (E, D, 2I) / down_proj (E, I, D) — exactly our layout, no per-expert
+split). Vision keys under ``model.visual.*``; the Conv3D patch embed flattens to a
+matmul weight because kernel == stride.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.llama.state_dict_adapter import _o_in, _o_out, _proj_in, _proj_out, _t
+
+__all__ = ["Qwen3VLMoeStateDictAdapter"]
+
+
+def _conv3d_in(w: np.ndarray) -> np.ndarray:
+    # (D, C, tp, P, P) -> (C*tp*P*P, D); processor flattens pixels in the same order
+    return np.ascontiguousarray(w.reshape(w.shape[0], -1).T)
+
+
+def _conv3d_out_factory(cfg_v):
+    def f(w: np.ndarray) -> np.ndarray:
+        return np.ascontiguousarray(w.T).reshape(
+            -1, cfg_v.in_channels, cfg_v.temporal_patch_size, cfg_v.patch_size, cfg_v.patch_size
+        )
+
+    return f
+
+
+class Qwen3VLMoeStateDictAdapter(MappingAdapter):
+    def __init__(self, cfg):
+        t, v = cfg.text, cfg.vision
+        n, kvh, hd = t.num_attention_heads, t.num_key_value_heads, t.head_dim
+        lm = "model.language_model.layers.{i}"
+        vb = "model.visual.blocks.{i}"
+
+        entries = [
+            Entry("model.language_model.embed_tokens.weight", "embed"),
+            Entry("model.language_model.norm.weight", "final_norm"),
+            # text decoder (all layers MoE)
+            Entry(f"{lm}.input_layernorm.weight", "moe_layers.attn_norm"),
+            Entry(f"{lm}.post_attention_layernorm.weight", "moe_layers.mlp_norm"),
+            Entry(f"{lm}.self_attn.q_proj.weight", "moe_layers.wq", _proj_in(n, hd), _proj_out(n, hd)),
+            Entry(f"{lm}.self_attn.k_proj.weight", "moe_layers.wk", _proj_in(kvh, hd), _proj_out(kvh, hd)),
+            Entry(f"{lm}.self_attn.v_proj.weight", "moe_layers.wv", _proj_in(kvh, hd), _proj_out(kvh, hd)),
+            Entry(f"{lm}.self_attn.o_proj.weight", "moe_layers.wo", _o_in(n, hd), _o_out(n, hd)),
+            Entry(f"{lm}.self_attn.q_norm.weight", "moe_layers.q_norm"),
+            Entry(f"{lm}.self_attn.k_norm.weight", "moe_layers.k_norm"),
+            Entry(f"{lm}.mlp.gate.weight", "moe_layers.moe.gate.weight"),
+            # packed expert tensors map 1:1 (HF chunks gate|up exactly like ours)
+            Entry(f"{lm}.mlp.experts.gate_up_proj", "moe_layers.moe.experts.gate_up_proj"),
+            Entry(f"{lm}.mlp.experts.down_proj", "moe_layers.moe.experts.down_proj"),
+            # vision tower
+            Entry("model.visual.patch_embed.proj.weight", "visual.patch_w",
+                  _conv3d_in, _conv3d_out_factory(v)),
+            Entry("model.visual.patch_embed.proj.bias", "visual.b_patch"),
+            Entry("model.visual.pos_embed.weight", "visual.pos_embed"),
+        ]
+        vis_range = (0, v.depth)
+        entries += [
+            Entry(f"{vb}.norm1.weight", "visual.blocks.ln1_w", layer_range=vis_range),
+            Entry(f"{vb}.norm1.bias", "visual.blocks.b_ln1", layer_range=vis_range),
+            Entry(f"{vb}.norm2.weight", "visual.blocks.ln2_w", layer_range=vis_range),
+            Entry(f"{vb}.norm2.bias", "visual.blocks.b_ln2", layer_range=vis_range),
+            Entry(f"{vb}.attn.qkv.weight", "visual.blocks.qkv_w", _t, _t, layer_range=vis_range),
+            Entry(f"{vb}.attn.qkv.bias", "visual.blocks.b_qkv", layer_range=vis_range),
+            Entry(f"{vb}.attn.proj.weight", "visual.blocks.proj_w", _t, _t, layer_range=vis_range),
+            Entry(f"{vb}.attn.proj.bias", "visual.blocks.b_proj", layer_range=vis_range),
+            Entry(f"{vb}.mlp.linear_fc1.weight", "visual.blocks.fc1_w", _t, _t, layer_range=vis_range),
+            Entry(f"{vb}.mlp.linear_fc1.bias", "visual.blocks.b_fc1", layer_range=vis_range),
+            Entry(f"{vb}.mlp.linear_fc2.weight", "visual.blocks.fc2_w", _t, _t, layer_range=vis_range),
+            Entry(f"{vb}.mlp.linear_fc2.bias", "visual.blocks.b_fc2", layer_range=vis_range),
+        ]
+        for part, ours in (("merger", "visual.merger"),):
+            entries += [
+                Entry(f"model.visual.{part}.norm.weight", f"{ours}.norm_w"),
+                Entry(f"model.visual.{part}.norm.bias", f"{ours}.b_norm"),
+                Entry(f"model.visual.{part}.linear_fc1.weight", f"{ours}.fc1_w", _t, _t),
+                Entry(f"model.visual.{part}.linear_fc1.bias", f"{ours}.b_fc1"),
+                Entry(f"model.visual.{part}.linear_fc2.weight", f"{ours}.fc2_w", _t, _t),
+                Entry(f"model.visual.{part}.linear_fc2.bias", f"{ours}.b_fc2"),
+            ]
+        n_ds = len(v.deepstack_visual_indexes)
+        dsm = "model.visual.deepstack_merger_list.{i}"
+        ds_range = (0, n_ds)
+        entries += [
+            Entry(f"{dsm}.norm.weight", "visual.ds_mergers.norm_w", layer_range=ds_range),
+            Entry(f"{dsm}.norm.bias", "visual.ds_mergers.b_norm", layer_range=ds_range),
+            Entry(f"{dsm}.linear_fc1.weight", "visual.ds_mergers.fc1_w", _t, _t, layer_range=ds_range),
+            Entry(f"{dsm}.linear_fc1.bias", "visual.ds_mergers.b_fc1", layer_range=ds_range),
+            Entry(f"{dsm}.linear_fc2.weight", "visual.ds_mergers.fc2_w", _t, _t, layer_range=ds_range),
+            Entry(f"{dsm}.linear_fc2.bias", "visual.ds_mergers.b_fc2", layer_range=ds_range),
+        ]
+        if not t.tie_word_embeddings:
+            entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
+        super().__init__(entries, t.num_hidden_layers, num_experts=t.moe.n_routed_experts)

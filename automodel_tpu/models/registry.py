@@ -31,6 +31,7 @@ MODEL_REGISTRY: dict[str, str] = {
     "Qwen3NextForCausalLM": "automodel_tpu.models.qwen3_next.model:Qwen3NextForCausalLM",
     "GPT2LMHeadModel": "automodel_tpu.models.gpt2.model:GPT2LMHeadModel",
     "LlavaForConditionalGeneration": "automodel_tpu.models.llava.model:LlavaForConditionalGeneration",
+    "Qwen3VLMoeForConditionalGeneration": "automodel_tpu.models.qwen3_vl_moe.model:Qwen3VLMoeForConditionalGeneration",
     "LlamaBidirectionalModel": "automodel_tpu.models.llama_bidirectional.model:LlamaBidirectionalModel",
 }
 

@@ -13,7 +13,14 @@ from typing import Any
 
 import jax.numpy as jnp
 
-__all__ = ["rope_frequencies", "apply_rope", "apply_rope_interleaved"]
+__all__ = [
+    "apply_rope",
+    "apply_rope_angles",
+    "apply_rope_interleaved",
+    "mrope_angles",
+    "rope_frequencies",
+    "rope_attention_scaling",
+]
 
 
 def rope_frequencies(
@@ -111,6 +118,44 @@ def apply_rope_interleaved(
     return out.reshape(x.shape).astype(dtype)
 
 
+def mrope_angles(
+    positions3: jnp.ndarray,  # (3, B, S) t/h/w position ids
+    inv_freq: jnp.ndarray,  # (rot/2,)
+    mrope_section: "tuple[int, int, int]",
+) -> jnp.ndarray:
+    """Interleaved multimodal rope angles (Qwen3-VL): per-axis angles merged as
+    [T H W T H W ... T T] along the frequency dim — H overwrites slots 1,4,7,...,
+    W slots 2,5,8,... up to 3*section (transformers Qwen3VLMoeTextRotaryEmbedding
+    .apply_interleaved_mrope). Returns (B, S, rot/2)."""
+    freqs = positions3[..., None].astype(jnp.float32) * inv_freq  # (3, B, S, rot/2)
+    merged = freqs[0]
+    for axis, offset in ((1, 1), (2, 2)):
+        sl = slice(offset, int(mrope_section[axis]) * 3, 3)
+        merged = merged.at[..., sl].set(freqs[axis][..., sl])
+    return merged
+
+
+def apply_rope_angles(
+    x: jnp.ndarray,  # (batch, seq, heads, head_dim)
+    angles: jnp.ndarray,  # (batch, seq, rot/2) precomputed position*inv_freq
+    attention_scaling: float = 1.0,
+) -> jnp.ndarray:
+    """rotate_half rope with precomputed angles (mrope / vision 2D rope paths)."""
+    dtype = x.dtype
+    cos = jnp.cos(angles) * attention_scaling
+    sin = jnp.sin(angles) * attention_scaling
+    cos = jnp.concatenate([cos, cos], axis=-1)[:, :, None, :]
+    sin = jnp.concatenate([sin, sin], axis=-1)[:, :, None, :]
+    rot = cos.shape[-1]
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    x1, x2 = jnp.split(x_rot.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    out = x_rot.astype(jnp.float32) * cos + rotated * sin
+    if x_pass.shape[-1]:
+        return jnp.concatenate([out.astype(dtype), x_pass], axis=-1)
+    return out.astype(dtype)
+
+
 def apply_rope(
     x: jnp.ndarray,
     positions: jnp.ndarray,
@@ -122,17 +167,5 @@ def apply_rope(
     rotate_half convention: out = x*cos + [-x2, x1]*sin with the half split at
     head_dim//2, matching transformers' apply_rotary_pos_emb.
     """
-    dtype = x.dtype
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # (b, s, rot/2)
-    cos = jnp.cos(angles) * attention_scaling
-    sin = jnp.sin(angles) * attention_scaling
-    cos = jnp.concatenate([cos, cos], axis=-1)[:, :, None, :]  # (b, s, 1, rot)
-    sin = jnp.concatenate([sin, sin], axis=-1)[:, :, None, :]
-    rot = cos.shape[-1]
-    x_rot, x_pass = x[..., :rot], x[..., rot:]
-    x1, x2 = jnp.split(x_rot.astype(jnp.float32), 2, axis=-1)
-    rotated = jnp.concatenate([-x2, x1], axis=-1)
-    out = x_rot.astype(jnp.float32) * cos + rotated * sin
-    if x_pass.shape[-1]:
-        return jnp.concatenate([out.astype(dtype), x_pass], axis=-1)
-    return out.astype(dtype)
+    return apply_rope_angles(x, angles, attention_scaling)
