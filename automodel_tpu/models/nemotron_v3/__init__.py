@@ -1,0 +1,3 @@
+from automodel_tpu.models.nemotron_v3.model import NemotronHForCausalLM, NemotronV3Config
+
+__all__ = ["NemotronHForCausalLM", "NemotronV3Config"]

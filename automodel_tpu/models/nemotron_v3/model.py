@@ -1,0 +1,448 @@
+"""NemotronV3 / Nemotron-H — TPU-native hybrid Mamba2 + Attention + MLP + MoE
+(reference models/nemotron_v3/model.py:36, layers.py:155 Mamba2 mixer,
+layers.py:458 single-mixer pre-norm blocks).
+
+Each layer is ONE mixer (norm -> mixer -> residual), the type given per layer by
+``layers_block_type`` ("mamba" | "attention" | "mlp" | "moe"). Attention is GQA
+*without* rope (NemotronH convention); MLP/experts use ReLU²; MoE routes with
+DSv3-style sigmoid scores, group-limited top-k, a shared ReLU² expert and a forced
+score-correction-bias buffer.
+
+TPU-first structure: params live in four stacked per-type streams; the forward
+run-length-encodes the layer pattern and ``lax.scan``s each maximal same-type run,
+so compile time scales with the number of type switches, not depth. Mamba2 uses the
+chunked SSD scan in ops/mamba2.py; packed sequences reset conv taps and recurrence
+at document boundaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.common.transformer import _constrain
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.layers import cast_moe_compute_params, init_moe_params, moe_forward, moe_logical_axes
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.gated_delta import causal_conv1d
+from automodel_tpu.ops.mamba2 import group_rms_norm_gated, mamba_chunk_scan, softplus_dt
+from automodel_tpu.ops.norms import rms_norm
+
+__all__ = ["NemotronV3Config", "NemotronHForCausalLM"]
+
+BLOCK_TYPES = ("mamba", "attention", "mlp", "moe")
+
+
+@dataclasses.dataclass
+class NemotronV3Config:
+    vocab_size: int = 1024
+    hidden_size: int = 256
+    intermediate_size: int = 512
+    num_hidden_layers: int = 4
+    layers_block_type: tuple[str, ...] = ("mamba", "attention", "mlp", "moe")
+    layer_norm_epsilon: float = 1e-5
+    # attention (no rope)
+    num_attention_heads: int = 4
+    num_key_value_heads: int = 2
+    head_dim: int = 64
+    attention_bias: bool = False
+    # mamba2
+    mamba_num_heads: int = 8
+    mamba_head_dim: int = 32
+    ssm_state_size: int = 64
+    n_groups: int = 2
+    chunk_size: int = 128
+    conv_kernel: int = 4
+    use_conv_bias: bool = True
+    use_bias: bool = False  # in_proj/out_proj bias
+    time_step_limit: tuple[float, float] = (0.0, float("inf"))
+    # mlp
+    mlp_bias: bool = False
+    residual_in_fp32: bool = False
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+    moe: MoEConfig | None = None
+
+    def __post_init__(self):
+        bad = set(self.layers_block_type) - set(BLOCK_TYPES)
+        if bad:
+            raise ValueError(f"unknown layers_block_type entries {bad}")
+        if "moe" in self.layers_block_type and self.moe is None:
+            raise ValueError("moe layers present but no MoEConfig")
+
+    @property
+    def mamba_intermediate(self) -> int:
+        return self.mamba_num_heads * self.mamba_head_dim
+
+    @property
+    def conv_dim(self) -> int:
+        return self.mamba_intermediate + 2 * self.n_groups * self.ssm_state_size
+
+    def type_indices(self, t: str) -> tuple[int, ...]:
+        return tuple(i for i, bt in enumerate(self.layers_block_type) if bt == t)
+
+    @property
+    def runs(self) -> tuple[tuple[str, int], ...]:
+        """Maximal same-type runs in execution order."""
+        return tuple(
+            (t, len(list(g))) for t, g in itertools.groupby(self.layers_block_type)
+        )
+
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "NemotronV3Config":
+        moe = None
+        layer_types = tuple(hf["layers_block_type"])
+        if "moe" in layer_types:
+            moe = MoEConfig(
+                n_routed_experts=hf["n_routed_experts"],
+                n_activated_experts=hf["num_experts_per_tok"],
+                dim=hf["hidden_size"],
+                moe_inter_dim=hf["moe_intermediate_size"],
+                n_shared_experts=1,
+                n_expert_groups=max(hf.get("n_group") or 1, 1),
+                n_limited_groups=max(hf.get("topk_group") or 1, 1),
+                score_func="sigmoid",
+                route_scale=hf.get("routed_scaling_factor", 1.0),
+                norm_topk_prob=hf.get("norm_topk_prob", True),
+                expert_bias=hf.get("mlp_bias", False),
+                expert_activation="relu2",
+                shared_expert_inter_dim=hf.get("moe_shared_expert_intermediate_size"),
+                shared_expert_activation="relu2",
+                force_score_correction_bias=True,
+            )
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_hidden_layers=hf["num_hidden_layers"],
+            layers_block_type=layer_types,
+            layer_norm_epsilon=hf.get("layer_norm_epsilon", hf.get("rms_norm_eps", 1e-5)),
+            num_attention_heads=hf["num_attention_heads"],
+            num_key_value_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get("head_dim", hf["hidden_size"] // hf["num_attention_heads"]),
+            attention_bias=hf.get("attention_bias", False),
+            mamba_num_heads=hf["mamba_num_heads"],
+            mamba_head_dim=hf["mamba_head_dim"],
+            ssm_state_size=hf["ssm_state_size"],
+            n_groups=hf["n_groups"],
+            chunk_size=hf.get("chunk_size", 128),
+            conv_kernel=hf.get("conv_kernel", 4),
+            use_conv_bias=hf.get("use_conv_bias", True),
+            use_bias=hf.get("use_bias", False),
+            time_step_limit=tuple(hf.get("time_step_limit", (0.0, float("inf")))),
+            mlp_bias=hf.get("mlp_bias", False),
+            residual_in_fp32=hf.get("residual_in_fp32", False),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            initializer_range=hf.get("initializer_range", 0.02),
+            moe=moe,
+        )
+
+
+def _stream_shapes(cfg: NemotronV3Config, t: str) -> dict[str, tuple[int, ...]]:
+    d = cfg.hidden_size
+    shapes: dict[str, tuple[int, ...]] = {"norm": (d,)}
+    if t == "mamba":
+        inter, hm = cfg.mamba_intermediate, cfg.mamba_num_heads
+        proj = inter + cfg.conv_dim + hm
+        shapes |= {
+            "in_proj": (d, proj),
+            "conv_w": (cfg.conv_dim, cfg.conv_kernel),
+            "dt_bias": (hm,),
+            "a_log": (hm,),
+            "d_skip": (hm,),
+            "gated_norm": (inter,),
+            "out_proj": (inter, d),
+        }
+        if cfg.use_conv_bias:
+            shapes["b_conv"] = (cfg.conv_dim,)
+        if cfg.use_bias:
+            shapes["b_in"] = (proj,)
+            shapes["b_out"] = (d,)
+    elif t == "attention":
+        h, kv, dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        shapes |= {"wq": (d, h, dh), "wk": (d, kv, dh), "wv": (d, kv, dh), "wo": (h, dh, d)}
+        if cfg.attention_bias:
+            shapes |= {"bq": (h, dh), "bk": (kv, dh), "bv": (kv, dh), "bo": (d,)}
+    elif t == "mlp":
+        shapes |= {"w_up": (d, cfg.intermediate_size), "w_down": (cfg.intermediate_size, d)}
+        if cfg.mlp_bias:
+            shapes |= {"b_up": (cfg.intermediate_size,), "b_down": (d,)}
+    return shapes  # moe: just the norm; expert params come from init_moe_params
+
+
+_STREAM_AXES = {
+    "norm": ("norm",),
+    "in_proj": ("embed", "mlp"),
+    "conv_w": (None, None),
+    "b_conv": ("mlp",),
+    "b_in": ("mlp",),
+    "dt_bias": ("heads",),
+    "a_log": ("heads",),
+    "d_skip": ("heads",),
+    "gated_norm": ("norm",),
+    "out_proj": ("mlp", "embed"),
+    "b_out": ("norm",),
+    "wq": ("embed", "heads", "head_dim"),
+    "wk": ("embed", "kv_heads", "head_dim"),
+    "wv": ("embed", "kv_heads", "head_dim"),
+    "wo": ("heads", "head_dim", "embed"),
+    "bq": ("heads", "head_dim"),
+    "bk": ("kv_heads", "head_dim"),
+    "bv": ("kv_heads", "head_dim"),
+    "bo": ("norm",),
+    "w_up": ("embed", "mlp"),
+    "b_up": ("mlp",),
+    "w_down": ("mlp", "embed"),
+    "b_down": ("norm",),
+}
+
+_STREAM_KEY = {"mamba": "mamba_layers", "attention": "attn_layers", "mlp": "mlp_layers", "moe": "moe_layers"}
+
+
+class NemotronHForCausalLM:
+    """Functional model: holds config + backend, operates on param pytrees."""
+
+    config_class = NemotronV3Config
+    hf_architectures = ("NemotronHForCausalLM", "NemotronV3ForCausalLM")
+
+    def __init__(self, config: NemotronV3Config, backend: BackendConfig | None = None):
+        self.config = config
+        self.backend = backend or BackendConfig()
+
+    # ---- params ----
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> dict:
+        cfg = self.config
+        std = cfg.initializer_range
+        keys = iter(jax.random.split(key, 8))
+        params: dict = {
+            "embed": (jax.random.normal(next(keys), (cfg.vocab_size, cfg.hidden_size), jnp.float32) * std).astype(dtype),
+            "final_norm": jnp.ones((cfg.hidden_size,), dtype),
+        }
+
+        def init_stack(t: str, L: int, key) -> dict:
+            shapes = _stream_shapes(cfg, t)
+            ks = jax.random.split(key, len(shapes))
+            out = {}
+            for idx, (name, shape) in enumerate(shapes.items()):
+                if name in ("norm", "gated_norm"):
+                    out[name] = jnp.ones((L, *shape), dtype)
+                elif name == "dt_bias" or name == "d_skip":
+                    out[name] = jnp.ones((L, *shape), dtype)
+                elif name == "a_log":
+                    # A = arange(1..H) (reference layers.py:208): log stays fp32
+                    a = jnp.log(jnp.arange(1, shape[0] + 1, dtype=jnp.float32))
+                    out[name] = jnp.broadcast_to(a, (L, *shape)).copy()
+                elif name.startswith("b"):
+                    out[name] = jnp.zeros((L, *shape), dtype)
+                else:
+                    out[name] = (jax.random.normal(ks[idx], (L, *shape), jnp.float32) * std).astype(dtype)
+            return out
+
+        for t in BLOCK_TYPES:
+            idx = cfg.type_indices(t)
+            if not idx:
+                continue
+            stack = init_stack(t, len(idx), next(keys))
+            if t == "moe":
+                stack["moe"] = jax.vmap(lambda k: init_moe_params(cfg.moe, k, dtype, std))(
+                    jax.random.split(next(keys), len(idx))
+                )
+            params[_STREAM_KEY[t]] = stack
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = (
+                jax.random.normal(next(keys), (cfg.hidden_size, cfg.vocab_size), jnp.float32) * std
+            ).astype(dtype)
+        return params
+
+    def abstract_params(self, dtype=jnp.bfloat16) -> dict:
+        return jax.eval_shape(lambda k: self.init(k, dtype), jax.random.key(0))
+
+    def logical_axes(self) -> dict:
+        cfg = self.config
+        axes: dict = {"embed": ("vocab", "embed"), "final_norm": ("norm",)}
+        for t in BLOCK_TYPES:
+            idx = cfg.type_indices(t)
+            if not idx:
+                continue
+            stream = {name: ("layers",) + _STREAM_AXES[name] for name in _stream_shapes(cfg, t)}
+            if t == "moe":
+                stream["moe"] = jax.tree.map(
+                    lambda tp: ("layers",) + tp,
+                    moe_logical_axes(cfg.moe),
+                    is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(e, (str, type(None))) for e in x),
+                )
+            axes[_STREAM_KEY[t]] = stream
+        if not cfg.tie_word_embeddings:
+            axes["lm_head"] = ("embed", "vocab")
+        return axes
+
+    # ---- forward ----
+
+    def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
+                 rules=None, return_hidden=False, training=True):
+        cfg, backend = self.config, self.backend
+        dtype = backend.jnp_dtype
+        B, S = input_ids.shape
+        eps = cfg.layer_norm_epsilon
+
+        reset_mask = None
+        if segment_ids is not None:
+            reset_mask = jnp.concatenate(
+                [jnp.zeros((B, 1), bool), segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1
+            )
+
+        def mamba_block(lp, h):
+            x = rms_norm(h, lp["norm"], eps).astype(dtype)
+            if token_mask is not None:
+                x = x * token_mask[..., None].astype(x.dtype)
+            inter, hm = cfg.mamba_intermediate, cfg.mamba_num_heads
+            gns = cfg.n_groups * cfg.ssm_state_size
+            proj = jnp.einsum("bsd,dp->bsp", x, lp["in_proj"])
+            if "b_in" in lp:
+                proj = proj + lp["b_in"]
+            gate, xbc, dt_raw = jnp.split(proj, [inter, inter + cfg.conv_dim], axis=-1)
+            xbc = causal_conv1d(
+                xbc, lp["conv_w"], segment_ids=segment_ids, bias=lp.get("b_conv")
+            )
+            xi, Bm, Cm = jnp.split(xbc, [inter, inter + gns], axis=-1)
+            dt = softplus_dt(dt_raw, lp["dt_bias"], cfg.time_step_limit)
+            A = -jnp.exp(lp["a_log"].astype(jnp.float32))
+            y, _ = mamba_chunk_scan(
+                xi.reshape(B, S, hm, cfg.mamba_head_dim), dt, A,
+                Bm.reshape(B, S, cfg.n_groups, cfg.ssm_state_size),
+                Cm.reshape(B, S, cfg.n_groups, cfg.ssm_state_size),
+                lp["d_skip"], chunk_size=cfg.chunk_size, reset_mask=reset_mask,
+            )
+            y = group_rms_norm_gated(
+                y.reshape(B, S, inter), lp["gated_norm"], gate,
+                group_size=inter // cfg.n_groups, eps=eps,
+            )
+            out = jnp.einsum("bsi,id->bsd", y, lp["out_proj"])
+            if "b_out" in lp:
+                out = out + lp["b_out"]
+            return h + out, _zero_stats()
+
+        def attn_block(lp, h):
+            x = rms_norm(h, lp["norm"], eps).astype(dtype)
+            q = jnp.einsum("bsd,dnh->bsnh", x, lp["wq"])
+            k = jnp.einsum("bsd,dnh->bsnh", x, lp["wk"])
+            v = jnp.einsum("bsd,dnh->bsnh", x, lp["wv"])
+            if cfg.attention_bias:
+                q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+            out = dot_product_attention(
+                q, k, v, causal=True, segment_ids_q=segment_ids, backend=backend.attention,
+            )
+            o = jnp.einsum("bsnh,nhd->bsd", out, lp["wo"])
+            if cfg.attention_bias:
+                o = o + lp["bo"]
+            return h + o, _zero_stats()
+
+        def mlp_block(lp, h):
+            x = rms_norm(h, lp["norm"], eps).astype(dtype)
+            up = jnp.einsum("bsd,di->bsi", x, lp["w_up"])
+            if "b_up" in lp:
+                up = up + lp["b_up"]
+            act = jnp.square(jax.nn.relu(up))
+            out = jnp.einsum("bsi,id->bsd", act, lp["w_down"])
+            if "b_down" in lp:
+                out = out + lp["b_down"]
+            return h + out, _zero_stats()
+
+        def moe_block(lp, h):
+            x = rms_norm(h, lp["norm"], eps).astype(dtype)
+            moe_params = cast_moe_compute_params(lp["moe"], dtype)
+            y, aux, load = moe_forward(
+                cfg.moe, moe_params, x, token_mask,
+                training=training,
+                dispatcher="capacity" if backend.experts_backend == "dense" else "ragged",
+                fake_balanced_gate=backend.fake_balanced_gate,
+                fake_gate_noise=backend.fake_gate_noise,
+            )
+            return h + y, (jnp.float32(0) if aux is None else aux, load)
+
+        def _zero_stats():
+            E = cfg.moe.n_routed_experts if cfg.moe else 1
+            return jnp.float32(0), jnp.zeros((E,), jnp.float32)
+
+        block_fns = {"mamba": mamba_block, "attention": attn_block, "mlp": mlp_block, "moe": moe_block}
+
+        h = params["embed"].astype(dtype)[input_ids]
+        if cfg.residual_in_fp32:
+            # reference keeps the residual stream fp32 (layers.py:555-557);
+            # mixer outputs promote on add, norms read fp32 and cast back
+            h = h.astype(jnp.float32)
+        h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+
+        offsets = dict.fromkeys(BLOCK_TYPES, 0)
+        auxs, loads, load_is_moe = [], [], []
+        for t, n in cfg.runs:
+            stream = params[_STREAM_KEY[t]]
+            o = offsets[t]
+            run_params = jax.tree.map(lambda a: a[o : o + n], stream)
+            offsets[t] = o + n
+            fn = block_fns[t]
+
+            def body(hh, lp):
+                # compute-dtype cast; decay logs stay fp32, moe casts in moe_block
+                lp = {
+                    k: v if k in ("moe", "a_log") else jax.tree.map(lambda a: a.astype(dtype), v)
+                    for k, v in lp.items()
+                }
+                hh, stats = fn(lp, hh)
+                hh = _constrain(hh, rules, ("batch", "act_seq", "act_embed"))
+                return hh, stats
+
+            body = backend.layer_remat(body)
+            if backend.scan_layers and n > 1:
+                h, (aux_r, load_r) = jax.lax.scan(body, h, run_params)
+                auxs.append(aux_r)
+                loads.append(load_r)
+            else:
+                for i in range(n):
+                    lp = jax.tree.map(lambda a: a[i], run_params)
+                    h, (aux, load) = body(h, lp)
+                    auxs.append(aux[None])
+                    loads.append(load[None])
+            load_is_moe += [t == "moe"] * n
+
+        aux_all = jnp.concatenate(auxs)
+        load_all = jnp.concatenate(loads)
+        moe_sel = np.asarray(load_is_moe, bool)  # static layer pattern: concrete mask
+        emit_aux = (
+            cfg.moe is not None and cfg.moe.aux_loss_coeff > 0 and training
+            and not backend.fake_balanced_gate
+        )
+        stats = {
+            "aux_loss": aux_all.sum() if emit_aux else None,
+            "expert_load": load_all[moe_sel] if cfg.moe is not None else load_all[:0],
+        }
+
+        h = rms_norm(h, params["final_norm"].astype(dtype), eps)
+        if return_hidden:
+            return h, stats
+        unembed = params.get("lm_head")
+        if unembed is None:
+            unembed = params["embed"].T
+        logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
+        return logits, stats
+
+    # ---- interop ----
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.nemotron_v3.state_dict_adapter import NemotronV3StateDictAdapter
+
+        return NemotronV3StateDictAdapter(self.config)
+
+    @classmethod
+    def from_config(cls, config, backend: BackendConfig | None = None):
+        if isinstance(config, dict):
+            config = NemotronV3Config.from_hf(config)
+        return cls(config, backend)

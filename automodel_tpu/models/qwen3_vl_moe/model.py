@@ -147,18 +147,26 @@ class Qwen3VLMoeForConditionalGeneration:
     def get_mrope_positions(
         self,
         input_ids: np.ndarray,  # (B, S)
-        grid_thw: np.ndarray | None,  # (n_images, 3) in reading order across the batch
+        grid_thw: np.ndarray | None,  # image grids, (n_images, 3), reading order
         attention_mask: np.ndarray | None = None,
+        video_grid_thw: np.ndarray | None = None,  # (n_videos, 3)
     ) -> np.ndarray:
         """3D (t, h, w) position ids, (3, B, S) — numpy mirror of HF get_rope_index
         (modeling_qwen3_vl_moe.py:1082): text tokens advance all three axes together;
         a vision span of (t, h, w) patches gets grid coordinates offset after the
-        preceding text, and the following text resumes from max+1."""
+        preceding text, and the following text resumes from max+1. Video grids are
+        split into per-frame t=1 spans (Qwen3-VL timestamp encoding — frames are
+        separate placeholder runs separated by timestamp text, :1091-1094)."""
         cfg = self.config
         B, S = input_ids.shape
         ms = cfg.vision.spatial_merge_size
+        if video_grid_thw is not None:
+            v = np.asarray(video_grid_thw)
+            v = np.repeat(v, v[:, 0], axis=0)
+            v[:, 0] = 1
+            video_grid_thw = v
         pos = np.zeros((3, B, S), dtype=np.int64)
-        img_idx = 0
+        img_idx, vid_idx = 0, 0
         for b in range(B):
             valid = np.ones((S,), bool) if attention_mask is None else attention_mask[b].astype(bool)
             ids = input_ids[b][valid]
@@ -172,8 +180,12 @@ class Qwen3VLMoeForConditionalGeneration:
                     cursor += 1
                     st += 1
                     continue
-                t, h, w = (int(x) for x in grid_thw[img_idx])
-                img_idx += 1
+                if ids[st] == cfg.video_token_id:
+                    t, h, w = (int(x) for x in video_grid_thw[vid_idx])
+                    vid_idx += 1
+                else:
+                    t, h, w = (int(x) for x in grid_thw[img_idx])
+                    img_idx += 1
                 gh, gw = h // ms, w // ms
                 n = t * gh * gw
                 ti = np.repeat(np.arange(t), gh * gw)

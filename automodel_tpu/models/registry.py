@@ -30,6 +30,8 @@ MODEL_REGISTRY: dict[str, str] = {
     "MiniMaxM2ForCausalLM": "automodel_tpu.models.minimax_m2.model:MiniMaxM2ForCausalLM",
     "Qwen3NextForCausalLM": "automodel_tpu.models.qwen3_next.model:Qwen3NextForCausalLM",
     "GPT2LMHeadModel": "automodel_tpu.models.gpt2.model:GPT2LMHeadModel",
+    "NemotronHForCausalLM": "automodel_tpu.models.nemotron_v3.model:NemotronHForCausalLM",
+    "NemotronV3ForCausalLM": "automodel_tpu.models.nemotron_v3.model:NemotronHForCausalLM",
     "LlavaForConditionalGeneration": "automodel_tpu.models.llava.model:LlavaForConditionalGeneration",
     "Qwen3VLMoeForConditionalGeneration": "automodel_tpu.models.qwen3_vl_moe.model:Qwen3VLMoeForConditionalGeneration",
     "LlamaBidirectionalModel": "automodel_tpu.models.llama_bidirectional.model:LlamaBidirectionalModel",

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from automodel_tpu.models.common.backend import BackendConfig
 from automodel_tpu.ops.attention import dot_product_attention
 from automodel_tpu.ops.norms import layer_norm
-from automodel_tpu.ops.rope import apply_rope_angles
+from automodel_tpu.ops.rope import apply_rope_angles, rope_frequencies
 
 __all__ = ["Qwen3VLVisionConfig", "init_vision_params", "vision_logical_axes",
            "vision_forward", "prepare_vision_inputs"]
@@ -225,7 +225,7 @@ def vision_forward(
     h = h + pos
 
     # 2D rope: per-token angles [row*(inv_freq), col*(inv_freq)] over head_dim/2
-    inv_freq = 1.0 / (10000.0 ** (jnp.arange(0, dh // 2, 2, dtype=jnp.float32) / (dh // 2)))
+    inv_freq = rope_frequencies(dh // 2)
     angles = (pos_pairs[:, :, None].astype(jnp.float32) * inv_freq).reshape(h.shape[0], -1)
     angles = angles[None]  # (1, Tv, dh/2)
 
@@ -240,10 +240,8 @@ def vision_forward(
         x = jax.nn.gelu(x @ mp["fc1_w"] + mp["b_fc1"], approximate=False)
         return x @ mp["fc2_w"] + mp["b_fc2"]
 
-    deepstack = []
-    for li in range(cfg.depth):
-        lp = jax.tree.map(lambda a: a[li], p["blocks"])
-        x = layer_norm(h, lp["ln1_w"], lp["b_ln1"], 1e-6)
+    def block_fn(hh, lp):
+        x = layer_norm(hh, lp["ln1_w"], lp["b_ln1"], 1e-6)
         qkv = (x @ lp["qkv_w"] + lp["b_qkv"]).reshape(1, -1, 3, H, dh)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         q = apply_rope_angles(q, angles)
@@ -252,13 +250,25 @@ def vision_forward(
             q, k, v, causal=False, segment_ids_q=seg, segment_ids_kv=seg,
             backend=backend.attention,
         )[0].reshape(-1, d)
-        h = h + (attn @ lp["proj_w"] + lp["b_proj"])
-        x = layer_norm(h, lp["ln2_w"], lp["b_ln2"], 1e-6)
-        h = h + (jax.nn.gelu(x @ lp["fc1_w"] + lp["b_fc1"], approximate=approx) @ lp["fc2_w"] + lp["b_fc2"])
-        if li in cfg.deepstack_visual_indexes:
-            j = cfg.deepstack_visual_indexes.index(li)
+        hh = hh + (attn @ lp["proj_w"] + lp["b_proj"])
+        x = layer_norm(hh, lp["ln2_w"], lp["b_ln2"], 1e-6)
+        hh = hh + (jax.nn.gelu(x @ lp["fc1_w"] + lp["b_fc1"], approximate=approx) @ lp["fc2_w"] + lp["b_fc2"])
+        return hh, None
+
+    body = backend.layer_remat(block_fn)
+
+    # scan the contiguous segments between deepstack taps (compile time ~ #taps)
+    deepstack = []
+    bounds = [i + 1 for i in cfg.deepstack_visual_indexes]
+    start = 0
+    for j, end in enumerate([*bounds, cfg.depth]):
+        if end > start:
+            seg_params = jax.tree.map(lambda a: a[start:end], p["blocks"])
+            h, _ = jax.lax.scan(body, h, seg_params)
+        if j < len(bounds):
             mp = jax.tree.map(lambda a: a[j], p["ds_mergers"])
             deepstack.append(merger_apply(mp, h, post_shuffle=True))
+        start = end
 
     merged = merger_apply(p["merger"], h, post_shuffle=False)
     ds = jnp.stack(deepstack) if deepstack else jnp.zeros((0, merged.shape[0], cfg.out_hidden_size), dtype)

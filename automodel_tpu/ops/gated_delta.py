@@ -34,6 +34,7 @@ def causal_conv1d(
     weight: jnp.ndarray,
     activation: str = "silu",
     segment_ids: jnp.ndarray | None = None,
+    bias: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Depthwise causal conv over the sequence dim.
 
@@ -50,6 +51,8 @@ def causal_conv1d(
             seg_shift = jnp.pad(segment_ids, ((0, 0), (j, 0)))[:, : x.shape[1]]
             same = (seg_shift == segment_ids)[..., None].astype(x.dtype)
             y = y + shifted * same * weight[:, K - 1 - j]
+        if bias is not None:
+            y = y + bias
         if activation == "silu":
             y = jax.nn.silu(y)
         return y
@@ -64,6 +67,8 @@ def causal_conv1d(
         feature_group_count=ch,
     )
     y = y.swapaxes(1, 2)
+    if bias is not None:
+        y = y + bias
     if activation == "silu":
         y = jax.nn.silu(y)
     elif activation is not None and activation != "none":

@@ -1,0 +1,145 @@
+"""Mamba2 (SSD) chunked scan — TPU-native (reference nemotron_v3/layers.py:155
+delegates to mamba_ssm's Triton mamba_chunk_scan_combined; math per the Mamba2
+paper's state-space dual form).
+
+Same chunking skeleton as ops/gated_delta.py: intra-chunk terms are dense
+MXU-friendly einsums under a cumulative log-decay mask; the inter-chunk recurrence
+is a ``lax.scan`` carrying the (H, dh, N) state. fp32 throughout (decay exponentials
+underflow bf16), cast back at the end.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_P = jax.lax.Precision.HIGHEST  # recurrence compounds matmul error; keep fp32 MXU passes
+
+__all__ = ["mamba_chunk_scan", "group_rms_norm_gated", "softplus_dt"]
+
+
+def softplus_dt(
+    dt_raw: jnp.ndarray, dt_bias: jnp.ndarray, limit: tuple[float, float] | None = None
+) -> jnp.ndarray:
+    """softplus(dt + bias) with optional (min, max) clamp (config time_step_limit)."""
+    dt = jax.nn.softplus(dt_raw.astype(jnp.float32) + dt_bias.astype(jnp.float32))
+    if limit is not None and tuple(limit) != (0.0, float("inf")):
+        dt = jnp.clip(dt, limit[0], limit[1])
+    return dt
+
+
+def group_rms_norm_gated(
+    x: jnp.ndarray,  # (..., inter)
+    weight: jnp.ndarray,  # (inter,)
+    gate: jnp.ndarray | None,  # (..., inter)
+    group_size: int,
+    eps: float = 1e-5,
+    norm_before_gate: bool = False,
+) -> jnp.ndarray:
+    """mamba_ssm rmsnorm_fn semantics: with norm_before_gate=False (NemotronV3),
+    the gate multiplies *before* the group-wise RMS normalization."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    if gate is not None and not norm_before_gate:
+        xf = xf * jax.nn.silu(gate.astype(jnp.float32))
+    g = xf.shape[-1] // group_size
+    xg = xf.reshape(*xf.shape[:-1], g, group_size)
+    xg = xg * jax.lax.rsqrt(jnp.mean(xg * xg, axis=-1, keepdims=True) + eps)
+    out = xg.reshape(xf.shape) * weight.astype(jnp.float32)
+    if gate is not None and norm_before_gate:
+        out = out * jax.nn.silu(gate.astype(jnp.float32))
+    return out.astype(dtype)
+
+
+def mamba_chunk_scan(
+    x: jnp.ndarray,  # (B, S, H, dh)
+    dt: jnp.ndarray,  # (B, S, H) post-softplus step sizes
+    A: jnp.ndarray,  # (H,) negative per-head decay rates
+    Bm: jnp.ndarray,  # (B, S, G, N) input gates (grouped, broadcast over H//G heads)
+    Cm: jnp.ndarray,  # (B, S, G, N) output gates
+    D: jnp.ndarray | None = None,  # (H,) skip connection
+    *,
+    chunk_size: int = 128,
+    initial_state: jnp.ndarray | None = None,  # (B, H, dh, N)
+    output_final_state: bool = False,
+    reset_mask: jnp.ndarray | None = None,  # (B, S) True at packed-document starts
+):
+    """SSD: h_t = h_{t-1}·exp(dt_t A) + dt_t·(x_t ⊗ B_t); y_t = h_t·C_t + D·x_t.
+    Returns (y (B, S, H, dh), final_state | None).
+
+    ``reset_mask`` zeroes the recurrence across packed-document boundaries by
+    injecting a large negative log-decay at segment starts (within-segment decays
+    are cumulative-sum differences, so the injection cancels exactly there)."""
+    out_dtype = x.dtype
+    batch, S, H, dh = x.shape
+    G, N = Bm.shape[2], Bm.shape[3]
+    r = H // G
+
+    xf = x.astype(jnp.float32).transpose(0, 2, 1, 3)  # (B,H,S,dh)
+    dtf = dt.astype(jnp.float32).transpose(0, 2, 1)  # (B,H,S)
+    Bf = Bm.astype(jnp.float32).transpose(0, 2, 1, 3)  # (B,G,S,N)
+    Cf = Cm.astype(jnp.float32).transpose(0, 2, 1, 3)
+
+    C_ = chunk_size
+    pad = (-S) % C_
+    if pad:
+        xf = jnp.pad(xf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        dtf = jnp.pad(dtf, ((0, 0), (0, 0), (0, pad)))
+        Bf, Cf = (jnp.pad(t, ((0, 0), (0, 0), (0, pad), (0, 0))) for t in (Bf, Cf))
+    Nc = (S + pad) // C_
+
+    xf = xf.reshape(batch, H, Nc, C_, dh)
+    dtf = dtf.reshape(batch, H, Nc, C_)
+    Bf = Bf.reshape(batch, G, Nc, C_, N)
+    Cf = Cf.reshape(batch, G, Nc, C_, N)
+
+    dA = dtf * A.astype(jnp.float32)[None, :, None, None]  # (B,H,Nc,C)
+    if reset_mask is not None:
+        rm = reset_mask.astype(jnp.float32)
+        if pad:
+            rm = jnp.pad(rm, ((0, 0), (0, pad)))
+        dA = dA - 50.0 * rm.reshape(batch, 1, Nc, C_)
+    gcs = jnp.cumsum(dA, axis=-1)
+
+    tril = jnp.tril(jnp.ones((C_, C_), bool))
+    log_decay = jnp.where(tril, gcs[..., :, None] - gcs[..., None, :], -jnp.inf)
+    decay = jnp.exp(log_decay)  # (B,H,Nc,C,C)
+
+    # intra-chunk: y[i] = sum_{j<=i} (C_i·B_j) decay[i,j] dt_j x_j, heads grouped by G
+    CB = jnp.einsum("bgncn2,bgnmn2->bgncm".replace("n2", "k"), Cf, Bf, precision=_P)  # (B,G,Nc,C,C)
+    CB = jnp.repeat(CB, r, axis=1)  # (B,H,Nc,C,C)
+    M = CB * decay * dtf[..., None, :]
+    y = jnp.einsum("bhncm,bhnmd->bhncd", M, xf, precision=_P)
+
+    # chunk state contributions: S_c = sum_j exp(gcs_last - gcs_j) dt_j B_j ⊗ x_j
+    w = jnp.exp(gcs[..., -1:] - gcs) * dtf  # (B,H,Nc,C)
+    Bh = jnp.repeat(Bf, r, axis=1)  # (B,H,Nc,C,N)
+    chunk_states = jnp.einsum("bhncd,bhncn2->bhndn2".replace("n2", "k"), xf * w[..., None], Bh, precision=_P)
+
+    # inter-chunk recurrence
+    state0 = (
+        jnp.zeros((batch, H, dh, N), jnp.float32)
+        if initial_state is None
+        else initial_state.astype(jnp.float32)
+    )
+    Ch = jnp.repeat(Cf, r, axis=1)  # (B,H,Nc,C,N)
+    chunk_decay = jnp.exp(gcs[..., -1])  # (B,H,Nc)
+    in_decay = jnp.exp(gcs)  # (B,H,Nc,C)
+
+    def step(state, xs):
+        cs_i, cd_i, ind_i, C_i = xs
+        inter = jnp.einsum("bhck,bhdk->bhcd", C_i, state, precision=_P) * ind_i[..., None]
+        state = state * cd_i[..., None, None] + cs_i
+        return state, inter
+
+    xs = tuple(
+        t.transpose(2, 0, 1, *range(3, t.ndim))
+        for t in (chunk_states, chunk_decay, in_decay, Ch)
+    )
+    final_state, inters = jax.lax.scan(step, state0, xs)
+    y = y + inters.transpose(1, 2, 0, 3, 4)
+
+    y = y.reshape(batch, H, Nc * C_, dh)[:, :, :S].transpose(0, 2, 1, 3)
+    if D is not None:
+        y = y + D.astype(jnp.float32)[None, None, :, None] * x.astype(jnp.float32)
+    return y.astype(out_dtype), (final_state if output_final_state else None)

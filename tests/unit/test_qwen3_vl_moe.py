@@ -108,6 +108,27 @@ class TestQwen3VLMoeParity:
         ours = model.get_mrope_positions(ids, grid)
         np.testing.assert_array_equal(ours, theirs.numpy())
 
+    def test_rope_index_matches_hf_video(self, tmp_path):
+        """Video spans: HF splits t>1 grids into per-frame t=1 runs (timestamp
+        encoding); placeholder runs are per-frame, separated by text."""
+        torch.manual_seed(5)
+        hf = HFModel(tiny_cfg())
+        model, _ = _build(tmp_path, hf)
+        t, h, w = 2, 4, 4
+        per_frame = (h // 2) * (w // 2)
+        ids = np.random.RandomState(5).randint(0, 100, (1, 20))
+        # <ts><vstart><frame1 tokens><ts><vstart><frame2 tokens>
+        ids[0, 1] = VSTART
+        ids[0, 2 : 2 + per_frame] = 122  # video token id
+        ids[0, 7] = VSTART
+        ids[0, 8 : 8 + per_frame] = 122
+        grid = np.array([[t, h, w]])
+        theirs, _ = hf.model.get_rope_index(
+            torch.tensor(ids), video_grid_thw=torch.tensor(grid)
+        )
+        ours = model.get_mrope_positions(ids, None, video_grid_thw=grid)
+        np.testing.assert_array_equal(ours, theirs.numpy())
+
     def test_adapter_key_parity(self, tmp_path):
         torch.manual_seed(3)
         hf = HFModel(tiny_cfg())
