@@ -1,0 +1,3 @@
+from automodel_tpu.models.step3p5.model import Step3p5Config, Step3p5ForCausalLM
+
+__all__ = ["Step3p5Config", "Step3p5ForCausalLM"]

@@ -1,0 +1,409 @@
+"""Step-3.5 — TPU-native (reference models/step3p5/model.py:346, layers.py).
+
+Distinctives: zero-centered (1+w) RMSNorms throughout; alternating full/sliding
+attention where sliding layers may use *different* head counts
+(``attention_other_setting``); per-head q/k norms; optional head-wise sigmoid
+attention gate (g_proj); per-layer rope theta / partial rotary factor / rope on-off;
+MoE at an arbitrary ``moe_layers_enum`` index set with a separate clamped-SwiGLU
+shared expert per MoE layer; dense layers use clamped SwiGLU (clamp after silu on
+the gate, symmetric clamp on up — reference layers.py:152-160). Routed experts are
+plain SwiGLU (the reference's swiglu path ignores activation_limit).
+
+TPU-first structure: four param streams keyed (attention kind × ffn kind); the
+forward groups consecutive layers with identical static behavior (stream + rope
+meta + clamp) and ``lax.scan``s each group, so compile time scales with the number
+of behavior switches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.common.transformer import _constrain
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.layers import cast_moe_compute_params, init_moe_params, moe_forward, moe_logical_axes
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope_angles, rope_frequencies
+
+__all__ = ["Step3p5Config", "Step3p5ForCausalLM"]
+
+
+@dataclasses.dataclass
+class Step3p5Config:
+    vocab_size: int = 1024
+    hidden_size: int = 256
+    intermediate_size: int = 512
+    num_hidden_layers: int = 4
+    num_attention_heads: int = 4
+    num_attention_groups: int = 2  # kv heads (HF step3p5 naming)
+    head_dim: int | None = None
+    layer_types: tuple[str, ...] | None = None  # "full_attention" | "sliding_attention"
+    attention_other_setting: dict[str, int] | None = None  # sliding-layer head counts
+    sliding_window: int | None = None
+    use_head_wise_attn_gate: bool = False
+    rope_theta: "float | tuple[float, ...]" = 10000.0
+    partial_rotary_factors: tuple[float, ...] | None = None
+    use_rope_layers: tuple[bool, ...] | None = None
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    moe_layers_enum: tuple[int, ...] = ()
+    share_expert_dim: int | None = None
+    swiglu_limits: tuple[float, ...] | None = None  # routed experts (unused: plain swiglu)
+    swiglu_limits_shared: tuple[float, ...] | None = None  # dense MLP + shared expert
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+    moe: MoEConfig | None = None
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        if self.layer_types is None:
+            self.layer_types = ("full_attention",) * self.num_hidden_layers
+        if self.moe_layers_enum and self.moe is None:
+            raise ValueError("moe_layers_enum set but no MoEConfig")
+
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "Step3p5Config":
+        moe = None
+        moe_layers = hf.get("moe_layers_enum") or ()
+        if isinstance(moe_layers, str):
+            moe_layers = tuple(int(x) for x in moe_layers.split(",") if x.strip())
+        else:
+            moe_layers = tuple(int(x) for x in moe_layers)
+        if moe_layers:
+            moe = MoEConfig(
+                n_routed_experts=hf["moe_num_experts"],
+                n_activated_experts=hf.get("moe_top_k", 2),
+                dim=hf["hidden_size"],
+                moe_inter_dim=hf.get("moe_intermediate_size", hf["intermediate_size"]),
+                n_shared_experts=0,  # shared expert handled separately (own clamp/dim)
+                score_func="sigmoid" if hf.get("moe_router_activation", "softmax") == "sigmoid" else "softmax",
+                softmax_before_topk=hf.get("moe_router_activation", "softmax") == "softmax",
+                route_scale=hf.get("moe_router_scaling_factor", 1.0),
+                norm_topk_prob=True,
+                router_bias=hf.get("use_moe_router_bias", False),
+            )
+        theta = hf.get("rope_theta", 10000.0)
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_hidden_layers=hf["num_hidden_layers"],
+            num_attention_heads=hf["num_attention_heads"],
+            num_attention_groups=hf.get("num_attention_groups", hf["num_attention_heads"]),
+            head_dim=hf.get("head_dim"),
+            layer_types=tuple(hf["layer_types"]) if hf.get("layer_types") else None,
+            attention_other_setting=hf.get("attention_other_setting"),
+            sliding_window=hf.get("sliding_window"),
+            use_head_wise_attn_gate=hf.get("use_head_wise_attn_gate", False),
+            rope_theta=tuple(theta) if isinstance(theta, (list, tuple)) else theta,
+            partial_rotary_factors=tuple(hf["partial_rotary_factors"]) if hf.get("partial_rotary_factors") else None,
+            use_rope_layers=tuple(hf["use_rope_layers"]) if hf.get("use_rope_layers") else None,
+            max_position_embeddings=hf.get("max_position_embeddings", 4096),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+            moe_layers_enum=moe_layers,
+            share_expert_dim=hf.get("share_expert_dims", hf.get("share_expert_dim")),
+            swiglu_limits=tuple(hf["swiglu_limits"]) if hf.get("swiglu_limits") else None,
+            swiglu_limits_shared=tuple(hf["swiglu_limits_shared"]) if hf.get("swiglu_limits_shared") else None,
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            initializer_range=hf.get("initializer_range", 0.02),
+            moe=moe,
+        )
+
+    # ---- per-layer static metadata ----
+
+    def attn_kind(self, i: int) -> str:
+        return "sliding" if self.layer_types[i] == "sliding_attention" else "full"
+
+    def heads(self, i: int) -> tuple[int, int]:
+        """(n_heads, n_kv_heads) for layer i."""
+        if self.attn_kind(i) == "sliding" and self.attention_other_setting:
+            return (
+                self.attention_other_setting.get("num_attention_heads", self.num_attention_heads),
+                self.attention_other_setting.get("num_attention_groups", self.num_attention_groups),
+            )
+        return self.num_attention_heads, self.num_attention_groups
+
+    def ffn_kind(self, i: int) -> str:
+        return "moe" if i in set(self.moe_layers_enum) else "mlp"
+
+    def theta(self, i: int) -> float:
+        return float(self.rope_theta[i]) if isinstance(self.rope_theta, (list, tuple)) else float(self.rope_theta)
+
+    def prf(self, i: int) -> float:
+        return float(self.partial_rotary_factors[i]) if self.partial_rotary_factors else 1.0
+
+    def use_rope(self, i: int) -> bool:
+        if self.use_rope_layers is not None and len(self.use_rope_layers) > i:
+            return bool(self.use_rope_layers[i])
+        return True
+
+    def shared_limit(self, i: int) -> float | None:
+        v = self.swiglu_limits_shared[i] if self.swiglu_limits_shared else None
+        # reference treats 0 as "no clamp" (model.py:93-102), so falsy-zero is correct
+        return float(v) if v else None
+
+    def stream_key(self, i: int) -> str:
+        return f"{self.attn_kind(i)}_{self.ffn_kind(i)}"
+
+    def meta_key(self, i: int):
+        """Everything that changes the traced layer body."""
+        return (self.stream_key(i), self.theta(i), self.prf(i), self.use_rope(i), self.shared_limit(i))
+
+    def stream_indices(self) -> dict[str, tuple[int, ...]]:
+        out: dict[str, list[int]] = {}
+        for i in range(self.num_hidden_layers):
+            out.setdefault(self.stream_key(i), []).append(i)
+        return {k: tuple(v) for k, v in out.items()}
+
+
+def _stream_shapes(cfg: Step3p5Config, key: str) -> dict[str, tuple[int, ...]]:
+    d, dh = cfg.hidden_size, cfg.head_dim
+    akind, fkind = key.split("_")
+    i0 = next(i for i in range(cfg.num_hidden_layers) if cfg.stream_key(i) == key)
+    n, kv = cfg.heads(i0)
+    shapes = {
+        "attn_norm": (d,),
+        "mlp_norm": (d,),
+        "wq": (d, n, dh),
+        "wk": (d, kv, dh),
+        "wv": (d, kv, dh),
+        "wo": (n, dh, d),
+        "q_norm": (dh,),
+        "k_norm": (dh,),
+    }
+    if cfg.use_head_wise_attn_gate:
+        shapes["wg"] = (d, n)
+    if fkind == "mlp":
+        shapes |= {
+            "w_gate": (d, cfg.intermediate_size),
+            "w_up": (d, cfg.intermediate_size),
+            "w_down": (cfg.intermediate_size, d),
+        }
+    else:
+        sh = cfg.share_expert_dim or cfg.intermediate_size
+        shapes |= {"sh_gate": (d, sh), "sh_up": (d, sh), "sh_down": (sh, d)}
+    return shapes
+
+
+_AXES = {
+    "attn_norm": ("norm",), "mlp_norm": ("norm",),
+    "wq": ("embed", "heads", "head_dim"), "wk": ("embed", "kv_heads", "head_dim"),
+    "wv": ("embed", "kv_heads", "head_dim"), "wo": ("heads", "head_dim", "embed"),
+    "q_norm": ("norm",), "k_norm": ("norm",), "wg": ("embed", "heads"),
+    "w_gate": ("embed", "mlp"), "w_up": ("embed", "mlp"), "w_down": ("mlp", "embed"),
+    "sh_gate": ("embed", "mlp"), "sh_up": ("embed", "mlp"), "sh_down": ("mlp", "embed"),
+}
+
+
+def _clamped_swiglu(x, w_gate, w_up, w_down, limit):
+    """Step3p5MLP: clamp AFTER silu on the gate, symmetric clamp on up
+    (reference layers.py:152-160)."""
+    gate = jax.nn.silu(jnp.einsum("bsd,di->bsi", x, w_gate))
+    up = jnp.einsum("bsd,di->bsi", x, w_up)
+    if limit is not None:
+        gate = jnp.minimum(gate, limit)
+        up = jnp.clip(up, -limit, limit)
+    return jnp.einsum("bsi,id->bsd", gate * up, w_down)
+
+
+class Step3p5ForCausalLM:
+    """Functional model: holds config + backend, operates on param pytrees."""
+
+    config_class = Step3p5Config
+    hf_architectures = ("Step3p5ForCausalLM",)
+
+    def __init__(self, config: Step3p5Config, backend: BackendConfig | None = None):
+        self.config = config
+        self.backend = backend or BackendConfig()
+
+    # ---- params ----
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> dict:
+        cfg = self.config
+        std = cfg.initializer_range
+        keys = iter(jax.random.split(key, 12))
+        params: dict = {
+            "embed": (jax.random.normal(next(keys), (cfg.vocab_size, cfg.hidden_size), jnp.float32) * std).astype(dtype),
+            "final_norm": jnp.zeros((cfg.hidden_size,), dtype),  # zero-centered (1+w)
+        }
+        for skey, idx in cfg.stream_indices().items():
+            shapes = _stream_shapes(cfg, skey)
+            ks = jax.random.split(next(keys), len(shapes))
+            stack = {}
+            for j, (name, shape) in enumerate(shapes.items()):
+                if name.endswith("norm"):
+                    stack[name] = jnp.zeros((len(idx), *shape), dtype)  # (1+w) convention
+                else:
+                    stack[name] = (jax.random.normal(ks[j], (len(idx), *shape), jnp.float32) * std).astype(dtype)
+            if skey.endswith("_moe"):
+                stack["moe"] = jax.vmap(lambda k: init_moe_params(cfg.moe, k, dtype, std))(
+                    jax.random.split(next(keys), len(idx))
+                )
+            params[skey] = stack
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = (
+                jax.random.normal(next(keys), (cfg.hidden_size, cfg.vocab_size), jnp.float32) * std
+            ).astype(dtype)
+        return params
+
+    def abstract_params(self, dtype=jnp.bfloat16) -> dict:
+        return jax.eval_shape(lambda k: self.init(k, dtype), jax.random.key(0))
+
+    def logical_axes(self) -> dict:
+        cfg = self.config
+        axes: dict = {"embed": ("vocab", "embed"), "final_norm": ("norm",)}
+        for skey in cfg.stream_indices():
+            stream = {name: ("layers",) + _AXES[name] for name in _stream_shapes(cfg, skey)}
+            if skey.endswith("_moe"):
+                stream["moe"] = jax.tree.map(
+                    lambda tp: ("layers",) + tp,
+                    moe_logical_axes(cfg.moe),
+                    is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(e, (str, type(None))) for e in x),
+                )
+            axes[skey] = stream
+        if not cfg.tie_word_embeddings:
+            axes["lm_head"] = ("embed", "vocab")
+        return axes
+
+    # ---- forward ----
+
+    def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
+                 rules=None, return_hidden=False, training=True):
+        cfg, backend = self.config, self.backend
+        dtype = backend.jnp_dtype
+        B, S = input_ids.shape
+        eps = cfg.rms_norm_eps
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        emit_aux = (
+            cfg.moe is not None and cfg.moe.aux_loss_coeff > 0 and training
+            and not backend.fake_balanced_gate
+        )
+
+        # per-distinct-rope-meta angle tables, computed once
+        angle_cache: dict = {}
+
+        def angles_for(i):
+            mk = (cfg.theta(i), cfg.prf(i))
+            if mk not in angle_cache:
+                inv_freq = rope_frequencies(cfg.head_dim, mk[0], None, partial_rotary_factor=mk[1])
+                angle_cache[mk] = positions[..., None].astype(jnp.float32) * inv_freq
+            return angle_cache[mk]
+
+        def make_body(i):
+            """Layer body for the behavior class of layer i (shared by its run)."""
+            akind, fkind = cfg.attn_kind(i), cfg.ffn_kind(i)
+            window = cfg.sliding_window if akind == "sliding" else None
+            use_rope = cfg.use_rope(i)
+            angles = angles_for(i) if use_rope else None
+            limit = cfg.shared_limit(i)
+
+            def body(h, lp):
+                moe_params = lp.pop("moe", None)
+                lp = jax.tree.map(lambda a: a.astype(dtype), lp)
+                x = rms_norm(h, lp["attn_norm"], eps, offset=1.0)
+                q = jnp.einsum("bsd,dnh->bsnh", x, lp["wq"])
+                k = jnp.einsum("bsd,dnh->bsnh", x, lp["wk"])
+                v = jnp.einsum("bsd,dnh->bsnh", x, lp["wv"])
+                q = rms_norm(q, lp["q_norm"], eps, offset=1.0)
+                k = rms_norm(k, lp["k_norm"], eps, offset=1.0)
+                if use_rope:
+                    q = apply_rope_angles(q, angles)
+                    k = apply_rope_angles(k, angles)
+                out = dot_product_attention(
+                    q, k, v, causal=True, segment_ids_q=segment_ids,
+                    sliding_window=window, backend=backend.attention,
+                )
+                if cfg.use_head_wise_attn_gate:
+                    gate = jax.nn.sigmoid(jnp.einsum("bsd,dn->bsn", x, lp["wg"]))
+                    out = out * gate[..., None]
+                h = h + jnp.einsum("bsnh,nhd->bsd", out, lp["wo"])
+                h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+
+                x = rms_norm(h, lp["mlp_norm"], eps, offset=1.0)
+                if fkind == "mlp":
+                    h = h + _clamped_swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"], limit)
+                    stats = (jnp.float32(0), jnp.zeros((cfg.moe.n_routed_experts if cfg.moe else 1,), jnp.float32))
+                else:
+                    share = _clamped_swiglu(x, lp["sh_gate"], lp["sh_up"], lp["sh_down"], limit)
+                    moe_params = cast_moe_compute_params(moe_params, dtype)
+                    y, aux, load = moe_forward(
+                        cfg.moe, moe_params, x, token_mask,
+                        training=training,
+                        dispatcher="capacity" if backend.experts_backend == "dense" else "ragged",
+                        fake_balanced_gate=backend.fake_balanced_gate,
+                        fake_gate_noise=backend.fake_gate_noise,
+                    )
+                    h = h + share + y
+                    stats = (aux if (aux is not None and emit_aux) else jnp.float32(0), load)
+                h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+                return h, stats
+
+            return backend.layer_remat(body)
+
+        h = params["embed"].astype(dtype)[input_ids]
+        h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+
+        stream_offsets = dict.fromkeys(cfg.stream_indices(), 0)
+        auxs, loads, load_is_moe = [], [], []
+        layer_ids = range(cfg.num_hidden_layers)
+        for mkey, group in itertools.groupby(layer_ids, key=cfg.meta_key):
+            group = list(group)
+            i0 = group[0]
+            skey = cfg.stream_key(i0)
+            o = stream_offsets[skey]
+            n = len(group)
+            run_params = jax.tree.map(lambda a: a[o : o + n], params[skey])
+            stream_offsets[skey] = o + n
+            body = make_body(i0)
+            if backend.scan_layers and n > 1:
+                h, (aux_r, load_r) = jax.lax.scan(lambda hh, lp: body(hh, dict(lp)), h, run_params)
+                auxs.append(aux_r)
+                loads.append(load_r)
+            else:
+                for j in range(n):
+                    lp = jax.tree.map(lambda a: a[j], run_params)
+                    h, (aux, load) = body(h, dict(lp))
+                    auxs.append(aux[None])
+                    loads.append(load[None])
+            load_is_moe += [cfg.ffn_kind(i) == "moe" for i in group]
+
+        aux_all = jnp.concatenate(auxs)
+        load_all = jnp.concatenate(loads)
+        moe_sel = np.asarray(load_is_moe, bool)
+        stats = {
+            "aux_loss": aux_all.sum() if emit_aux else None,
+            "expert_load": load_all[moe_sel] if cfg.moe is not None else load_all[:0],
+        }
+
+        h = rms_norm(h, params["final_norm"].astype(dtype), eps, offset=1.0)
+        if return_hidden:
+            return h, stats
+        unembed = params.get("lm_head")
+        if unembed is None:
+            unembed = params["embed"].T
+        logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
+        return logits, stats
+
+    # ---- interop ----
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.step3p5.state_dict_adapter import Step3p5StateDictAdapter
+
+        return Step3p5StateDictAdapter(self.config)
+
+    @classmethod
+    def from_config(cls, config, backend: BackendConfig | None = None):
+        if isinstance(config, dict):
+            config = Step3p5Config.from_hf(config)
+        return cls(config, backend)

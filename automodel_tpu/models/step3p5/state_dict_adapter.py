@@ -1,0 +1,88 @@
+"""Step-3.5 HF mapping (reference models/step3p5/state_dict_adapter.py).
+
+HF ships experts already grouped: ``moe.gate_proj/up_proj`` (E, I, D) and
+``moe.down_proj`` (E, D, I); router ``moe.gate.weight`` (E, D) with optional
+``moe.router_bias``; shared expert under ``share_expert.*``. Four per-type streams
+pin explicit ``layer_indices``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.llama.state_dict_adapter import _o_in, _o_out, _proj_in, _proj_out, _t
+
+__all__ = ["Step3p5StateDictAdapter"]
+
+
+def _grouped_gate_up_in(gate: np.ndarray, up: np.ndarray) -> np.ndarray:
+    """HF (E, I, D) x2 -> ours (E, D, 2I) with [gate | up] concat."""
+    return np.concatenate([gate.transpose(0, 2, 1), up.transpose(0, 2, 1)], axis=-1)
+
+
+def _grouped_gate_up_out(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    inter = w.shape[-1] // 2
+    return (
+        np.ascontiguousarray(w[..., :inter].transpose(0, 2, 1)),
+        np.ascontiguousarray(w[..., inter:].transpose(0, 2, 1)),
+    )
+
+
+def _grouped_t(w: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(w.transpose(0, 2, 1))
+
+
+class Step3p5StateDictAdapter(MappingAdapter):
+    def __init__(self, cfg):
+        pre = "model.layers.{i}"
+        dh = cfg.head_dim
+        entries = [
+            Entry("model.embed_tokens.weight", "embed"),
+            Entry("model.norm.weight", "final_norm"),
+        ]
+        if not cfg.tie_word_embeddings:
+            entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
+
+        for skey, idx in cfg.stream_indices().items():
+            n, kv = cfg.heads(idx[0])
+            entries += [
+                Entry(f"{pre}.input_layernorm.weight", f"{skey}.attn_norm", layer_indices=idx),
+                Entry(f"{pre}.post_attention_layernorm.weight", f"{skey}.mlp_norm", layer_indices=idx),
+                Entry(f"{pre}.self_attn.q_proj.weight", f"{skey}.wq", _proj_in(n, dh), _proj_out(n, dh), layer_indices=idx),
+                Entry(f"{pre}.self_attn.k_proj.weight", f"{skey}.wk", _proj_in(kv, dh), _proj_out(kv, dh), layer_indices=idx),
+                Entry(f"{pre}.self_attn.v_proj.weight", f"{skey}.wv", _proj_in(kv, dh), _proj_out(kv, dh), layer_indices=idx),
+                Entry(f"{pre}.self_attn.o_proj.weight", f"{skey}.wo", _o_in(n, dh), _o_out(n, dh), layer_indices=idx),
+                Entry(f"{pre}.self_attn.q_norm.weight", f"{skey}.q_norm", layer_indices=idx),
+                Entry(f"{pre}.self_attn.k_norm.weight", f"{skey}.k_norm", layer_indices=idx),
+            ]
+            if cfg.use_head_wise_attn_gate:
+                entries.append(
+                    Entry(f"{pre}.self_attn.g_proj.weight", f"{skey}.wg", _t, _t, layer_indices=idx)
+                )
+            if skey.endswith("_mlp"):
+                entries += [
+                    Entry(f"{pre}.mlp.gate_proj.weight", f"{skey}.w_gate", _t, _t, layer_indices=idx),
+                    Entry(f"{pre}.mlp.up_proj.weight", f"{skey}.w_up", _t, _t, layer_indices=idx),
+                    Entry(f"{pre}.mlp.down_proj.weight", f"{skey}.w_down", _t, _t, layer_indices=idx),
+                ]
+            else:
+                entries += [
+                    Entry(f"{pre}.share_expert.gate_proj.weight", f"{skey}.sh_gate", _t, _t, layer_indices=idx),
+                    Entry(f"{pre}.share_expert.up_proj.weight", f"{skey}.sh_up", _t, _t, layer_indices=idx),
+                    Entry(f"{pre}.share_expert.down_proj.weight", f"{skey}.sh_down", _t, _t, layer_indices=idx),
+                    Entry(f"{pre}.moe.gate.weight", f"{skey}.moe.gate.weight", layer_indices=idx),
+                    Entry(
+                        (f"{pre}.moe.gate_proj.weight", f"{pre}.moe.up_proj.weight"),
+                        f"{skey}.moe.experts.gate_up_proj",
+                        _grouped_gate_up_in, _grouped_gate_up_out, layer_indices=idx,
+                    ),
+                    Entry(f"{pre}.moe.down_proj.weight", f"{skey}.moe.experts.down_proj",
+                          _grouped_t, _grouped_t, layer_indices=idx),
+                ]
+                if cfg.moe.router_bias:
+                    entries.append(
+                        Entry(f"{pre}.moe.router_bias", f"{skey}.moe.gate.bias", layer_indices=idx)
+                    )
+
+        super().__init__(entries, cfg.num_hidden_layers)

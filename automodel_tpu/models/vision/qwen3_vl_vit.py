@@ -48,6 +48,13 @@ class Qwen3VLVisionConfig:
     hidden_act: str = "gelu_pytorch_tanh"
     initializer_range: float = 0.02
 
+    def __post_init__(self):
+        # the segmented forward scan taps deepstack features in index order
+        if list(self.deepstack_visual_indexes) != sorted(self.deepstack_visual_indexes):
+            raise ValueError("deepstack_visual_indexes must be sorted ascending")
+        if self.deepstack_visual_indexes and self.deepstack_visual_indexes[-1] >= self.depth:
+            raise ValueError("deepstack_visual_indexes out of range")
+
     @classmethod
     def from_hf(cls, hf: dict[str, Any]) -> "Qwen3VLVisionConfig":
         keys = {f.name for f in dataclasses.fields(cls)}

@@ -1,0 +1,125 @@
+"""Step-3.5: hybrid attention geometries, per-layer rope, clamped SwiGLU, MoE with
+separate shared expert. (No HF implementation in this transformers version; the
+reference step3p5/ is the spec, so checks are semantic self-consistency.)"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.step3p5.model import Step3p5Config, Step3p5ForCausalLM
+
+
+def _hf_cfg(**kw):
+    base = dict(
+        architectures=["Step3p5ForCausalLM"], vocab_size=128, hidden_size=64,
+        intermediate_size=96, num_hidden_layers=4, num_attention_heads=4,
+        num_attention_groups=2, head_dim=16,
+        layer_types=["sliding_attention", "sliding_attention", "full_attention", "full_attention"],
+        attention_other_setting={"num_attention_heads": 8, "num_attention_groups": 4},
+        sliding_window=8, use_head_wise_attn_gate=True,
+        rope_theta=[10000.0, 10000.0, 50000.0, 50000.0],
+        partial_rotary_factors=[1.0, 1.0, 0.5, 0.5],
+        use_rope_layers=[True, True, True, False],
+        moe_layers_enum=(2, 3), moe_num_experts=8, moe_top_k=2,
+        moe_intermediate_size=32, share_expert_dims=48,
+        moe_router_activation="sigmoid", use_moe_router_bias=True,
+        swiglu_limits_shared=[7.0, 7.0, 7.0, 7.0],
+        max_position_embeddings=128,
+    )
+    base.update(kw)
+    return base
+
+
+def _fp32_backend(**kw):
+    return BackendConfig(dtype="float32", remat_policy="full", **kw)
+
+
+class TestStep3p5:
+    def test_config_mapping(self):
+        cfg = Step3p5Config.from_hf(_hf_cfg())
+        assert cfg.heads(0) == (8, 4)  # sliding uses attention_other_setting
+        assert cfg.heads(2) == (4, 2)
+        assert cfg.ffn_kind(1) == "mlp" and cfg.ffn_kind(2) == "moe"
+        assert cfg.theta(2) == 50000.0 and cfg.prf(2) == 0.5
+        assert not cfg.use_rope(3)
+        assert cfg.moe.score_func == "sigmoid" and cfg.moe.router_bias
+
+    def test_forward_finite_and_stats(self):
+        model = Step3p5ForCausalLM.from_config(_hf_cfg(), _fp32_backend())
+        params = model.init(jax.random.key(0), jnp.float32)
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 16)))
+        logits, stats = model(params, ids, training=False)
+        assert logits.shape == (2, 16, 128)
+        assert np.all(np.isfinite(np.asarray(logits)))
+        assert stats["expert_load"].shape == (2, 8)
+
+    def test_scan_matches_unrolled(self):
+        hf = _hf_cfg(num_hidden_layers=6,
+                     layer_types=["sliding_attention"] * 3 + ["full_attention"] * 3,
+                     rope_theta=10000.0, partial_rotary_factors=None, use_rope_layers=None,
+                     moe_layers_enum=(3, 4, 5), swiglu_limits_shared=[7.0] * 6)
+        model = Step3p5ForCausalLM.from_config(hf, _fp32_backend())
+        params = model.init(jax.random.key(1), jnp.float32)
+        model_u = Step3p5ForCausalLM.from_config(hf, _fp32_backend(scan_layers=False))
+        ids = jnp.asarray(np.random.RandomState(1).randint(0, 128, (1, 20)))
+        a, _ = model(params, ids, training=False)
+        b, _ = model_u(params, ids, training=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    def test_causality_and_sliding(self):
+        model = Step3p5ForCausalLM.from_config(_hf_cfg(), _fp32_backend())
+        params = model.init(jax.random.key(2), jnp.float32)
+        ids = jnp.asarray(np.random.RandomState(2).randint(0, 128, (1, 16)))
+        a, _ = model(params, ids, training=False)
+        ids2 = ids.at[0, 12:].set((ids[0, 12:] + 1) % 128)
+        b, _ = model(params, ids2, training=False)
+        np.testing.assert_allclose(np.asarray(a[0, :12]), np.asarray(b[0, :12]), atol=1e-5)
+
+    def test_clamp_changes_output(self):
+        base = _hf_cfg(swiglu_limits_shared=None)
+        m1 = Step3p5ForCausalLM.from_config(base, _fp32_backend())
+        params = m1.init(jax.random.key(3), jnp.float32)
+        # scale up an MLP weight so activations exceed the clamp
+        for k in params:
+            if k.endswith("_mlp"):
+                params[k]["w_up"] = params[k]["w_up"] * 50
+        m2 = Step3p5ForCausalLM.from_config(_hf_cfg(swiglu_limits_shared=[0.5] * 4), _fp32_backend())
+        ids = jnp.asarray(np.random.RandomState(3).randint(0, 128, (1, 8)))
+        a, _ = m1(params, ids, training=False)
+        b, _ = m2(params, ids, training=False)
+        assert np.abs(np.asarray(a) - np.asarray(b)).max() > 1e-4
+
+    def test_adapter_roundtrip(self):
+        model = Step3p5ForCausalLM.from_config(_hf_cfg(), _fp32_backend())
+        params = model.init(jax.random.key(4), jnp.float32)
+        adapter = model.state_dict_adapter()
+        hf = adapter.to_hf(params)
+        for k in (
+            "model.layers.0.self_attn.g_proj.weight",
+            "model.layers.1.mlp.gate_proj.weight",
+            "model.layers.2.moe.gate_proj.weight",
+            "model.layers.2.moe.router_bias",
+            "model.layers.3.share_expert.down_proj.weight",
+        ):
+            assert k in hf, k
+        back = adapter.from_hf(hf)
+        flat_a, flat_b = jax.tree.leaves(params), jax.tree.leaves(back)
+        assert len(flat_a) == len(flat_b)
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_grads_finite(self):
+        model = Step3p5ForCausalLM.from_config(_hf_cfg(), _fp32_backend())
+        params = model.init(jax.random.key(5), jnp.float32)
+        ids = jnp.asarray(np.random.RandomState(5).randint(0, 128, (2, 16)))
+
+        def loss_fn(p):
+            logits, _ = model(p, ids[:, :-1], training=True)
+            ll = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            return -jnp.take_along_axis(ll, ids[:, 1:, None], -1).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        assert np.isfinite(float(loss))
+        assert all(np.all(np.isfinite(np.asarray(g))) for g in jax.tree.leaves(grads))
