@@ -243,13 +243,14 @@ def moe_decoder_forward(
     return_hidden: bool = False,
     training: bool = True,
     attention_fn=None,
+    inputs_embeds: jnp.ndarray | None = None,  # (B, S, D) overrides the embed lookup (VLM merge)
 ) -> tuple[jnp.ndarray, dict[str, Any]]:
     """Returns ``(logits_or_hidden, stats)``; stats has ``aux_loss`` (scalar or None)
     and ``expert_load`` (num_moe_layers, E)."""
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
     dtype = backend.jnp_dtype
-    h = params["embed"].astype(dtype)[input_ids]
+    h = params["embed"].astype(dtype)[input_ids] if inputs_embeds is None else inputs_embeds.astype(dtype)
     h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
 
     sliding_flags = jnp.asarray(cfg.sliding_flags, dtype=jnp.int32)

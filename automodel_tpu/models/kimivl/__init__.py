@@ -1,0 +1,3 @@
+from automodel_tpu.models.kimivl.model import KimiVLConfig, KimiVLForConditionalGeneration
+
+__all__ = ["KimiVLConfig", "KimiVLForConditionalGeneration"]

@@ -1,0 +1,76 @@
+"""Kimi-VL HF mapping (reference kimivl/model.py:768 KimiVLStateDictAdapter).
+
+HF layout: ``vision_tower.*`` (MoonViT), ``multi_modal_projector.*``,
+``language_model.model.*`` (DeepSeek-V2/V3 keys), ``language_model.lm_head.weight``.
+The text part reuses DeepseekV3StateDictAdapter with re-prefixed HF keys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.deepseek_v3.state_dict_adapter import DeepseekV3StateDictAdapter
+from automodel_tpu.models.llama.state_dict_adapter import _t
+
+__all__ = ["KimiVLStateDictAdapter"]
+
+
+def _conv2d_in(w: np.ndarray) -> np.ndarray:
+    # (D, C, P, P) -> (C*P*P, D)
+    return np.ascontiguousarray(w.reshape(w.shape[0], -1).T)
+
+
+def _conv2d_out_factory(v):
+    def f(w: np.ndarray) -> np.ndarray:
+        return np.ascontiguousarray(w.T).reshape(-1, v.in_channels, v.patch_size, v.patch_size)
+
+    return f
+
+
+class KimiVLStateDictAdapter(MappingAdapter):
+    def __init__(self, cfg):
+        v = cfg.vision
+        vb = "vision_tower.encoder.blocks.{i}"
+        vis_range = (0, v.num_hidden_layers)
+
+        # text = DSv3 keys under the language_model. prefix
+        # ("model.layers..." -> "language_model.model.layers...", same for lm_head)
+        text_adapter = DeepseekV3StateDictAdapter(cfg.text)
+        entries = []
+        for e in text_adapter.entries:
+            new = tuple("language_model." + k for k in e.hf_keys)
+            entries.append(dataclasses.replace(e, hf=new if len(new) > 1 else new[0]))
+
+        entries += [
+            Entry("vision_tower.patch_embed.proj.weight", "visual.patch_w",
+                  _conv2d_in, _conv2d_out_factory(v)),
+            Entry("vision_tower.patch_embed.proj.bias", "visual.b_patch"),
+            Entry("vision_tower.patch_embed.pos_emb.weight", "visual.pos_emb"),
+            Entry(f"{vb}.norm0.weight", "visual.blocks.ln0_w", layer_range=vis_range),
+            Entry(f"{vb}.norm0.bias", "visual.blocks.b_ln0", layer_range=vis_range),
+            Entry(f"{vb}.norm1.weight", "visual.blocks.ln1_w", layer_range=vis_range),
+            Entry(f"{vb}.norm1.bias", "visual.blocks.b_ln1", layer_range=vis_range),
+            Entry(f"{vb}.wqkv.weight", "visual.blocks.wqkv", _t, _t, layer_range=vis_range),
+            Entry(f"{vb}.wqkv.bias", "visual.blocks.b_qkv", layer_range=vis_range),
+            Entry(f"{vb}.wo.weight", "visual.blocks.wo", _t, _t, layer_range=vis_range),
+            Entry(f"{vb}.wo.bias", "visual.blocks.b_o", layer_range=vis_range),
+            Entry(f"{vb}.mlp.fc0.weight", "visual.blocks.fc0", _t, _t, layer_range=vis_range),
+            Entry(f"{vb}.mlp.fc0.bias", "visual.blocks.b_fc0", layer_range=vis_range),
+            Entry(f"{vb}.mlp.fc1.weight", "visual.blocks.fc1", _t, _t, layer_range=vis_range),
+            Entry(f"{vb}.mlp.fc1.bias", "visual.blocks.b_fc1", layer_range=vis_range),
+            Entry("vision_tower.encoder.final_layernorm.weight", "visual.final_ln_w"),
+            Entry("vision_tower.encoder.final_layernorm.bias", "visual.b_final_ln"),
+            Entry("multi_modal_projector.pre_norm.weight", "projector.pre_ln_w"),
+            Entry("multi_modal_projector.pre_norm.bias", "projector.b_pre_ln"),
+            Entry("multi_modal_projector.linear_1.weight", "projector.w1", _t, _t),
+            Entry("multi_modal_projector.linear_1.bias", "projector.b1"),
+            Entry("multi_modal_projector.linear_2.weight", "projector.w2", _t, _t),
+            Entry("multi_modal_projector.linear_2.bias", "projector.b2"),
+        ]
+        super().__init__(
+            entries, cfg.text.num_hidden_layers,
+            num_experts=cfg.text.moe.n_routed_experts,
+        )

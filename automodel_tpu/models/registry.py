@@ -35,6 +35,7 @@ MODEL_REGISTRY: dict[str, str] = {
     "NemotronV3ForCausalLM": "automodel_tpu.models.nemotron_v3.model:NemotronHForCausalLM",
     "LlavaForConditionalGeneration": "automodel_tpu.models.llava.model:LlavaForConditionalGeneration",
     "Qwen3VLMoeForConditionalGeneration": "automodel_tpu.models.qwen3_vl_moe.model:Qwen3VLMoeForConditionalGeneration",
+    "KimiVLForConditionalGeneration": "automodel_tpu.models.kimivl.model:KimiVLForConditionalGeneration",
     "LlamaBidirectionalModel": "automodel_tpu.models.llama_bidirectional.model:LlamaBidirectionalModel",
 }
 

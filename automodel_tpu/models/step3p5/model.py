@@ -168,7 +168,7 @@ class Step3p5Config:
 
 def _stream_shapes(cfg: Step3p5Config, key: str) -> dict[str, tuple[int, ...]]:
     d, dh = cfg.hidden_size, cfg.head_dim
-    akind, fkind = key.split("_")
+    fkind = key.split("_")[1]
     i0 = next(i for i in range(cfg.num_hidden_layers) if cfg.stream_key(i) == key)
     n, kv = cfg.heads(i0)
     shapes = {
