@@ -1,0 +1,6 @@
+from automodel_tpu.models.kimi_k25_vl.model import (
+    KimiK25VLConfig,
+    KimiK25VLForConditionalGeneration,
+)
+
+__all__ = ["KimiK25VLConfig", "KimiK25VLForConditionalGeneration"]

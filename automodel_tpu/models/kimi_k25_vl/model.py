@@ -1,0 +1,48 @@
+"""Kimi-K2.5-VL — TPU-native (reference models/kimi_k25_vl/model.py:879).
+
+KimiVL with the MoonViT3d temporal tower: fixed sincos time embedding per frame
+(Learnable2DInterpPosEmbDividedFixed, reference :228), spatial rope repeated over
+frames (Rope2DPosEmbRepeated, :271), and temporal mean-pooling in the merger
+(tpool_patch_merger, :421) — all handled by the shared moonvit module's
+scatter-mean path (pos_emb_time > 1). The projector may use a separate
+mm_hidden_size / projector_ln_eps; text is DeepSeek-V3 MLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from automodel_tpu.models.deepseek_v3.model import DeepseekV3Config
+from automodel_tpu.models.kimivl.model import KimiVLConfig, KimiVLForConditionalGeneration
+from automodel_tpu.models.vision.moonvit import MoonViTConfig
+
+__all__ = ["KimiK25VLConfig", "KimiK25VLForConditionalGeneration"]
+
+
+@dataclasses.dataclass
+class KimiK25VLConfig(KimiVLConfig):
+    projector_ln_eps: float = 1e-5
+
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "KimiK25VLConfig":
+        v = dict(hf.get("vision_config", {}))
+        if v.get("init_pos_emb_time"):
+            v["pos_emb_time"] = v["init_pos_emb_time"]
+        return cls(
+            text=DeepseekV3Config.from_hf(hf["text_config"]),
+            vision=MoonViTConfig.from_hf(v),
+            media_placeholder_token_id=hf.get("media_placeholder_token_id", 163605),
+            projector_ln_eps=hf.get("projector_ln_eps", 1e-5),
+        )
+
+
+class KimiK25VLForConditionalGeneration(KimiVLForConditionalGeneration):
+    config_class = KimiK25VLConfig
+    hf_architectures = ("KimiK25VLForConditionalGeneration",)
+
+    @classmethod
+    def from_config(cls, config, backend=None):
+        if isinstance(config, dict):
+            config = KimiK25VLConfig.from_hf(config)
+        return cls(config, backend)

@@ -126,13 +126,18 @@ class KimiVLForConditionalGeneration:
 
         if pixel_values is not None:
             vi = vision_inputs
+            mu = cfg.vision.merge_kernel_size[0] * cfg.vision.merge_kernel_size[1]
+            # merged-slot count is a static shape: one projector row per media token
+            n_merged_units = media_coords[0].shape[0] * mu
             feats = moonvit_forward(
                 cfg.vision, self.backend, params["visual"], pixel_values,
                 vi["rope_angles"], vi["segment_ids"], vi["pos_idx"], vi["pos_w"],
-                vi["merge_perm"],
+                vi["out_idx"], vi["out_w"], n_merged_units,
+                time_emb=vi.get("time_emb"),
             )  # (Tm, mu, d_vis)
             pp = params["projector"]
-            x = layer_norm(feats, pp["pre_ln_w"].astype(dtype), pp["b_pre_ln"].astype(dtype))
+            ln_eps = getattr(cfg, "projector_ln_eps", 1e-5)
+            x = layer_norm(feats, pp["pre_ln_w"].astype(dtype), pp["b_pre_ln"].astype(dtype), ln_eps)
             x = x.reshape(feats.shape[0], -1)
             x = jax.nn.gelu(x @ pp["w1"].astype(dtype) + pp["b1"].astype(dtype), approximate=False)
             x = x @ pp["w2"].astype(dtype) + pp["b2"].astype(dtype)

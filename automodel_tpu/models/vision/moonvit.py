@@ -5,6 +5,11 @@ Native-resolution ViT: per-image (h, w) patch grids packed into one token stream
 grid, LayerNorm pre-norm blocks with biased qkv, and a 2x2 patch merger feeding the
 projector.
 
+Also serves the MoonViT3d variant (Kimi-K2.5, reference kimi_k25_vl/model.py:228-490):
+temporal frames add a fixed sincos time embedding, spatial rope repeats per frame,
+and the merger mean-pools over frames — expressed here as a host-precomputed
+scatter-mean (out_idx/out_w) that degenerates to a pure permutation for t=1.
+
 TPU-first contract: all data-dependent bookkeeping is host-side numpy
 (``prepare_moonvit_inputs``): rope angles, per-image segment ids, the row-major ->
 merge-unit permutation, and — the interesting one — the bicubic resize expressed as
@@ -44,6 +49,9 @@ class MoonViTConfig:
     merge_kernel_size: tuple[int, int] = (2, 2)
     in_channels: int = 3
     initializer_range: float = 0.02
+    # >1 enables the MoonViT3d temporal path (Kimi-K2.5): fixed sincos time
+    # embedding per frame + temporal mean-pooling in the merger
+    pos_emb_time: int = 1
 
     @classmethod
     def from_hf(cls, hf: dict[str, Any]) -> "MoonViTConfig":
@@ -131,53 +139,89 @@ def _cubic_taps(dst: int, src: int) -> tuple[np.ndarray, np.ndarray]:
     return idx, wts
 
 
+def _sincos_1d(dim: int, t_size: int) -> np.ndarray:
+    """MAE-style [sin | cos] temporal embedding (reference kimi_k25_vl/model.py:169-190)."""
+    omega = 1.0 / 10000 ** (np.arange(dim // 2, dtype=np.float32) / (dim / 2.0))
+    out = np.arange(t_size, dtype=np.float32)[:, None] * omega[None, :]
+    return np.concatenate([np.sin(out), np.cos(out)], axis=1)
+
+
 def prepare_moonvit_inputs(grid_hws: np.ndarray, cfg: MoonViTConfig) -> dict[str, np.ndarray]:
     """Host-side bookkeeping per packed image: rope angles, segment ids, 16-tap
-    bicubic gather for the learned pos-emb table, and the merge-unit permutation."""
+    bicubic gather for the learned pos-emb table, the fixed temporal embedding, and
+    the merger scatter (mean over frames; pure permutation for t=1 grids).
+
+    ``grid_hws`` rows are (h, w) or (t, h, w)."""
     dh = cfg.head_dim
+    d = cfg.hidden_size
     Hp, Wp = cfg.init_pos_emb_height, cfg.init_pos_emb_width
     kh, kw = cfg.merge_kernel_size
     n_freq = dh // 4
     freqs = 1.0 / (10000.0 ** (np.arange(0, dh, 4)[:n_freq].astype(np.float64) / dh))
+    time_table = _sincos_1d(d, max(cfg.pos_emb_time, 1))
 
-    angles, seg, pos_idx, pos_w, perm = [], [], [], [], []
-    seg_id, offset = 0, 0
-    for h, w in np.asarray(grid_hws):
-        h, w = int(h), int(w)
+    grids = np.asarray(grid_hws)
+    if grids.shape[1] == 2:
+        grids = np.concatenate([np.ones((len(grids), 1), grids.dtype), grids], axis=1)
+
+    angles, seg, pos_idx, pos_w, time_emb, out_idx, out_w = [], [], [], [], [], [], []
+    seg_id, merged_offset = 0, 0
+    for t, h, w in grids:
+        t, h, w = int(t), int(h), int(w)
         if h % kh or w % kw:
             raise ValueError(f"grid ({h}, {w}) not divisible by merge kernel ({kh}, {kw})")
-        # 2D rope: interleave (x*f, y*f) per frequency (reference Rope2DPosEmb:
-        # freqs_cis[..., 2i] rotates by x, 2i+1 by y)
+        if t > max(cfg.pos_emb_time, 1):
+            raise ValueError(f"t={t} exceeds pos_emb_time={cfg.pos_emb_time}")
+        # 2D rope: interleave (x*f, y*f) per frequency, repeated over frames
+        # (reference Rope2DPosEmb / Rope2DPosEmbRepeated)
         ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
         xa = xs.reshape(-1, 1) * freqs[None, :]
         ya = ys.reshape(-1, 1) * freqs[None, :]
-        ang = np.stack([xa, ya], axis=-1).reshape(h * w, -1)  # (T, dh/2)
-        angles.append(ang)
-        seg.append(np.full((h * w,), seg_id, np.int32))
+        ang = np.stack([xa, ya], axis=-1).reshape(h * w, -1)  # (h*w, dh/2)
+        angles.append(np.tile(ang, (t, 1)))
+        # one attention segment per image (all frames attend jointly,
+        # reference cu_seqlens over t*h*w)
+        seg.append(np.full((t * h * w,), seg_id, np.int32))
         seg_id += 1
         # bicubic taps: outer product of per-axis 4-tap kernels -> 16 taps
         iy, wy = _cubic_taps(h, Hp)
         ix, wx = _cubic_taps(w, Wp)
         flat_idx = (iy[:, None, :, None] * Wp + ix[None, :, None, :]).reshape(h * w, 16)
         flat_w = (wy[:, None, :, None] * wx[None, :, None, :]).reshape(h * w, 16)
-        pos_idx.append(flat_idx)
-        pos_w.append(flat_w)
-        # row-major -> merge-unit order (patch_merger view/permute)
+        pos_idx.append(np.tile(flat_idx, (t, 1)))
+        pos_w.append(np.tile(flat_w, (t, 1)))
+        # fixed sincos time embedding per frame (zero for single-frame images,
+        # reference Learnable2DInterpPosEmbDividedFixed: t==1 skips the add)
+        if t > 1:
+            time_emb.append(np.repeat(time_table[:t], h * w, axis=0))
+        else:
+            time_emb.append(np.zeros((h * w, d), np.float32))
+        # row-major -> merge-unit order, then mean over frames: token (f, y, x)
+        # lands in merged slot (block, intra) with weight 1/t
         p = (
             np.arange(h * w)
             .reshape(h // kh, kh, w // kw, kw)
             .transpose(0, 2, 1, 3)
             .reshape(-1)
         )
-        perm.append(p + offset)
-        offset += h * w
-    return {
+        inv = np.empty_like(p)
+        inv[p] = np.arange(h * w)  # row-major token -> merge-unit slot
+        oi = np.tile(inv, t) + merged_offset
+        out_idx.append(oi)
+        out_w.append(np.full((t * h * w,), 1.0 / t, np.float32))
+        merged_offset += h * w
+    out = {
         "rope_angles": np.concatenate(angles).astype(np.float32),  # (T, dh/2)
         "segment_ids": np.concatenate(seg),  # (T,)
         "pos_idx": np.concatenate(pos_idx).astype(np.int32),  # (T, 16)
         "pos_w": np.concatenate(pos_w).astype(np.float32),  # (T, 16)
-        "merge_perm": np.concatenate(perm).astype(np.int32),  # (T,)
+        "out_idx": np.concatenate(out_idx).astype(np.int32),  # (T,)
+        "out_w": np.concatenate(out_w).astype(np.float32),  # (T,)
     }
+    if any(int(t) > 1 for t, _, _ in grids):
+        # only multi-frame batches carry the temporal embedding (zeros otherwise)
+        out["time_emb"] = np.concatenate(time_emb).astype(np.float32)  # (T, hidden)
+    return out
 
 
 def _rope_interleaved_angles(x: jnp.ndarray, angles: jnp.ndarray) -> jnp.ndarray:
@@ -200,9 +244,12 @@ def moonvit_forward(
     segment_ids: jnp.ndarray,  # (T,)
     pos_idx: jnp.ndarray,  # (T, 16)
     pos_w: jnp.ndarray,  # (T, 16)
-    merge_perm: jnp.ndarray,  # (T,)
+    out_idx: jnp.ndarray,  # (T,) merged-slot scatter indices
+    out_w: jnp.ndarray,  # (T,) scatter weights (1/t per frame)
+    num_merged_units: int,  # static: total merged slots (= sum h*w per image)
+    time_emb: jnp.ndarray | None = None,  # (T, hidden) fixed temporal sincos (3d)
 ) -> jnp.ndarray:
-    """Returns merged features (T // (kh*kw), kh*kw, hidden) ready for the projector."""
+    """Returns merged features (num_merged_units // mu, mu, hidden) for the projector."""
     dtype = backend.jnp_dtype
     d, H, dh = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
     mu = cfg.merge_kernel_size[0] * cfg.merge_kernel_size[1]
@@ -211,6 +258,8 @@ def moonvit_forward(
     h = patches.astype(dtype) @ p["patch_w"] + p["b_patch"]
     table = p["pos_emb"].reshape(-1, d)
     h = h + (table[pos_idx] * pos_w[..., None].astype(dtype)).sum(axis=1)
+    if time_emb is not None:
+        h = h + time_emb.astype(dtype)
 
     seg = segment_ids[None]
 
@@ -231,4 +280,7 @@ def moonvit_forward(
 
     h, _ = jax.lax.scan(backend.layer_remat(block_fn), h, p["blocks"])
     h = layer_norm(h, p["final_ln_w"], p["b_final_ln"])
-    return h[merge_perm].reshape(-1, mu, d)
+    # merge-unit regroup + mean over frames as one scatter-add
+    merged = jnp.zeros((int(num_merged_units), d), h.dtype)
+    merged = merged.at[out_idx].add(h * out_w[:, None].astype(h.dtype))
+    return merged.reshape(-1, mu, d)

@@ -88,12 +88,29 @@ class TestMoonViTRope:
         expect = np.stack([2 * freqs, 1 * freqs], axis=-1).reshape(-1)
         np.testing.assert_allclose(ang[6], expect, rtol=1e-6)
 
-    def test_merge_perm_groups_2x2(self):
+    def test_merge_scatter_groups_2x2(self):
         cfg = MoonViTConfig(patch_size=4, num_attention_heads=2, hidden_size=16,
                             num_hidden_layers=1, intermediate_size=16)
         vin = prepare_moonvit_inputs(np.array([[4, 4]]), cfg)
         # first merge unit = row-major positions (0,0),(0,1),(1,0),(1,1) = 0,1,4,5
-        np.testing.assert_array_equal(vin["merge_perm"][:4], [0, 1, 4, 5])
+        np.testing.assert_array_equal(vin["out_idx"][[0, 1, 4, 5]], [0, 1, 2, 3])
+        np.testing.assert_allclose(vin["out_w"], np.ones(16))
+
+    def test_temporal_mean_pooling(self):
+        """t=2 frames mean-pool into the same merged slots with weight 1/2, and the
+        fixed sincos time embedding distinguishes frames."""
+        cfg = MoonViTConfig(patch_size=4, num_attention_heads=2, hidden_size=16,
+                            num_hidden_layers=1, intermediate_size=16, pos_emb_time=4)
+        vin = prepare_moonvit_inputs(np.array([[2, 2, 2]]), cfg)
+        assert vin["out_idx"].shape == (8,)
+        np.testing.assert_array_equal(vin["out_idx"][:4], vin["out_idx"][4:])
+        np.testing.assert_allclose(vin["out_w"], np.full(8, 0.5))
+        assert int(vin["out_idx"].max()) + 1 == 4
+        # frame 0 gets time_table[0]=[sin(0)|cos(0)] = [0..0, 1..1]; frame 1 differs
+        assert np.abs(vin["time_emb"][:4] - vin["time_emb"][4:]).max() > 0.1
+        np.testing.assert_allclose(vin["time_emb"][0, 8:], np.ones(8), atol=1e-6)
+        # rope repeats spatially across frames
+        np.testing.assert_allclose(vin["rope_angles"][:4], vin["rope_angles"][4:])
 
 
 class TestKimiVL:
@@ -183,4 +200,38 @@ class TestKimiVL:
         assert np.isfinite(float(loss))
         assert all(np.all(np.isfinite(np.asarray(g))) for g in jax.tree.leaves(grads))
         # the learned pos-emb table must receive gradient through the bicubic gather
+        assert np.abs(np.asarray(grads["visual"]["pos_emb"])).max() > 0
+
+
+class TestKimiK25VL:
+    def test_video_forward_and_grads(self):
+        from automodel_tpu.models.kimi_k25_vl.model import KimiK25VLForConditionalGeneration
+
+        hf = _hf_cfg()
+        hf["architectures"] = ["KimiK25VLForConditionalGeneration"]
+        hf["vision_config"]["init_pos_emb_time"] = 4
+        model = KimiK25VLForConditionalGeneration.from_config(
+            hf, BackendConfig(dtype="float32", remat_policy="full")
+        )
+        assert model.config.vision.pos_emb_time == 4
+        params = model.init(jax.random.key(0), jnp.float32)
+        rng = np.random.RandomState(0)
+        # one 2-frame 4x4 video -> 4 merged tokens (mean over frames)
+        grid = np.array([[2, 4, 4]])
+        ids = rng.randint(0, 100, (1, 16))
+        ids[0, 2:6] = model.config.media_placeholder_token_id
+        pixels = jnp.asarray(rng.randn(32, model.config.vision.patch_dim).astype(np.float32))
+        vin = {k: jnp.asarray(v) for k, v in model.prepare_vision_inputs(grid).items()}
+        coords = tuple(jnp.asarray(c) for c in model.media_token_coords(ids))
+        jids = jnp.asarray(ids)
+        logits, _ = model(params, jids, pixel_values=pixels, vision_inputs=vin,
+                          media_coords=coords, training=False)
+        assert np.all(np.isfinite(np.asarray(logits)))
+
+        def loss_fn(p):
+            out, _ = model(p, jids, pixel_values=pixels, vision_inputs=vin,
+                           media_coords=coords, training=True)
+            return (out.astype(jnp.float32) ** 2).mean()
+
+        grads = jax.grad(loss_fn)(params)
         assert np.abs(np.asarray(grads["visual"]["pos_emb"])).max() > 0
