@@ -127,8 +127,12 @@ class KimiVLForConditionalGeneration:
         if pixel_values is not None:
             vi = vision_inputs
             mu = cfg.vision.merge_kernel_size[0] * cfg.vision.merge_kernel_size[1]
-            # merged-slot count is a static shape: one projector row per media token
+            # merged-slot count is a static shape: one projector row per media token.
+            # OOB scatter indices are silently dropped by .at[].add, so mismatched
+            # placeholder/pixel counts must fail loudly here (shapes are host-known).
             n_merged_units = media_coords[0].shape[0] * mu
+            if vi["out_idx"].shape[0] != pixel_values.shape[0]:
+                raise ValueError("vision_inputs do not match pixel_values token count")
             feats = moonvit_forward(
                 cfg.vision, self.backend, params["visual"], pixel_values,
                 vi["rope_angles"], vi["segment_ids"], vi["pos_idx"], vi["pos_w"],

@@ -1,0 +1,6 @@
+from automodel_tpu.models.nemotron_parse.model import (
+    NemotronParseConfig,
+    NemotronParseForConditionalGeneration,
+)
+
+__all__ = ["NemotronParseConfig", "NemotronParseForConditionalGeneration"]

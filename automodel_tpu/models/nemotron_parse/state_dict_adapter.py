@@ -1,0 +1,103 @@
+"""NemotronParse HF mapping (reference nemotron_parse/model.py HF layout:
+``decoder.*`` mBART keys, ``encoder.conv1/conv2/layer_norm*/sum_proj`` neck keys,
+``lm_head``, ``decoder.extra_heads/extra_proj``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.llama.state_dict_adapter import (
+    _bias_in,
+    _bias_out,
+    _o_in,
+    _o_out,
+    _proj_in,
+    _proj_out,
+    _t,
+)
+
+__all__ = ["NemotronParseStateDictAdapter"]
+
+
+def _conv1_in(w: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(w[:, :, 0].T)  # (out, in, 1) -> (in, out)
+
+
+def _conv1_out(w: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(w.T)[:, :, None]
+
+
+def _conv2_in(w: np.ndarray) -> np.ndarray:
+    # (out, in, 1, K) -> (K*in, out): output = sum_k x[w_pos+k] @ W[:, :, 0, k]^T
+    out_d, in_d, _, K = w.shape
+    return np.ascontiguousarray(w[:, :, 0].transpose(2, 1, 0).reshape(K * in_d, out_d))
+
+
+def _conv2_out_factory(cfg):
+    def f(w: np.ndarray) -> np.ndarray:
+        K = cfg.neck_merge
+        in_d = cfg.neck_dim
+        return np.ascontiguousarray(
+            w.reshape(K, in_d, -1).transpose(2, 1, 0)[:, :, None, :]
+        )
+
+    return f
+
+
+class NemotronParseStateDictAdapter(MappingAdapter):
+    def __init__(self, cfg):
+        H, dh = cfg.decoder_attention_heads, cfg.head_dim
+        pre = "decoder.layers.{i}"
+
+        def attn(hf_prefix, ours_prefix):
+            return [
+                Entry(f"{pre}.{hf_prefix}.q_proj.weight", f"layers.{ours_prefix}_wq",
+                      _proj_in(H, dh), _proj_out(H, dh)),
+                Entry(f"{pre}.{hf_prefix}.q_proj.bias", f"layers.{ours_prefix}_bq",
+                      _bias_in(H, dh), _bias_out(H, dh)),
+                Entry(f"{pre}.{hf_prefix}.k_proj.weight", f"layers.{ours_prefix}_wk",
+                      _proj_in(H, dh), _proj_out(H, dh)),
+                Entry(f"{pre}.{hf_prefix}.k_proj.bias", f"layers.{ours_prefix}_bk",
+                      _bias_in(H, dh), _bias_out(H, dh)),
+                Entry(f"{pre}.{hf_prefix}.v_proj.weight", f"layers.{ours_prefix}_wv",
+                      _proj_in(H, dh), _proj_out(H, dh)),
+                Entry(f"{pre}.{hf_prefix}.v_proj.bias", f"layers.{ours_prefix}_bv",
+                      _bias_in(H, dh), _bias_out(H, dh)),
+                Entry(f"{pre}.{hf_prefix}.out_proj.weight", f"layers.{ours_prefix}_wo",
+                      _o_in(H, dh), _o_out(H, dh)),
+                Entry(f"{pre}.{hf_prefix}.out_proj.bias", f"layers.{ours_prefix}_bo"),
+            ]
+
+        entries = [
+            Entry("decoder.embed_tokens.weight", "embed"),
+            Entry("decoder.layernorm_embedding.weight", "emb_ln_w"),
+            Entry("decoder.layernorm_embedding.bias", "b_emb_ln"),
+            Entry("decoder.layer_norm.weight", "final_ln_w"),
+            Entry("decoder.layer_norm.bias", "b_final_ln"),
+            Entry("lm_head.weight", "lm_head", _t, _t),
+            *attn("self_attn", "self"),
+            Entry(f"{pre}.self_attn_layer_norm.weight", "layers.self_ln_w"),
+            Entry(f"{pre}.self_attn_layer_norm.bias", "layers.b_self_ln"),
+            *attn("encoder_attn", "cross"),
+            Entry(f"{pre}.encoder_attn_layer_norm.weight", "layers.cross_ln_w"),
+            Entry(f"{pre}.encoder_attn_layer_norm.bias", "layers.b_cross_ln"),
+            Entry(f"{pre}.fc1.weight", "layers.fc1", _t, _t),
+            Entry(f"{pre}.fc1.bias", "layers.b_fc1"),
+            Entry(f"{pre}.fc2.weight", "layers.fc2", _t, _t),
+            Entry(f"{pre}.fc2.bias", "layers.b_fc2"),
+            Entry(f"{pre}.final_layer_norm.weight", "layers.final_ln_w"),
+            Entry(f"{pre}.final_layer_norm.bias", "layers.b_final_ln"),
+            Entry("encoder.conv1.weight", "neck.conv1_w", _conv1_in, _conv1_out),
+            Entry("encoder.conv1.bias", "neck.b_conv1"),
+            Entry("encoder.layer_norm1.weight", "neck.ln1_w"),
+            Entry("encoder.layer_norm1.bias", "neck.b_ln1"),
+            Entry("encoder.conv2.weight", "neck.conv2_w", _conv2_in, _conv2_out_factory(cfg)),
+            Entry("encoder.layer_norm2.weight", "neck.ln2_w"),
+            Entry("encoder.layer_norm2.bias", "neck.b_ln2"),
+            Entry("encoder.sum_proj.weight", "neck.sum_w", _t, _t),
+            Entry("encoder.sum_proj.bias", "neck.b_sum"),
+            Entry("encoder.layer_norm3.weight", "neck.ln3_w"),
+            Entry("encoder.layer_norm3.bias", "neck.b_ln3"),
+        ]
+        super().__init__(entries, cfg.decoder_layers)

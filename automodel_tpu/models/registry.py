@@ -37,6 +37,7 @@ MODEL_REGISTRY: dict[str, str] = {
     "Qwen3VLMoeForConditionalGeneration": "automodel_tpu.models.qwen3_vl_moe.model:Qwen3VLMoeForConditionalGeneration",
     "KimiVLForConditionalGeneration": "automodel_tpu.models.kimivl.model:KimiVLForConditionalGeneration",
     "KimiK25VLForConditionalGeneration": "automodel_tpu.models.kimi_k25_vl.model:KimiK25VLForConditionalGeneration",
+    "NemotronParseForConditionalGeneration": "automodel_tpu.models.nemotron_parse.model:NemotronParseForConditionalGeneration",
     "LlamaBidirectionalModel": "automodel_tpu.models.llama_bidirectional.model:LlamaBidirectionalModel",
 }
 

@@ -193,9 +193,9 @@ def prepare_moonvit_inputs(grid_hws: np.ndarray, cfg: MoonViTConfig) -> dict[str
         # fixed sincos time embedding per frame (zero for single-frame images,
         # reference Learnable2DInterpPosEmbDividedFixed: t==1 skips the add)
         if t > 1:
-            time_emb.append(np.repeat(time_table[:t], h * w, axis=0))
+            time_emb.append((t, h * w))
         else:
-            time_emb.append(np.zeros((h * w, d), np.float32))
+            time_emb.append((1, h * w))
         # row-major -> merge-unit order, then mean over frames: token (f, y, x)
         # lands in merged slot (block, intra) with weight 1/t
         p = (
@@ -219,8 +219,14 @@ def prepare_moonvit_inputs(grid_hws: np.ndarray, cfg: MoonViTConfig) -> dict[str
         "out_w": np.concatenate(out_w).astype(np.float32),  # (T,)
     }
     if any(int(t) > 1 for t, _, _ in grids):
-        # only multi-frame batches carry the temporal embedding (zeros otherwise)
-        out["time_emb"] = np.concatenate(time_emb).astype(np.float32)  # (T, hidden)
+        # only multi-frame batches carry the temporal embedding (zeros otherwise);
+        # built lazily so all-image batches never allocate the (T, hidden) block
+        out["time_emb"] = np.concatenate(
+            [
+                np.repeat(time_table[:t], hw, axis=0) if t > 1 else np.zeros((hw, d), np.float32)
+                for t, hw in time_emb
+            ]
+        ).astype(np.float32)  # (T, hidden)
     return out
 
 

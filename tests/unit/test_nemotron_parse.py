@@ -1,0 +1,168 @@
+"""NemotronParse: decoder parity vs HF MBartDecoder (positional embeddings zeroed —
+the reference's decoder drops them, nemotron_parse/model.py:212-243), neck parity vs
+torch convs, adapter round-trip, grads."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.nemotron_parse.model import (
+    NemotronParseConfig,
+    NemotronParseForConditionalGeneration,
+)
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from transformers.models.mbart.modeling_mbart import MBartConfig, MBartDecoder
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=128, d_model=64, decoder_layers=2, decoder_attention_heads=4,
+        decoder_ffn_dim=96, radio_feature_dim=40, radio_summary_dim=80, neck_dim=64,
+    )
+    base.update(kw)
+    return NemotronParseConfig(**base)
+
+
+def _fp32_backend():
+    return BackendConfig(dtype="float32", remat_policy="full")
+
+
+def _hf_decoder(cfg):
+    hf_cfg = MBartConfig(
+        vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        decoder_layers=cfg.decoder_layers,
+        decoder_attention_heads=cfg.decoder_attention_heads,
+        decoder_ffn_dim=cfg.decoder_ffn_dim, scale_embedding=cfg.scale_embedding,
+        activation_function="gelu", dropout=0.0, attention_dropout=0.0,
+        activation_dropout=0.0, decoder_layerdrop=0.0, max_position_embeddings=64,
+    )
+    dec = MBartDecoder(hf_cfg).eval()
+    with torch.no_grad():
+        dec.embed_positions.weight.zero_()  # reference decoder has no pos embeddings
+    return dec
+
+
+def _load_from_hf_decoder(model, dec):
+    """Map the HF MBartDecoder state dict through our adapter (decoder.* prefix)."""
+    sd = {f"decoder.{k}": v.numpy() for k, v in dec.state_dict().items()
+          if "embed_positions" not in k}
+    # adapter also expects lm_head + neck keys; synthesize them
+    cfg = model.config
+    rng = np.random.RandomState(0)
+    sd["lm_head.weight"] = rng.randn(cfg.vocab_size, cfg.d_model).astype(np.float32) * 0.02
+    sd["encoder.conv1.weight"] = rng.randn(cfg.neck_dim, cfg.radio_feature_dim, 1).astype(np.float32) * 0.02
+    sd["encoder.conv1.bias"] = np.zeros(cfg.neck_dim, np.float32)
+    sd["encoder.conv2.weight"] = rng.randn(cfg.neck_dim, cfg.neck_dim, 1, 4).astype(np.float32) * 0.02
+    for j in (1, 2, 3):
+        sd[f"encoder.layer_norm{j}.weight"] = np.ones(cfg.neck_dim, np.float32)
+        sd[f"encoder.layer_norm{j}.bias"] = np.zeros(cfg.neck_dim, np.float32)
+    sd["encoder.sum_proj.weight"] = rng.randn(cfg.neck_dim, cfg.radio_summary_dim).astype(np.float32) * 0.02
+    sd["encoder.sum_proj.bias"] = np.zeros(cfg.neck_dim, np.float32)
+    return model.state_dict_adapter().from_hf(sd), sd
+
+
+class TestDecoderParity:
+    def test_matches_hf_mbart_decoder(self):
+        torch.manual_seed(0)
+        cfg = _cfg()
+        model = NemotronParseForConditionalGeneration(cfg, _fp32_backend())
+        dec = _hf_decoder(cfg)
+        params, _ = _load_from_hf_decoder(model, dec)
+
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (2, 12))
+        enc = rng.randn(2, 9, cfg.d_model).astype(np.float32)
+        with torch.no_grad():
+            theirs = dec(
+                input_ids=torch.tensor(ids),
+                encoder_hidden_states=torch.tensor(enc),
+            ).last_hidden_state.numpy()
+        hidden, _ = model(
+            params, jnp.asarray(ids), encoder_hidden_states=jnp.asarray(enc), training=False,
+        )
+        # compare pre-lm_head hidden: project back via lm_head pinv? simpler:
+        # run our forward and theirs through the SAME lm_head
+        ours_logits = np.asarray(hidden)
+        theirs_logits = theirs @ np.asarray(params["lm_head"])
+        np.testing.assert_allclose(ours_logits, theirs_logits, atol=2e-4, rtol=1e-3)
+
+    def test_neck_matches_torch_convs(self):
+        cfg = _cfg()
+        model = NemotronParseForConditionalGeneration(cfg, _fp32_backend())
+        dec = _hf_decoder(cfg)
+        params, sd = _load_from_hf_decoder(model, dec)
+
+        rng = np.random.RandomState(1)
+        h, w = 2, 8
+        feats = rng.randn(2, h * w, cfg.radio_feature_dim).astype(np.float32)
+        summary = rng.randn(2, cfg.radio_summary_dim).astype(np.float32)
+
+        # torch reference (RadioWithNeck math, reference model.py:387-407)
+        t = torch.tensor
+        x = torch.nn.functional.conv1d(t(feats).permute(0, 2, 1), t(sd["encoder.conv1.weight"]),
+                                       t(sd["encoder.conv1.bias"])).permute(0, 2, 1)
+        x = torch.nn.functional.layer_norm(x, (cfg.neck_dim,), eps=1e-6)
+        x = x.permute(0, 2, 1).reshape(2, cfg.neck_dim, h, w)
+        x = torch.nn.functional.conv2d(x, t(sd["encoder.conv2.weight"]), stride=(1, 4))
+        x = x.flatten(2).permute(0, 2, 1)
+        x = torch.nn.functional.layer_norm(x, (cfg.neck_dim,), eps=1e-6)
+        s = t(summary) @ t(sd["encoder.sum_proj.weight"]).T + t(sd["encoder.sum_proj.bias"])
+        s = torch.nn.functional.layer_norm(s, (cfg.neck_dim,), eps=1e-6)
+        ref = torch.cat([x, s[:, None]], dim=1).numpy()
+
+        ours = np.asarray(model.encode(params, jnp.asarray(feats), jnp.asarray(summary), (h, w)))
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_end_to_end_and_grads(self):
+        cfg = _cfg()
+        model = NemotronParseForConditionalGeneration(cfg, _fp32_backend())
+        params = model.init(jax.random.key(0), jnp.float32)
+        rng = np.random.RandomState(2)
+        labels = rng.randint(0, 128, (2, 10))
+        dec_in = jnp.asarray(cfg.shift_tokens_right(labels))
+        feats = jnp.asarray(rng.randn(2, 16, cfg.radio_feature_dim).astype(np.float32))
+        summary = jnp.asarray(rng.randn(2, cfg.radio_summary_dim).astype(np.float32))
+
+        def loss_fn(p):
+            logits, _ = model(p, dec_in, encoder_features=feats, summary=summary,
+                              grid_hw=(2, 8), training=True)
+            ll = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            return -jnp.take_along_axis(ll, jnp.asarray(labels)[..., None], -1).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        assert np.isfinite(float(loss))
+        assert all(np.all(np.isfinite(np.asarray(g))) for g in jax.tree.leaves(grads))
+        assert np.abs(np.asarray(grads["neck"]["conv2_w"])).max() > 0
+
+    def test_adapter_roundtrip(self):
+        cfg = _cfg()
+        model = NemotronParseForConditionalGeneration(cfg, _fp32_backend())
+        params = model.init(jax.random.key(1), jnp.float32)
+        adapter = model.state_dict_adapter()
+        hf = adapter.to_hf(params)
+        assert "decoder.layers.0.encoder_attn.k_proj.weight" in hf
+        assert "encoder.conv2.weight" in hf
+        assert hf["encoder.conv2.weight"].shape == (cfg.neck_dim, cfg.neck_dim, 1, 4)
+        back = adapter.from_hf(hf)
+        flat_a, flat_b = jax.tree.leaves(params), jax.tree.leaves(back)
+        assert len(flat_a) == len(flat_b)
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_causality(self):
+        cfg = _cfg()
+        model = NemotronParseForConditionalGeneration(cfg, _fp32_backend())
+        params = model.init(jax.random.key(2), jnp.float32)
+        rng = np.random.RandomState(3)
+        ids = jnp.asarray(rng.randint(0, 128, (1, 12)))
+        enc = jnp.asarray(rng.randn(1, 5, cfg.d_model).astype(np.float32))
+        a, _ = model(params, ids, encoder_hidden_states=enc, training=False)
+        ids2 = ids.at[0, 8:].set((ids[0, 8:] + 1) % 128)
+        b, _ = model(params, ids2, encoder_hidden_states=enc, training=False)
+        np.testing.assert_allclose(np.asarray(a[0, :8]), np.asarray(b[0, :8]), atol=1e-5)
