@@ -1,0 +1,6 @@
+from automodel_tpu.models.qwen3_omni_moe.model import (
+    Qwen3OmniMoeThinkerConfig,
+    Qwen3OmniMoeThinkerForConditionalGeneration,
+)
+
+__all__ = ["Qwen3OmniMoeThinkerConfig", "Qwen3OmniMoeThinkerForConditionalGeneration"]

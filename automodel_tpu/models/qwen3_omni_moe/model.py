@@ -1,0 +1,148 @@
+"""Qwen3-Omni-MoE thinker — TPU-native (reference models/qwen3_omni_moe/model.py:177;
+the reference swaps only the thinker text stack and keeps HF towers — here the
+audio tower (models/audio/qwen3_omni_audio.py) and vision tower
+(models/vision/qwen3_vl_vit.py — identical math to the omni tower, only merger key
+names differ) are native too).
+
+Composition = Qwen3-VL-MoE (deepstack vision + interleaved mrope text) plus audio:
+encoded audio tokens replace the embedding rows at ``audio_token_id`` positions.
+Audio tokens take text-like (all-axes-equal) mrope positions, which the inherited
+``get_mrope_positions`` walk already produces for non-vision tokens
+(HF get_rope_index audio branch, modeling_qwen3_omni_moe.py:333-344).
+
+Multi-frame video spans use omni timestamp semantics not yet supported here —
+``get_mrope_positions`` rejects them loudly."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from automodel_tpu.models.audio.qwen3_omni_audio import (
+    Qwen3OmniAudioConfig,
+    audio_forward,
+    audio_logical_axes,
+    init_audio_params,
+    prepare_audio_inputs,
+)
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.qwen3_vl_moe.model import (
+    Qwen3VLMoeConfig,
+    Qwen3VLMoeForConditionalGeneration,
+)
+
+__all__ = ["Qwen3OmniMoeThinkerConfig", "Qwen3OmniMoeThinkerForConditionalGeneration"]
+
+
+@dataclasses.dataclass
+class Qwen3OmniMoeThinkerConfig(Qwen3VLMoeConfig):
+    audio: Qwen3OmniAudioConfig = None
+    audio_token_id: int = 151646
+
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "Qwen3OmniMoeThinkerConfig":
+        hf = hf.get("thinker_config", hf)
+        base = Qwen3VLMoeConfig.from_hf(hf)
+        return cls(
+            **{f.name: getattr(base, f.name) for f in dataclasses.fields(Qwen3VLMoeConfig)},
+            audio=Qwen3OmniAudioConfig.from_hf(hf.get("audio_config", {})),
+            audio_token_id=hf.get("audio_token_id", 151646),
+        )
+
+
+class Qwen3OmniMoeThinkerForConditionalGeneration(Qwen3VLMoeForConditionalGeneration):
+    config_class = Qwen3OmniMoeThinkerConfig
+    hf_architectures = (
+        "Qwen3OmniMoeThinkerForConditionalGeneration",
+        "Qwen3OmniMoeForConditionalGeneration",
+    )
+
+    # ---- params ----
+
+    def init(self, key, dtype=jnp.float32):
+        import jax
+
+        k_base, k_audio = jax.random.split(jax.random.fold_in(key, 0))
+        params = super().init(k_base, dtype)
+        params["audio"] = init_audio_params(self.config.audio, k_audio, dtype)
+        return params
+
+    def logical_axes(self):
+        axes = super().logical_axes()
+        axes["audio"] = audio_logical_axes(self.config.audio)
+        return axes
+
+    # ---- host-side helpers ----
+
+    def prepare_audio_inputs(self, features) -> dict[str, np.ndarray]:
+        return prepare_audio_inputs(features, self.config.audio)
+
+    def audio_token_coords(self, input_ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        b, s = np.where(input_ids == self.config.audio_token_id)
+        return b.astype(np.int32), s.astype(np.int32)
+
+    def get_mrope_positions(self, input_ids, grid_thw, attention_mask=None, video_grid_thw=None):
+        if video_grid_thw is not None and (np.asarray(video_grid_thw)[:, 0] > 1).any():
+            # omni derives video t-indices from timestamps (position_id_per_seconds /
+            # second-per-grid interleaving, HF get_rope_index) — not yet implemented
+            raise NotImplementedError(
+                "Qwen3-Omni multi-frame video position ids (timestamp mrope) are not supported"
+            )
+        return super().get_mrope_positions(
+            input_ids, grid_thw, attention_mask=attention_mask, video_grid_thw=video_grid_thw
+        )
+
+    # ---- forward ----
+
+    def __call__(
+        self,
+        params,
+        input_ids,
+        pixel_values=None,
+        vision_inputs=None,
+        visual_coords=None,
+        audio_chunks=None,  # (N, mel, chunk_len)
+        audio_inputs=None,  # dict from prepare_audio_inputs
+        audio_coords=None,  # (b_idx, s_idx) of audio placeholder tokens
+        positions3=None,
+        segment_ids=None,
+        token_mask=None,
+        rules=None,
+        return_hidden=False,
+        training=True,
+    ):
+        extra_embeds = None
+        if audio_chunks is not None:
+            ai = audio_inputs
+            audio_tokens = audio_forward(
+                self.config.audio, self.backend, params["audio"],
+                audio_chunks, ai["gather_idx"], ai["segment_ids"],
+            )
+            extra_embeds = (audio_coords, audio_tokens)
+        return super().__call__(
+            params, input_ids,
+            pixel_values=pixel_values, vision_inputs=vision_inputs,
+            visual_coords=visual_coords, positions3=positions3,
+            segment_ids=segment_ids, token_mask=token_mask, rules=rules,
+            return_hidden=return_hidden, training=training,
+            extra_embeds=extra_embeds,
+        )
+
+    # ---- interop ----
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.qwen3_omni_moe.state_dict_adapter import (
+            Qwen3OmniMoeThinkerStateDictAdapter,
+        )
+
+        return Qwen3OmniMoeThinkerStateDictAdapter(self.config)
+
+    @classmethod
+    def from_config(cls, config, backend: BackendConfig | None = None):
+        if isinstance(config, dict):
+            config = Qwen3OmniMoeThinkerConfig.from_hf(config)
+        return cls(config, backend)

@@ -1,0 +1,124 @@
+"""Qwen3-Omni-MoE thinker HF mapping: text under ``model.*`` with per-expert
+tensors (qwen3-moe style, unlike qwen3-vl-moe's packed experts), vision under
+``visual.*`` with ln_q/mlp.{0,2} merger keys, audio under ``audio_tower.*``."""
+
+from __future__ import annotations
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.llama.state_dict_adapter import _o_in, _o_out, _proj_in, _proj_out, _t
+from automodel_tpu.models.qwen3_moe.state_dict_adapter import moe_expert_entries
+from automodel_tpu.models.qwen3_vl_moe.state_dict_adapter import (
+    _conv3d_in,
+    _conv3d_out_factory,
+)
+
+__all__ = ["Qwen3OmniMoeThinkerStateDictAdapter"]
+
+
+class Qwen3OmniMoeThinkerStateDictAdapter(MappingAdapter):
+    def __init__(self, cfg):
+        t, v, a = cfg.text, cfg.vision, cfg.audio
+        n, kvh, hd = t.num_attention_heads, t.num_key_value_heads, t.head_dim
+        lm = "model.layers.{i}"
+        vb = "visual.blocks.{i}"
+        ab = "audio_tower.layers.{i}"
+
+        entries = [
+            Entry("model.embed_tokens.weight", "embed"),
+            Entry("model.norm.weight", "final_norm"),
+            Entry(f"{lm}.input_layernorm.weight", "moe_layers.attn_norm"),
+            Entry(f"{lm}.post_attention_layernorm.weight", "moe_layers.mlp_norm"),
+            Entry(f"{lm}.self_attn.q_proj.weight", "moe_layers.wq", _proj_in(n, hd), _proj_out(n, hd)),
+            Entry(f"{lm}.self_attn.k_proj.weight", "moe_layers.wk", _proj_in(kvh, hd), _proj_out(kvh, hd)),
+            Entry(f"{lm}.self_attn.v_proj.weight", "moe_layers.wv", _proj_in(kvh, hd), _proj_out(kvh, hd)),
+            Entry(f"{lm}.self_attn.o_proj.weight", "moe_layers.wo", _o_in(n, hd), _o_out(n, hd)),
+            Entry(f"{lm}.self_attn.q_norm.weight", "moe_layers.q_norm"),
+            Entry(f"{lm}.self_attn.k_norm.weight", "moe_layers.k_norm"),
+            Entry(f"{lm}.mlp.gate.weight", "moe_layers.moe.gate.weight"),
+            *moe_expert_entries(f"{lm}.mlp", "moe_layers.moe"),
+        ]
+        if not t.tie_word_embeddings:
+            entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
+
+        # vision tower (same tensors as qwen3-vl-moe; merger key names differ)
+        vis_range = (0, v.depth)
+        entries += [
+            Entry("visual.patch_embed.proj.weight", "visual.patch_w",
+                  _conv3d_in, _conv3d_out_factory(v)),
+            Entry("visual.patch_embed.proj.bias", "visual.b_patch"),
+            Entry("visual.pos_embed.weight", "visual.pos_embed"),
+            Entry(f"{vb}.norm1.weight", "visual.blocks.ln1_w", layer_range=vis_range),
+            Entry(f"{vb}.norm1.bias", "visual.blocks.b_ln1", layer_range=vis_range),
+            Entry(f"{vb}.norm2.weight", "visual.blocks.ln2_w", layer_range=vis_range),
+            Entry(f"{vb}.norm2.bias", "visual.blocks.b_ln2", layer_range=vis_range),
+            Entry(f"{vb}.attn.qkv.weight", "visual.blocks.qkv_w", _t, _t, layer_range=vis_range),
+            Entry(f"{vb}.attn.qkv.bias", "visual.blocks.b_qkv", layer_range=vis_range),
+            Entry(f"{vb}.attn.proj.weight", "visual.blocks.proj_w", _t, _t, layer_range=vis_range),
+            Entry(f"{vb}.attn.proj.bias", "visual.blocks.b_proj", layer_range=vis_range),
+            Entry(f"{vb}.mlp.linear_fc1.weight", "visual.blocks.fc1_w", _t, _t, layer_range=vis_range),
+            Entry(f"{vb}.mlp.linear_fc1.bias", "visual.blocks.b_fc1", layer_range=vis_range),
+            Entry(f"{vb}.mlp.linear_fc2.weight", "visual.blocks.fc2_w", _t, _t, layer_range=vis_range),
+            Entry(f"{vb}.mlp.linear_fc2.bias", "visual.blocks.b_fc2", layer_range=vis_range),
+            Entry("visual.merger.ln_q.weight", "visual.merger.norm_w"),
+            Entry("visual.merger.ln_q.bias", "visual.merger.b_norm"),
+            Entry("visual.merger.mlp.0.weight", "visual.merger.fc1_w", _t, _t),
+            Entry("visual.merger.mlp.0.bias", "visual.merger.b_fc1"),
+            Entry("visual.merger.mlp.2.weight", "visual.merger.fc2_w", _t, _t),
+            Entry("visual.merger.mlp.2.bias", "visual.merger.b_fc2"),
+        ]
+        ds_range = (0, len(v.deepstack_visual_indexes))
+        dsm = "visual.merger_list.{i}"
+        entries += [
+            Entry(f"{dsm}.ln_q.weight", "visual.ds_mergers.norm_w", layer_range=ds_range),
+            Entry(f"{dsm}.ln_q.bias", "visual.ds_mergers.b_norm", layer_range=ds_range),
+            Entry(f"{dsm}.mlp.0.weight", "visual.ds_mergers.fc1_w", _t, _t, layer_range=ds_range),
+            Entry(f"{dsm}.mlp.0.bias", "visual.ds_mergers.b_fc1", layer_range=ds_range),
+            Entry(f"{dsm}.mlp.2.weight", "visual.ds_mergers.fc2_w", _t, _t, layer_range=ds_range),
+            Entry(f"{dsm}.mlp.2.bias", "visual.ds_mergers.b_fc2", layer_range=ds_range),
+        ]
+
+        # audio tower
+        aud_range = (0, a.encoder_layers)
+        entries += [
+            Entry("audio_tower.conv2d1.weight", "audio.conv1_w"),
+            Entry("audio_tower.conv2d1.bias", "audio.b_conv1"),
+            Entry("audio_tower.conv2d2.weight", "audio.conv2_w"),
+            Entry("audio_tower.conv2d2.bias", "audio.b_conv2"),
+            Entry("audio_tower.conv2d3.weight", "audio.conv3_w"),
+            Entry("audio_tower.conv2d3.bias", "audio.b_conv3"),
+            Entry("audio_tower.conv_out.weight", "audio.conv_out_w", _t, _t),
+            Entry(f"{ab}.self_attn_layer_norm.weight", "audio.layers.attn_ln_w", layer_range=aud_range),
+            Entry(f"{ab}.self_attn_layer_norm.bias", "audio.layers.b_attn_ln", layer_range=aud_range),
+            Entry(f"{ab}.self_attn.q_proj.weight", "audio.layers.wq", _t, _t, layer_range=aud_range),
+            Entry(f"{ab}.self_attn.q_proj.bias", "audio.layers.b_q", layer_range=aud_range),
+            Entry(f"{ab}.self_attn.k_proj.weight", "audio.layers.wk", _t, _t, layer_range=aud_range),
+            Entry(f"{ab}.self_attn.k_proj.bias", "audio.layers.b_k", layer_range=aud_range),
+            Entry(f"{ab}.self_attn.v_proj.weight", "audio.layers.wv", _t, _t, layer_range=aud_range),
+            Entry(f"{ab}.self_attn.v_proj.bias", "audio.layers.b_v", layer_range=aud_range),
+            Entry(f"{ab}.self_attn.out_proj.weight", "audio.layers.wo", _t, _t, layer_range=aud_range),
+            Entry(f"{ab}.self_attn.out_proj.bias", "audio.layers.b_o", layer_range=aud_range),
+            Entry(f"{ab}.final_layer_norm.weight", "audio.layers.final_ln_w", layer_range=aud_range),
+            Entry(f"{ab}.final_layer_norm.bias", "audio.layers.b_final_ln", layer_range=aud_range),
+            Entry(f"{ab}.fc1.weight", "audio.layers.fc1", _t, _t, layer_range=aud_range),
+            Entry(f"{ab}.fc1.bias", "audio.layers.b_fc1", layer_range=aud_range),
+            Entry(f"{ab}.fc2.weight", "audio.layers.fc2", _t, _t, layer_range=aud_range),
+            Entry(f"{ab}.fc2.bias", "audio.layers.b_fc2", layer_range=aud_range),
+            Entry("audio_tower.ln_post.weight", "audio.post_ln_w"),
+            Entry("audio_tower.ln_post.bias", "audio.b_post_ln"),
+            Entry("audio_tower.proj1.weight", "audio.proj1_w", _t, _t),
+            Entry("audio_tower.proj1.bias", "audio.b_proj1"),
+            Entry("audio_tower.proj2.weight", "audio.proj2_w", _t, _t),
+            Entry("audio_tower.proj2.bias", "audio.b_proj2"),
+        ]
+        super().__init__(entries, t.num_hidden_layers, num_experts=t.moe.n_routed_experts)
+
+    def from_hf(self, tensors, dtype=None):
+        # full Qwen3-Omni checkpoints prefix thinker weights with "thinker." and also
+        # carry talker./code2wav. weights; standalone thinker checkpoints do not
+        if any(k.startswith("thinker.") for k in tensors):
+            tensors = {
+                k[len("thinker.") :]: v
+                for k, v in tensors.items()
+                if k.startswith("thinker.")
+            }
+        return super().from_hf(tensors, dtype=dtype)

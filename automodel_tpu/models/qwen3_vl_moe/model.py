@@ -209,6 +209,7 @@ class Qwen3VLMoeForConditionalGeneration:
         vision_inputs=None,  # dict from prepare_vision_inputs (jnp arrays ok)
         visual_coords=None,  # (b_idx (Tm,), s_idx (Tm,)) from visual_token_coords
         positions3=None,  # (3, B, S) from get_mrope_positions; None = text-only arange
+        extra_embeds=None,  # ((b_idx, s_idx), tokens): extra modality scatter (omni audio)
         segment_ids=None,
         token_mask=None,
         rules=None,
@@ -236,6 +237,9 @@ class Qwen3VLMoeForConditionalGeneration:
             )
             b_idx, s_idx = visual_coords
             h = h.at[b_idx, s_idx].set(vis.astype(dtype))
+        if extra_embeds is not None:
+            (eb_idx, es_idx), toks = extra_embeds
+            h = h.at[eb_idx, es_idx].set(toks.astype(dtype))
 
         h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
         emit_aux = cfg.moe.aux_loss_coeff > 0 and training and not backend.fake_balanced_gate

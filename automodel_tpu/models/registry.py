@@ -38,6 +38,8 @@ MODEL_REGISTRY: dict[str, str] = {
     "KimiVLForConditionalGeneration": "automodel_tpu.models.kimivl.model:KimiVLForConditionalGeneration",
     "KimiK25VLForConditionalGeneration": "automodel_tpu.models.kimi_k25_vl.model:KimiK25VLForConditionalGeneration",
     "NemotronParseForConditionalGeneration": "automodel_tpu.models.nemotron_parse.model:NemotronParseForConditionalGeneration",
+    "Qwen3OmniMoeThinkerForConditionalGeneration": "automodel_tpu.models.qwen3_omni_moe.model:Qwen3OmniMoeThinkerForConditionalGeneration",
+    "Qwen3OmniMoeForConditionalGeneration": "automodel_tpu.models.qwen3_omni_moe.model:Qwen3OmniMoeThinkerForConditionalGeneration",
     "LlamaBidirectionalModel": "automodel_tpu.models.llama_bidirectional.model:LlamaBidirectionalModel",
 }
 

@@ -1,0 +1,122 @@
+"""Qwen3-Omni-MoE thinker: full logits parity vs HF with audio + image inputs."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.auto import AutoModelForImageTextToText
+from automodel_tpu.models.common.backend import BackendConfig
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from transformers.models.qwen3_omni_moe.configuration_qwen3_omni_moe import (
+    Qwen3OmniMoeThinkerConfig as HFThinkerConfig,
+)
+from transformers.models.qwen3_omni_moe.modeling_qwen3_omni_moe import (
+    Qwen3OmniMoeThinkerForConditionalGeneration as HFThinker,
+)
+
+AUDIO, IMG, VSTART = 120, 121, 123
+
+
+def tiny_cfg():
+    return HFThinkerConfig(
+        audio_config=dict(
+            d_model=32, encoder_layers=2, encoder_attention_heads=4, encoder_ffn_dim=48,
+            num_mel_bins=32, n_window=8, n_window_infer=32, downsample_hidden_size=16,
+            output_dim=64, conv_chunksize=500,
+        ),
+        vision_config=dict(
+            depth=3, hidden_size=32, intermediate_size=48, num_heads=4, patch_size=4,
+            spatial_merge_size=2, temporal_patch_size=2, out_hidden_size=64,
+            num_position_embeddings=16, deepstack_visual_indexes=[0, 2], in_channels=3,
+        ),
+        text_config=dict(
+            vocab_size=128, hidden_size=64, intermediate_size=96, moe_intermediate_size=32,
+            num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+            num_experts=8, num_experts_per_tok=2, max_position_embeddings=128,
+            rope_scaling={"rope_type": "default", "mrope_section": [4, 2, 2], "mrope_interleaved": True},
+        ),
+        audio_token_id=AUDIO, image_token_id=IMG, video_token_id=122,
+        vision_start_token_id=VSTART, audio_start_token_id=124,
+    )
+
+
+def _fp32_backend():
+    return BackendConfig(dtype="float32", remat_policy="full")
+
+
+def _build(tmp_path, hf):
+    d = str(tmp_path / "hf")
+    hf.save_pretrained(d, safe_serialization=True)
+    return AutoModelForImageTextToText.from_pretrained(d, dtype=jnp.float32, backend=_fp32_backend())
+
+
+class TestOmniThinkerParity:
+    def test_logits_match_hf_audio_and_image(self, tmp_path):
+        torch.manual_seed(0)
+        hf = HFThinker(tiny_cfg()).eval()
+        model, params = _build(tmp_path, hf)
+
+        rng = np.random.RandomState(0)
+        seq = 40
+        ids = rng.randint(0, 100, (1, seq))
+        # audio span: 23 mel frames -> _get_feat_extract_output_lengths = 3 tokens
+        audio_T = 23
+        n_audio_tok = 3
+        ids[0, 2 : 2 + n_audio_tok] = AUDIO
+        # image span: (1, 8, 8) grid -> 16 merged tokens
+        ids[0, 10] = VSTART
+        ids[0, 11:27] = IMG
+        grid = np.array([[1, 8, 8]])
+        pixels = rng.randn(64, 3 * 2 * 4 * 4).astype(np.float32)
+        mel = rng.randn(32, audio_T).astype(np.float32)
+
+        with torch.no_grad():
+            theirs = hf(
+                input_ids=torch.tensor(ids),
+                attention_mask=torch.ones_like(torch.tensor(ids)),
+                input_features=torch.tensor(mel)[None],
+                feature_attention_mask=torch.ones(1, audio_T, dtype=torch.long),
+                pixel_values=torch.tensor(pixels),
+                image_grid_thw=torch.tensor(grid),
+            ).logits.float().numpy()
+
+        vin = {k: jnp.asarray(v) for k, v in model.prepare_vision_inputs(grid).items()}
+        vcoords = tuple(jnp.asarray(c) for c in model.visual_token_coords(ids))
+        ain = model.prepare_audio_inputs([mel])
+        acoords = tuple(jnp.asarray(c) for c in model.audio_token_coords(ids))
+        pos3 = jnp.asarray(model.get_mrope_positions(ids, grid))
+        ours, _ = model(
+            params, jnp.asarray(ids),
+            pixel_values=jnp.asarray(pixels), vision_inputs=vin, visual_coords=vcoords,
+            audio_chunks=jnp.asarray(ain["chunks"]),
+            audio_inputs={k: jnp.asarray(v) for k, v in ain.items()},
+            audio_coords=acoords, positions3=pos3, training=False,
+        )
+        np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-3, rtol=1e-3)
+
+    def test_rope_index_matches_hf_with_audio(self, tmp_path):
+        torch.manual_seed(1)
+        hf = HFThinker(tiny_cfg())
+        model, _ = _build(tmp_path, hf)
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 100, (1, 20))
+        ids[0, 2:5] = AUDIO  # 3 audio tokens (text-like positions)
+        theirs, _ = hf.get_rope_index(
+            torch.tensor(ids), attention_mask=torch.ones_like(torch.tensor(ids)),
+            audio_seqlens=torch.tensor([23]),
+        )
+        ours = model.get_mrope_positions(ids, None)
+        np.testing.assert_array_equal(ours, theirs.numpy())
+
+    def test_adapter_key_parity(self, tmp_path):
+        torch.manual_seed(2)
+        hf = HFThinker(tiny_cfg())
+        model, params = _build(tmp_path, hf)
+        hf_dict = model.state_dict_adapter().to_hf(params)
+        theirs = {k for k in hf.state_dict() if "rotary" not in k}
+        assert set(hf_dict) == theirs
