@@ -70,13 +70,23 @@ class Qwen3OmniAudioConfig:
         return f
 
 
-def audio_output_lengths(input_lengths: np.ndarray) -> np.ndarray:
-    """Per-audio encoder output frame count (HF _get_feat_extract_output_lengths,
-    modeling_qwen3_omni_moe.py:79-87; assumes the default 100-frame chunking)."""
+def audio_output_lengths(input_lengths: np.ndarray, chunk_len: int = 100) -> np.ndarray:
+    """Per-audio encoder output frame count: full chunks contribute
+    conv_out(chunk_len) frames, the tail contributes conv_out(tail). Equals HF's
+    _get_feat_extract_output_lengths (modeling_qwen3_omni_moe.py:79-87) for the
+    shipped 100-frame chunking; computed from the actual conv math here so it stays
+    consistent with prepare_audio_inputs for any chunk_len."""
     input_lengths = np.asarray(input_lengths)
-    leave = input_lengths % 100
-    feat = (leave - 1) // 2 + 1
-    return ((feat - 1) // 2 + 1 - 1) // 2 + 1 + (input_lengths // 100) * 13
+    tail = input_lengths % chunk_len
+
+    # exact 3x (k=3, s=2, p=1) halving: out(n) = ceil(ceil(ceil(n/2)/2)/2) for n>=1
+    def _out3(n):
+        for _ in range(3):
+            n = (n + 1) // 2
+        return n
+
+    tail_out = np.where(tail > 0, _out3(tail), 0)
+    return (input_lengths // chunk_len) * _out3(chunk_len) + tail_out
 
 
 def _conv_out_len(n: int) -> int:
@@ -149,6 +159,11 @@ def prepare_audio_inputs(
     precompute the valid-frame gather and windowed-attention segment ids (HF
     cu_seqlens construction, modeling_qwen3_omni_moe.py:714-759)."""
     C = cfg.chunk_len
+    if cfg.n_window_infer % C:
+        raise ValueError(
+            f"n_window_infer ({cfg.n_window_infer}) must be a multiple of the "
+            f"chunk length 2*n_window ({C})"
+        )
     chunks, gather, seg = [], [], []
     chunk_base = 0
     seg_id = 0

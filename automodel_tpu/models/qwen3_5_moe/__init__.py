@@ -1,0 +1,3 @@
+from automodel_tpu.models.qwen3_5_moe.model import Qwen3_5MoeConfig, Qwen3_5MoeForCausalLM
+
+__all__ = ["Qwen3_5MoeConfig", "Qwen3_5MoeForCausalLM"]

@@ -1,0 +1,49 @@
+"""Qwen3.5-MoE (text stack) — TPU-native (reference models/qwen3_5_moe/model.py:359).
+
+Qwen3-Next-style hybrid decoder (gated DeltaNet linear attention + gated full
+attention + MoE) whose HF checkpoint stores the DeltaNet projections *separately*
+(``in_proj_qkv`` / ``in_proj_z`` / ``in_proj_b`` / ``in_proj_a``, reference
+model.py:71-99) and the experts packed as ``gate_up_proj (E, 2I, D)`` /
+``down_proj (E, D, I)`` (reference state_dict_adapter.py:19-25). Compute reuses the
+qwen3_next machinery unchanged — the adapter re-interleaves the separate projections
+into the fused per-key-head layout at load time.
+
+Like the reference (which gates this family on a transformers build that ships
+``qwen3_5_moe``), only the text stack is supported here; the VL tower keys under
+``model.visual.*`` are not yet mapped."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from automodel_tpu.models.qwen3_next.model import Qwen3NextConfig, Qwen3NextForCausalLM
+
+__all__ = ["Qwen3_5MoeConfig", "Qwen3_5MoeForCausalLM"]
+
+
+@dataclasses.dataclass
+class Qwen3_5MoeConfig(Qwen3NextConfig):
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "Qwen3_5MoeConfig":
+        t = hf.get("text_config", hf)
+        base = Qwen3NextConfig.from_hf(t)
+        return cls(**dataclasses.asdict(base) | {"moe": base.moe})
+
+
+class Qwen3_5MoeForCausalLM(Qwen3NextForCausalLM):
+    config_class = Qwen3_5MoeConfig
+    hf_architectures = ("Qwen3_5MoeForConditionalGeneration", "Qwen3_5MoeForCausalLM")
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.qwen3_5_moe.state_dict_adapter import (
+            Qwen3_5MoeStateDictAdapter,
+        )
+
+        return Qwen3_5MoeStateDictAdapter(self.config)
+
+    @classmethod
+    def from_config(cls, config, backend=None):
+        if isinstance(config, dict):
+            config = Qwen3_5MoeConfig.from_hf(config)
+        return cls(config, backend)

@@ -1,0 +1,162 @@
+"""Qwen3.5-MoE HF mapping (reference models/qwen3_5_moe/state_dict_adapter.py).
+
+Text keys under ``model.language_model.*``; DeltaNet projections separate
+(in_proj_qkv/z/b/a — flat [q|k|v] head-major rows) re-interleaved into the fused
+per-key-head [q|k|v·r|z·r] layout qwen3_next computes with; experts packed
+(gate_up_proj (E, 2I, D), down_proj (E, D, I)) — transpose-only."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.llama.state_dict_adapter import _o_in, _o_out, _proj_in, _proj_out, _t
+
+__all__ = ["Qwen3_5MoeStateDictAdapter"]
+
+
+def _fused_qkvz_in_factory(cfg):
+    Hk, dk = cfg.linear_num_key_heads, cfg.linear_key_head_dim
+    Hv, dv = cfg.linear_num_value_heads, cfg.linear_value_head_dim
+    r = Hv // Hk
+
+    def f(qkv: np.ndarray, z: np.ndarray) -> np.ndarray:
+        D = qkv.shape[1]
+        q = qkv[: Hk * dk].reshape(Hk, dk, D)
+        k = qkv[Hk * dk : 2 * Hk * dk].reshape(Hk, dk, D)
+        v = qkv[2 * Hk * dk :].reshape(Hk, r * dv, D)
+        zz = z.reshape(Hk, r * dv, D)
+        out = np.concatenate([q, k, v, zz], axis=1)  # (Hk, M, D)
+        return np.ascontiguousarray(out.transpose(2, 0, 1))
+
+    return f
+
+
+def _fused_qkvz_out_factory(cfg):
+    Hk, dk = cfg.linear_num_key_heads, cfg.linear_key_head_dim
+    Hv, dv = cfg.linear_num_value_heads, cfg.linear_value_head_dim
+    r = Hv // Hk
+
+    def f(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        hkm = w.transpose(1, 2, 0)  # (Hk, M, D)
+        q = hkm[:, :dk]
+        k = hkm[:, dk : 2 * dk]
+        v = hkm[:, 2 * dk : 2 * dk + r * dv]
+        z = hkm[:, 2 * dk + r * dv :]
+        D = w.shape[0]
+        qkv = np.concatenate([q.reshape(-1, D), k.reshape(-1, D), v.reshape(-1, D)], axis=0)
+        return np.ascontiguousarray(qkv), np.ascontiguousarray(z.reshape(-1, D))
+
+    return f
+
+
+def _fused_ba_in_factory(cfg):
+    Hk = cfg.linear_num_key_heads
+    r = cfg.linear_num_value_heads // Hk
+
+    def f(b: np.ndarray, a: np.ndarray) -> np.ndarray:
+        D = b.shape[1]
+        out = np.concatenate([b.reshape(Hk, r, D), a.reshape(Hk, r, D)], axis=1)
+        return np.ascontiguousarray(out.transpose(2, 0, 1))
+
+    return f
+
+
+def _fused_ba_out_factory(cfg):
+    Hk = cfg.linear_num_key_heads
+    r = cfg.linear_num_value_heads // Hk
+
+    def f(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        hkm = w.transpose(1, 2, 0)  # (Hk, 2r, D)
+        D = w.shape[0]
+        return (
+            np.ascontiguousarray(hkm[:, :r].reshape(-1, D)),
+            np.ascontiguousarray(hkm[:, r:].reshape(-1, D)),
+        )
+
+    return f
+
+
+def _packed_t(w: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(w.transpose(0, 2, 1))
+
+
+def _conv_in(w: np.ndarray) -> np.ndarray:
+    return w[:, 0, :]
+
+
+def _conv_out(w: np.ndarray) -> np.ndarray:
+    return w[:, None, :]
+
+
+class Qwen3_5MoeStateDictAdapter(MappingAdapter):
+    def __init__(self, cfg):
+        lin_idx, full_idx = cfg.linear_layer_indices, cfg.full_layer_indices
+        H, Hkv, dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        pre = "model.language_model.layers.{i}"
+
+        entries = [
+            Entry("model.language_model.embed_tokens.weight", "embed"),
+            Entry("model.language_model.norm.weight", "final_norm"),
+        ]
+        if not cfg.tie_word_embeddings:
+            entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
+
+        def stream(ours_prefix: str, idx) -> list[Entry]:
+            out = [
+                Entry(f"{pre}.input_layernorm.weight", f"{ours_prefix}.attn_norm", layer_indices=idx),
+                Entry(f"{pre}.post_attention_layernorm.weight", f"{ours_prefix}.mlp_norm", layer_indices=idx),
+                Entry(f"{pre}.mlp.gate.weight", f"{ours_prefix}.moe.gate.weight", layer_indices=idx),
+                Entry(f"{pre}.mlp.experts.gate_up_proj",
+                      f"{ours_prefix}.moe.experts.gate_up_proj", _packed_t, _packed_t, layer_indices=idx),
+                Entry(f"{pre}.mlp.experts.down_proj",
+                      f"{ours_prefix}.moe.experts.down_proj", _packed_t, _packed_t, layer_indices=idx),
+                Entry(f"{pre}.mlp.shared_expert.gate_proj.weight",
+                      f"{ours_prefix}.moe.shared_experts.w_gate", _t, _t, optional=True, layer_indices=idx),
+                Entry(f"{pre}.mlp.shared_expert.up_proj.weight",
+                      f"{ours_prefix}.moe.shared_experts.w_up", _t, _t, optional=True, layer_indices=idx),
+                Entry(f"{pre}.mlp.shared_expert.down_proj.weight",
+                      f"{ours_prefix}.moe.shared_experts.w_down", _t, _t, optional=True, layer_indices=idx),
+                Entry(f"{pre}.mlp.shared_expert_gate.weight",
+                      f"{ours_prefix}.moe.shared_expert_gate", _t, _t, optional=True, layer_indices=idx),
+            ]
+            return out
+
+        if lin_idx:
+            entries += stream("linear_layers", lin_idx)
+            entries += [
+                Entry((f"{pre}.linear_attn.in_proj_qkv.weight", f"{pre}.linear_attn.in_proj_z.weight"),
+                      "linear_layers.wqkvz",
+                      _fused_qkvz_in_factory(cfg), _fused_qkvz_out_factory(cfg), layer_indices=lin_idx),
+                Entry((f"{pre}.linear_attn.in_proj_b.weight", f"{pre}.linear_attn.in_proj_a.weight"),
+                      "linear_layers.wba",
+                      _fused_ba_in_factory(cfg), _fused_ba_out_factory(cfg), layer_indices=lin_idx),
+                Entry(f"{pre}.linear_attn.conv1d.weight", "linear_layers.conv_w",
+                      _conv_in, _conv_out, layer_indices=lin_idx),
+                Entry(f"{pre}.linear_attn.dt_bias", "linear_layers.dt_bias", layer_indices=lin_idx),
+                Entry(f"{pre}.linear_attn.A_log", "linear_layers.a_log",
+                      to_ours=lambda x: x.astype(np.float32), keep_dtype=True, layer_indices=lin_idx),
+                Entry(f"{pre}.linear_attn.norm.weight", "linear_layers.norm", layer_indices=lin_idx),
+                Entry(f"{pre}.linear_attn.out_proj.weight", "linear_layers.wo",
+                      _o_in(cfg.linear_num_value_heads, cfg.linear_value_head_dim),
+                      _o_out(cfg.linear_num_value_heads, cfg.linear_value_head_dim),
+                      layer_indices=lin_idx),
+            ]
+        if full_idx:
+            entries += stream("full_layers", full_idx)
+            from automodel_tpu.models.qwen3_next.state_dict_adapter import _fused_in, _fused_out
+
+            entries += [
+                Entry(f"{pre}.self_attn.q_proj.weight", "full_layers.wq",
+                      _fused_in(H), _fused_out, layer_indices=full_idx),
+                Entry(f"{pre}.self_attn.k_proj.weight", "full_layers.wk",
+                      _proj_in(Hkv, dh), _proj_out(Hkv, dh), layer_indices=full_idx),
+                Entry(f"{pre}.self_attn.v_proj.weight", "full_layers.wv",
+                      _proj_in(Hkv, dh), _proj_out(Hkv, dh), layer_indices=full_idx),
+                Entry(f"{pre}.self_attn.o_proj.weight", "full_layers.wo",
+                      _o_in(H, dh), _o_out(H, dh), layer_indices=full_idx),
+                Entry(f"{pre}.self_attn.q_norm.weight", "full_layers.q_norm", layer_indices=full_idx),
+                Entry(f"{pre}.self_attn.k_norm.weight", "full_layers.k_norm", layer_indices=full_idx),
+            ]
+
+        super().__init__(entries, cfg.num_hidden_layers, num_experts=cfg.moe.n_routed_experts)

@@ -7,10 +7,7 @@ from __future__ import annotations
 from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
 from automodel_tpu.models.llama.state_dict_adapter import _o_in, _o_out, _proj_in, _proj_out, _t
 from automodel_tpu.models.qwen3_moe.state_dict_adapter import moe_expert_entries
-from automodel_tpu.models.qwen3_vl_moe.state_dict_adapter import (
-    _conv3d_in,
-    _conv3d_out_factory,
-)
+from automodel_tpu.models.qwen3_vl_moe.state_dict_adapter import vision_entries
 
 __all__ = ["Qwen3OmniMoeThinkerStateDictAdapter"]
 
@@ -20,7 +17,6 @@ class Qwen3OmniMoeThinkerStateDictAdapter(MappingAdapter):
         t, v, a = cfg.text, cfg.vision, cfg.audio
         n, kvh, hd = t.num_attention_heads, t.num_key_value_heads, t.head_dim
         lm = "model.layers.{i}"
-        vb = "visual.blocks.{i}"
         ab = "audio_tower.layers.{i}"
 
         entries = [
@@ -40,42 +36,10 @@ class Qwen3OmniMoeThinkerStateDictAdapter(MappingAdapter):
         if not t.tie_word_embeddings:
             entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
 
-        # vision tower (same tensors as qwen3-vl-moe; merger key names differ)
-        vis_range = (0, v.depth)
-        entries += [
-            Entry("visual.patch_embed.proj.weight", "visual.patch_w",
-                  _conv3d_in, _conv3d_out_factory(v)),
-            Entry("visual.patch_embed.proj.bias", "visual.b_patch"),
-            Entry("visual.pos_embed.weight", "visual.pos_embed"),
-            Entry(f"{vb}.norm1.weight", "visual.blocks.ln1_w", layer_range=vis_range),
-            Entry(f"{vb}.norm1.bias", "visual.blocks.b_ln1", layer_range=vis_range),
-            Entry(f"{vb}.norm2.weight", "visual.blocks.ln2_w", layer_range=vis_range),
-            Entry(f"{vb}.norm2.bias", "visual.blocks.b_ln2", layer_range=vis_range),
-            Entry(f"{vb}.attn.qkv.weight", "visual.blocks.qkv_w", _t, _t, layer_range=vis_range),
-            Entry(f"{vb}.attn.qkv.bias", "visual.blocks.b_qkv", layer_range=vis_range),
-            Entry(f"{vb}.attn.proj.weight", "visual.blocks.proj_w", _t, _t, layer_range=vis_range),
-            Entry(f"{vb}.attn.proj.bias", "visual.blocks.b_proj", layer_range=vis_range),
-            Entry(f"{vb}.mlp.linear_fc1.weight", "visual.blocks.fc1_w", _t, _t, layer_range=vis_range),
-            Entry(f"{vb}.mlp.linear_fc1.bias", "visual.blocks.b_fc1", layer_range=vis_range),
-            Entry(f"{vb}.mlp.linear_fc2.weight", "visual.blocks.fc2_w", _t, _t, layer_range=vis_range),
-            Entry(f"{vb}.mlp.linear_fc2.bias", "visual.blocks.b_fc2", layer_range=vis_range),
-            Entry("visual.merger.ln_q.weight", "visual.merger.norm_w"),
-            Entry("visual.merger.ln_q.bias", "visual.merger.b_norm"),
-            Entry("visual.merger.mlp.0.weight", "visual.merger.fc1_w", _t, _t),
-            Entry("visual.merger.mlp.0.bias", "visual.merger.b_fc1"),
-            Entry("visual.merger.mlp.2.weight", "visual.merger.fc2_w", _t, _t),
-            Entry("visual.merger.mlp.2.bias", "visual.merger.b_fc2"),
-        ]
-        ds_range = (0, len(v.deepstack_visual_indexes))
-        dsm = "visual.merger_list.{i}"
-        entries += [
-            Entry(f"{dsm}.ln_q.weight", "visual.ds_mergers.norm_w", layer_range=ds_range),
-            Entry(f"{dsm}.ln_q.bias", "visual.ds_mergers.b_norm", layer_range=ds_range),
-            Entry(f"{dsm}.mlp.0.weight", "visual.ds_mergers.fc1_w", _t, _t, layer_range=ds_range),
-            Entry(f"{dsm}.mlp.0.bias", "visual.ds_mergers.b_fc1", layer_range=ds_range),
-            Entry(f"{dsm}.mlp.2.weight", "visual.ds_mergers.fc2_w", _t, _t, layer_range=ds_range),
-            Entry(f"{dsm}.mlp.2.bias", "visual.ds_mergers.b_fc2", layer_range=ds_range),
-        ]
+        # vision tower: same tensors as qwen3-vl-moe, different prefix/merger keys
+        entries += vision_entries(
+            v, prefix="visual", merger_norm="ln_q", merger_fc=("mlp.0", "mlp.2")
+        )
 
         # audio tower
         aud_range = (0, a.encoder_layers)

@@ -30,12 +30,64 @@ def _conv3d_out_factory(cfg_v):
     return f
 
 
+def vision_entries(
+    v,
+    prefix: str = "model.visual",
+    merger_norm: str = "norm",
+    merger_fc: "tuple[str, str]" = ("linear_fc1", "linear_fc2"),
+) -> list[Entry]:
+    """Qwen3-VL-family vision tower entries, shared with the omni adapter (which
+    differs only in key prefix and merger sub-key names)."""
+    vb = f"{prefix}.blocks.{{i}}"
+    vis_range = (0, v.depth)
+    entries = [
+        Entry(f"{prefix}.patch_embed.proj.weight", "visual.patch_w",
+              _conv3d_in, _conv3d_out_factory(v)),
+        Entry(f"{prefix}.patch_embed.proj.bias", "visual.b_patch"),
+        Entry(f"{prefix}.pos_embed.weight", "visual.pos_embed"),
+        Entry(f"{vb}.norm1.weight", "visual.blocks.ln1_w", layer_range=vis_range),
+        Entry(f"{vb}.norm1.bias", "visual.blocks.b_ln1", layer_range=vis_range),
+        Entry(f"{vb}.norm2.weight", "visual.blocks.ln2_w", layer_range=vis_range),
+        Entry(f"{vb}.norm2.bias", "visual.blocks.b_ln2", layer_range=vis_range),
+        Entry(f"{vb}.attn.qkv.weight", "visual.blocks.qkv_w", _t, _t, layer_range=vis_range),
+        Entry(f"{vb}.attn.qkv.bias", "visual.blocks.b_qkv", layer_range=vis_range),
+        Entry(f"{vb}.attn.proj.weight", "visual.blocks.proj_w", _t, _t, layer_range=vis_range),
+        Entry(f"{vb}.attn.proj.bias", "visual.blocks.b_proj", layer_range=vis_range),
+        Entry(f"{vb}.mlp.linear_fc1.weight", "visual.blocks.fc1_w", _t, _t, layer_range=vis_range),
+        Entry(f"{vb}.mlp.linear_fc1.bias", "visual.blocks.b_fc1", layer_range=vis_range),
+        Entry(f"{vb}.mlp.linear_fc2.weight", "visual.blocks.fc2_w", _t, _t, layer_range=vis_range),
+        Entry(f"{vb}.mlp.linear_fc2.bias", "visual.blocks.b_fc2", layer_range=vis_range),
+    ]
+    fc1, fc2 = merger_fc
+    for hf_part, ours in (("merger", "visual.merger"),):
+        entries += [
+            Entry(f"{prefix}.{hf_part}.{merger_norm}.weight", f"{ours}.norm_w"),
+            Entry(f"{prefix}.{hf_part}.{merger_norm}.bias", f"{ours}.b_norm"),
+            Entry(f"{prefix}.{hf_part}.{fc1}.weight", f"{ours}.fc1_w", _t, _t),
+            Entry(f"{prefix}.{hf_part}.{fc1}.bias", f"{ours}.b_fc1"),
+            Entry(f"{prefix}.{hf_part}.{fc2}.weight", f"{ours}.fc2_w", _t, _t),
+            Entry(f"{prefix}.{hf_part}.{fc2}.bias", f"{ours}.b_fc2"),
+        ]
+    n_ds = len(v.deepstack_visual_indexes)
+    ds_prefix = f"{prefix}.deepstack_merger_list" if merger_norm == "norm" else f"{prefix}.merger_list"
+    dsm = ds_prefix + ".{i}"
+    ds_range = (0, n_ds)
+    entries += [
+        Entry(f"{dsm}.{merger_norm}.weight", "visual.ds_mergers.norm_w", layer_range=ds_range),
+        Entry(f"{dsm}.{merger_norm}.bias", "visual.ds_mergers.b_norm", layer_range=ds_range),
+        Entry(f"{dsm}.{fc1}.weight", "visual.ds_mergers.fc1_w", _t, _t, layer_range=ds_range),
+        Entry(f"{dsm}.{fc1}.bias", "visual.ds_mergers.b_fc1", layer_range=ds_range),
+        Entry(f"{dsm}.{fc2}.weight", "visual.ds_mergers.fc2_w", _t, _t, layer_range=ds_range),
+        Entry(f"{dsm}.{fc2}.bias", "visual.ds_mergers.b_fc2", layer_range=ds_range),
+    ]
+    return entries
+
+
 class Qwen3VLMoeStateDictAdapter(MappingAdapter):
     def __init__(self, cfg):
         t, v = cfg.text, cfg.vision
         n, kvh, hd = t.num_attention_heads, t.num_key_value_heads, t.head_dim
         lm = "model.language_model.layers.{i}"
-        vb = "model.visual.blocks.{i}"
 
         entries = [
             Entry("model.language_model.embed_tokens.weight", "embed"),
@@ -53,47 +105,8 @@ class Qwen3VLMoeStateDictAdapter(MappingAdapter):
             # packed expert tensors map 1:1 (HF chunks gate|up exactly like ours)
             Entry(f"{lm}.mlp.experts.gate_up_proj", "moe_layers.moe.experts.gate_up_proj"),
             Entry(f"{lm}.mlp.experts.down_proj", "moe_layers.moe.experts.down_proj"),
-            # vision tower
-            Entry("model.visual.patch_embed.proj.weight", "visual.patch_w",
-                  _conv3d_in, _conv3d_out_factory(v)),
-            Entry("model.visual.patch_embed.proj.bias", "visual.b_patch"),
-            Entry("model.visual.pos_embed.weight", "visual.pos_embed"),
         ]
-        vis_range = (0, v.depth)
-        entries += [
-            Entry(f"{vb}.norm1.weight", "visual.blocks.ln1_w", layer_range=vis_range),
-            Entry(f"{vb}.norm1.bias", "visual.blocks.b_ln1", layer_range=vis_range),
-            Entry(f"{vb}.norm2.weight", "visual.blocks.ln2_w", layer_range=vis_range),
-            Entry(f"{vb}.norm2.bias", "visual.blocks.b_ln2", layer_range=vis_range),
-            Entry(f"{vb}.attn.qkv.weight", "visual.blocks.qkv_w", _t, _t, layer_range=vis_range),
-            Entry(f"{vb}.attn.qkv.bias", "visual.blocks.b_qkv", layer_range=vis_range),
-            Entry(f"{vb}.attn.proj.weight", "visual.blocks.proj_w", _t, _t, layer_range=vis_range),
-            Entry(f"{vb}.attn.proj.bias", "visual.blocks.b_proj", layer_range=vis_range),
-            Entry(f"{vb}.mlp.linear_fc1.weight", "visual.blocks.fc1_w", _t, _t, layer_range=vis_range),
-            Entry(f"{vb}.mlp.linear_fc1.bias", "visual.blocks.b_fc1", layer_range=vis_range),
-            Entry(f"{vb}.mlp.linear_fc2.weight", "visual.blocks.fc2_w", _t, _t, layer_range=vis_range),
-            Entry(f"{vb}.mlp.linear_fc2.bias", "visual.blocks.b_fc2", layer_range=vis_range),
-        ]
-        for part, ours in (("merger", "visual.merger"),):
-            entries += [
-                Entry(f"model.visual.{part}.norm.weight", f"{ours}.norm_w"),
-                Entry(f"model.visual.{part}.norm.bias", f"{ours}.b_norm"),
-                Entry(f"model.visual.{part}.linear_fc1.weight", f"{ours}.fc1_w", _t, _t),
-                Entry(f"model.visual.{part}.linear_fc1.bias", f"{ours}.b_fc1"),
-                Entry(f"model.visual.{part}.linear_fc2.weight", f"{ours}.fc2_w", _t, _t),
-                Entry(f"model.visual.{part}.linear_fc2.bias", f"{ours}.b_fc2"),
-            ]
-        n_ds = len(v.deepstack_visual_indexes)
-        dsm = "model.visual.deepstack_merger_list.{i}"
-        ds_range = (0, n_ds)
-        entries += [
-            Entry(f"{dsm}.norm.weight", "visual.ds_mergers.norm_w", layer_range=ds_range),
-            Entry(f"{dsm}.norm.bias", "visual.ds_mergers.b_norm", layer_range=ds_range),
-            Entry(f"{dsm}.linear_fc1.weight", "visual.ds_mergers.fc1_w", _t, _t, layer_range=ds_range),
-            Entry(f"{dsm}.linear_fc1.bias", "visual.ds_mergers.b_fc1", layer_range=ds_range),
-            Entry(f"{dsm}.linear_fc2.weight", "visual.ds_mergers.fc2_w", _t, _t, layer_range=ds_range),
-            Entry(f"{dsm}.linear_fc2.bias", "visual.ds_mergers.b_fc2", layer_range=ds_range),
-        ]
+        entries += vision_entries(v)
         if not t.tie_word_embeddings:
             entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
         super().__init__(entries, t.num_hidden_layers, num_experts=t.moe.n_routed_experts)

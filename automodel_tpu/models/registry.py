@@ -29,6 +29,8 @@ MODEL_REGISTRY: dict[str, str] = {
     "Glm4MoeForCausalLM": "automodel_tpu.models.glm4_moe.model:Glm4MoeForCausalLM",
     "MiniMaxM2ForCausalLM": "automodel_tpu.models.minimax_m2.model:MiniMaxM2ForCausalLM",
     "Qwen3NextForCausalLM": "automodel_tpu.models.qwen3_next.model:Qwen3NextForCausalLM",
+    "Qwen3_5MoeForConditionalGeneration": "automodel_tpu.models.qwen3_5_moe.model:Qwen3_5MoeForCausalLM",
+    "Qwen3_5MoeForCausalLM": "automodel_tpu.models.qwen3_5_moe.model:Qwen3_5MoeForCausalLM",
     "GPT2LMHeadModel": "automodel_tpu.models.gpt2.model:GPT2LMHeadModel",
     "NemotronHForCausalLM": "automodel_tpu.models.nemotron_v3.model:NemotronHForCausalLM",
     "Step3p5ForCausalLM": "automodel_tpu.models.step3p5.model:Step3p5ForCausalLM",

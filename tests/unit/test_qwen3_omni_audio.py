@@ -104,7 +104,7 @@ class TestOmniAudioEncoder:
         rng = np.random.RandomState(1)
         mels = [rng.randn(cfg.num_mel_bins, T).astype(np.float32) for T in lens]
         vin = prepare_audio_inputs(mels, cfg)
-        assert vin["gather_idx"].shape[0] == int(audio_output_lengths(np.array(lens)).sum())
+        assert vin["gather_idx"].shape[0] == int(audio_output_lengths(np.array(lens), cfg.chunk_len).sum())
 
     def test_grads_finite(self):
         cfg = Qwen3OmniAudioConfig.from_hf(tiny_cfg())
