@@ -70,6 +70,13 @@ class Qwen3OmniAudioConfig:
         return f
 
 
+def _conv_out_len(n):
+    """Elementwise 3x (k=3, s=2, p=1) conv output length: ceil-halving applied 3x."""
+    for _ in range(3):
+        n = (n + 1) // 2
+    return n
+
+
 def audio_output_lengths(input_lengths: np.ndarray, chunk_len: int = 100) -> np.ndarray:
     """Per-audio encoder output frame count: full chunks contribute
     conv_out(chunk_len) frames, the tail contributes conv_out(tail). Equals HF's
@@ -78,21 +85,8 @@ def audio_output_lengths(input_lengths: np.ndarray, chunk_len: int = 100) -> np.
     consistent with prepare_audio_inputs for any chunk_len."""
     input_lengths = np.asarray(input_lengths)
     tail = input_lengths % chunk_len
-
-    # exact 3x (k=3, s=2, p=1) halving: out(n) = ceil(ceil(ceil(n/2)/2)/2) for n>=1
-    def _out3(n):
-        for _ in range(3):
-            n = (n + 1) // 2
-        return n
-
-    tail_out = np.where(tail > 0, _out3(tail), 0)
-    return (input_lengths // chunk_len) * _out3(chunk_len) + tail_out
-
-
-def _conv_out_len(n: int) -> int:
-    for _ in range(3):
-        n = (n + 1) // 2  # k=3, s=2, p=1
-    return n
+    tail_out = np.where(tail > 0, _conv_out_len(tail), 0)
+    return (input_lengths // chunk_len) * _conv_out_len(chunk_len) + tail_out
 
 
 def init_audio_params(cfg: Qwen3OmniAudioConfig, key: jax.Array, dtype=jnp.float32) -> dict:

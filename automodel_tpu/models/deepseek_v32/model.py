@@ -74,7 +74,7 @@ class DeepseekV32Config(DeepseekV3Config):
     def from_hf(cls, hf: dict[str, Any]) -> "DeepseekV32Config":
         base = DeepseekV3Config.from_hf(hf)
         return cls(
-            **dataclasses.asdict(base) | {"moe": base.moe},
+            **{f.name: getattr(base, f.name) for f in dataclasses.fields(base)},
             index_n_heads=hf.get("index_n_heads", 64),
             index_head_dim=hf.get("index_head_dim", 128),
             index_topk=hf.get("index_topk", 2048),

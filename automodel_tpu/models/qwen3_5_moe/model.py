@@ -28,7 +28,7 @@ class Qwen3_5MoeConfig(Qwen3NextConfig):
     def from_hf(cls, hf: dict[str, Any]) -> "Qwen3_5MoeConfig":
         t = hf.get("text_config", hf)
         base = Qwen3NextConfig.from_hf(t)
-        return cls(**dataclasses.asdict(base) | {"moe": base.moe})
+        return cls(**{f.name: getattr(base, f.name) for f in dataclasses.fields(base)})
 
 
 class Qwen3_5MoeForCausalLM(Qwen3NextForCausalLM):

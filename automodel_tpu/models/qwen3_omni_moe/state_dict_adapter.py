@@ -38,7 +38,8 @@ class Qwen3OmniMoeThinkerStateDictAdapter(MappingAdapter):
 
         # vision tower: same tensors as qwen3-vl-moe, different prefix/merger keys
         entries += vision_entries(
-            v, prefix="visual", merger_norm="ln_q", merger_fc=("mlp.0", "mlp.2")
+            v, prefix="visual", merger_norm="ln_q", merger_fc=("mlp.0", "mlp.2"),
+            ds_list_name="merger_list",
         )
 
         # audio tower

@@ -35,6 +35,7 @@ def vision_entries(
     prefix: str = "model.visual",
     merger_norm: str = "norm",
     merger_fc: "tuple[str, str]" = ("linear_fc1", "linear_fc2"),
+    ds_list_name: str = "deepstack_merger_list",
 ) -> list[Entry]:
     """Qwen3-VL-family vision tower entries, shared with the omni adapter (which
     differs only in key prefix and merger sub-key names)."""
@@ -69,8 +70,7 @@ def vision_entries(
             Entry(f"{prefix}.{hf_part}.{fc2}.bias", f"{ours}.b_fc2"),
         ]
     n_ds = len(v.deepstack_visual_indexes)
-    ds_prefix = f"{prefix}.deepstack_merger_list" if merger_norm == "norm" else f"{prefix}.merger_list"
-    dsm = ds_prefix + ".{i}"
+    dsm = f"{prefix}.{ds_list_name}" + ".{i}"
     ds_range = (0, n_ds)
     entries += [
         Entry(f"{dsm}.{merger_norm}.weight", "visual.ds_mergers.norm_w", layer_range=ds_range),

@@ -95,11 +95,12 @@ def dot_product_attention(
         and positions_q is None  # flash path masks by absolute index, not positions
         and positions_kv is None
         # kernel constraints: static window (a traced per-layer window can't close
-        # over a pallas kernel), uniform head_dim, block-divisible seq lengths
+        # over a pallas kernel), uniform head_dim, seqs divisible by some block >= 8
+        # (the kernel's block picker halves until it divides)
         and isinstance(sliding_window, (int, type(None)))
         and q.shape[-1] == v.shape[-1]
-        and q.shape[1] % min(128, q.shape[1]) == 0
-        and k.shape[1] % min(128, k.shape[1]) == 0
+        and q.shape[1] % 8 == 0
+        and k.shape[1] % 8 == 0
     ):
         from automodel_tpu.ops.pallas.flash_attention import flash_attention
 

@@ -392,8 +392,8 @@ def flash_attention(
     segment_ids_kv: jnp.ndarray | None = None,  # (B, Skv)
     sliding_window: int | None = None,
     softmax_scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Flash attention over (batch, seq, heads, head_dim); returns same shape as q."""
@@ -402,8 +402,17 @@ def flash_attention(
     if softmax_scale is None:
         softmax_scale = d**-0.5
     groups = n // nk
-    block_q = min(block_q, sq)
-    block_k = min(block_k, skv)
+    # measured on v5e at (B4, S2048, H32/KV8, d64): (512, 1024) runs ~2x faster
+    # than (128, 128) fwd+bwd; fall back to the largest power-of-two block that
+    # divides the sequence so the grid stays exact
+    def _pick(seq, target):
+        b = min(target, seq)
+        while b > 8 and seq % b:
+            b //= 2
+        return b
+
+    block_q = _pick(sq, block_q or 512)
+    block_k = _pick(skv, block_k or 1024)
     if sq % block_q or skv % block_k:
         raise ValueError(
             f"flash_attention needs seq lengths divisible by block sizes: "

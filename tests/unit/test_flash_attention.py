@@ -73,9 +73,17 @@ class TestFlashForward:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
     def test_rejects_indivisible_seq(self):
-        q = _rand(18, 1, 48, 2, 8)
+        # 50 is not divisible by any block >= 8
+        q = _rand(18, 1, 50, 2, 8)
         with pytest.raises(ValueError, match="divisible"):
             flash_attention(q, q, q, block_q=32, block_k=32, interpret=True)
+
+    def test_block_fallback_divides_seq(self):
+        # 48 % 32 != 0, but the picker falls back to 16 and matches xla
+        q, k, v = _rand(19, 1, 48, 2, 8), _rand(20, 1, 48, 2, 8), _rand(21, 1, 48, 2, 8)
+        out = flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
+        ref = dot_product_attention(q, k, v, causal=True, backend="xla")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
 
 
 class TestFlashBackward:

@@ -15,7 +15,7 @@ def _hf_cfg():
     return dict(
         architectures=["Qwen3_5MoeForConditionalGeneration"],
         text_config=dict(
-            vocab_size=128, hidden_size=64, moe_intermediate_size=32,
+            vocab_size=128, hidden_size=64, moe_intermediate_size=24,
             shared_expert_intermediate_size=48, num_hidden_layers=4,
             layer_types=["linear_attention", "linear_attention", "linear_attention", "full_attention"],
             num_attention_heads=4, num_key_value_heads=2, head_dim=16,
@@ -48,7 +48,7 @@ class TestQwen3_5Moe:
         ):
             assert k in hf, k
         # packed expert layout (E, 2I, D) / (E, D, I)
-        assert hf["model.language_model.layers.0.mlp.experts.gate_up_proj"].shape == (8, 64, 64)
+        assert hf["model.language_model.layers.0.mlp.experts.gate_up_proj"].shape == (8, 48, 64)
         back = adapter.from_hf(hf)
         flat_a, flat_b = jax.tree.leaves(params), jax.tree.leaves(back)
         assert len(flat_a) == len(flat_b)
