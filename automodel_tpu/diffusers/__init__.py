@@ -1,0 +1,3 @@
+from automodel_tpu.diffusers.auto_diffusion_pipeline import AutoDiffusionPipeline
+
+__all__ = ["AutoDiffusionPipeline"]

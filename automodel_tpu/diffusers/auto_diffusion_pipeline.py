@@ -1,0 +1,39 @@
+"""Diffusion pipeline stub (reference _diffusers/auto_diffusion_pipeline.py:79).
+
+The reference exposes a minimal ``NeMoAutoDiffusionPipeline.from_pretrained`` that
+loads a Hugging Face diffusers pipeline with device/dtype placement and nothing
+else; diffusion *training* is out of scope there too. This mirrors that surface:
+a thin loader that defers to ``diffusers`` when installed (it is not part of the
+baked TPU image) and otherwise fails with a clear message.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["AutoDiffusionPipeline"]
+
+
+class AutoDiffusionPipeline:
+    """Minimal diffusers loader (reference NeMoAutoDiffusionPipeline)."""
+
+    @staticmethod
+    def from_pretrained(
+        pretrained_model_name_or_path: str,
+        dtype: Any = None,
+        device: Any = None,
+        **kwargs,
+    ):
+        try:
+            import diffusers  # noqa: PLC0415
+        except ModuleNotFoundError as e:  # pragma: no cover - env without diffusers
+            raise ModuleNotFoundError(
+                "AutoDiffusionPipeline requires the `diffusers` package, which is "
+                "not part of the TPU image; install it to load diffusion pipelines"
+            ) from e
+        pipe = diffusers.DiffusionPipeline.from_pretrained(
+            pretrained_model_name_or_path, torch_dtype=dtype, **kwargs
+        )
+        if device is not None:
+            pipe = pipe.to(device)
+        return pipe

@@ -406,7 +406,9 @@ def flash_attention(
     # than (128, 128) fwd+bwd; fall back to the largest power-of-two block that
     # divides the sequence so the grid stays exact
     def _pick(seq, target):
-        b = min(target, seq)
+        # largest power-of-two block <= target that divides seq (>= 8); if none
+        # divides, return 8 so the kernel's divisibility check raises clearly
+        b = 1 << (max(min(target, seq), 8).bit_length() - 1)
         while b > 8 and seq % b:
             b //= 2
         return b

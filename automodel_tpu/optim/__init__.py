@@ -1,4 +1,11 @@
-from automodel_tpu.optim.scheduler import OptimizerParamScheduler, build_lr_schedule
 from automodel_tpu.optim.builder import build_optimizer
+from automodel_tpu.optim.dion import build_dion_optimizer, dion
+from automodel_tpu.optim.scheduler import OptimizerParamScheduler, build_lr_schedule
 
-__all__ = ["OptimizerParamScheduler", "build_lr_schedule", "build_optimizer"]
+__all__ = [
+    "OptimizerParamScheduler",
+    "build_dion_optimizer",
+    "build_lr_schedule",
+    "build_optimizer",
+    "dion",
+]

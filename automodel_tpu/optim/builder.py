@@ -48,6 +48,7 @@ def build_optimizer(
     eps: float = 1e-8,
     max_grad_norm: float | None = None,
     optimizer: str = "adamw",
+    **optimizer_kwargs,
 ) -> optax.GradientTransformation:
     """AdamW (or SGD/adafactor) with decay masking and optional global-norm clip.
 
@@ -72,6 +73,17 @@ def build_optimizer(
         chain.append(optax.sgd(learning_rate=lr, momentum=betas[0]))
     elif optimizer == "adafactor":
         chain.append(optax.adafactor(learning_rate=lr))
+    elif optimizer == "dion":
+        from automodel_tpu.optim.dion import build_dion_optimizer
+
+        # clipping is handled inside (before the split transform); extra YAML keys
+        # (mu, rank_fraction, adamw_lr_scale) pass straight through
+        return build_dion_optimizer(
+            lr, weight_decay=weight_decay, b1=betas[0], b2=betas[1],
+            max_grad_norm=max_grad_norm, **optimizer_kwargs,
+        )
     else:
         raise ValueError(f"unknown optimizer {optimizer!r}")
+    if optimizer_kwargs:
+        raise ValueError(f"unknown optimizer kwargs for {optimizer!r}: {sorted(optimizer_kwargs)}")
     return optax.chain(*chain)

@@ -1,0 +1,201 @@
+"""Dion optimizer — distributed orthonormalized updates (reference optim/utils.py
+integrates the external ``dion`` package; implemented natively here as an optax
+transform, per Ahn et al., "Dion: Distributed Orthonormalized Updates",
+arXiv:2504.05295 Algorithm 1).
+
+Per matrix parameter W (m, n) with momentum M and a persistent right factor
+Q (n, r):
+
+    M  += g
+    P   = orthonormalize(M @ Q)          (QR, column space power iteration)
+    R   = M^T @ P
+    M  -= (1 - mu) * P @ R^T             (error feedback: only the applied
+                                          low-rank part decays from momentum)
+    Q   = column_normalize(R)
+    dW  = -lr * (sqrt(m / n) * P @ Q^T + weight_decay * W)
+
+Leading stack dims (layer scan, experts) are vmapped. Non-matrix leaves
+(norms, biases) and token-dimension leaves (embeddings, lm_head) take the
+reference's fallback path: plain AdamW with its own lr.
+
+TPU notes: QR on (m, r) tall matrices maps to XLA's householder pipeline; the
+whole update is jit-friendly (no data-dependent shapes) and the Q state shards
+like the parameter's second axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["dion", "build_dion_optimizer"]
+
+
+class DionState(NamedTuple):
+    momentum: Any  # pytree matching matrix leaves
+    q: Any  # pytree of right factors
+
+
+def _orthonormalize(p: jnp.ndarray) -> jnp.ndarray:
+    # reduced QR (unguarded: rank-deficient columns give arbitrary-but-valid
+    # orthonormal completions, which the error feedback absorbs next step)
+    q, _ = jnp.linalg.qr(p.astype(jnp.float32))
+    return q
+
+
+def _col_normalize(r: jnp.ndarray, eps: float = 1e-8) -> jnp.ndarray:
+    return r / (jnp.linalg.norm(r, axis=-2, keepdims=True) + eps)
+
+
+def _dion_update_2d(g, m, q, mu: float):
+    """One Dion step for a single (m, n) matrix; returns (update, m_new, q_new)."""
+    g = g.astype(jnp.float32)
+    m = m + g
+    p = _orthonormalize(m @ q)  # (rows, r)
+    r = m.T @ p  # (cols, r)
+    m = m - (1.0 - mu) * (p @ r.T)
+    q_new = _col_normalize(r)
+    rows, cols = g.shape[-2], g.shape[-1]
+    scale = jnp.sqrt(jnp.asarray(rows / cols, jnp.float32))
+    # positive ascent direction; the caller applies the -lr (optax convention)
+    update = scale * (p @ q_new.T)
+    return update, m, q_new
+
+
+def dion(
+    learning_rate: optax.ScalarOrSchedule,
+    mu: float = 0.95,
+    rank_fraction: float = 0.25,
+    min_rank: int = 1,
+) -> optax.GradientTransformation:
+    """Dion for matrix leaves (ndim >= 2; leading dims vmapped as stacks).
+
+    Wrap with ``optax.masked`` / ``multi_transform`` for mixed parameter groups —
+    or use :func:`build_dion_optimizer`, which applies the reference's grouping.
+    """
+
+    def rank_of(shape) -> int:
+        return max(min_rank, int(min(shape[-2], shape[-1]) * rank_fraction))
+
+    def init_fn(params):
+        def init_leaf(p):
+            if p.ndim < 2:
+                raise ValueError("dion() only handles matrix leaves; mask others out")
+            r = rank_of(p.shape)
+            # deterministic per-shape init; orthonormalized on first use
+            key = jax.random.key(p.ndim * 1000 + p.shape[-1])
+            q = jax.random.normal(key, (*p.shape[:-2], p.shape[-1], r), jnp.float32)
+            return q
+
+        momentum = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        qs = jax.tree.map(init_leaf, params)
+        return DionState(momentum=momentum, q=qs)
+
+    def update_fn(updates, state, params=None):
+        del params
+        lr = learning_rate
+
+        def leaf(g, m, q):
+            fn = _dion_update_2d
+            for _ in range(g.ndim - 2):
+                fn = jax.vmap(fn, in_axes=(0, 0, 0, None))
+            u, m2, q2 = fn(g, m, q, mu)
+            # dict result (not tuple): optax.MaskedNode is a tuple subclass and must
+            # pass through untouched under multi_transform
+            return {"u": u, "m": m2, "q": q2}
+
+        is_res = lambda x: isinstance(x, dict) and set(x) == {"u", "m", "q"}
+        out = jax.tree.map(leaf, updates, state.momentum, state.q)
+        upd = jax.tree.map(lambda o: o["u"], out, is_leaf=is_res)
+        m_new = jax.tree.map(lambda o: o["m"], out, is_leaf=is_res)
+        q_new = jax.tree.map(lambda o: o["q"], out, is_leaf=is_res)
+        if callable(lr):
+            # schedules thread through optax.scale_by_schedule (build_dion_optimizer)
+            raise ValueError("pass schedules via build_dion_optimizer")
+        upd = jax.tree.map(lambda u: -lr * u, upd)
+        return upd, DionState(momentum=m_new, q=q_new)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _is_matrix_path(path: tuple, leaf) -> bool:
+    """Reference dion grouping (optim/utils.py:34-151): matmul weights get Dion;
+    embeddings / unembeddings / norms / biases / conv kernels fall back to AdamW.
+
+    Stacked layer params keep their leading scan dim, so the check is name-based
+    (a stacked norm is (L, d) and must NOT be orthonormalized)."""
+    parts = [getattr(k, "key", str(k)).lower() for k in path]
+    name = "/".join(parts)
+    if leaf.ndim < 2 or min(leaf.shape[-2:]) < 2:
+        return False
+    if any(tok in name for tok in ("embed", "lm_head", "pos_emb", "score_correction", "conv", "norm")):
+        return False
+    if any(pt.startswith("b_") or pt in ("bias", "sinks", "dt_bias", "a_log", "d_skip") for pt in parts):
+        return False
+    return True
+
+
+def build_dion_optimizer(
+    learning_rate: optax.ScalarOrSchedule,
+    mu: float = 0.95,
+    rank_fraction: float = 0.25,
+    adamw_lr_scale: float = 1.0,
+    weight_decay: float = 0.0,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    max_grad_norm: float | None = None,
+) -> optax.GradientTransformation:
+    """Dion on matrix params + AdamW on the rest, with optional global clipping.
+
+    Decoupled weight decay applies to BOTH groups, masked off norms/biases (the
+    same no_decay_mask contract as build_optimizer's adamw path)."""
+    from automodel_tpu.optim.builder import no_decay_mask
+
+    def masked_decay_mask(params):
+        # robust under multi_transform's MaskedNode placeholders (no .ndim)
+        def mask_tree(tree, under_layers=False):
+            out = {}
+            for k, v in tree.items():
+                if isinstance(v, dict):
+                    out[k] = mask_tree(v, under_layers or k == "layers")
+                else:
+                    rank = getattr(v, "ndim", 0) - (1 if under_layers else 0)
+                    out[k] = rank >= 2
+            return out
+
+        return mask_tree(params)
+
+    def label_fn(params):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: "dion" if _is_matrix_path(path, leaf) else "adamw", params
+        )
+
+    neg_lr = (lambda c: -learning_rate(c)) if callable(learning_rate) else -learning_rate
+    decay = (
+        [optax.add_decayed_weights(weight_decay, mask=masked_decay_mask)]
+        if weight_decay
+        else []
+    )
+    dion_tx = optax.chain(
+        # lr=-1 cancels dion()'s internal descent sign, leaving the raw ascent
+        # direction for the standard optax add_decayed_weights -> scale(-lr) tail
+        dion(-1.0, mu=mu, rank_fraction=rank_fraction),
+        *decay,
+        optax.scale_by_schedule(neg_lr) if callable(learning_rate) else optax.scale(neg_lr),
+    )
+    adamw_lr = (
+        (lambda c: adamw_lr_scale * learning_rate(c)) if callable(learning_rate)
+        else adamw_lr_scale * learning_rate
+    )
+    adamw_tx = optax.adamw(
+        adamw_lr, b1=b1, b2=b2, weight_decay=weight_decay,
+        mask=masked_decay_mask if weight_decay else None,
+    )
+
+    tx = optax.multi_transform({"dion": dion_tx, "adamw": adamw_tx}, label_fn)
+    if max_grad_norm:
+        tx = optax.chain(optax.clip_by_global_norm(max_grad_norm), tx)
+    return tx
