@@ -34,7 +34,8 @@ def no_decay_mask(params: Any) -> Any:
             if isinstance(v, dict):
                 out[k] = mask_tree(v, under_layers or k == "layers")
             else:
-                rank = v.ndim - (1 if under_layers else 0)
+                # getattr: robust under optax multi_transform MaskedNode leaves
+                rank = getattr(v, "ndim", 0) - (1 if under_layers else 0)
                 out[k] = rank >= 2
         return out
 
@@ -79,7 +80,7 @@ def build_optimizer(
         # clipping is handled inside (before the split transform); extra YAML keys
         # (mu, rank_fraction, adamw_lr_scale) pass straight through
         return build_dion_optimizer(
-            lr, weight_decay=weight_decay, b1=betas[0], b2=betas[1],
+            lr, weight_decay=weight_decay, b1=betas[0], b2=betas[1], eps=eps,
             max_grad_norm=max_grad_norm, **optimizer_kwargs,
         )
     else:

@@ -146,27 +146,14 @@ def build_dion_optimizer(
     weight_decay: float = 0.0,
     b1: float = 0.9,
     b2: float = 0.95,
+    eps: float = 1e-8,
     max_grad_norm: float | None = None,
 ) -> optax.GradientTransformation:
     """Dion on matrix params + AdamW on the rest, with optional global clipping.
 
     Decoupled weight decay applies to BOTH groups, masked off norms/biases (the
     same no_decay_mask contract as build_optimizer's adamw path)."""
-    from automodel_tpu.optim.builder import no_decay_mask
-
-    def masked_decay_mask(params):
-        # robust under multi_transform's MaskedNode placeholders (no .ndim)
-        def mask_tree(tree, under_layers=False):
-            out = {}
-            for k, v in tree.items():
-                if isinstance(v, dict):
-                    out[k] = mask_tree(v, under_layers or k == "layers")
-                else:
-                    rank = getattr(v, "ndim", 0) - (1 if under_layers else 0)
-                    out[k] = rank >= 2
-            return out
-
-        return mask_tree(params)
+    from automodel_tpu.optim.builder import no_decay_mask as masked_decay_mask
 
     def label_fn(params):
         return jax.tree_util.tree_map_with_path(
@@ -191,7 +178,7 @@ def build_dion_optimizer(
         else adamw_lr_scale * learning_rate
     )
     adamw_tx = optax.adamw(
-        adamw_lr, b1=b1, b2=b2, weight_decay=weight_decay,
+        adamw_lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
         mask=masked_decay_mask if weight_decay else None,
     )
 

@@ -71,6 +71,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
 
     # ------------------------------------------------------------------ setup
     def setup(self):
+        self._check_nan_grads = bool(self.cfg.get("distributed.check_for_nan_in_grad", False))
         cfg = self.cfg
         setup_logging(cfg.get("log_level", "INFO"))
         self.dist = initialize_distributed(auto=bool(cfg.get("distributed.auto_init", False)))
@@ -469,6 +470,18 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     self.params = self.train_params
                 step = self.step_scheduler.step
                 steps_since_log += 1
+                # reference check_for_nan_in_grad (distributed/config.py:129): a
+                # non-finite gradient is a training bug — stop loudly EVERY step
+                # (not just log steps) before the optimizer state or a checkpoint
+                # is corrupted. Costs one scalar device->host pull per step.
+                if self._check_nan_grads:
+                    g = float(metrics["grad_norm"])
+                    l = float(metrics["loss"])
+                    if not (np.isfinite(g) and np.isfinite(l)):
+                        raise RuntimeError(
+                            f"non-finite training signal at step {step}: "
+                            f"loss={l} grad_norm={g}"
+                        )
                 if self.step_scheduler.is_log_step:
                     loss = float(metrics["loss"])
                     gnorm = float(metrics["grad_norm"])

@@ -151,3 +151,25 @@ class TestTrainRecipeE2E:
         recipe.run_train_validation_loop()
         rows = _read_jsonl(tmp_path / "out" / "training.jsonl")
         assert rows[0]["loss"] > 4.0  # sane CE for random data
+
+
+class TestNanGuard:
+    def test_nonfinite_grad_raises(self, tmp_path, cpu_devices):
+        """distributed.check_for_nan_in_grad stops loudly on a non-finite signal
+        (reference check_for_nan_in_grad, distributed/config.py:129) — forced here
+        with an absurd lr that overflows bf16 within a few steps."""
+        import pytest
+
+        from automodel_tpu.config.loader import load_config
+        from automodel_tpu.recipes.llm.train_ft import (
+            TrainFinetuneRecipeForNextTokenPrediction,
+        )
+
+        cfg = load_config(_write_cfg(tmp_path))
+        cfg["optimizer"]["lr"] = 1.0e12
+        cfg["optimizer"]["max_grad_norm"] = None
+        cfg["distributed"]["check_for_nan_in_grad"] = True
+        cfg["step_scheduler"]["max_steps"] = 10
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        with pytest.raises(RuntimeError, match="non-finite"):
+            recipe.run_train_validation_loop()
