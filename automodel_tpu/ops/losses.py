@@ -100,7 +100,11 @@ def linear_cross_entropy(
     blocks_h = flat_h.reshape(-1, block_size, e)
     blocks_l = flat_labels.reshape(-1, block_size)
 
+    @jax.checkpoint
     def body(carry, blk):
+        # remat: the (block, vocab) logits tile is recomputed in backward instead of
+        # saved per scan step — without this the scan residuals re-materialize the
+        # full logits tensor and the fusion saves nothing (cut-cross-entropy trick)
         h_b, l_b = blk
         logits_b = h_b.astype(jnp.float32) @ unembed.astype(jnp.float32)
         s, c = _ce_sum(logits_b, l_b, ignore_index)

@@ -365,12 +365,14 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     seq_len_hint=self.seq_len,
                 )
                 step = make_pp_train_step(pp_loss, self.optimizer,
-                                          post_update=self._post_update())
+                                          post_update=self._post_update(),
+                                          guard_nonfinite=self._check_nan_grads)
             else:
                 pp_loss = make_dense_decoder_pp_loss(
                     self.model, self.mesh, self.rules, loss_name=self.loss_name
                 )
-                step = make_pp_train_step(pp_loss, self.optimizer)
+                step = make_pp_train_step(pp_loss, self.optimizer,
+                                          guard_nonfinite=self._check_nan_grads)
         elif self.peft is not None:
             from automodel_tpu.peft.lora import merge_lora_params
 
@@ -383,10 +385,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 merged = merge_lora_params(base, lora, self.peft)
                 return self._forward_loss(merged, batch, num_label_tokens)
 
-            step = make_train_step(peft_loss, self.optimizer, with_frozen=True)
+            step = make_train_step(peft_loss, self.optimizer, with_frozen=True,
+                                   guard_nonfinite=self._check_nan_grads)
         else:
             forward = self._qat_wrap(self._forward_loss)
-            step = make_train_step(forward, self.optimizer, post_update=self._post_update())
+            step = make_train_step(forward, self.optimizer, post_update=self._post_update(),
+                                   guard_nonfinite=self._check_nan_grads)
         return jax.jit(step, donate_argnums=(0, 1))
 
     def _qat_wrap(self, forward):
@@ -471,17 +475,17 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 step = self.step_scheduler.step
                 steps_since_log += 1
                 # reference check_for_nan_in_grad (distributed/config.py:129): a
-                # non-finite gradient is a training bug — stop loudly EVERY step
-                # (not just log steps) before the optimizer state or a checkpoint
-                # is corrupted. Costs one scalar device->host pull per step.
-                if self._check_nan_grads:
-                    g = float(metrics["grad_norm"])
-                    l = float(metrics["loss"])
-                    if not (np.isfinite(g) and np.isfinite(l)):
-                        raise RuntimeError(
-                            f"non-finite training signal at step {step}: "
-                            f"loss={l} grad_norm={g}"
-                        )
+                # non-finite gradient is a training bug. The jitted step already
+                # SKIPPED the corrupt update (guard_nonfinite), so params and
+                # optimizer state stay clean; raise loudly here every step.
+                # Costs one scalar device->host pull per step.
+                if self._check_nan_grads and bool(metrics["nonfinite"]):
+                    raise RuntimeError(
+                        f"non-finite training signal at step {step}: "
+                        f"loss={float(metrics['loss'])} "
+                        f"grad_norm={float(metrics['grad_norm'])} "
+                        "(the offending update was skipped; params remain clean)"
+                    )
                 if self.step_scheduler.is_log_step:
                     loss = float(metrics["loss"])
                     gnorm = float(metrics["grad_norm"])

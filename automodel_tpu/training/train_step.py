@@ -36,6 +36,7 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     post_update: Callable[[dict, dict], dict] | None = None,
     with_frozen: bool = False,
+    guard_nonfinite: bool = False,
 ):
     """Build the accumulating train step.
 
@@ -86,8 +87,21 @@ def make_train_step(
             micro_step, (zero_grads, jnp.float32(0.0), zero_aux), batch_stack
         )
         grad_norm = optax.global_norm(grads)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        new_updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        if guard_nonfinite:
+            # reference check_for_nan_in_grad: skip the whole update when the
+            # gradient is non-finite so params/opt_state never corrupt; the host
+            # reads metrics["nonfinite"] and raises (recipe contract)
+            ok = jnp.isfinite(grad_norm) & jnp.isfinite(loss)
+            new_updates = jax.tree.map(
+                lambda u: jnp.where(ok, u, jnp.zeros_like(u)), new_updates
+            )
+            new_opt_state = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old) if hasattr(new, "dtype") else new,
+                new_opt_state, opt_state,
+            )
+        params = optax.apply_updates(params, new_updates)
+        opt_state = new_opt_state
         if post_update is not None:
             params = post_update(params, aux)
         metrics = {
@@ -96,6 +110,8 @@ def make_train_step(
             "num_label_tokens": num_label_tokens,
             **aux,
         }
+        if guard_nonfinite:
+            metrics["nonfinite"] = ~ok
         return params, opt_state, metrics
 
     return train_step
@@ -105,6 +121,7 @@ def make_pp_train_step(
     forward_loss: Callable[..., jnp.ndarray],
     optimizer: optax.GradientTransformation,
     post_update: Callable[[dict, dict], dict] | None = None,
+    guard_nonfinite: bool = False,
 ):
     """Train step for pipeline parallelism: ``forward_loss`` consumes the WHOLE
     (n_micro, ...) batch stack at once — microbatching happens inside the pipeline
@@ -123,8 +140,21 @@ def make_pp_train_step(
             params, batch_stack, num_label_tokens
         )
         grad_norm = optax.global_norm(grads)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        new_updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        if guard_nonfinite:
+            # reference check_for_nan_in_grad: skip the whole update when the
+            # gradient is non-finite so params/opt_state never corrupt; the host
+            # reads metrics["nonfinite"] and raises (recipe contract)
+            ok = jnp.isfinite(grad_norm) & jnp.isfinite(loss)
+            new_updates = jax.tree.map(
+                lambda u: jnp.where(ok, u, jnp.zeros_like(u)), new_updates
+            )
+            new_opt_state = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old) if hasattr(new, "dtype") else new,
+                new_opt_state, opt_state,
+            )
+        params = optax.apply_updates(params, new_updates)
+        opt_state = new_opt_state
         if post_update is not None:
             params = post_update(params, aux)
         metrics = {
@@ -133,6 +163,8 @@ def make_pp_train_step(
             "num_label_tokens": num_label_tokens,
             **aux,
         }
+        if guard_nonfinite:
+            metrics["nonfinite"] = ~ok
         return params, opt_state, metrics
 
     return train_step
