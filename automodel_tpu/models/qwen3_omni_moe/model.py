@@ -10,8 +10,9 @@ Audio tokens take text-like (all-axes-equal) mrope positions, which the inherite
 ``get_mrope_positions`` walk already produces for non-vision tokens
 (HF get_rope_index audio branch, modeling_qwen3_omni_moe.py:333-344).
 
-Multi-frame video spans use omni timestamp semantics not yet supported here —
-``get_mrope_positions`` rejects them loudly."""
+Video spans use omni timestamp semantics: one contiguous placeholder run whose
+t-indices are floor(frame * second_per_grid * position_id_per_seconds)
+(HF-pinned). Interleaved audio-in-video position ids are not yet supported."""
 
 from __future__ import annotations
 
@@ -42,6 +43,7 @@ __all__ = ["Qwen3OmniMoeThinkerConfig", "Qwen3OmniMoeThinkerForConditionalGenera
 class Qwen3OmniMoeThinkerConfig(Qwen3VLMoeConfig):
     audio: Qwen3OmniAudioConfig = None
     audio_token_id: int = 151646
+    position_id_per_seconds: int = 25
 
     @classmethod
     def from_hf(cls, hf: dict[str, Any]) -> "Qwen3OmniMoeThinkerConfig":
@@ -51,6 +53,7 @@ class Qwen3OmniMoeThinkerConfig(Qwen3VLMoeConfig):
             **{f.name: getattr(base, f.name) for f in dataclasses.fields(Qwen3VLMoeConfig)},
             audio=Qwen3OmniAudioConfig.from_hf(hf.get("audio_config", {})),
             audio_token_id=hf.get("audio_token_id", 151646),
+            position_id_per_seconds=hf.get("position_id_per_seconds", 25),
         )
 
 
@@ -85,16 +88,77 @@ class Qwen3OmniMoeThinkerForConditionalGeneration(Qwen3VLMoeForConditionalGenera
         b, s = np.where(input_ids == self.config.audio_token_id)
         return b.astype(np.int32), s.astype(np.int32)
 
-    def get_mrope_positions(self, input_ids, grid_thw, attention_mask=None, video_grid_thw=None):
-        if video_grid_thw is not None and (np.asarray(video_grid_thw)[:, 0] > 1).any():
-            # omni derives video t-indices from timestamps (position_id_per_seconds /
-            # second-per-grid interleaving, HF get_rope_index) — not yet implemented
-            raise NotImplementedError(
-                "Qwen3-Omni multi-frame video position ids (timestamp mrope) are not supported"
+    def get_mrope_positions(
+        self,
+        input_ids,
+        grid_thw,
+        attention_mask=None,
+        video_grid_thw=None,
+        second_per_grids=None,  # (n_videos,) seconds per temporal grid (default 1.0)
+    ):
+        """Omni mrope: audio spans take text-like positions (inherited walk);
+
+        NOTE: this forks the Qwen3VLMoe walk (qwen3_vl_moe/model.py
+        get_mrope_positions) because omni videos are ONE contiguous t*gh*gw span
+        with timestamp t-indices while VL splits them into per-frame t=1 spans —
+        fixes to the parent walk's cursor/mask handling must be mirrored here.
+        video spans are ONE contiguous run of t*gh*gw placeholders whose t-index is
+        timestamp-scaled — floor(frame * second_per_grid * position_id_per_seconds)
+        (HF get_rope_index video branch + get_llm_pos_ids_for_vision). Interleaved
+        audio-in-video is not supported."""
+        cfg = self.config
+        vids = None if video_grid_thw is None else np.asarray(video_grid_thw)
+        if vids is None or not (vids[:, 0] > 1).any():
+            return super().get_mrope_positions(
+                input_ids, grid_thw, attention_mask=attention_mask, video_grid_thw=video_grid_thw
             )
-        return super().get_mrope_positions(
-            input_ids, grid_thw, attention_mask=attention_mask, video_grid_thw=video_grid_thw
-        )
+        if second_per_grids is None:
+            second_per_grids = np.ones((len(vids),), np.float32)
+        ms = cfg.vision.spatial_merge_size
+        B, S = input_ids.shape
+        pos = np.zeros((3, B, S), dtype=np.int64)
+        img_idx, vid_idx = 0, 0
+        for b in range(B):
+            valid = np.ones((S,), bool) if attention_mask is None else attention_mask[b].astype(bool)
+            ids = input_ids[b][valid]
+            out = np.zeros((3, len(ids)), dtype=np.int64)
+            st, cursor = 0, 0
+            is_img = ids == cfg.image_token_id
+            is_vid = ids == cfg.video_token_id
+            while st < len(ids):
+                if not (is_img[st] or is_vid[st]):
+                    out[:, st] = cursor
+                    cursor += 1
+                    st += 1
+                    continue
+                if is_vid[st]:
+                    t, h, w = (int(x) for x in vids[vid_idx])
+                    spg = float(second_per_grids[vid_idx])
+                    vid_idx += 1
+                    t_index = np.floor(
+                        np.arange(t) * spg * cfg.position_id_per_seconds
+                    ).astype(np.int64)
+                else:
+                    t, h, w = (int(x) for x in grid_thw[img_idx])
+                    img_idx += 1
+                    t_index = np.arange(t)
+                gh, gw = h // ms, w // ms
+                n = t * gh * gw
+                span = is_vid[st : st + n] if is_vid[st] else is_img[st : st + n]
+                if len(span) < n or not span.all():
+                    # use_audio_in_video interleaves audio tokens per frame inside
+                    # the video span — those position ids are not implemented, and
+                    # assigning grid coordinates blindly would silently desync
+                    raise NotImplementedError(
+                        "interleaved audio-in-video position ids are not supported"
+                    )
+                out[0, st : st + n] = np.repeat(t_index, gh * gw) + cursor
+                out[1, st : st + n] = np.tile(np.repeat(np.arange(gh), gw), t) + cursor
+                out[2, st : st + n] = np.tile(np.arange(gw), t * gh) + cursor
+                cursor = int(out[:, st : st + n].max()) + 1
+                st += n
+            pos[:, b, valid] = out
+        return pos
 
     # ---- forward ----
 

@@ -31,6 +31,20 @@ def count_label_tokens(labels: jnp.ndarray, ignore_index: int = IGNORE_INDEX) ->
     return (labels != ignore_index).sum()
 
 
+def _guard_nonfinite_update(new_updates, new_opt_state, opt_state, grad_norm, loss):
+    """reference check_for_nan_in_grad: skip the whole update when the training
+    signal is non-finite so params/opt_state never corrupt; the host reads
+    metrics["nonfinite"] and raises (recipe contract). Returns
+    (updates, opt_state, nonfinite_flag)."""
+    ok = jnp.isfinite(grad_norm) & jnp.isfinite(loss)
+    new_updates = jax.tree.map(lambda u: jnp.where(ok, u, jnp.zeros_like(u)), new_updates)
+    new_opt_state = jax.tree.map(
+        lambda new, old: jnp.where(ok, new, old) if hasattr(new, "dtype") else new,
+        new_opt_state, opt_state,
+    )
+    return new_updates, new_opt_state, ~ok
+
+
 def make_train_step(
     forward_loss: Callable[..., jnp.ndarray],
     optimizer: optax.GradientTransformation,
@@ -89,16 +103,8 @@ def make_train_step(
         grad_norm = optax.global_norm(grads)
         new_updates, new_opt_state = optimizer.update(grads, opt_state, params)
         if guard_nonfinite:
-            # reference check_for_nan_in_grad: skip the whole update when the
-            # gradient is non-finite so params/opt_state never corrupt; the host
-            # reads metrics["nonfinite"] and raises (recipe contract)
-            ok = jnp.isfinite(grad_norm) & jnp.isfinite(loss)
-            new_updates = jax.tree.map(
-                lambda u: jnp.where(ok, u, jnp.zeros_like(u)), new_updates
-            )
-            new_opt_state = jax.tree.map(
-                lambda new, old: jnp.where(ok, new, old) if hasattr(new, "dtype") else new,
-                new_opt_state, opt_state,
+            new_updates, new_opt_state, nonfinite = _guard_nonfinite_update(
+                new_updates, new_opt_state, opt_state, grad_norm, loss
             )
         params = optax.apply_updates(params, new_updates)
         opt_state = new_opt_state
@@ -111,7 +117,7 @@ def make_train_step(
             **aux,
         }
         if guard_nonfinite:
-            metrics["nonfinite"] = ~ok
+            metrics["nonfinite"] = nonfinite
         return params, opt_state, metrics
 
     return train_step
@@ -142,16 +148,8 @@ def make_pp_train_step(
         grad_norm = optax.global_norm(grads)
         new_updates, new_opt_state = optimizer.update(grads, opt_state, params)
         if guard_nonfinite:
-            # reference check_for_nan_in_grad: skip the whole update when the
-            # gradient is non-finite so params/opt_state never corrupt; the host
-            # reads metrics["nonfinite"] and raises (recipe contract)
-            ok = jnp.isfinite(grad_norm) & jnp.isfinite(loss)
-            new_updates = jax.tree.map(
-                lambda u: jnp.where(ok, u, jnp.zeros_like(u)), new_updates
-            )
-            new_opt_state = jax.tree.map(
-                lambda new, old: jnp.where(ok, new, old) if hasattr(new, "dtype") else new,
-                new_opt_state, opt_state,
+            new_updates, new_opt_state, nonfinite = _guard_nonfinite_update(
+                new_updates, new_opt_state, opt_state, grad_norm, loss
             )
         params = optax.apply_updates(params, new_updates)
         opt_state = new_opt_state
@@ -164,7 +162,7 @@ def make_pp_train_step(
             **aux,
         }
         if guard_nonfinite:
-            metrics["nonfinite"] = ~ok
+            metrics["nonfinite"] = nonfinite
         return params, opt_state, metrics
 
     return train_step

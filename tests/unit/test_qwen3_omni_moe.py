@@ -120,3 +120,25 @@ class TestOmniThinkerParity:
         hf_dict = model.state_dict_adapter().to_hf(params)
         theirs = {k for k in hf.state_dict() if "rotary" not in k}
         assert set(hf_dict) == theirs
+
+    def test_rope_index_matches_hf_timestamp_video(self, tmp_path):
+        """Omni video: one contiguous t*gh*gw span with timestamp-scaled t-index
+        (position_id_per_seconds x second_per_grid)."""
+        torch.manual_seed(6)
+        hf = HFThinker(tiny_cfg())
+        model, _ = _build(tmp_path, hf)
+        t, h, w = 3, 4, 4
+        n_tok = t * (h // 2) * (w // 2)
+        ids = np.random.RandomState(6).randint(0, 100, (1, 30))
+        ids[0, 2] = VSTART
+        ids[0, 3 : 3 + n_tok] = 122  # video tokens, contiguous span
+        grid = np.array([[t, h, w]])
+        theirs, _ = hf.get_rope_index(
+            torch.tensor(ids), attention_mask=torch.ones_like(torch.tensor(ids)),
+            video_grid_thw=torch.tensor(grid),
+            second_per_grids=torch.tensor([2.0]),
+        )
+        ours = model.get_mrope_positions(
+            ids, None, video_grid_thw=grid, second_per_grids=np.array([2.0])
+        )
+        np.testing.assert_array_equal(ours, theirs.numpy())
