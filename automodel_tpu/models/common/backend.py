@@ -34,6 +34,10 @@ class BackendConfig:
     """
 
     attention: str = "xla"
+    # "allgather": rely on XLA SPMD to gather k/v across the cp axis (always
+    # correct). "ring": ppermute ring attention over cp (overlaps comm with
+    # compute; full/causal GQA attention without sinks/soft-cap/traced windows)
+    context_parallel: str = "allgather"
     # "default" (einsum) | "fp8" (e4m3/e5m2 dynamic scaling). fp8 covers the dense
     # attention/MLP projections; MoE expert GEMMs keep their own experts_backend.
     linear: str = "default"

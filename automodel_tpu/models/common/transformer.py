@@ -211,14 +211,28 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
         q = q * scale[..., None, None].astype(q.dtype)
     q = _constrain(q, rules, ("batch", "act_attn_seq", "act_heads", None))
     k = _constrain(k, rules, ("batch", "act_attn_seq", "act_heads", None))
-    out = dot_product_attention(
-        q, k, v,
-        causal=cfg.causal,
-        segment_ids_q=segment_ids,
-        sliding_window=sliding,
-        sinks=lp.get("sinks"),
-        backend=backend.attention,
+    mesh = rules.mesh if rules is not None else None
+    use_ring = (
+        backend.context_parallel == "ring"
+        and mesh is not None
+        and mesh.shape.get("cp", 1) > 1
+        and lp.get("sinks") is None
+        and sliding is None  # traced per-layer windows can't close over shard_map
     )
+    if use_ring:
+        from automodel_tpu.parallel.ring_attention import make_ring_attention
+
+        ring = make_ring_attention(mesh, causal=cfg.causal)
+        out = ring(q, k, v, positions, segment_ids)
+    else:
+        out = dot_product_attention(
+            q, k, v,
+            causal=cfg.causal,
+            segment_ids_q=segment_ids,
+            sliding_window=sliding,
+            sinks=lp.get("sinks"),
+            backend=backend.attention,
+        )
     o = project(out, lp["wo"], 2, lin)
     if cfg.attention_out_bias:
         o = o + lp["bo"]

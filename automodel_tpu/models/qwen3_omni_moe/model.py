@@ -145,12 +145,18 @@ class Qwen3OmniMoeThinkerForConditionalGeneration(Qwen3VLMoeForConditionalGenera
                 gh, gw = h // ms, w // ms
                 n = t * gh * gw
                 span = is_vid[st : st + n] if is_vid[st] else is_img[st : st + n]
-                if len(span) < n or not span.all():
+                if len(span) < n:
+                    raise ValueError(
+                        f"vision span truncated: expected {n} placeholder tokens for "
+                        f"grid ({t},{h},{w}) but the sequence ends after {len(span)}"
+                    )
+                if not span.all():
                     # use_audio_in_video interleaves audio tokens per frame inside
                     # the video span — those position ids are not implemented, and
                     # assigning grid coordinates blindly would silently desync
                     raise NotImplementedError(
-                        "interleaved audio-in-video position ids are not supported"
+                        "non-contiguous vision span (audio-in-video interleaving is "
+                        "not supported; check grid/token alignment otherwise)"
                     )
                 out[0, st : st + n] = np.repeat(t_index, gh * gw) + cursor
                 out[1, st : st + n] = np.tile(np.repeat(np.arange(gh), gw), t) + cursor
