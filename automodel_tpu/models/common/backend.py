@@ -53,6 +53,10 @@ class BackendConfig:
     def __post_init__(self):
         if self.linear not in ("default", "fp8"):
             raise ValueError(f"unknown linear backend {self.linear!r} (default | fp8)")
+        if self.context_parallel not in ("allgather", "ring"):
+            raise ValueError(
+                f"unknown context_parallel {self.context_parallel!r} (allgather | ring)"
+            )
 
     @property
     def jnp_dtype(self):
