@@ -2,7 +2,7 @@
 
 Usage::
 
-    automodel finetune llm -c examples/llm_finetune/llama_1b.yaml [--a.b.c v ...]
+    automodel finetune llm -c examples/llm_finetune/llama3_2_1b_hellaswag.yaml [--a.b.c v ...]
     automodel pretrain llm -c cfg.yaml
     automodel benchmark llm -c cfg.yaml
 
