@@ -145,7 +145,12 @@ def make_indexer_bias_fn(cfg: DeepseekV32Config):
 
         k_sel = min(cfg.index_topk, S)
         kth = jax.lax.top_k(scores, k_sel)[0][..., -1:]
-        return jnp.where(scores >= kth, 0.0, neg)
+        # Re-intersect with `allowed`: rows with < k_sel allowed keys have
+        # kth == finfo.min, and `scores >= kth` alone would then admit every
+        # position. Ties at the threshold still admit a superset of k_sel keys
+        # (all are causally valid). Masking here keeps the bias self-contained
+        # rather than relying on the downstream attention mask.
+        return jnp.where(allowed & (scores >= kth), 0.0, neg)
 
     return bias_fn
 

@@ -19,8 +19,11 @@ Leading stack dims (layer scan, experts) are vmapped. Non-matrix leaves
 reference's fallback path: plain AdamW with its own lr.
 
 TPU notes: QR on (m, r) tall matrices maps to XLA's householder pipeline; the
-whole update is jit-friendly (no data-dependent shapes) and the Q state shards
-like the parameter's second axis.
+whole update is jit-friendly (no data-dependent shapes). The Q state lives in
+the *canonical flattened* geometry (stack..., cols, r): ``opt_state_shardings``
+shards its leading stack dims like the parameter's and replicates the rest
+(cols x r is rank_fraction^2 of the weight's footprint per stack entry; at very
+large widths shard it explicitly before reaching for rank_fraction >= 0.5).
 """
 
 from __future__ import annotations
@@ -65,13 +68,73 @@ def _dion_update_2d(g, m, q, mu: float):
     return update, m, q_new
 
 
+def _leaf_name(path: tuple) -> str:
+    return (getattr(path[-1], "key", str(path[-1])) if path else "").lower()
+
+
+_STACK_AXES = ("layers", "expert", "experts", "blocks")
+
+
+def _axes_canon_shape(shape: tuple, axes) -> tuple | None:
+    """Canonical (stack..., rows, cols) from the model's logical axis names.
+
+    Leading ``layers``/``expert`` axes stay vmapped stacks; consecutive runs of
+    head-split axes (any name containing "head": heads, kv_heads, head_dim) merge
+    into one matrix dim. Returns None when the leaf does not reduce to exactly a
+    2-D matrix (biases, norms, conv kernels, exotic 3-way layouts) — the caller
+    routes those to AdamW."""
+    if axes is None or len(axes) != len(shape):
+        return None
+    n_stack = 0
+    for a in axes:
+        if a in _STACK_AXES:
+            n_stack += 1
+        else:
+            break
+    sizes: list[int] = []
+    prev_head = False
+    for dim, name in zip(shape[n_stack:], axes[n_stack:]):
+        is_head = "head" in (name or "")
+        if is_head and prev_head:
+            sizes[-1] *= dim
+        else:
+            sizes.append(dim)
+        prev_head = is_head
+    if len(sizes) != 2 or min(sizes) < 2:
+        return None
+    return (*shape[:n_stack], *sizes)
+
+
+def _canon_shape(path: tuple, shape: tuple, axes_by_path: dict | None = None) -> tuple:
+    """Canonical (stack..., rows, cols) view of a matrix leaf.
+
+    Head-split attention projections must be orthonormalized as their full matmul
+    matrix, not per-head blocks. When the model's logical axes are available
+    (``build_dion_optimizer(logical_axes=...)``) the grouping is layout-driven and
+    covers every family (MLA wq_b/wkv_b, DeltaNet wqkvz, ...). The name fallback
+    handles only the classic stacked 4-D cases: wq/wk/wv (L, D, N, H) ->
+    (L, D, N*H) and wo (L, N, H, D) -> (L, N*H, D); 3-D leaves are left alone
+    (a stacked (L, d, d) projection is already a per-layer matrix)."""
+    if axes_by_path is not None:
+        canon = _axes_canon_shape(shape, axes_by_path.get(jax.tree_util.keystr(path)))
+        if canon is not None:
+            return canon
+    name = _leaf_name(path)
+    if len(shape) >= 4 and name in ("wq", "wk", "wv"):
+        return (*shape[:-2], shape[-2] * shape[-1])
+    if len(shape) >= 4 and name == "wo":
+        return (*shape[:-3], shape[-3] * shape[-2], shape[-1])
+    return tuple(shape)
+
+
 def dion(
     learning_rate: optax.ScalarOrSchedule,
     mu: float = 0.95,
     rank_fraction: float = 0.25,
     min_rank: int = 1,
+    axes_by_path: dict | None = None,
 ) -> optax.GradientTransformation:
-    """Dion for matrix leaves (ndim >= 2; leading dims vmapped as stacks).
+    """Dion for matrix leaves (canonical matrix view; leading dims vmapped as stacks).
 
     Wrap with ``optax.masked`` / ``multi_transform`` for mixed parameter groups —
     or use :func:`build_dion_optimizer`, which applies the reference's grouping.
@@ -81,34 +144,37 @@ def dion(
         return max(min_rank, int(min(shape[-2], shape[-1]) * rank_fraction))
 
     def init_fn(params):
-        def init_leaf(p):
+        def init_leaf(path, p):
             if p.ndim < 2:
                 raise ValueError("dion() only handles matrix leaves; mask others out")
-            r = rank_of(p.shape)
+            shape = _canon_shape(path, p.shape, axes_by_path)
+            r = rank_of(shape)
             # deterministic per-shape init; orthonormalized on first use
-            key = jax.random.key(p.ndim * 1000 + p.shape[-1])
-            q = jax.random.normal(key, (*p.shape[:-2], p.shape[-1], r), jnp.float32)
+            key = jax.random.key(len(shape) * 1000 + shape[-1])
+            q = jax.random.normal(key, (*shape[:-2], shape[-1], r), jnp.float32)
             return q
 
         momentum = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        qs = jax.tree.map(init_leaf, params)
+        qs = jax.tree_util.tree_map_with_path(init_leaf, params)
         return DionState(momentum=momentum, q=qs)
 
     def update_fn(updates, state, params=None):
         del params
         lr = learning_rate
 
-        def leaf(g, m, q):
+        def leaf(path, g, m, q):
+            flat = _canon_shape(path, g.shape, axes_by_path)
+            gf, mf = g.reshape(flat), m.reshape(flat)
             fn = _dion_update_2d
-            for _ in range(g.ndim - 2):
+            for _ in range(len(flat) - 2):
                 fn = jax.vmap(fn, in_axes=(0, 0, 0, None))
-            u, m2, q2 = fn(g, m, q, mu)
+            u, m2, q2 = fn(gf, mf, q, mu)
             # dict result (not tuple): optax.MaskedNode is a tuple subclass and must
             # pass through untouched under multi_transform
-            return {"u": u, "m": m2, "q": q2}
+            return {"u": u.reshape(g.shape), "m": m2.reshape(g.shape), "q": q2}
 
         is_res = lambda x: isinstance(x, dict) and set(x) == {"u", "m", "q"}
-        out = jax.tree.map(leaf, updates, state.momentum, state.q)
+        out = jax.tree_util.tree_map_with_path(leaf, updates, state.momentum, state.q)
         upd = jax.tree.map(lambda o: o["u"], out, is_leaf=is_res)
         m_new = jax.tree.map(lambda o: o["m"], out, is_leaf=is_res)
         q_new = jax.tree.map(lambda o: o["q"], out, is_leaf=is_res)
@@ -133,7 +199,14 @@ def _is_matrix_path(path: tuple, leaf) -> bool:
         return False
     if any(tok in name for tok in ("embed", "lm_head", "pos_emb", "score_correction", "conv", "norm")):
         return False
-    if any(pt.startswith("b_") or pt in ("bias", "sinks", "dt_bias", "a_log", "d_skip") for pt in parts):
+    if any(
+        pt.startswith("b_")
+        or "bias" in pt
+        # per-head attention bias vectors (bq (N,H) etc.) are AdamW leaves even
+        # though their trailing dims look matrix-shaped
+        or pt in ("bq", "bk", "bv", "bo", "ba", "sinks", "a_log", "d_skip")
+        for pt in parts
+    ):
         return False
     return True
 
@@ -148,16 +221,40 @@ def build_dion_optimizer(
     b2: float = 0.95,
     eps: float = 1e-8,
     max_grad_norm: float | None = None,
+    logical_axes: Any = None,
 ) -> optax.GradientTransformation:
     """Dion on matrix params + AdamW on the rest, with optional global clipping.
+
+    ``logical_axes`` (the model's ``logical_axes()`` pytree) makes the matrix
+    canonicalization layout-driven: head-split dims merge into the true matmul
+    matrix and leaves that do not reduce to a 2-D matrix fall back to AdamW.
+    Without it, a conservative name-based heuristic covers the standard
+    wq/wk/wv/wo stacked layouts.
 
     Decoupled weight decay applies to BOTH groups, masked off norms/biases (the
     same no_decay_mask contract as build_optimizer's adamw path)."""
     from automodel_tpu.optim.builder import no_decay_mask as masked_decay_mask
 
+    axes_by_path = None
+    if logical_axes is not None:
+        flat = jax.tree_util.tree_flatten_with_path(
+            logical_axes, is_leaf=lambda x: isinstance(x, tuple)
+        )[0]
+        axes_by_path = {jax.tree_util.keystr(p): v for p, v in flat}
+
+    def is_dion_leaf(path, leaf) -> bool:
+        if not _is_matrix_path(path, leaf):
+            return False
+        if axes_by_path is not None:
+            axes = axes_by_path.get(jax.tree_util.keystr(path))
+            # known layout that doesn't reduce to a matrix -> AdamW
+            if axes is not None and _axes_canon_shape(tuple(leaf.shape), axes) is None:
+                return False
+        return True
+
     def label_fn(params):
         return jax.tree_util.tree_map_with_path(
-            lambda path, leaf: "dion" if _is_matrix_path(path, leaf) else "adamw", params
+            lambda path, leaf: "dion" if is_dion_leaf(path, leaf) else "adamw", params
         )
 
     neg_lr = (lambda c: -learning_rate(c)) if callable(learning_rate) else -learning_rate
@@ -169,7 +266,7 @@ def build_dion_optimizer(
     dion_tx = optax.chain(
         # lr=-1 cancels dion()'s internal descent sign, leaving the raw ascent
         # direction for the standard optax add_decayed_weights -> scale(-lr) tail
-        dion(-1.0, mu=mu, rank_fraction=rank_fraction),
+        dion(-1.0, mu=mu, rank_fraction=rank_fraction, axes_by_path=axes_by_path),
         *decay,
         optax.scale_by_schedule(neg_lr) if callable(learning_rate) else optax.scale(neg_lr),
     )

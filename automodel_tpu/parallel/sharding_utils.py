@@ -29,7 +29,7 @@ def _keystr(path) -> str:
 def opt_state_shardings(opt_state_shapes: Any, params: Any, mesh: Mesh) -> Any:
     """Pytree of NamedShardings matching ``opt_state_shapes``' structure."""
     param_paths = [
-        (_keystr(path), leaf.sharding)
+        (_keystr(path), leaf.shape, leaf.sharding)
         for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
         if hasattr(leaf, "sharding")
     ]
@@ -37,9 +37,28 @@ def opt_state_shardings(opt_state_shapes: Any, params: Any, mesh: Mesh) -> Any:
 
     def assign(path, leaf):
         ks = _keystr(path)
-        for pks, sharding in param_paths:
-            if ks.endswith(pks) and getattr(leaf, "shape", None) is not None:
+        shape = getattr(leaf, "shape", None)
+        for pks, pshape, sharding in param_paths:
+            if not ks.endswith(pks) or shape is None:
+                continue
+            if tuple(shape) == tuple(pshape):
                 return sharding
+            # Different geometry at a param's path (e.g. Dion's flattened low-rank
+            # Q factor): inherit the sharding of the leading dims that still line
+            # up (the layer/expert stack dims), replicate the rest.
+            spec = tuple(sharding.spec)
+            # cap at the stack-dim count (both geometries keep their trailing two
+            # matrix dims) so a dim-size coincidence (e.g. N*H == D) can't pull a
+            # matrix-axis spec onto the state leaf
+            n_max = min(len(shape) - 2, len(pshape) - 2, len(spec))
+            n = 0
+            while n < n_max and shape[n] == pshape[n]:
+                n += 1
+            if n:
+                return NamedSharding(
+                    mesh, PartitionSpec(*spec[:n], *([None] * (len(shape) - n)))
+                )
+            return replicated
         return replicated
 
     return jax.tree_util.tree_map_with_path(assign, opt_state_shapes)

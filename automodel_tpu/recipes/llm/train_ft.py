@@ -124,6 +124,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         lr_cfg.setdefault("lr_decay_steps", total_steps)
         self.lr_schedule = build_lr_schedule(max_lr=max_lr, **lr_cfg)
         betas = opt_cfg.pop("betas", (0.9, 0.95))
+        if opt_cfg.get("optimizer") == "dion" and self.peft is None:
+            # layout-driven matrix canonicalization (head-split dims merge into the
+            # true matmul matrix); under PEFT the adapter tree has its own paths and
+            # dion falls back to the name heuristic
+            opt_cfg.setdefault("logical_axes", self.model.logical_axes())
         self.optimizer = build_optimizer(
             lr=self.lr_schedule, betas=tuple(betas), **opt_cfg
         )

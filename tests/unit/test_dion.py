@@ -62,6 +62,110 @@ class TestDion:
             losses.append(float(l))
         assert losses[-1] < losses[0] * 0.5
 
+    def test_head_split_projection_uses_full_matrix(self):
+        """wq (L, D, N, H) must orthonormalize the (D, N*H) matmul matrix per layer,
+        not per-(layer, embed-row) (H, dh) blocks — the update of each layer slice
+        must be low-rank as a (D, N*H) matrix."""
+        rng = np.random.RandomState(3)
+        L, D, N, H = 2, 24, 4, 4
+        g = jnp.asarray(rng.randn(L, D, N, H).astype(np.float32))
+        tx = dion(0.1, rank_fraction=0.5)
+        state = tx.init({"layers": {"wq": g}})
+        # q factor lives in the flattened geometry: (L, N*H, r)
+        assert state.q["layers"]["wq"].shape == (L, N * H, 8)
+        upd, _ = tx.update({"layers": {"wq": g}}, state)
+        assert upd["layers"]["wq"].shape == (L, D, N, H)
+        u0 = np.asarray(upd["layers"]["wq"][0]).reshape(D, N * H)
+        s = np.linalg.svd(u0, compute_uv=False)
+        assert (s[8:] < 1e-4).all(), "per-layer update must be rank<=r over (D, N*H)"
+
+    def test_wo_projection_flattens_leading_heads(self):
+        rng = np.random.RandomState(4)
+        L, N, H, D = 2, 4, 4, 24
+        g = jnp.asarray(rng.randn(L, N, H, D).astype(np.float32))
+        tx = dion(0.1, rank_fraction=0.5)
+        state = tx.init({"layers": {"wo": g}})
+        assert state.q["layers"]["wo"].shape == (L, D, 8)
+        upd, _ = tx.update({"layers": {"wo": g}}, state)
+        assert upd["layers"]["wo"].shape == (L, N, H, D)
+
+    def test_square_stacked_projection_untouched(self):
+        """A vision-tower style wq stored already-flattened as (L, d, d) must be
+        treated as a per-layer (d, d) matrix — NOT have its layer dim fused in."""
+        from automodel_tpu.optim.dion import _canon_shape
+
+        assert _canon_shape((), (4, 8, 8)) == (4, 8, 8)
+
+    def test_axes_driven_canonicalization(self):
+        """logical_axes grouping: MLA wq_b (L, r, N, H) -> (L, r, N*H); DeltaNet
+        wqkvz (L, D, Hk, M) -> (L, D, Hk*M); 3-way layouts fall back to AdamW."""
+        from automodel_tpu.optim.dion import _axes_canon_shape
+
+        # no stack prefix -> three matrix dims -> ambiguous
+        assert _axes_canon_shape((2, 6, 4, 8), (None, None, "heads", "head_dim")) is None
+        assert _axes_canon_shape(
+            (2, 6, 4, 8), ("layers", None, "heads", "head_dim")
+        ) == (2, 6, 32)
+        assert _axes_canon_shape(
+            (2, 16, 4, 8), ("layers", "embed", "kv_heads", "head_dim")
+        ) == (2, 16, 32)
+        assert _axes_canon_shape(
+            (2, 4, 8, 16), ("layers", "heads", "head_dim", "embed")
+        ) == (2, 32, 16)
+        # per-head bias (L, N, H) -> single merged dim -> not a matrix
+        assert _axes_canon_shape((2, 4, 8), ("layers", "heads", "head_dim")) is None
+        # three distinct matrix dims: ambiguous, AdamW
+        assert _axes_canon_shape((2, 4, 8, 16), ("layers", "a", "b", "c")) is None
+
+    def test_build_with_logical_axes_mla(self):
+        """build_dion_optimizer(logical_axes=...) orthonormalizes wq_b over the
+        full (r, N*H) matrix per layer."""
+        rng = np.random.RandomState(5)
+        L, r_lat, N, H = 2, 12, 4, 4
+        params = {"layers": {"wq_b": jnp.asarray(rng.randn(L, r_lat, N, H).astype(np.float32))}}
+        axes = {"layers": {"wq_b": ("layers", None, "heads", "head_dim")}}
+        tx = build_dion_optimizer(0.1, rank_fraction=0.5, logical_axes=axes)
+        state = tx.init(params)
+        q = state.inner_states["dion"].inner_state[0].q["layers"]["wq_b"]
+        assert q.shape == (L, N * H, 6)
+        upd, _ = tx.update(jax.tree.map(jnp.ones_like, params), state, params)
+        u0 = np.asarray(upd["layers"]["wq_b"][0]).reshape(r_lat, N * H)
+        s = np.linalg.svd(u0, compute_uv=False)
+        assert (s[6:] < 1e-4).all()
+
+    def test_dense_decoder_param_tree(self):
+        """End-to-end over a real dense-decoder tree: labels route per-head biases
+        to adamw, and the jitted dion+adamw step runs over every leaf."""
+        from automodel_tpu.models.common.backend import BackendConfig
+        from automodel_tpu.models.llama.model import LlamaConfig, LlamaForCausalLM
+        from automodel_tpu.optim.dion import _is_matrix_path
+
+        import jax.tree_util as jtu
+
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            attention_bias=True,
+        )
+        model = LlamaForCausalLM(cfg, BackendConfig(dtype="float32"))
+        params = model.init(jax.random.key(0), jnp.float32)
+        labels = jtu.tree_map_with_path(
+            lambda p, l: "dion" if _is_matrix_path(p, l) else "adamw", params
+        )
+        layer_labels = labels["layers"]
+        for name in ("bq", "bk", "bv"):
+            if name in layer_labels:
+                assert layer_labels[name] == "adamw", f"{name} must not be orthonormalized"
+        assert layer_labels["wq"] == "dion"
+        assert layer_labels["w_down"] == "dion"
+
+        tx = build_dion_optimizer(optax.constant_schedule(1e-3), max_grad_norm=1.0)
+        state = tx.init(params)
+        grads = jax.tree.map(jnp.ones_like, params)
+        upd, _ = jax.jit(tx.update)(grads, state, params)
+        chex_shapes = jax.tree.map(lambda u, p: u.shape == p.shape, upd, params)
+        assert all(jax.tree.leaves(chex_shapes))
+
     def test_grouping_labels(self):
         from automodel_tpu.optim.dion import _is_matrix_path
 
