@@ -190,10 +190,12 @@ def _constrain(x, rules, names):
 
 def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, x, positions,
                      segment_ids, inv_freq, attn_scale, sliding, rules):
+    from jax.ad_checkpoint import checkpoint_name
+
     lin = backend.linear
-    q = project(x, lp["wq"], 1, lin)
-    k = project(x, lp["wk"], 1, lin)
-    v = project(x, lp["wv"], 1, lin)
+    q = checkpoint_name(project(x, lp["wq"], 1, lin), "attn_q")
+    k = checkpoint_name(project(x, lp["wk"], 1, lin), "attn_k")
+    v = checkpoint_name(project(x, lp["wv"], 1, lin), "attn_v")
     if cfg.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -223,16 +225,16 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
         from automodel_tpu.parallel.ring_attention import make_ring_attention
 
         ring = make_ring_attention(mesh, causal=cfg.causal)
-        out = ring(q, k, v, positions, segment_ids)
+        out = checkpoint_name(ring(q, k, v, positions, segment_ids), "attn_out")
     else:
-        out = dot_product_attention(
+        out = checkpoint_name(dot_product_attention(
             q, k, v,
             causal=cfg.causal,
             segment_ids_q=segment_ids,
             sliding_window=sliding,
             sinks=lp.get("sinks"),
             backend=backend.attention,
-        )
+        ), "attn_out")
     o = project(out, lp["wo"], 2, lin)
     if cfg.attention_out_bias:
         o = o + lp["bo"]
@@ -240,9 +242,13 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
 
 
 def _mlp_block(backend: BackendConfig, lp: dict, x, rules):
+    from jax.ad_checkpoint import checkpoint_name
+
     lin = backend.linear
-    gate = project(x, lp["w_gate"], 1, lin)
-    up = project(x, lp["w_up"], 1, lin)
+    # names feed the "dots_except_mlp" remat policy (backend.py): these two
+    # (tokens, intermediate) tensors are the activation-memory peak of the layer
+    gate = checkpoint_name(project(x, lp["w_gate"], 1, lin), "mlp_gate")
+    up = checkpoint_name(project(x, lp["w_up"], 1, lin), "mlp_up")
     act = _constrain(jax.nn.silu(gate) * up, rules, ("batch", "act_attn_seq", "act_mlp"))
     return project(act, lp["w_down"], 1, lin)
 

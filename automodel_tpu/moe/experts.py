@@ -84,10 +84,17 @@ def sorted_ragged_ffn(
 ) -> jnp.ndarray:
     """The grouped-GEMM FFN core shared by the GSPMD and explicit-EP paths:
     ragged_dot gate_up -> bias -> activation -> ragged_dot down -> bias."""
-    h = jax.lax.ragged_dot(xs, params["gate_up_proj"], group_sizes)
+    from jax.ad_checkpoint import checkpoint_name
+
+    # "mlp_gate"/"mlp_act": the (tokens*K, 2I) expert intermediates are the MoE
+    # analogue of the dense gate/up tensors — the mlp_* remat policies
+    # (backend.py) save/recompute them the same way
+    h = checkpoint_name(
+        jax.lax.ragged_dot(xs, params["gate_up_proj"], group_sizes), "mlp_gate"
+    )
     if "gate_up_bias" in params:
         h = h + params["gate_up_bias"][sorted_expert_ids]
-    act = expert_activation(cfg, h).astype(xs.dtype)
+    act = checkpoint_name(expert_activation(cfg, h).astype(xs.dtype), "mlp_act")
     out = jax.lax.ragged_dot(act, params["down_proj"], group_sizes)
     if "down_bias" in params:
         out = out + params["down_bias"][sorted_expert_ids]
@@ -167,10 +174,14 @@ def capacity_experts_apply(
     disp = jnp.einsum("tke,tkc->tec", expert_oh, slot)
     xd = jnp.einsum("tec,td->ecd", disp, x)  # (E, C, D)
 
-    h = jnp.einsum("ecd,edf->ecf", xd, params["gate_up_proj"].astype(x.dtype))
+    from jax.ad_checkpoint import checkpoint_name
+
+    h = checkpoint_name(
+        jnp.einsum("ecd,edf->ecf", xd, params["gate_up_proj"].astype(x.dtype)), "mlp_gate"
+    )
     if "gate_up_bias" in params:
         h = h + params["gate_up_bias"][:, None, :]
-    act = expert_activation(cfg, h).astype(x.dtype)
+    act = checkpoint_name(expert_activation(cfg, h).astype(x.dtype), "mlp_act")
     out = jnp.einsum("ecf,efd->ecd", act, params["down_proj"].astype(x.dtype))
     if "down_bias" in params:
         out = out + params["down_bias"][:, None, :]

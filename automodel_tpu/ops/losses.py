@@ -23,7 +23,10 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-__all__ = ["masked_cross_entropy", "chunked_cross_entropy", "linear_cross_entropy", "kd_loss"]
+__all__ = [
+    "masked_cross_entropy", "chunked_cross_entropy", "linear_cross_entropy",
+    "fused_linear_ce_tokens", "pallas_linear_ce_supported", "kd_loss",
+]
 
 IGNORE_INDEX = -100
 
@@ -72,12 +75,51 @@ def chunked_cross_entropy(
 
     def body(carry, chunk):
         logits_c, labels_c = chunk
-        s, c = _ce_sum(logits_c, labels_c, ignore_index)
-        return (carry[0] + s, carry[1] + c), None
+        # per-chunk sums ride as stacked outputs, not carries: a zero-init carry
+        # would clash with shard_map's varying-axis tracking inside manual regions
+        return carry, _ce_sum(logits_c, labels_c, ignore_index)
 
-    (total, count), _ = jax.lax.scan(body, (jnp.float32(0), jnp.int32(0)), (flat_logits, flat_labels))
+    _, (sums, counts) = jax.lax.scan(body, (), (flat_logits, flat_labels))
+    total, count = sums.sum(), counts.sum()
     denom = count if num_label_tokens is None else num_label_tokens
     return total / jnp.maximum(denom, 1).astype(jnp.float32)
+
+
+def fused_linear_ce_tokens(
+    hidden2d: jnp.ndarray,  # (N, embed)
+    unembed: jnp.ndarray,  # (embed, vocab_local)
+    labels: jnp.ndarray,  # (N,) GLOBAL label ids
+    ignore_index: int = IGNORE_INDEX,
+    vocab_offset: jnp.ndarray | int = 0,
+    interpret: bool | None = None,
+    filter_eps: float | None = 1e-7,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Pallas fused projection+CE partials: per-token (z, gold), logits never in HBM.
+
+    Vocab-shard aware: with ``unembed`` a vocab shard and ``vocab_offset`` its
+    global start, combine across shards with ``logsumexp(z)`` / ``sum(gold)``
+    before forming ``loss = z - gold`` (reference te_cross_entropy.py:113).
+    Returns None-equivalent is not provided — callers must check
+    :func:`pallas_linear_ce_supported` first.
+    """
+    from automodel_tpu.ops.pallas.linear_ce import fused_logsumexp, gold_logits, pick_blocks
+
+    n, e = hidden2d.shape
+    block_n, block_v = pick_blocks(e, unembed.shape[1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    local_labels = labels.astype(jnp.int32) - vocab_offset
+    gold = gold_logits(hidden2d, unembed, local_labels)
+    pad = (-n) % block_n
+    h_pad = jnp.pad(hidden2d, ((0, pad), (0, 0))) if pad else hidden2d
+    z = fused_logsumexp(h_pad, unembed, block_n, block_v, interpret, filter_eps)
+    return z[:n], gold
+
+
+def pallas_linear_ce_supported(embed: int, vocab_local: int) -> bool:
+    from automodel_tpu.ops.pallas.linear_ce import pick_blocks
+
+    return pick_blocks(embed, vocab_local) is not None
 
 
 def linear_cross_entropy(
@@ -87,9 +129,33 @@ def linear_cross_entropy(
     num_label_tokens: jnp.ndarray | int | None = None,
     ignore_index: int = IGNORE_INDEX,
     block_size: int = 1024,
+    impl: str = "auto",  # auto | pallas | xla
+    filter_eps: float | None = 1e-7,
 ) -> jnp.ndarray:
-    """Fused projection+CE: logits exist only one (block, vocab) tile at a time."""
+    """Fused projection+CE: logits exist only one (block, vocab) tile at a time.
+
+    ``impl="pallas"`` (or auto on TPU) routes to the Pallas kernel pair with a
+    manual VJP — logits live only as a VMEM tile even in the backward. The XLA
+    path is the blockwise-remat scan; it is also the fallback for shapes the
+    kernel can't tile. NOTE: the pallas path assumes an unsharded (replicated)
+    ``unembed``; under tensor-parallel vocab sharding use
+    :func:`fused_linear_ce_tokens` inside shard_map instead.
+    """
     e = hidden.shape[-1]
+    use_pallas = impl == "pallas" or (impl == "auto" and jax.default_backend() == "tpu")
+    if use_pallas and pallas_linear_ce_supported(e, unembed.shape[-1]):
+        flat_h = hidden.reshape(-1, e)
+        flat_labels = labels.reshape(-1)
+        z, gold = fused_linear_ce_tokens(
+            flat_h, unembed, flat_labels, ignore_index,
+            interpret=None if impl == "auto" else (jax.default_backend() != "tpu"),
+            filter_eps=filter_eps,
+        )
+        valid = flat_labels != ignore_index
+        total = jnp.where(valid, z - gold, 0.0).sum()
+        count = valid.sum()
+        denom = count if num_label_tokens is None else num_label_tokens
+        return total / jnp.maximum(denom, 1).astype(jnp.float32)
     flat_h = hidden.reshape(-1, e)
     flat_labels = labels.reshape(-1)
     n = flat_h.shape[0]
@@ -104,13 +170,14 @@ def linear_cross_entropy(
     def body(carry, blk):
         # remat: the (block, vocab) logits tile is recomputed in backward instead of
         # saved per scan step — without this the scan residuals re-materialize the
-        # full logits tensor and the fusion saves nothing (cut-cross-entropy trick)
+        # full logits tensor and the fusion saves nothing (cut-cross-entropy trick).
+        # Sums ride as stacked outputs, not carries (shard_map varying-axis safety).
         h_b, l_b = blk
         logits_b = h_b.astype(jnp.float32) @ unembed.astype(jnp.float32)
-        s, c = _ce_sum(logits_b, l_b, ignore_index)
-        return (carry[0] + s, carry[1] + c), None
+        return carry, _ce_sum(logits_b, l_b, ignore_index)
 
-    (total, count), _ = jax.lax.scan(body, (jnp.float32(0), jnp.int32(0)), (blocks_h, blocks_l))
+    _, (sums, counts) = jax.lax.scan(body, (), (blocks_h, blocks_l))
+    total, count = sums.sum(), counts.sum()
     denom = count if num_label_tokens is None else num_label_tokens
     return total / jnp.maximum(denom, 1).astype(jnp.float32)
 

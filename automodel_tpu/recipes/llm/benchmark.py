@@ -36,34 +36,48 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
 
         from automodel_tpu.data.collate import stack_batches
 
+        # pre-stage every batch on device BEFORE the timed window: per-step
+        # device_put round-trips (especially through a remote-execution tunnel)
+        # would otherwise bill host I/O to the step time being measured
         it = iter(self.step_scheduler)
-        get = lambda: {
-            k: jax.device_put(v, self.rules.sharding((None, "batch", None)))
-            for k, v in stack_batches(next(it)).items()
-        }
+        staged = [
+            {
+                k: jax.device_put(v, self.rules.sharding((None, "batch", None)))
+                for k, v in stack_batches(next(it)).items()
+            }
+            for _ in range(warmup + steps)
+        ]
+        staged_it = iter(staged)
+        get = lambda: next(staged_it)
 
         tracing = False
         with self.mesh:
+            # sync via host transfer: block_until_ready does NOT block through the
+            # axon remote-execution tunnel (bench.py learned this the hard way —
+            # throughput numbers inflate ~1000x otherwise)
             m = None
             for _ in range(warmup):
                 self.params, self.opt_state, m = self._train_step(self.params, self.opt_state, get())
             if m is not None:
-                jax.block_until_ready(m["loss"])
+                float(m["loss"])
 
-            step_times = []
+            # time the whole window with ONE sync at each end: a per-step host
+            # sync stalls the device pipeline every step (and costs a full
+            # round-trip through a remote-execution tunnel)
+            t0 = time.perf_counter()
             for i in range(steps):
                 if profile_start is not None and i == int(profile_start):
                     jax.profiler.start_trace(profile_dir)
                     tracing = True
-                batch = get()
-                t0 = time.perf_counter()
-                self.params, self.opt_state, m = self._train_step(self.params, self.opt_state, batch)
-                jax.block_until_ready(m["loss"])
-                step_times.append(time.perf_counter() - t0)
+                self.params, self.opt_state, m = self._train_step(self.params, self.opt_state, get())
                 if tracing and profile_end is not None and i >= int(profile_end):
+                    float(m["loss"])  # flush before closing the trace
                     jax.profiler.stop_trace()
                     tracing = False
                     logger.info("profile written to %s", profile_dir)
+            float(m["loss"])  # host transfer = real sync through the tunnel
+            window = time.perf_counter() - t0
+            step_times = [window / steps]
             if tracing:
                 jax.profiler.stop_trace()
                 logger.info("profile written to %s", profile_dir)
