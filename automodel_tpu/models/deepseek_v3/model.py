@@ -216,19 +216,38 @@ def _mla_block(cfg: DeepseekV3Config, backend: BackendConfig, lp: dict, x, posit
         [k_nope, jnp.broadcast_to(k_pe, (*k_nope.shape[:-1], cfg.qk_rope_head_dim))], axis=-1
     )
 
-    q = _constrain(q, rules, ("batch", "act_attn_seq", "act_heads", None))
-    k = _constrain(k, rules, ("batch", "act_attn_seq", "act_heads", None))
+    from jax.ad_checkpoint import checkpoint_name
+
+    q = checkpoint_name(_constrain(q, rules, ("batch", "act_attn_seq", "act_heads", None)), "attn_q")
+    k = checkpoint_name(_constrain(k, rules, ("batch", "act_attn_seq", "act_heads", None)), "attn_k")
+    v = checkpoint_name(v, "attn_v")
     extra_bias = None
     if bias_fn is not None:
         extra_bias = bias_fn(lp, x, q_latent, positions, segment_ids)
-    out = dot_product_attention(
-        q, k, v,
-        causal=True,
-        segment_ids_q=segment_ids,
-        softmax_scale=cfg.softmax_scale,
-        extra_bias=extra_bias,
-        backend=backend.attention,
+    mesh = rules.mesh if rules is not None else None
+    use_ring = (
+        backend.context_parallel == "ring"
+        and mesh is not None
+        and mesh.shape.get("cp", 1) > 1
+        and extra_bias is None  # V3.2 sparse-indexer bias is (S_global, S_global)
     )
+    if use_ring:
+        # MLA ring CP (reference runs MLA through TE ring attention the same way,
+        # moe/parallelizer.py:267-285): v_head_dim != qk dim is fine — the ring
+        # accumulator follows v's dim
+        from automodel_tpu.parallel.ring_attention import make_ring_attention
+
+        ring = make_ring_attention(mesh, causal=True, softmax_scale=cfg.softmax_scale)
+        out = ring(q, k, v, positions, segment_ids)
+    else:
+        out = dot_product_attention(
+            q, k, v,
+            causal=True,
+            segment_ids_q=segment_ids,
+            softmax_scale=cfg.softmax_scale,
+            extra_bias=extra_bias,
+            backend=backend.attention,
+        )
     return jnp.einsum("bsnh,nhd->bsd", out, lp["wo"])
 
 

@@ -86,18 +86,16 @@ def dot_product_attention(
     backend: Backend = "xla",
 ) -> jnp.ndarray:
     """Multi-head attention with GQA, packing segments, sliding window, soft-cap, sinks."""
+    interpret = backend == "flash_interpret"  # CPU kernel-semantics testing
     if (
-        backend == "flash"
+        backend in ("flash", "flash_interpret")
         and extra_bias is None
-        and jax.default_backend() == "tpu"
-        and logit_soft_cap is None
-        and sinks is None
+        and (jax.default_backend() == "tpu" or interpret)
         and positions_q is None  # flash path masks by absolute index, not positions
         and positions_kv is None
-        # kernel constraints: static window (a traced per-layer window can't close
-        # over a pallas kernel), uniform head_dim, seqs divisible by some block >= 8
-        # (the kernel's block picker halves until it divides)
-        and isinstance(sliding_window, (int, type(None)))
+        # kernel constraints: uniform head_dim, seqs divisible by some block >= 8
+        # (the kernel's block picker halves until it divides); sliding windows may
+        # be ints OR traced scalars (they ride into the kernel through SMEM)
         and q.shape[-1] == v.shape[-1]
         and q.shape[1] % 8 == 0
         and k.shape[1] % 8 == 0
@@ -111,6 +109,9 @@ def dot_product_attention(
             segment_ids_kv=segment_ids_kv,
             sliding_window=sliding_window,
             softmax_scale=softmax_scale,
+            logit_soft_cap=logit_soft_cap,
+            sinks=sinks,
+            interpret=interpret,
         )
 
     b, sq, nh, hd = q.shape

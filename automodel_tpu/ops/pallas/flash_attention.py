@@ -70,9 +70,20 @@ def _run_block(q_start, kv_start, block_q, block_k, *, causal, window):
     return run
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, window, block_q, block_k,
-                num_kv, segmented):
+def _soft_cap(s, cap):
+    """tanh logit capping (gemma2/grok style); None -> identity."""
+    return s if cap is None else jnp.tanh(s / cap) * cap
+
+
+def _soft_cap_jac(s_capped, cap):
+    """d(capped)/d(raw) expressed in the *capped* value: 1 - (capped/cap)^2."""
+    return 1.0 - (s_capped / cap) ** 2
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, sink_ref, w_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
+                num_kv, segmented, softcap, has_sink, windowed):
+    window = w_ref[0] if windowed else None
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -90,6 +101,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (bq, bk)
+        s = _soft_cap(s, softcap)
 
         allowed = _block_mask(
             q_start, kv_start, block_q, block_k, causal=causal, window=window,
@@ -114,16 +126,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, o_ref, lse_ref,
 
     @pl.when(ki == num_kv - 1)
     def _finalize():
-        l = l_ref[:, :1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse = jnp.where(l == 0.0, NEG_INF, m_ref[:, :1] + jnp.log(safe_l))
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        if has_sink:
+            # gpt-oss attention sinks: a per-head extra logit column absorbing
+            # softmax mass. Fold it into the running (m, l) stats: the sink
+            # contributes exp(sink) to the denominator and nothing to the value
+            # accumulator; lse then already accounts for it, so the backward
+            # kernels need no change (p = exp(s - lse) sums to < 1).
+            sink = sink_ref[0, 0, 0]
+            m0, l0 = m_ref[:, :1], l_ref[:, :1]
+            m_eff = jnp.maximum(m0, sink)
+            alpha = jnp.exp(m0 - m_eff)  # 0 for fully-masked rows (m0 = -inf)
+            l = l0 * alpha + jnp.exp(sink - m_eff)
+            o_ref[0] = (acc_ref[:] * alpha / l).astype(o_ref.dtype)
+            lse_ref[0] = jnp.broadcast_to(m_eff + jnp.log(l), lse_ref.shape[1:])
+        else:
+            l = l_ref[:, :1]
+            safe_l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+            lse = jnp.where(l == 0.0, NEG_INF, m_ref[:, :1] + jnp.log(safe_l))
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, acc_ref, *, scale, causal, window, block_q, block_k, num_kv,
-               segmented):
+def _dq_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, w_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, acc_ref, *, scale, causal, block_q, block_k, num_kv,
+               segmented, softcap, windowed):
+    window = w_ref[0] if windowed else None
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -139,6 +166,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        s = _soft_cap(s, softcap)
         allowed = _block_mask(
             q_start, kv_start, block_q, block_k, causal=causal, window=window,
             seg_q=sq_ref[0, :, :1] if segmented else None,
@@ -151,6 +179,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, :, :1])
+        if softcap is not None:
+            ds = ds * _soft_cap_jac(s, softcap)
         acc_ref[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32) * scale
 
     @pl.when(ki == num_kv - 1)
@@ -158,9 +188,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, window,
-                block_q, block_k, num_q, segmented):
+def _dkv_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, w_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                block_q, block_k, num_q, segmented, softcap, windowed):
+    window = w_ref[0] if windowed else None
     ki, qi = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -177,6 +208,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, lse_ref, delta_ref
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        s = _soft_cap(s, softcap)
         allowed = _block_mask(
             q_start, kv_start, block_q, block_k, causal=causal, window=window,
             seg_q=sq_ref[0, :, :1] if segmented else None,
@@ -191,6 +223,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, lse_ref, delta_ref
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, :, :1])
+        if softcap is not None:
+            ds = ds * _soft_cap_jac(s, softcap)
         dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32) * scale
 
@@ -210,22 +244,26 @@ def _kv_sublanes(x):
     return jax.lax.broadcast_in_dim(x, (x.shape[0], SUBLANES, x.shape[1]), (0, 2))
 
 
-def _specs(bn_map, d, block_q, block_k, segmented):
-    """(q, k, v, seg_q, seg_kv) block specs; bn_map maps grid b -> kv row."""
+def _specs(bn_map, d, block_q, block_k, segmented, has_sink=False, windowed=False):
+    """(q, k, v, seg_q, seg_kv, sinks, window) block specs; bn_map maps grid b -> kv row.
+    The sliding window rides as a (1,) SMEM scalar so traced per-layer windows
+    (gpt-oss/gemma alternating layer types under a layer scan) stay kernel-eligible."""
     return [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (bn_map(b), j, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (bn_map(b), j, 0)),
         pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)) if segmented else None,
         pl.BlockSpec((1, SUBLANES, block_k), lambda b, i, j: (bn_map(b), 0, j)) if segmented else None,
+        pl.BlockSpec((1, 1, LANES), lambda b, i, j: (b, 0, 0)) if has_sink else None,
+        pl.BlockSpec(memory_space=pltpu.SMEM) if windowed else None,
     ]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
-def _flash(q, k, v, seg_q, seg_kv, scale, causal, window,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13))
+def _flash(q, k, v, seg_q, seg_kv, sinks, warr, scale, causal, softcap,
            block_q, block_k, groups, interpret):
-    o, _ = _flash_fwd_impl(q, k, v, seg_q, seg_kv, scale, causal, window,
-                           block_q, block_k, groups, interpret)
+    o, _ = _flash_fwd_impl(q, k, v, seg_q, seg_kv, sinks, warr, scale, causal,
+                           softcap, block_q, block_k, groups, interpret)
     return o
 
 
@@ -234,25 +272,40 @@ def _filter_specs(specs, args):
     return [s for s, _ in keep], [a for _, a in keep]
 
 
-def _flash_fwd_impl(q, k, v, seg_q, seg_kv, scale, causal, window,
-                    block_q, block_k, groups, interpret):
+def _flash_fwd_impl(q, k, v, seg_q, seg_kv, sinks, warr, scale, causal,
+                    softcap, block_q, block_k, groups, interpret):
     """q: (BN, Sq, D); k/v: (BK, Skv, D) with BN = BK * groups.
-    seg_q: (BN, Sq, LANES) or None; seg_kv: (BK, SUBLANES, Skv) or None."""
+    seg_q: (BN, Sq, LANES) or None; seg_kv: (BK, SUBLANES, Skv) or None;
+    sinks: (BN, 1, LANES) f32 per-row sink logits or None;
+    warr: (1,) int32 sliding window (possibly traced) or None."""
     bn, sq, d = q.shape
     _, skv, _ = k.shape
     num_q, num_kv = sq // block_q, skv // block_k
     segmented = seg_q is not None
+    has_sink = sinks is not None
+    windowed = warr is not None
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, window=window,
+        _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, num_kv=num_kv, segmented=segmented,
+        softcap=softcap, has_sink=has_sink, windowed=windowed,
     )
+
+    def kernel_entry(*refs):
+        it = iter(refs)
+        q_r, k_r, v_r = next(it), next(it), next(it)
+        sq_r = next(it) if segmented else None
+        skv_r = next(it) if segmented else None
+        sink_r = next(it) if has_sink else None
+        w_r = next(it) if windowed else None
+        kernel(q_r, k_r, v_r, sq_r, skv_r, sink_r, w_r, *it)
+
     specs, args = _filter_specs(
-        _specs(lambda b: b // groups, d, block_q, block_k, segmented),
-        [q, k, v, seg_q, seg_kv],
+        _specs(lambda b: b // groups, d, block_q, block_k, segmented, has_sink, windowed),
+        [q, k, v, seg_q, seg_kv, sinks, warr],
     )
     o, lse = pl.pallas_call(
-        kernel if segmented else (lambda q, k, v, o, l, *s: kernel(q, k, v, None, None, o, l, *s)),
+        kernel_entry,
         grid=(bn, num_q, num_kv),
         in_specs=specs,
         out_specs=[
@@ -276,16 +329,17 @@ def _flash_fwd_impl(q, k, v, seg_q, seg_kv, scale, causal, window,
     return o, lse
 
 
-def _flash_fwd(q, k, v, seg_q, seg_kv, scale, causal, window,
+def _flash_fwd(q, k, v, seg_q, seg_kv, sinks, warr, scale, causal, softcap,
                block_q, block_k, groups, interpret):
-    o, lse = _flash_fwd_impl(q, k, v, seg_q, seg_kv, scale, causal, window,
-                             block_q, block_k, groups, interpret)
-    return o, (q, k, v, seg_q, seg_kv, o, lse)
+    o, lse = _flash_fwd_impl(q, k, v, seg_q, seg_kv, sinks, warr, scale, causal,
+                             softcap, block_q, block_k, groups, interpret)
+    return o, (q, k, v, seg_q, seg_kv, sinks, warr, o, lse)
 
 
-def _flash_bwd(scale, causal, window, block_q, block_k, groups, interpret,
+def _flash_bwd(scale, causal, softcap, block_q, block_k, groups, interpret,
                residuals, do):
-    q, k, v, seg_q, seg_kv, o, lse = residuals
+    q, k, v, seg_q, seg_kv, sinks, warr, o, lse = residuals
+    windowed = warr is not None
     bn, sq, d = q.shape
     bk_heads, skv, _ = k.shape
     num_q, num_kv = sq // block_q, skv // block_k
@@ -301,18 +355,26 @@ def _flash_bwd(scale, causal, window, block_q, block_k, groups, interpret,
         ]
 
     dq_kernel = functools.partial(
-        _dq_kernel, scale=scale, causal=causal, window=window,
+        _dq_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, num_kv=num_kv, segmented=segmented,
+        softcap=softcap, windowed=windowed,
     )
+
+    def dq_entry(*refs):
+        it = iter(refs)
+        q_r, k_r, v_r = next(it), next(it), next(it)
+        sq_r = next(it) if segmented else None
+        skv_r = next(it) if segmented else None
+        w_r = next(it) if windowed else None
+        dq_kernel(q_r, k_r, v_r, sq_r, skv_r, w_r, *it)
+
     specs, args = _filter_specs(
-        _specs(lambda b: b // groups, d, block_q, block_k, segmented)
+        _specs(lambda b: b // groups, d, block_q, block_k, segmented, False, windowed)
         + row_specs(lambda b, i, j: (b, i, 0)),
-        [q, k, v, seg_q, seg_kv, do, lse, delta],
+        [q, k, v, seg_q, seg_kv, None, warr, do, lse, delta],  # None: no sink input in bwd
     )
     dq = pl.pallas_call(
-        dq_kernel if segmented else (
-            lambda q, k, v, do, l, dl, dq, a: dq_kernel(q, k, v, None, None, do, l, dl, dq, a)
-        ),
+        dq_entry,
         grid=(bn, num_q, num_kv),
         in_specs=specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -333,9 +395,19 @@ def _flash_bwd(scale, causal, window, block_q, block_k, groups, interpret,
         else seg_kv
     )
     dkv_kernel = functools.partial(
-        _dkv_kernel, scale=scale, causal=causal, window=window,
+        _dkv_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, num_q=num_q, segmented=segmented,
+        softcap=softcap, windowed=windowed,
     )
+
+    def dkv_entry(*refs):
+        it = iter(refs)
+        q_r, k_r, v_r = next(it), next(it), next(it)
+        sq_r = next(it) if segmented else None
+        skv_r = next(it) if segmented else None
+        w_r = next(it) if windowed else None
+        dkv_kernel(q_r, k_r, v_r, sq_r, skv_r, w_r, *it)
+
     # grid order here is (bn, kv, q): q/do/lse/delta index with the LAST grid dim
     qkv_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
@@ -343,17 +415,14 @@ def _flash_bwd(scale, causal, window, block_q, block_k, groups, interpret,
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)) if segmented else None,
         pl.BlockSpec((1, SUBLANES, block_k), lambda b, j, i: (b, 0, j)) if segmented else None,
+        pl.BlockSpec(memory_space=pltpu.SMEM) if windowed else None,
     ]
     specs, args = _filter_specs(
         qkv_specs + row_specs(lambda b, j, i: (b, i, 0)),
-        [q, kx, vx, seg_q, skx, do, lse, delta],
+        [q, kx, vx, seg_q, skx, warr, do, lse, delta],
     )
     dk, dv = pl.pallas_call(
-        dkv_kernel if segmented else (
-            lambda q, k, v, do, l, dl, dk, dv, ka, va: dkv_kernel(
-                q, k, v, None, None, do, l, dl, dk, dv, ka, va
-            )
-        ),
+        dkv_entry,
         grid=(bn, num_kv, num_q),
         in_specs=specs,
         out_specs=[
@@ -376,7 +445,18 @@ def _flash_bwd(scale, causal, window, block_q, block_k, groups, interpret,
     if groups > 1:
         dk = dk.reshape(bk_heads, groups, skv, d).sum(1).astype(k.dtype)
         dv = dv.reshape(bk_heads, groups, skv, d).sum(1).astype(v.dtype)
-    return dq, dk, dv, None, None
+    dsinks = None
+    if sinks is not None:
+        # d loss / d sink_b = -sum_i exp(sink_b - lse_{b,i}) * Delta_{b,i}
+        # (the sink column's p * (dp - Delta) with dp = 0); cheap XLA reduction
+        # over the saved lse + delta. Gradient lands on lane 0, matching the
+        # kernel's sink_ref[0, 0, 0] read; the wrapper's broadcast transposes
+        # the rest away.
+        p_sink = jnp.exp(sinks[:, 0, 0][:, None] - lse[:, :, 0])  # (bn, sq)
+        dsink_rows = -(p_sink * delta[:, :, 0]).sum(-1)  # (bn,)
+        dsinks = jnp.zeros_like(sinks).at[:, 0, 0].set(dsink_rows)
+    dwarr = None
+    return dq, dk, dv, None, None, dsinks, dwarr
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -392,6 +472,8 @@ def flash_attention(
     segment_ids_kv: jnp.ndarray | None = None,  # (B, Skv)
     sliding_window: int | None = None,
     softmax_scale: float | None = None,
+    logit_soft_cap: float | None = None,
+    sinks: jnp.ndarray | None = None,  # (N,) per-head sink logits (gpt-oss)
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool = False,
@@ -431,7 +513,19 @@ def flash_attention(
         skv_ids = segment_ids_kv if segment_ids_kv is not None else segment_ids_q
         seg_q = _q_lanes(jnp.repeat(sq_ids.astype(jnp.int32), n, axis=0))
         seg_kv = _kv_sublanes(jnp.repeat(skv_ids.astype(jnp.int32), nk, axis=0))
+    sinks_rows = None
+    if sinks is not None:
+        # per-head scalar -> one (1, LANES) row per (batch, head) grid row; the
+        # kernel reads lane 0 and AD sums the tile/broadcast back to (N,)
+        sinks_rows = jnp.broadcast_to(
+            jnp.tile(sinks.astype(jnp.float32), b)[:, None, None], (b * n, 1, LANES)
+        )
 
-    o = _flash(qf, kf, vf, seg_q, seg_kv, softmax_scale, causal,
-               sliding_window, block_q, block_k, groups, interpret)
+    warr = None
+    if sliding_window is not None:
+        # (1,) SMEM scalar: keeps traced per-layer windows (gpt-oss/gemma layer
+        # scans) kernel-eligible instead of forcing the XLA fallback
+        warr = jnp.asarray(sliding_window, jnp.int32).reshape(1)
+    o = _flash(qf, kf, vf, seg_q, seg_kv, sinks_rows, warr, softmax_scale, causal,
+               logit_soft_cap, block_q, block_k, groups, interpret)
     return o.reshape(b, n, sq, d).transpose(0, 2, 1, 3)

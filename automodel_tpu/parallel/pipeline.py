@@ -10,14 +10,26 @@ hand-built 1F1B schedule, the layer-stacked param layout makes stage slicing a
 yielding the mirrored backward pipeline automatically — no schedule code, no shape
 inference, no stage graphs.
 
-Schedule: GPipe-style (all-forward then all-backward per optimizer step) with
-bubble fraction (pp-1)/(n_micro+pp-1); the reference's 1F1B/interleaved/zero-bubble
-schedules trade that bubble for explicit per-microbatch scheduling — a later
-optimization (interleaving = assigning non-contiguous layer blocks per rank, which
-this layout also supports by reshaping the layer dim).
+Schedules. The base schedule is GPipe-shaped (a forward tick sweep; reverse-mode
+AD emits the mirrored backward sweep), bubble fraction (pp-1)/(n_micro+pp-1) per
+sweep. The reference's literal 1F1B (pipelining/functional.py:490) is a
+*per-rank asynchronous* schedule: ranks do different work at the same wall-clock
+instant, which XLA's SPMD lockstep (one program, every rank the same tick) cannot
+express — emulating it with a fwd+bwd-per-tick uniform program makes warmup/drain
+ticks cost 3 flop-units instead of 1 and is strictly slower than the AD schedule
+(1F1B's remaining advantage, O(pp) in-flight activations, is covered here by
+per-stage rematerialization). What DOES map to SPMD is 1F1B's *interleaved
+virtual-stage* refinement (functional.py:166): ``circular_repeats=V`` assigns
+each rank V non-contiguous layer blocks (round-major: global block v*pp + r on
+rank r); activations wrap pp-1 -> 0 between rounds, total ticks shrink from
+V*(n+pp-1) to V*n + pp - 1, and the bubble fraction drops ~V-fold to
+(pp-1)/(V*n + pp - 1). AD again yields the mirrored interleaved backward.
 
 Composition: shard_map is manual over ``pp`` only; FSDP/TP shardings on other mesh
 axes stay GSPMD-managed inside (same partial-manual pattern as moe.dispatch).
+Embedding runs *outside* the manual region in plain GSPMD (so the token gather
+partitions over tp/fsdp normally), and the head/loss params keep their native
+shardings inside.
 """
 
 from __future__ import annotations
@@ -28,16 +40,31 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline_spmd", "make_pipeline_forward", "make_dense_decoder_pp_loss", "make_moe_pp_loss"]
+__all__ = [
+    "pipeline_spmd", "pipeline_ticks", "make_pipeline_forward",
+    "make_dense_decoder_pp_loss", "make_moe_pp_loss",
+]
+
+
+def pipeline_ticks(n_micro: int, pp: int, circular_repeats: int = 1) -> int:
+    """Forward tick count; the per-sweep bubble fraction is (ticks - work) / ticks.
+
+    V=1: n + pp - 1 ticks of 1 layer-block each (work = n). Circular V>1: each
+    tick runs 1/V of a rank's layers, total V*n + pp - 1 ticks (work = V*n) —
+    the bubble fraction (pp-1)/(V*n + pp - 1) shrinks ~V-fold."""
+    if circular_repeats > 1:
+        return circular_repeats * n_micro + pp - 1
+    return n_micro + pp - 1
 
 
 def pipeline_spmd(
-    stage_params,  # pytree; leaves (L_local, ...) — this rank's layer slice
+    stage_params,  # pytree; leaves (L_local, ...) — or (V, L_local, ...) circular
     x_stack,  # pytree; leaves (n_micro, ...) — stage-0 inputs (already embedded)
     layer_apply: Callable,  # (stage_params, x) -> y  or -> (y, aux) with with_aux
     *,
     axis: str = "pp",
     with_aux: bool = False,
+    circular_repeats: int = 1,
 ):
     """Run the pipeline; returns an x_stack-like pytree of outputs, valid on the
     LAST stage (other ranks hold garbage — mask with axis_index == pp-1).
@@ -47,41 +74,78 @@ def pipeline_spmd(
     ring so each stage sees its microbatch's metadata. Call inside shard_map manual
     over ``axis``.
 
+    ``circular_repeats=V`` enables interleaved virtual stages (reference
+    functional.py:166): ``stage_params`` leaves carry a leading (V, ...) round
+    dim — this rank's V non-contiguous blocks in round-major global order — and
+    activations wrap pp-1 -> 0 between rounds. Requires n_micro % pp == 0.
+    Schedule: stage 0 feeds wave w's fresh microbatch j at tick w*pp*V + j and
+    services round v of that wave at phase v*pp + j, so fresh feeds and wrapped
+    activations never contend; total ticks = V*n_micro + pp - 1.
+
     ``with_aux``: ``layer_apply`` returns ``(y, aux_tree)``; aux is *summed* over
     the ticks where this stage held a real microbatch (warmup/drain ticks carry
     garbage activations and are masked out) — the per-stage accumulation MoE
-    expert-load/aux-loss stats need. Returns ``(outputs, aux_sum)``.
+    expert-load/aux-loss stats need. With circular repeats the aux gains a
+    leading (V, ...) round dim. Returns ``(outputs, aux_sum)``.
     """
     pp = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     leaves = jax.tree.leaves(x_stack)
     n_micro = leaves[0].shape[0]
-    steps = n_micro + pp - 1
-    # stage s -> s+1; the wraparound edge (pp-1 -> 0) carries only garbage, which
-    # stage 0 immediately overwrites with fresh microbatch input.
+    V = circular_repeats
+    if V > 1 and n_micro % pp != 0:
+        raise ValueError(
+            f"circular pipeline needs n_micro % pp == 0, got {n_micro} % {pp}"
+        )
+    steps = pipeline_ticks(n_micro, pp, V)
+    # stage s -> s+1; with circular repeats the wraparound edge (pp-1 -> 0)
+    # carries real activations between rounds (with V=1 it carries only garbage,
+    # which stage 0 immediately overwrites with fresh microbatch input).
     perm = [(i, (i + 1) % pp) for i in range(pp)]
 
-    def _apply(x):
-        out = layer_apply(stage_params, x)
+    def _round_params(v):
+        if V == 1:
+            return stage_params
+        return jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, v, 0, keepdims=False), stage_params
+        )
+
+    def _apply(params, x):
+        out = layer_apply(params, x)
         return out if with_aux else (out, {})
 
     def tick(carry, t):
         outputs, state, aux_acc = carry
-        mb = jnp.clip(t, 0, n_micro - 1)
+        # this stage's position in the schedule: elapsed ticks since the work
+        # now arriving here left stage 0
+        e = t - idx
+        cycle = pp * V
+        wave = jnp.maximum(e, 0) // cycle
+        phase = jnp.maximum(e, 0) % cycle
+        v = phase // pp  # virtual-stage round being serviced
+        j = phase % pp
+        mb = jnp.clip(wave * pp + j, 0, n_micro - 1)
+        real = (e >= 0) & (wave * pp + j < n_micro)
         feed = jax.tree.map(
             lambda a: jax.lax.dynamic_index_in_dim(a, mb, 0, keepdims=False), x_stack
         )
-        x = jax.tree.map(lambda f, s: jnp.where(idx == 0, f, s), feed, state)
-        y, aux = _apply(x)
-        # stage idx holds microbatch t-idx at tick t: real iff 0 <= t-idx < n_micro
-        valid = ((t >= idx) & (t - idx < n_micro)).astype(jnp.float32)
-        aux_acc = jax.tree.map(lambda acc, a: acc + a * valid, aux_acc, aux)
-        # last stage finishes microbatch t-(pp-1) at tick t; earlier ticks write
-        # garbage into slot 0 which the t = pp-1 tick overwrites (writes are in
-        # time order, so the final write per slot is the correct one)
-        out_slot = jnp.clip(t - (pp - 1), 0, n_micro - 1)
+        x = jax.tree.map(
+            lambda f, s: jnp.where((idx == 0) & (v == 0), f, s), feed, state
+        )
+        y, aux = _apply(_round_params(v), x)
+        valid = real.astype(jnp.float32)
+        if V == 1:
+            aux_acc = jax.tree.map(lambda acc, a: acc + a * valid, aux_acc, aux)
+        else:
+            aux_acc = jax.tree.map(
+                lambda acc, a: acc.at[v].add(a * valid), aux_acc, aux
+            )
+        # last stage emits microbatch mb when it finishes the final round; writes
+        # are unconditional and time-ordered — slot mb's ticks ascend in round, so
+        # the final-round write always lands last and intermediate/garbage writes
+        # are harmlessly overwritten (only the last stage's buffer is ever read)
         outputs = jax.tree.map(
-            lambda o, yl: jax.lax.dynamic_update_index_in_dim(o, yl, out_slot, 0),
+            lambda o, yl: jax.lax.dynamic_update_index_in_dim(o, yl, mb, 0),
             outputs, y,
         )
         state = jax.tree.map(lambda yl: jax.lax.ppermute(yl, axis, perm), y)
@@ -96,8 +160,13 @@ def pipeline_spmd(
     x0 = jax.tree.map(lambda a: a[0], x_stack)
     # probe with pp-varying inputs: stage params are varying inside the manual
     # region, so layer_apply's internal scans require varying carries
-    aux_shapes = jax.eval_shape(lambda x: _apply(jax.tree.map(_vary, x))[1], x0)
-    zero_aux = jax.tree.map(lambda s: _vary(jnp.zeros(s.shape, s.dtype)), aux_shapes)
+    aux_shapes = jax.eval_shape(
+        lambda x: _apply(_round_params(jnp.int32(0)), jax.tree.map(_vary, x))[1], x0
+    )
+    zero_aux = jax.tree.map(
+        lambda s: _vary(jnp.zeros((V, *s.shape) if V > 1 else s.shape, s.dtype)),
+        aux_shapes,
+    )
     (outputs, _, aux_sum), _ = jax.lax.scan(tick, (outputs, state, zero_aux), jnp.arange(steps))
     if with_aux:
         return outputs, aux_sum
@@ -105,32 +174,39 @@ def pipeline_spmd(
 
 
 def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp", with_aux: bool = False,
-                          aux_out_specs=None):
-    """Wrap (embed, layer_apply, head_loss) into a pp-pipelined loss function.
+                          aux_out_specs=None, circular_repeats: int = 1):
+    """Wrap (layer_apply, head_loss) into a pp-pipelined loss function.
 
-    Returns ``fn(layer_params, other_params, batch_stack, embed_fn, layer_apply,
+    Returns ``fn(layer_params, other_params, x_stack, batch_stack, layer_apply,
     head_loss_fn)`` where:
-      - ``embed_fn(params, microbatch) -> x`` (stage-0 work, cheap enough to run
-        everywhere: replicated compute beats a broadcast)
+      - ``x_stack`` — already-embedded stage-0 inputs, (n_micro, ...) leaves,
+        computed by the caller OUTSIDE the manual region (plain GSPMD: the token
+        gather and any dense prefix partition over tp/fsdp normally)
       - ``layer_apply(stage_layer_params, x) -> y`` scans this rank's layer slice
         (``-> (y, aux)`` with ``with_aux``: aux sums over valid ticks per stage;
         ``aux_out_specs`` — a pytree of PartitionSpecs matching aux, typically
-        ``P(pp_axis)`` so per-stage layer stats reassemble in layer order)
+        ``P(pp_axis)`` so per-stage layer stats reassemble in layer order; with
+        circular repeats the aux carries a leading round dim -> P(None, pp_axis))
       - ``head_loss_fn(params, y, microbatch) -> scalar`` final-norm + head + loss
-        (additive across microbatches)
+        (additive across microbatches); head params keep their native tp/fsdp
+        shardings (GSPMD manages non-pp axes inside the manual region)
 
     Layer params must be stacked (L, ...) with the layer dim sharded over ``pp``
-    (sharding rule "layers" -> pp); all other params replicated over pp.
+    (sharding rule "layers" -> pp). With ``circular_repeats=V`` the caller
+    reshapes them to (V, pp, L/(V*pp), ...) — round-major interleaving — and this
+    wrapper shards dim 1 over pp.
     """
     pp = mesh.shape[pp_axis]
+    V = circular_repeats
 
-    def fn(layer_params, other_params, batch_stack, embed_fn, layer_apply, head_loss_fn):
-        def body(layer_params, other_params, batch_stack):
-            x_stack = jax.vmap(
-                lambda mb: embed_fn(other_params, mb), in_axes=0
-            )(batch_stack)
+    def fn(layer_params, other_params, x_stack, batch_stack, layer_apply, head_loss_fn):
+        def body(layer_params, other_params, x_stack, batch_stack):
+            if V > 1:
+                # (V, 1, Lb, ...) local slice -> (V, Lb, ...)
+                layer_params = jax.tree.map(lambda p: p[:, 0], layer_params)
             outs = pipeline_spmd(
-                layer_params, x_stack, layer_apply, axis=pp_axis, with_aux=with_aux
+                layer_params, x_stack, layer_apply, axis=pp_axis,
+                with_aux=with_aux, circular_repeats=V,
             )
             outs, aux = outs if with_aux else (outs, None)
             is_last = jax.lax.axis_index(pp_axis) == pp - 1
@@ -144,51 +220,89 @@ def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp", with_aux: bool = F
             loss = jax.lax.psum(jnp.where(is_last, losses.sum(), 0.0), pp_axis)
             return (loss, aux) if with_aux else loss
 
-        # Replicate non-layer params (embed/head/final-norm) before entering the
-        # partial-manual region: a gather whose operand carries tp shardings trips
-        # XLA's SpmdPartitioner (ExpandDeviceGroupsWithIota check) when pp is
-        # manual. Embed/head tp-sharding inside the pp loop is a later optimization.
+        # Head/final-norm params are replicated at region entry: XLA's
+        # SpmdPartitioner hard-aborts on tp-sharded operands of the head einsum
+        # inside a partial-manual(pp) region (jax 0.8 era). The *embedding
+        # gather* — the expensive tp-sharded op — already runs outside in plain
+        # GSPMD; the head matmul inside re-partitions over the batch dims anyway.
         from jax.sharding import NamedSharding
 
         other_params = jax.lax.with_sharding_constraint(
             other_params, NamedSharding(mesh, P())
         )
-        layer_specs = jax.tree.map(lambda _: P(pp_axis), layer_params)
+        layer_specs = jax.tree.map(
+            lambda _: P(None, pp_axis) if V > 1 else P(pp_axis), layer_params
+        )
         other_specs = jax.tree.map(lambda _: P(), other_params)
+        x_specs = jax.tree.map(lambda _: P(), x_stack)
         batch_specs = jax.tree.map(lambda _: P(), batch_stack)
         out_specs = (P(), aux_out_specs) if with_aux else P()
         return jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(layer_specs, other_specs, batch_specs),
+            in_specs=(layer_specs, other_specs, x_specs, batch_specs),
             out_specs=out_specs,
             axis_names={pp_axis},
-        )(layer_params, other_params, batch_stack)
+        )(layer_params, other_params, x_stack, batch_stack)
 
     return fn
 
 
-def _make_head_loss(cfg, dtype):
-    """Final-norm + unembed + additive masked CE, shared by both pp loss builders."""
-    from automodel_tpu.ops.losses import masked_cross_entropy
+def _make_head_loss(cfg, dtype, loss_name: str = "masked_ce"):
+    """Final-norm + unembed + additive CE, shared by both pp loss builders.
+
+    ``linear_ce`` (the default for the big models PP exists for) never
+    materializes the (tokens, vocab) logits — the XLA blockwise path (pallas
+    cannot be partitioned inside the manual region); ``chunked_ce`` bounds the
+    fp32 logits working set; ``masked_ce`` materializes per-microbatch logits.
+    """
+    from automodel_tpu.ops.losses import (
+        chunked_cross_entropy, linear_cross_entropy, masked_cross_entropy,
+    )
     from automodel_tpu.ops.norms import rms_norm
+
+    if loss_name not in ("masked_ce", "linear_ce", "chunked_ce"):
+        raise NotImplementedError(
+            f"pp loss {loss_name!r} (use masked_ce | linear_ce | chunked_ce)"
+        )
 
     def head_loss(other, y, mb):
         h = rms_norm(y["h"], other["final_norm"].astype(dtype), cfg.rms_norm_eps)
         unembed = other.get("lm_head")
         if unembed is None:
             unembed = other["embed"].T
-        logits = jnp.einsum("bsd,dv->bsv", h, jnp.asarray(unembed).astype(dtype))
+        unembed = jnp.asarray(unembed).astype(dtype)
         # additive (sum/num) microbatch losses, same contract as make_train_step
+        if loss_name == "linear_ce":
+            return linear_cross_entropy(h, unembed, mb["labels"], 1.0, impl="xla")
+        logits = jnp.einsum("bsd,dv->bsv", h, unembed)
+        if loss_name == "chunked_ce":
+            return chunked_cross_entropy(logits, mb["labels"], 1.0)
         return masked_cross_entropy(logits, mb["labels"], 1.0)
 
     return head_loss
 
 
-def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "masked_ce"):
+def _circular_reshape(tree, V: int, pp: int):
+    """(L, ...) layer stacks -> (V, pp, L/(V*pp), ...) round-major blocks."""
+
+    def reshape(p):
+        L = p.shape[0]
+        if L % (V * pp) != 0:
+            raise ValueError(
+                f"circular pipeline needs layers % (V*pp) == 0, got {L} % {V * pp}"
+            )
+        return p.reshape(V, pp, L // (V * pp), *p.shape[1:])
+
+    return jax.tree.map(reshape, tree)
+
+
+def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "masked_ce",
+                               circular_repeats: int = 1):
     """Pipelined forward+loss for Llama-lineage models (the reference's PP covers HF
     decoder LMs the same way: embed on first stage, head+loss on last,
-    recipes/llm/train_ft.py:1234-1242).
+    recipes/llm/train_ft.py:1234-1242). ``circular_repeats`` enables interleaved
+    virtual stages (reference functional.py:166 ``microbatch_group_size_per_vp_stage``).
 
     Returns ``forward_loss(params, batch_stack, num_label_tokens)`` where
     ``batch_stack`` leaves are (n_micro, ...) — the pipeline consumes all
@@ -198,11 +312,9 @@ def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "
 
     cfg, backend = model.config, model.backend
     dtype = backend.jnp_dtype
-    pipeline = make_pipeline_forward(mesh)
-
-    def embed_fn(other, mb):
-        h = other["embed"].astype(dtype)[mb["input_ids"]]
-        return {"h": h, "positions": mb["positions"], "segment_ids": mb["segment_ids"]}
+    pp = mesh.shape["pp"]
+    V = circular_repeats
+    pipeline = make_pipeline_forward(mesh, circular_repeats=V)
 
     # NB: no sharding-constraint rules inside the pp-manual region —
     # with_sharding_constraint over the full mesh clashes with manual pp axes;
@@ -213,24 +325,30 @@ def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "
         lp, sliding = stage
         return apply_layer_stack(cfg, backend, lp, sliding, x, None)
 
-    head_loss = _make_head_loss(cfg, dtype)
-
-    if loss_name != "masked_ce":
-        raise NotImplementedError(f"pp loss {loss_name!r} (use masked_ce)")
+    head_loss = _make_head_loss(cfg, dtype, loss_name)
 
     def forward_loss(params, batch_stack, num_label_tokens):
         sliding = jnp.asarray(cfg.sliding_flags, jnp.int32)
         layer_params = (params["layers"], sliding)
+        if V > 1:
+            layer_params = _circular_reshape(layer_params, V, pp)
         other = {k: v for k, v in params.items() if k != "layers"}
-        total = pipeline(layer_params, other, batch_stack,
-                         embed_fn, layer_apply, head_loss)
+        # embedding in plain GSPMD land (partitions over tp/fsdp normally)
+        embed = other["embed"].astype(dtype)
+        x_stack = {
+            "h": embed[batch_stack["input_ids"]],
+            "positions": batch_stack["positions"],
+            "segment_ids": batch_stack["segment_ids"],
+        }
+        total = pipeline(layer_params, other, x_stack, batch_stack,
+                         layer_apply, head_loss)
         return total / num_label_tokens
 
     return forward_loss
 
 
 def make_moe_pp_loss(model, mesh: Mesh, *, pp_axis: str = "pp", loss_name: str = "masked_ce",
-                     seq_len_hint: int = 0):
+                     seq_len_hint: int = 0, circular_repeats: int = 1):
     """Pipelined forward+loss for MoE decoders: the dense prefix + embedding run
     replicated on every rank (cheap, avoids a ragged first stage), the MoE layer
     stack pipelines over ``pp``, and expert-load stats accumulate per stage with
@@ -250,17 +368,19 @@ def make_moe_pp_loss(model, mesh: Mesh, *, pp_axis: str = "pp", loss_name: str =
         raise NotImplementedError(
             "pp + aux-loss balancing is not wired; use gate-bias (loss-free) balancing"
         )
-    if loss_name != "masked_ce":
-        raise NotImplementedError(f"pp loss {loss_name!r} (use masked_ce)")
     dtype = backend.jnp_dtype
+    pp = mesh.shape[pp_axis]
+    V = circular_repeats
     attention_fn = model.make_attention_fn() if hasattr(model, "make_attention_fn") else None
     dense_layer_fn, moe_layer_fn = make_moe_layer_fns(
         cfg, backend, rules=None, attention_fn=attention_fn, training=True,
         seq_len_hint=seq_len_hint,
     )
     k_dense = cfg.first_k_dense_replace
+    load_spec = P(None, pp_axis) if V > 1 else P(pp_axis)
     pipeline = make_pipeline_forward(
-        mesh, pp_axis=pp_axis, with_aux=True, aux_out_specs={"load": P(pp_axis)}
+        mesh, pp_axis=pp_axis, with_aux=True, aux_out_specs={"load": load_spec},
+        circular_repeats=V,
     )
 
     def embed_fn(other, mb):
@@ -285,14 +405,22 @@ def make_moe_pp_loss(model, mesh: Mesh, *, pp_axis: str = "pp", loss_name: str =
         )
         return state, {"load": loads}
 
-    head_loss = _make_head_loss(cfg, dtype)
+    head_loss = _make_head_loss(cfg, dtype, loss_name)
 
     def forward_loss(params, batch_stack, num_label_tokens):
         moe_sliding = jnp.asarray(cfg.sliding_flags[k_dense:], jnp.int32)
         layer_params = (params["moe_layers"], moe_sliding)
+        if V > 1:
+            layer_params = _circular_reshape(layer_params, V, pp)
         other = {k: v for k, v in params.items() if k != "moe_layers"}
-        loss, aux = pipeline(layer_params, other, batch_stack,
-                             embed_fn, layer_apply, head_loss)
-        return loss / num_label_tokens, {"expert_load": aux["load"]}
+        # embedding + dense prefix in plain GSPMD land, vmapped over microbatches
+        x_stack = jax.vmap(lambda mb: embed_fn(other, mb))(batch_stack)
+        loss, aux = pipeline(layer_params, other, x_stack, batch_stack,
+                             layer_apply, head_loss)
+        load = aux["load"]
+        if V > 1:
+            # (V, pp*Lb, E) round-major -> (L, E) global layer order
+            load = load.reshape(-1, *load.shape[2:])
+        return loss / num_label_tokens, {"expert_load": load}
 
     return forward_loss

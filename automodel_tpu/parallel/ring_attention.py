@@ -28,8 +28,9 @@ NEG_INF = -1e30
 def _partial_attention(q, k, v, allowed, scale):
     """Unnormalized blockwise attention; returns (acc, m, l) in fp32.
 
-    q (B, Sq, N, D); k/v (B, Sk, K, D); allowed (B, Sq, Sk) bool or None.
-    acc (B, K, G, Sq, D), m/l (B, K, G, Sq).
+    q/k (B, S, N|K, D); v (B, Sk, K, Dv) — Dv may differ from D (MLA's v_head_dim,
+    moe/parallelizer.py:267-285 runs ring CP through TE for MLA the same way);
+    allowed (B, Sq, Sk) bool or None. acc (B, K, G, Sq, Dv), m/l (B, K, G, Sq).
     """
     b, sq, n, d = q.shape
     kh = k.shape[2]
@@ -65,12 +66,13 @@ def ring_attention_local(
     """The per-shard body — call inside shard_map manual over ``axis``."""
     cp = jax.lax.axis_size(axis)
     b, sq, n, d = q.shape
+    dv = v.shape[-1]
     kh = k.shape[2]
     g = n // kh
     scale = softmax_scale if softmax_scale is not None else d**-0.5
     perm = [(j, (j + 1) % cp) for j in range(cp)]
 
-    acc = jnp.zeros((b, kh, g, sq, d), jnp.float32)
+    acc = jnp.zeros((b, kh, g, sq, dv), jnp.float32)
     m = jnp.full((b, kh, g, sq), NEG_INF, jnp.float32)
     l = jnp.zeros((b, kh, g, sq), jnp.float32)
     kv = (k, v, positions_kv, segment_ids_kv)
@@ -107,8 +109,8 @@ def ring_attention_local(
                 kv, is_leaf=lambda x: x is None,
             )
 
-    out = acc / jnp.where(l == 0.0, 1.0, l)[..., None]  # (b, kh, g, sq, d)
-    return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, n, d).astype(q.dtype)
+    out = acc / jnp.where(l == 0.0, 1.0, l)[..., None]  # (b, kh, g, sq, dv)
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, n, dv).astype(q.dtype)
 
 
 def make_ring_attention(

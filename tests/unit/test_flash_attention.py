@@ -115,3 +115,55 @@ class TestFlashBackward:
                 np.asarray(gf), np.asarray(gr), atol=5e-4,
                 err_msg=f"d{name} mismatch in case {case}",
             )
+
+
+class TestSinksAndSoftCap:
+    """gpt-oss sinks and gemma-style tanh capping inside the kernel (they
+    previously forced the XLA fallback, ops/attention.py round-1)."""
+
+    def test_soft_cap_matches_xla(self):
+        q, k, v = _rand(20, 2, 64, 4, 16), _rand(21, 2, 64, 4, 16), _rand(22, 2, 64, 4, 16)
+        got = _flash(q, k, v, logit_soft_cap=8.0)
+        want = _ref(q, k, v, logit_soft_cap=8.0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_soft_cap_grads(self):
+        q, k, v = _rand(23, 1, 32, 2, 8), _rand(24, 1, 32, 2, 8), _rand(25, 1, 32, 2, 8)
+
+        def loss(fn):
+            return lambda q_, k_, v_: (fn(q_, k_, v_, logit_soft_cap=5.0) ** 2).sum()
+
+        g_got = jax.grad(loss(_flash), argnums=(0, 1, 2))(q, k, v)
+        g_want = jax.grad(loss(_ref), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_got, g_want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+    def test_sinks_match_xla(self):
+        q, k, v = _rand(26, 2, 64, 4, 16), _rand(27, 2, 64, 4, 16), _rand(28, 2, 64, 4, 16)
+        sinks = jnp.asarray([0.5, -0.3, 1.2, 0.0], jnp.float32)
+        got = _flash(q, k, v, sinks=sinks)
+        want = _ref(q, k, v, sinks=sinks)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_sinks_grads_including_dsinks(self):
+        q, k, v = _rand(29, 1, 32, 4, 8), _rand(30, 1, 32, 4, 8), _rand(31, 1, 32, 4, 8)
+        sinks = jnp.asarray([0.2, -0.5, 0.8, 0.1], jnp.float32)
+
+        def loss(fn):
+            return lambda q_, k_, v_, s_: (fn(q_, k_, v_, sinks=s_) ** 2).sum()
+
+        g_got = jax.grad(loss(_flash), argnums=(0, 1, 2, 3))(q, k, v, sinks)
+        g_want = jax.grad(loss(_ref), argnums=(0, 1, 2, 3))(q, k, v, sinks)
+        for a, b in zip(g_got, g_want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+    def test_sinks_with_segments_and_gqa(self):
+        q = _rand(32, 2, 64, 4, 16)
+        k, v = _rand(33, 2, 64, 2, 16), _rand(34, 2, 64, 2, 16)
+        sinks = jnp.asarray([0.5, -0.1, 0.3, 0.9], jnp.float32)
+        seg = jnp.concatenate(
+            [jnp.full((2, 32), 1, jnp.int32), jnp.full((2, 32), 2, jnp.int32)], axis=1
+        )
+        got = _flash(q, k, v, sinks=sinks, segment_ids_q=seg)
+        want = _ref(q, k, v, sinks=sinks, segment_ids_q=seg)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)

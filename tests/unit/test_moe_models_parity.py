@@ -103,6 +103,22 @@ class TestGptOssParity:
         # sliding window flag wired through layer_types
         assert model.config.sliding_flags == [True, False]
 
+    def test_logits_match_hf_flash_kernel(self, tmp_path):
+        """Full model through the Pallas kernel (interpret): sinks + traced
+        per-layer sliding windows run INSIDE flash now, not the XLA fallback."""
+        torch.manual_seed(2)
+        hf = transformers.GptOssForCausalLM(tiny_gpt_oss_cfg())
+        hf.eval()
+        d = _save_hf(hf, tmp_path)
+        model, params = AutoModelForCausalLM.from_pretrained(
+            d, dtype=jnp.float32, backend=_fp32_backend(attention="flash_interpret")
+        )
+        ids = np.random.RandomState(0).randint(0, hf.config.vocab_size, (2, 24))
+        ours, _ = model(params, jnp.asarray(ids), training=False)
+        with torch.no_grad():
+            theirs = hf(torch.tensor(ids)).logits.float().numpy()
+        np.testing.assert_allclose(np.asarray(ours), theirs, atol=5e-4, rtol=1e-3)
+
     def test_key_parity(self, tmp_path):
         torch.manual_seed(3)
         hf = transformers.GptOssForCausalLM(tiny_gpt_oss_cfg())

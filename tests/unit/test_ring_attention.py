@@ -94,3 +94,55 @@ class TestRingAttention:
         np.testing.assert_allclose(
             np.asarray(got[:, inv]), np.asarray(want), atol=2e-5
         )
+
+
+class TestMlaRingCP:
+    """MLA ring CP: v_head_dim != qk head dim, and the full DeepseekV3 forward
+    under a cp=4 mesh matches the unsharded forward."""
+
+    def test_mismatched_v_dim(self, cp_mesh):
+        b, s, n, dqk, dv = 2, 64, 4, 24, 16
+        q, k = _rand(20, b, s, n, dqk), _rand(21, b, s, n, dqk)
+        v = _rand(22, b, s, n, dv)
+        ring = make_ring_attention(cp_mesh, softmax_scale=dqk**-0.5)
+        with jax.sharding.set_mesh(cp_mesh):
+            got = ring(q, k, v, _positions(b, s))
+        want = dot_product_attention(q, k, v_pad_ref(v, dqk), causal=True, backend="xla")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want)[..., :dv], atol=2e-5)
+
+    def test_deepseek_v3_forward_cp4(self, cp_mesh):
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+        from automodel_tpu.models.common.backend import BackendConfig
+        from automodel_tpu.parallel.mesh import default_sharding_rules
+
+        hf = {
+            "architectures": ["DeepseekV3ForCausalLM"],
+            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+            "moe_intermediate_size": 32, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "q_lora_rank": 24, "kv_lora_rank": 32,
+            "qk_nope_head_dim": 16, "qk_rope_head_dim": 8, "v_head_dim": 16,
+            "n_routed_experts": 4, "num_experts_per_tok": 2, "n_shared_experts": 1,
+            "norm_topk_prob": True, "first_k_dense_replace": 1,
+            "max_position_embeddings": 64,
+        }
+        ring_model = AutoModelForCausalLM.from_config(
+            hf, BackendConfig(dtype="float32", context_parallel="ring")
+        )
+        plain_model = AutoModelForCausalLM.from_config(hf, BackendConfig(dtype="float32"))
+        params = ring_model.init(jax.random.key(0), jnp.float32)
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(0, 128, (2, 64)), jnp.int32
+        )
+        rules = default_sharding_rules().with_mesh(cp_mesh)
+        with jax.sharding.set_mesh(cp_mesh):
+            got, _ = jax.jit(
+                lambda p, i: ring_model(p, i, rules=rules, training=False)
+            )(params, ids)
+        want, _ = plain_model(params, ids, training=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-4, rtol=1e-3)
+
+
+def v_pad_ref(v, dqk):
+    """Pad v's head dim so the XLA reference path (uniform dims) can serve as oracle."""
+    pad = dqk - v.shape[-1]
+    return jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pad)))
