@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import re
+import zlib
 from typing import Any, Sequence
 
 import jax
@@ -84,10 +85,8 @@ class PeftConfig:
             self.target_modules = [self.target_modules]
         if isinstance(self.exclude_modules, str):
             self.exclude_modules = [self.exclude_modules]
-        if self.dropout:
-            raise NotImplementedError(
-                "lora dropout is not supported in the merged-delta formulation; set dropout=0"
-            )
+        if not 0.0 <= self.dropout < 1.0:
+            raise ValueError(f"lora dropout must be in [0, 1), got {self.dropout}")
 
     @property
     def scaling(self) -> float:
@@ -283,9 +282,16 @@ def lora_logical_axes(logical_axes: Any, cfg: PeftConfig) -> dict:
     return out
 
 
-def merge_lora_params(params: Any, lora: Any, cfg: PeftConfig) -> Any:
+def merge_lora_params(params: Any, lora: Any, cfg: PeftConfig,
+                      dropout_rng: jax.Array | None = None) -> Any:
     """W -> W + (alpha/r) A@B (DoRA: renormalized + magnitude-scaled), leaving
     unmatched leaves untouched. Pure; call inside jit so XLA fuses per-layer.
+
+    LoRA dropout (reference _peft/lora.py:76 applies nn.Dropout on the adapter
+    input x): in the merged-delta formulation ``dropout(x) @ A`` is expressible
+    exactly when the mask is shared across tokens — a per-input-feature mask on
+    A's rows, rescaled by 1/(1-p). Pass ``dropout_rng`` (training only) to enable;
+    None keeps merging deterministic (eval / dropout=0).
 
     QLoRA: quantized base leaves (quantization.qlora.QuantizedTensor) are
     dequantized on the fly — matched ones before adding the delta, unmatched ones
@@ -305,6 +311,14 @@ def merge_lora_params(params: Any, lora: Any, cfg: PeftConfig) -> Any:
         if is_quantized_leaf(w):
             w = dequantize_leaf(w)  # back to the base dtype, fp32 math below
         a, b = leaf["lora_a"], leaf["lora_b"]
+        if dropout_rng is not None and cfg.dropout > 0.0:
+            # stable digest, NOT python hash(): the salted hash would bake a
+            # different trace-time constant per process, desyncing masks across
+            # SPMD hosts (same reason as training/rng.py _hash_name)
+            path_digest = zlib.crc32(path.encode())
+            key = jax.random.fold_in(dropout_rng, path_digest % (2**31))
+            keep = jax.random.bernoulli(key, 1.0 - cfg.dropout, a.shape[:-1])
+            a = a * (keep / (1.0 - cfg.dropout)).astype(a.dtype)[..., None]
         delta = jnp.einsum("...ir,...ro->...io", a.astype(jnp.float32), b.astype(jnp.float32)) * scaling
         w_flat = w.reshape(delta.shape).astype(jnp.float32)
         merged = w_flat + delta

@@ -66,6 +66,12 @@ __all__ = ["TrainFinetuneRecipeForNextTokenPrediction", "main"]
 
 
 class TrainFinetuneRecipeForNextTokenPrediction:
+    # class-level defaults: subclasses (KD, VLM, ...) override _build_train_step
+    # without necessarily setting these
+    _pre_qat_step = None
+    _qat_start_step = 0
+    _step_needs_rng = False
+
     def __init__(self, cfg: ConfigNode):
         self.cfg = cfg
 
@@ -143,8 +149,26 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 self.train_params
             )
 
-        # loss selection (reference build_loss_fn, train_ft.py:345)
-        self.loss_name = cfg.get("loss.name", "masked_ce")
+        # loss selection (reference build_loss_fn, train_ft.py:345). Big-vocab
+        # models default to the fused linear CE (reference defaults to
+        # cut-cross-entropy for the same reason, loss/linear_ce.py:119): the
+        # (tokens, vocab) logits tensor would otherwise dominate HBM.
+        default_loss = "masked_ce"
+        if (
+            getattr(self.model.config, "vocab_size", 0) >= 65536
+            and self.mesh_ctx.pp == 1
+            and self._moe_config is None
+        ):
+            default_loss = "linear_ce"
+        self.loss_name = cfg.get("loss.name", default_loss)
+        # pallas fused CE runs the kernel on the device-local view; under a
+        # multi-device mesh the GSPMD partitioner can't split a pallas_call, so
+        # fall back to the XLA blockwise path there (it partitions cleanly)
+        impl = cfg.get("loss.impl", "auto")
+        if impl == "auto":
+            impl = "pallas" if jax.default_backend() == "tpu" and self.mesh.size == 1 else "xla"
+        self.loss_impl = impl
+        self.loss_filter_eps = cfg.get("loss.filter_eps", 1e-7)
         # MoE load-balance metric logging (reference MoEMetricsConfig, moe/config.py:72)
         self.moe_metrics_mode = cfg.get(
             "moe_metrics.mode", "brief" if self._moe_config is not None else None
@@ -179,11 +203,15 @@ class TrainFinetuneRecipeForNextTokenPrediction:
     def _build_model_and_params(self):
         cfg = self.cfg
         pretrained = cfg.get("model.pretrained_model_name_or_path")
+        # fp32 master params by default (the reference's mixed-precision contract);
+        # "bfloat16" = pure-bf16 training — halves params+grads HBM, the trade
+        # benchmark / memory-bound configs take
+        params_dtype = jnp.dtype(cfg.get("model.params_dtype", "float32"))
         with self.mesh:
             if pretrained:
                 self.hf_config = load_hf_config(pretrained)
                 self.model, self.params = AutoModelForCausalLM.from_pretrained(
-                    pretrained, backend=self.backend, dtype=jnp.float32, rules=self.rules
+                    pretrained, backend=self.backend, dtype=params_dtype, rules=self.rules
                 )
             else:
                 model_cfg = cfg.get("model.config")
@@ -194,7 +222,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 axes = self.model.logical_axes()
                 shardings = self.rules.tree_sharding(axes)
                 init_fn = jax.jit(
-                    lambda k: self.model.init(k, jnp.float32), out_shardings=shardings
+                    lambda k: self.model.init(k, params_dtype), out_shardings=shardings
                 )
                 self.params = init_fn(self.rng.key("model_init"))
         n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
@@ -312,9 +340,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             # segment id 0 marks padding (sft_collate contract): pad tokens must not
             # count for routing load, aux loss, or the gate-bias update
             kwargs = {"token_mask": batch["segment_ids"] != 0, "training": training}
+        # sharding constraints are pure fusion barriers on a single device
+        rules = self.rules if self.mesh.size > 1 else None
         out = self.model(
             params, batch["input_ids"], positions=batch["positions"],
-            segment_ids=batch["segment_ids"], rules=self.rules,
+            segment_ids=batch["segment_ids"], rules=rules,
             return_hidden=self.loss_name == "linear_ce", **kwargs,
         )
         out, stats = out if isinstance(out, tuple) else (out, None)
@@ -326,7 +356,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 if table is None:
                     raise ValueError("linear_ce: model has neither lm_head nor a tied embedding table")
                 unembed = table.T
-            loss = linear_cross_entropy(out, unembed, batch["labels"], num_label_tokens)
+            # cast the (possibly fp32-master) unembed to the activation dtype:
+            # matches the masked path's logits precision and halves the kernel's
+            # VMEM tile footprint
+            loss = linear_cross_entropy(
+                out, jnp.asarray(unembed).astype(out.dtype), batch["labels"],
+                num_label_tokens, impl=self.loss_impl, filter_eps=self.loss_filter_eps,
+            )
         else:
             loss = masked_cross_entropy(out, batch["labels"], num_label_tokens)
         if stats is None:
@@ -353,6 +389,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         return make_gate_bias_post_update(moe.gate_bias_update_factor)
 
     def _build_train_step(self):
+        self._pre_qat_step = None
+        self._qat_start_step = 0
+        self._step_needs_rng = False
         if self.mesh_ctx.pp > 1:
             from automodel_tpu.parallel.pipeline import (
                 make_dense_decoder_pp_loss,
@@ -364,17 +403,19 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 raise NotImplementedError("peft + pp composition is not wired yet")
             if self.cfg.get("qat") is not None:
                 raise NotImplementedError("qat + pp composition is not wired yet")
+            virtual = int(self.cfg.get("distributed.pp_virtual_stages", 1))
             if self._moe_config is not None:
                 pp_loss = make_moe_pp_loss(
                     self.model, self.mesh, loss_name=self.loss_name,
-                    seq_len_hint=self.seq_len,
+                    seq_len_hint=self.seq_len, circular_repeats=virtual,
                 )
                 step = make_pp_train_step(pp_loss, self.optimizer,
                                           post_update=self._post_update(),
                                           guard_nonfinite=self._check_nan_grads)
             else:
                 pp_loss = make_dense_decoder_pp_loss(
-                    self.model, self.mesh, self.rules, loss_name=self.loss_name
+                    self.model, self.mesh, self.rules, loss_name=self.loss_name,
+                    circular_repeats=virtual,
                 )
                 step = make_pp_train_step(pp_loss, self.optimizer,
                                           guard_nonfinite=self._check_nan_grads)
@@ -386,16 +427,37 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             if self._post_update() is not None:
                 logger.warning("moe gate-bias update disabled under peft (base is frozen)")
 
-            def peft_loss(lora, base, batch, num_label_tokens):
-                merged = merge_lora_params(base, lora, self.peft)
-                return self._forward_loss(merged, batch, num_label_tokens)
+            use_dropout = self.peft.dropout > 0.0
 
+            if use_dropout:
+                def peft_loss(lora, base, batch, num_label_tokens, rng):
+                    merged = merge_lora_params(base, lora, self.peft, dropout_rng=rng)
+                    return self._forward_loss(merged, batch, num_label_tokens)
+            else:
+                def peft_loss(lora, base, batch, num_label_tokens):
+                    merged = merge_lora_params(base, lora, self.peft)
+                    return self._forward_loss(merged, batch, num_label_tokens)
+
+            self._step_needs_rng = use_dropout
             step = make_train_step(peft_loss, self.optimizer, with_frozen=True,
-                                   guard_nonfinite=self._check_nan_grads)
+                                   guard_nonfinite=self._check_nan_grads,
+                                   pass_rng=use_dropout)
         else:
             forward = self._qat_wrap(self._forward_loss)
             step = make_train_step(forward, self.optimizer, post_update=self._post_update(),
                                    guard_nonfinite=self._check_nan_grads)
+            # QAT delayed start (reference qat.py:46 fake_quant_after_n_steps): two
+            # compiled steps, python-level switch on the scheduler step — zero
+            # per-step overhead vs a lax.cond inside jit
+            qat_cfg = self.cfg.get("qat")
+            start = int(qat_cfg.get("fake_quant_after_n_steps") or 0) if qat_cfg else 0
+            if start > 0:
+                plain = make_train_step(
+                    self._forward_loss, self.optimizer, post_update=self._post_update(),
+                    guard_nonfinite=self._check_nan_grads,
+                )
+                self._pre_qat_step = jax.jit(plain, donate_argnums=(0, 1))
+                self._qat_start_step = start
         return jax.jit(step, donate_argnums=(0, 1))
 
     def _qat_wrap(self, forward):
@@ -412,8 +474,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
 
         known = {f.name for f in dataclasses.fields(QATConfig)}
         qat = QATConfig(**{k: v for k, v in qat_cfg.to_dict().items() if k in known})
-        if qat.fake_quant_after_n_steps:
-            logger.warning("qat.fake_quant_after_n_steps is not supported yet; quantizing from step 0")
+        # fake_quant_after_n_steps is handled by _build_train_step's two-step switch
         matcher = _MatchCfg(target_modules=qat.target_modules,
                             match_all_linear=qat.target_modules == ["*"])
         paths = sorted(match_lora_paths(self.model.logical_axes(), matcher))
@@ -472,7 +533,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     for k, v in stack.items()
                 }
                 extra = (self.params,) if self.peft is not None else ()
-                self.train_params, self.opt_state, metrics = self._train_step(
+                if self._step_needs_rng:
+                    extra = (*extra, self.rng.key("lora_dropout"))
+                step_fn = self._train_step
+                if self._pre_qat_step is not None and self.step_scheduler.step < self._qat_start_step:
+                    step_fn = self._pre_qat_step
+                self.train_params, self.opt_state, metrics = step_fn(
                     self.train_params, self.opt_state, stack, *extra
                 )
                 if self.peft is None:
@@ -563,13 +629,24 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     lambda p, b, n: self._forward_loss(p, b, n, training=False)
                 )
                 self._eval_step = jax.jit(make_eval_step(eval_loss))
-        losses = []
+        total, count = 0.0, 0
         extra = (self.params,) if self.peft is not None else ()
         for batch in self.val_dataloader:
             n = int((batch["labels"] != -100).sum())
-            losses.append(float(self._eval_step(self.train_params, batch, n, *extra)))
-        if losses:
-            val_loss = float(np.mean(losses))
+            total += float(self._eval_step(self.train_params, batch, n, *extra)) * n
+            count += n
+        if jax.process_count() > 1:
+            # token-weighted mean across the pod: each process sees a different
+            # dataloader shard, so a host-local mean logs a different val_loss per
+            # host (reference allreduces val loss the same way, train_ft.py:1456)
+            from jax.experimental import multihost_utils
+
+            agg = multihost_utils.process_allgather(
+                jnp.asarray([total, float(count)], jnp.float64)
+            )
+            total, count = float(agg[:, 0].sum()), float(agg[:, 1].sum())
+        if count:
+            val_loss = total / count
             self.val_metric_logger.log(step, val_loss=val_loss)
             for lg in self.experiment_loggers:
                 lg.log(step, val_loss=val_loss)

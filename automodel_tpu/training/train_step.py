@@ -51,6 +51,7 @@ def make_train_step(
     post_update: Callable[[dict, dict], dict] | None = None,
     with_frozen: bool = False,
     guard_nonfinite: bool = False,
+    pass_rng: bool = False,
 ):
     """Build the accumulating train step.
 
@@ -68,26 +69,34 @@ def make_train_step(
     untouched and undifferentiated — `forward_loss(trainable, frozen, batch, n)`.
     Freezing-by-argument replaces the reference's requires_grad ceremony
     (_peft/lora.py:335) and keeps optimizer state rank-r sized.
+
+    ``pass_rng=True``: the step takes a trailing ``rng`` key, split per microbatch
+    and appended to ``forward_loss``'s arguments (LoRA dropout etc.).
     """
 
-    def _call(params, microbatch, num_label_tokens, frozen):
-        if with_frozen:
-            out = forward_loss(params, frozen, microbatch, num_label_tokens)
-        else:
-            out = forward_loss(params, microbatch, num_label_tokens)
+    def _call(params, microbatch, num_label_tokens, frozen, rng=None):
+        args = (params, frozen, microbatch, num_label_tokens) if with_frozen else (
+            params, microbatch, num_label_tokens)
+        if pass_rng:
+            args = (*args, rng)
+        out = forward_loss(*args)
         return out if isinstance(out, tuple) else (out, {})
 
-    def train_step(params, opt_state, batch_stack, frozen=None):
+    def train_step(params, opt_state, batch_stack, frozen=None, rng=None):
         """batch_stack: pytree whose leaves are stacked (n_micro, ...) arrays."""
         # global label-token count: computed inside jit on the sharded labels, so the
         # sum is automatically global across data axes (reference allreduces by hand,
         # train_ft.py:1284)
         num_label_tokens = count_label_tokens(batch_stack["labels"])
+        n_micro = jax.tree.leaves(batch_stack)[0].shape[0]
+        keys = jax.random.split(rng, n_micro) if pass_rng else jnp.zeros((n_micro, 1))
 
-        def micro_step(carry, microbatch):
+        def micro_step(carry, scanned):
+            microbatch, key = scanned
             grads_acc, loss_acc, aux_acc = carry
             (loss, aux), grads = jax.value_and_grad(_call, has_aux=True)(
-                params, microbatch, num_label_tokens, frozen
+                params, microbatch, num_label_tokens, frozen,
+                key if pass_rng else None,
             )
             grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
             aux_acc = jax.tree.map(jnp.add, aux_acc, aux)
@@ -95,10 +104,13 @@ def make_train_step(
 
         zero_grads = jax.tree.map(jnp.zeros_like, params)
         micro0 = jax.tree.map(lambda x: x[0], batch_stack)
-        aux_shapes = jax.eval_shape(_call, params, micro0, num_label_tokens, frozen)[1]
+        aux_shapes = jax.eval_shape(
+            _call, params, micro0, num_label_tokens, frozen,
+            keys[0] if pass_rng else None,
+        )[1]
         zero_aux = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), aux_shapes)
         (grads, loss, aux), _ = jax.lax.scan(
-            micro_step, (zero_grads, jnp.float32(0.0), zero_aux), batch_stack
+            micro_step, (zero_grads, jnp.float32(0.0), zero_aux), (batch_stack, keys)
         )
         grad_norm = optax.global_norm(grads)
         new_updates, new_opt_state = optimizer.update(grads, opt_state, params)

@@ -156,3 +156,50 @@ class TestInitAndMerge:
                 node = node[p.key]
             assert len(node) == leaf.ndim, (path, node, leaf.shape)
         assert count_lora_params(lora) > 0
+
+
+class TestLoraDropout:
+    def test_dropout_masks_features_and_rescales(self):
+        from automodel_tpu.peft.lora import PeftConfig, merge_lora_params
+
+        cfg = PeftConfig(target_modules=["*w_gate"], dim=4, alpha=8, dropout=0.5)
+        rng = np.random.RandomState(0)
+        base = {"layers": {"w_gate": jnp.zeros((2, 16, 8), jnp.float32)}}
+        lora = {"layers": {"w_gate": {
+            "lora_a": jnp.asarray(rng.randn(2, 16, 4), jnp.float32),
+            "lora_b": jnp.asarray(rng.randn(2, 4, 8), jnp.float32),
+        }}}
+        det = merge_lora_params(base, lora, cfg)
+        k1 = jax.random.key(1)
+        drop1 = merge_lora_params(base, lora, cfg, dropout_rng=k1)
+        drop2 = merge_lora_params(base, lora, cfg, dropout_rng=jax.random.key(2))
+        # stochastic: different keys -> different merges; no key -> deterministic
+        assert not np.allclose(np.asarray(drop1["layers"]["w_gate"]),
+                               np.asarray(drop2["layers"]["w_gate"]))
+        np.testing.assert_array_equal(
+            np.asarray(merge_lora_params(base, lora, cfg)["layers"]["w_gate"]),
+            np.asarray(det["layers"]["w_gate"]),
+        )
+        # expectation preserved: mean over many keys approaches the deterministic delta
+        acc = np.zeros_like(np.asarray(det["layers"]["w_gate"]))
+        n = 300
+        for i in range(n):
+            acc += np.asarray(merge_lora_params(
+                base, lora, cfg, dropout_rng=jax.random.key(100 + i)
+            )["layers"]["w_gate"])
+        mean_err = np.abs(acc / n - np.asarray(det["layers"]["w_gate"])).mean()
+        assert mean_err < 0.25, f"dropout must preserve the expected delta, err {mean_err}"
+
+    def test_dropout_zero_ignores_rng(self):
+        from automodel_tpu.peft.lora import PeftConfig, merge_lora_params
+
+        cfg = PeftConfig(target_modules=["*w_gate"], dim=4, alpha=8, dropout=0.0)
+        base = {"layers": {"w_gate": jnp.ones((2, 16, 8), jnp.float32)}}
+        lora = {"layers": {"w_gate": {
+            "lora_a": jnp.ones((2, 16, 4), jnp.float32),
+            "lora_b": jnp.ones((2, 4, 8), jnp.float32),
+        }}}
+        a = merge_lora_params(base, lora, cfg, dropout_rng=jax.random.key(0))
+        b = merge_lora_params(base, lora, cfg)
+        np.testing.assert_array_equal(np.asarray(a["layers"]["w_gate"]),
+                                      np.asarray(b["layers"]["w_gate"]))
