@@ -72,6 +72,12 @@ def sft_collate(
 
 
 def stack_batches(batches: Sequence[Mapping[str, np.ndarray]]) -> dict[str, np.ndarray]:
-    """Stack microbatches into (n_micro, B, S) arrays for the scan inside train_step."""
-    keys = batches[0].keys()
-    return {k: np.stack([np.asarray(b[k]) for b in batches], axis=0) for k in keys}
+    """Stack microbatches into (n_micro, B, S) arrays for the scan inside train_step.
+
+    Tree-mapped so nested batch structures (VLM ``vision_inputs`` dicts) stack
+    leaf-wise."""
+    import jax
+
+    return jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs], axis=0), *batches
+    )

@@ -1,0 +1,452 @@
+"""Per-model VLM collators (reference datasets/vlm/collate_fns.py:148-394).
+
+The reference dispatches a per-processor collate function (qwen2.5-VL,
+qwen3-omni, kimi, phi4-mm); each pairs chat text containing media placeholders
+with the model's native patch/feature layout and masks labels to the answer
+span. The TPU versions keep every data-dependent computation on the HOST
+(numpy): patchification, media-token expansion, mrope position walks, and the
+models' ``prepare_*_inputs`` bookkeeping all happen here, so the jitted step
+sees only static-shaped arrays.
+
+Static-shape contract: all images are resized to ONE grid per config (unlike
+the reference's native-resolution buckets, which are free on GPUs but would
+retrace XLA per shape). ``image_size=(grid_h, grid_w)`` in *patches*; vary it
+per config, not per batch.
+
+Layout parity: ``qwen_patchify`` reproduces the HF Qwen2VL image processor's
+patch ordering exactly (verified against it in tests), so pretrained
+checkpoints see the pixel layout they were trained on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from automodel_tpu.data.collate import IGNORE_INDEX, shift_example
+from automodel_tpu.data.vlm.collate import IMAGE_PLACEHOLDER, _MEAN, _STD
+
+__all__ = [
+    "qwen_patchify", "qwen_vl_collate", "kimi_patchify", "kimi_vl_collate",
+    "qwen3_omni_collate", "log_mel_spectrogram", "AUDIO_PLACEHOLDER",
+]
+
+AUDIO_PLACEHOLDER = "<audio>"
+
+
+def _resize_hw(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """(H, W, C) -> (out_h, out_w, C) bilinear, pure numpy."""
+    h, w, _ = img.shape
+    if h == out_h and w == out_w:
+        return img.astype(np.float32)
+    ys = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * w / out_w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    img = img.astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def _to_chw_float(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """uint8/float (H, W, 3) -> CLIP-normalized (3, out_h, out_w) float32."""
+    img = np.asarray(img)
+    if img.dtype == np.uint8:
+        img = img.astype(np.float32) / 255.0
+    img = _resize_hw(img, out_h, out_w)
+    return np.transpose((img - _MEAN) / _STD, (2, 0, 1))
+
+
+def qwen_patchify(
+    img: np.ndarray,  # (H, W, 3) uint8 or float
+    *,
+    patch_size: int,
+    merge_size: int,
+    temporal_patch_size: int,
+    grid_h: int,
+    grid_w: int,
+) -> np.ndarray:
+    """One image -> (grid_h*grid_w, 3*temporal_patch*patch^2) in the HF
+    Qwen2VL processor layout (image_processing_qwen2_vl semantics: the single
+    frame repeats across the temporal patch; patches are ordered merge-window
+    major so the tower's spatial merge reads contiguous blocks)."""
+    m, p = merge_size, patch_size
+    x = _to_chw_float(img, grid_h * p, grid_w * p)  # (C, H, W)
+    x = np.tile(x[None], (temporal_patch_size, 1, 1, 1))  # (tp, C, H, W)
+    c = x.shape[1]
+    x = x.reshape(1, temporal_patch_size, c, grid_h // m, m, p, grid_w // m, m, p)
+    x = x.transpose(0, 3, 6, 4, 7, 2, 1, 5, 8)
+    return np.ascontiguousarray(
+        x.reshape(grid_h * grid_w, c * temporal_patch_size * p * p)
+    )
+
+
+def _encode_with_media(
+    tokenizer, ex: Mapping[str, Any], seq_len: int,
+    spans: Mapping[str, Sequence[Sequence[int]]],  # placeholder -> media id spans
+    answer_only_loss: bool = True,
+):
+    """Shared text path: expand each placeholder occurrence (possibly of several
+    modalities, in textual order) with its next media id span, then build shifted
+    inputs/labels masked to the answer."""
+    prompt = ex.get("prompt", "")
+    # auto-prepend placeholders the prompt doesn't mention
+    for ph, media in spans.items():
+        missing = len(media) - prompt.count(ph)
+        if missing < 0:
+            raise ValueError(
+                f"prompt has {prompt.count(ph)} {ph!r} placeholders for "
+                f"{len(media)} media items"
+            )
+        if missing:
+            prompt = ph * missing + "\n" + prompt
+    ids: list[int] = []
+    cursor = {ph: iter(media) for ph, media in spans.items()}
+    rest, first = prompt, True
+    while rest:
+        hits = [(rest.find(ph), ph) for ph in spans if ph in rest]
+        if not hits:
+            ids.extend(tokenizer.encode(rest, add_special_tokens=first))
+            break
+        pos, ph = min(hits)
+        if pos:
+            ids.extend(tokenizer.encode(rest[:pos], add_special_tokens=first))
+            first = False
+        ids.extend(next(cursor[ph]))
+        rest = rest[pos + len(ph):]
+        first = False
+    prompt_len = len(ids)
+    answer_ids = tokenizer.encode(str(ex["answer"]), add_special_tokens=False)
+    eos = getattr(tokenizer, "eos_token_id", None)
+    if eos is not None:
+        answer_ids = answer_ids + [eos]
+    ids = np.asarray(ids + answer_ids, np.int32)
+    if prompt_len >= seq_len:
+        raise ValueError(
+            f"seq_len {seq_len} cannot hold the prompt + media span ({prompt_len} tokens)"
+        )
+    inp, tgt = shift_example({"input_ids": ids, "prompt_len": prompt_len}, answer_only_loss)
+    return inp[:seq_len], tgt[:seq_len]
+
+
+def _check_uniform_media(per_ex_counts: Sequence[int], what: str):
+    """Static-shape contract: every example in every batch must carry the same
+    media multiplicity, or stacked microbatches change shape and jit retraces
+    (or crashes on np.stack). Fail loudly with the remedy."""
+    if len(set(per_ex_counts)) > 1:
+        raise ValueError(
+            f"examples carry different numbers of {what} ({sorted(set(per_ex_counts))}); "
+            f"TPU batches need a uniform media count per example — pad or filter the "
+            f"dataset (static shapes are the jit contract)"
+        )
+
+
+def _text_batch(examples, tokenizer, seq_len, pad_token_id, per_ex_spans):
+    b = len(examples)
+    input_ids = np.full((b, seq_len), pad_token_id, np.int32)
+    labels = np.full((b, seq_len), IGNORE_INDEX, np.int32)
+    segment_ids = np.zeros((b, seq_len), np.int32)
+    positions = np.zeros((b, seq_len), np.int32)
+    for row, (ex, spans) in enumerate(zip(examples, per_ex_spans)):
+        inp, tgt = _encode_with_media(tokenizer, ex, seq_len, spans)
+        n = len(inp)
+        input_ids[row, :n] = inp
+        labels[row, :n] = tgt
+        segment_ids[row, :n] = 1
+        positions[row, :n] = np.arange(n)
+    labels[segment_ids == 0] = IGNORE_INDEX
+    return input_ids, labels, positions, segment_ids
+
+
+def qwen_vl_collate(
+    examples: Sequence[Mapping[str, Any]],
+    tokenizer,
+    model,  # Qwen3VLMoeForConditionalGeneration-style native model
+    seq_len: int,
+    pad_token_id: int = 0,
+    image_size: tuple[int, int] | None = None,  # (grid_h, grid_w) in patches
+) -> dict[str, np.ndarray]:
+    """qwen2.5-VL / qwen3-VL collate (reference collate_fns.py qwen2_5 path).
+
+    Examples: {"prompt": str with <image> placeholders, "answer": str,
+    "image": array or "images": [array, ...]}. Emits the native model's full
+    input set: flat pixel patches, prepare_vision_inputs bookkeeping, visual
+    scatter coords, and 3-axis mrope positions.
+    """
+    cfg = model.config
+    vis = cfg.vision
+    if image_size is None:
+        gh = gw = max(vis.spatial_merge_size * 4, 8)
+    else:
+        gh, gw = image_size
+    ms = vis.spatial_merge_size
+    if gh % ms or gw % ms:
+        raise ValueError(f"image_size {gh}x{gw} must be a multiple of merge {ms}")
+    n_merged = (gh // ms) * (gw // ms)
+
+    per_ex_imgs = [
+        ex.get("images", [ex["image"]] if "image" in ex else []) for ex in examples
+    ]
+    _check_uniform_media([len(i) for i in per_ex_imgs], "images")
+    vstart = getattr(cfg, "vision_start_token_id", None)
+    span = [cfg.image_token_id] * n_merged
+    if vstart is not None:
+        span = [vstart] + span
+    per_ex_spans = [{IMAGE_PLACEHOLDER: [span] * len(imgs)} for imgs in per_ex_imgs]
+
+    input_ids, labels, positions, segment_ids = _text_batch(
+        examples, tokenizer, seq_len, pad_token_id, per_ex_spans
+    )
+
+    patches = [
+        qwen_patchify(
+            img, patch_size=vis.patch_size, merge_size=ms,
+            temporal_patch_size=vis.temporal_patch_size, grid_h=gh, grid_w=gw,
+        )
+        for imgs in per_ex_imgs for img in imgs
+    ]
+    n_images = len(patches)
+    grids = np.asarray([[1, gh, gw]] * n_images, np.int64)
+    pixel_values = (
+        np.concatenate(patches, 0) if patches
+        else np.zeros((0, vis.in_channels * vis.temporal_patch_size * vis.patch_size**2), np.float32)
+    )
+
+    coords_b, coords_s = model.visual_token_coords(input_ids)
+    batch = {
+        "input_ids": input_ids,
+        "labels": labels,
+        "positions": positions,
+        "segment_ids": segment_ids,
+        "pixel_values": pixel_values.astype(np.float32),
+        "vision_inputs": model.prepare_vision_inputs(grids),
+        "visual_coords_b": coords_b,
+        "visual_coords_s": coords_s,
+        "positions3": np.asarray(model.get_mrope_positions(input_ids, grids)),
+    }
+    return batch
+
+
+def kimi_patchify(img: np.ndarray, *, patch_size: int, grid_h: int, grid_w: int) -> np.ndarray:
+    """One image -> (grid_h*grid_w, 3*patch^2) MoonViT flat patches (row-major
+    patch order; kernel-merge grouping happens in prepare_moonvit_inputs)."""
+    p = patch_size
+    x = _to_chw_float(img, grid_h * p, grid_w * p)  # (C, H, W)
+    c = x.shape[0]
+    x = x.reshape(c, grid_h, p, grid_w, p).transpose(1, 3, 0, 2, 4)
+    return np.ascontiguousarray(x.reshape(grid_h * grid_w, c * p * p))
+
+
+def kimi_vl_collate(
+    examples: Sequence[Mapping[str, Any]],
+    tokenizer,
+    model,  # KimiVL-style native model
+    seq_len: int,
+    pad_token_id: int = 0,
+    image_size: tuple[int, int] | None = None,  # (grid_h, grid_w) in patches
+) -> dict[str, np.ndarray]:
+    """Kimi-VL collate (reference collate_fns.py kimi path): MoonViT flat
+    patches + media placeholder expansion (one merged token per merge kernel)."""
+    cfg = model.config
+    vis = cfg.vision
+    kh, kw = vis.merge_kernel_size
+    if image_size is None:
+        gh, gw = kh * 4, kw * 4
+    else:
+        gh, gw = image_size
+    if gh % kh or gw % kw:
+        raise ValueError(f"image_size {gh}x{gw} must be a multiple of merge {kh}x{kw}")
+    n_merged = (gh // kh) * (gw // kw)
+
+    per_ex_imgs = [
+        ex.get("images", [ex["image"]] if "image" in ex else []) for ex in examples
+    ]
+    _check_uniform_media([len(i) for i in per_ex_imgs], "images")
+    media_id = cfg.media_placeholder_token_id
+    per_ex_spans = [
+        {IMAGE_PLACEHOLDER: [[media_id] * n_merged] * len(imgs)} for imgs in per_ex_imgs
+    ]
+    input_ids, labels, positions, segment_ids = _text_batch(
+        examples, tokenizer, seq_len, pad_token_id, per_ex_spans
+    )
+
+    patches = [
+        kimi_patchify(img, patch_size=vis.patch_size, grid_h=gh, grid_w=gw)
+        for imgs in per_ex_imgs for img in imgs
+    ]
+    grids = np.asarray([[gh, gw]] * len(patches), np.int64)
+    pixel_values = (
+        np.concatenate(patches, 0) if patches
+        else np.zeros((0, vis.in_channels * vis.patch_size**2), np.float32)
+    )
+    b_idx, s_idx = np.where(input_ids == media_id)
+    return {
+        "input_ids": input_ids,
+        "labels": labels,
+        "positions": positions,
+        "segment_ids": segment_ids,
+        "pixel_values": pixel_values.astype(np.float32),
+        "vision_inputs": model.prepare_vision_inputs(grids),
+        "media_coords_b": b_idx.astype(np.int32),
+        "media_coords_s": s_idx.astype(np.int32),
+    }
+
+
+def log_mel_spectrogram(
+    audio: np.ndarray, *, num_mel_bins: int, sample_rate: int = 16000,
+    n_fft: int = 400, hop: int = 160,
+) -> np.ndarray:
+    """Whisper-style log-mel features, pure numpy: (num_mel_bins, T_frames).
+
+    The reference drives this through WhisperFeatureExtractor inside the omni
+    processor; the math is the standard STFT -> mel filterbank -> log10 with
+    dynamic-range clamping.
+    """
+    audio = np.asarray(audio, np.float32)
+    n_frames = 1 + (len(audio) - n_fft) // hop if len(audio) >= n_fft else 0
+    if n_frames <= 0:
+        audio = np.pad(audio, (0, n_fft - len(audio)))
+        n_frames = 1
+    window = np.hanning(n_fft + 1)[:-1].astype(np.float32)
+    frames = np.lib.stride_tricks.as_strided(
+        audio, (n_frames, n_fft), (audio.strides[0] * hop, audio.strides[0]),
+    )
+    spec = np.abs(np.fft.rfft(frames * window, axis=-1)) ** 2  # (T, n_fft//2+1)
+
+    # slaney-ish mel filterbank
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    mel_pts = mel_to_hz(np.linspace(hz_to_mel(0.0), hz_to_mel(sample_rate / 2), num_mel_bins + 2))
+    bins = np.floor((n_fft + 1) * mel_pts / sample_rate).astype(int)
+    fb = np.zeros((num_mel_bins, n_fft // 2 + 1), np.float32)
+    for i in range(num_mel_bins):
+        l, c, r = bins[i], bins[i + 1], bins[i + 2]
+        if c > l:
+            fb[i, l:c] = (np.arange(l, c) - l) / (c - l)
+        if r > c:
+            fb[i, c:r] = (r - np.arange(c, r)) / (r - c)
+    mel = np.maximum(spec @ fb.T, 1e-10)
+    logmel = np.log10(mel).T  # (mel, T)
+    logmel = np.maximum(logmel, logmel.max() - 8.0)
+    return ((logmel + 4.0) / 4.0).astype(np.float32)
+
+
+def qwen3_omni_collate(
+    examples: Sequence[Mapping[str, Any]],
+    tokenizer,
+    model,  # Qwen3OmniMoe-style native model
+    seq_len: int,
+    pad_token_id: int = 0,
+    image_size: tuple[int, int] | None = None,
+) -> dict[str, np.ndarray]:
+    """qwen3-omni collate (reference collate_fns.py qwen3_omni path): audio
+    (<audio> -> mel features -> audio placeholder span) composes with the
+    qwen-VL image path (<image> -> patch spans); mrope positions come from the
+    omni walk over both modalities.
+
+    Audio examples carry "audio" (raw waveform, 16kHz float) or
+    "audio_features" (precomputed (mel, T)); image examples carry
+    "image"/"images" like qwen_vl_collate.
+    """
+    import math
+
+    from automodel_tpu.models.audio.qwen3_omni_audio import _conv_out_len
+
+    cfg = model.config
+    acfg = cfg.audio
+    vis = cfg.vision
+
+    # ---- audio features + their token spans
+    per_ex_feats: list[list[np.ndarray]] = []
+    for ex in examples:
+        feats = []
+        if "audio_features" in ex:
+            feats.append(np.asarray(ex["audio_features"], np.float32))
+        elif "audio" in ex:
+            feats.append(log_mel_spectrogram(ex["audio"], num_mel_bins=acfg.num_mel_bins))
+        per_ex_feats.append(feats)
+    _check_uniform_media([len(f) for f in per_ex_feats], "audio clips")
+    _check_uniform_media(
+        [f.shape[1] for feats in per_ex_feats for f in feats] or [0], "audio frames"
+    )
+    all_feats = [f for feats in per_ex_feats for f in feats]
+    audio_inputs = model.prepare_audio_inputs(all_feats) if all_feats else None
+
+    # one audio placeholder token per valid output frame of the audio tower
+    # (the sum over chunks of the 3x-conv downsampled valid lengths)
+    def _n_tokens(mel: np.ndarray) -> int:
+        C = acfg.chunk_len
+        T = mel.shape[1]
+        return sum(
+            _conv_out_len(min(C, T - ci * C)) for ci in range(math.ceil(T / C))
+        )
+
+    # ---- images (same path as qwen_vl_collate)
+    per_ex_imgs = [
+        ex.get("images", [ex["image"]] if "image" in ex else []) for ex in examples
+    ]
+    _check_uniform_media([len(i) for i in per_ex_imgs], "images")
+    if image_size is None:
+        gh = gw = max(vis.spatial_merge_size * 4, 8)
+    else:
+        gh, gw = image_size
+    ms = vis.spatial_merge_size
+    n_merged = (gh // ms) * (gw // ms)
+    vstart = getattr(cfg, "vision_start_token_id", None)
+    img_span = [cfg.image_token_id] * n_merged
+    if vstart is not None:
+        img_span = [vstart] + img_span
+
+    per_ex_spans = [
+        {
+            AUDIO_PLACEHOLDER: [[cfg.audio_token_id] * _n_tokens(f) for f in feats],
+            IMAGE_PLACEHOLDER: [img_span] * len(imgs),
+        }
+        for feats, imgs in zip(per_ex_feats, per_ex_imgs)
+    ]
+    input_ids, labels, positions, segment_ids = _text_batch(
+        examples, tokenizer, seq_len, pad_token_id, per_ex_spans
+    )
+    batch = {
+        "input_ids": input_ids,
+        "labels": labels,
+        "positions": positions,
+        "segment_ids": segment_ids,
+    }
+    patches = [
+        qwen_patchify(
+            img, patch_size=vis.patch_size, merge_size=ms,
+            temporal_patch_size=vis.temporal_patch_size, grid_h=gh, grid_w=gw,
+        )
+        for imgs in per_ex_imgs for img in imgs
+    ]
+    grids = np.asarray([[1, gh, gw]] * len(patches), np.int64)
+    if patches:
+        vb, vs = model.visual_token_coords(input_ids)
+        batch |= {
+            "pixel_values": np.concatenate(patches, 0).astype(np.float32),
+            "vision_inputs": model.prepare_vision_inputs(grids),
+            "visual_coords_b": vb,
+            "visual_coords_s": vs,
+        }
+    if audio_inputs is not None:
+        ab, as_ = model.audio_token_coords(input_ids)
+        batch |= {
+            "audio_chunks": audio_inputs.pop("chunks"),
+            "audio_inputs": audio_inputs,
+            "audio_coords_b": ab,
+            "audio_coords_s": as_,
+        }
+    if patches or audio_inputs is not None:
+        batch["positions3"] = np.asarray(model.get_mrope_positions(input_ids, grids))
+    return batch

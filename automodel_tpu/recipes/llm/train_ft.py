@@ -332,9 +332,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
 
     @property
     def _moe_config(self):
-        return getattr(self.model.config, "moe", None)
+        cfg = self.model.config
+        return getattr(cfg, "moe", None) or getattr(getattr(cfg, "text", None), "moe", None)
 
-    def _forward_loss(self, params, batch, num_label_tokens, training=True):
+    def _model_forward(self, params, batch, training):
+        """The model call; subclasses (VLM) override to thread extra modalities
+        while the loss/aux handling below stays shared."""
         kwargs = {}
         if self._moe_config is not None:
             # segment id 0 marks padding (sft_collate contract): pad tokens must not
@@ -342,11 +345,14 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             kwargs = {"token_mask": batch["segment_ids"] != 0, "training": training}
         # sharding constraints are pure fusion barriers on a single device
         rules = self.rules if self.mesh.size > 1 else None
-        out = self.model(
+        return self.model(
             params, batch["input_ids"], positions=batch["positions"],
             segment_ids=batch["segment_ids"], rules=rules,
             return_hidden=self.loss_name == "linear_ce", **kwargs,
         )
+
+    def _forward_loss(self, params, batch, num_label_tokens, training=True):
+        out = self._model_forward(params, batch, training)
         out, stats = out if isinstance(out, tuple) else (out, None)
         if self.loss_name == "linear_ce":
             unembed = params.get("lm_head")
@@ -504,6 +510,14 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         if "dataloader" in client:
             self.dataloader.load_state_dict(client["dataloader"])
 
+    def _device_put_stack(self, stack):
+        """Shard the stacked (n_micro, B, S) token streams over the batch axes;
+        subclasses with extra modalities (VLM media tensors) override per key."""
+        return {
+            k: jax.device_put(v, self.rules.sharding((None, "batch", None)))
+            for k, v in stack.items()
+        }
+
     # ------------------------------------------------------------------ train
     def run_train_validation_loop(self):
         mesh = self.mesh
@@ -526,12 +540,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                                     f">= model vocab_size {vocab}: tokenizer/model mismatch"
                                 )
                     checked_vocab = True
-                stack = {
-                    k: jax.device_put(
-                        v, self.rules.sharding((None, "batch", None))
-                    )
-                    for k, v in stack.items()
-                }
+                stack = self._device_put_stack(stack)
                 extra = (self.params,) if self.peft is not None else ()
                 if self._step_needs_rng:
                     extra = (*extra, self.rng.key("lora_dropout"))
@@ -635,10 +644,14 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             n = int((batch["labels"] != -100).sum())
             total += float(self._eval_step(self.train_params, batch, n, *extra)) * n
             count += n
+        self._log_val_loss(step, total, count)
+
+    def _log_val_loss(self, step: int, total: float, count: float):
+        """Token-weighted mean aggregated across the pod: each process sees a
+        different dataloader shard, so a host-local mean would log a different
+        val_loss per host (reference allreduces val loss the same way,
+        train_ft.py:1456)."""
         if jax.process_count() > 1:
-            # token-weighted mean across the pod: each process sees a different
-            # dataloader shard, so a host-local mean logs a different val_loss per
-            # host (reference allreduces val loss the same way, train_ft.py:1456)
             from jax.experimental import multihost_utils
 
             agg = multihost_utils.process_allgather(

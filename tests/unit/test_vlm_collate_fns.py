@@ -1,0 +1,222 @@
+"""Per-model VLM collators: HF-processor patch-layout parity, media expansion,
+mrope wiring (reference datasets/vlm/collate_fns.py per-processor dispatch)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from automodel_tpu.data.vlm.collate_fns import (
+    kimi_patchify, log_mel_spectrogram, qwen_patchify, qwen_vl_collate,
+)
+
+
+class WordTok:
+    eos_token_id = 1
+
+    def encode(self, text, add_special_tokens=True):
+        return [2 + (hash(w) % 90) for w in text.split()]
+
+
+class TestQwenPatchify:
+    def test_matches_hf_processor_layout(self):
+        transformers = pytest.importorskip("transformers")
+        from transformers.models.qwen2_vl.image_processing_qwen2_vl import (
+            Qwen2VLImageProcessor,
+        )
+
+        rng = np.random.RandomState(0)
+        img = (rng.rand(56, 56, 3) * 255).astype(np.uint8)
+        proc = Qwen2VLImageProcessor(
+            patch_size=4, merge_size=2, temporal_patch_size=2,
+            min_pixels=1, max_pixels=10**9, do_resize=False,
+        )
+        out = proc(images=[img], return_tensors="np")
+        want = out["pixel_values"]
+        grid = out["image_grid_thw"][0]  # (t, h, w)
+        got = qwen_patchify(
+            img, patch_size=4, merge_size=2, temporal_patch_size=2,
+            grid_h=int(grid[1]), grid_w=int(grid[2]),
+        )
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=2e-2, rtol=1e-2)
+
+    def test_kimi_patchify_shape(self):
+        img = np.random.RandomState(1).rand(28, 28, 3).astype(np.float32)
+        got = kimi_patchify(img, patch_size=4, grid_h=4, grid_w=4)
+        assert got.shape == (16, 3 * 16)
+
+
+class TestQwenVLCollate:
+    def _model(self):
+        from automodel_tpu.models.auto import AutoModelForImageTextToText
+        from automodel_tpu.models.common.backend import BackendConfig
+
+        hf = {
+            "architectures": ["Qwen3VLMoeForConditionalGeneration"],
+            "text_config": {
+                "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+                "moe_intermediate_size": 32, "num_hidden_layers": 2,
+                "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+                "num_experts": 8, "num_experts_per_tok": 2, "max_position_embeddings": 128,
+                "rope_scaling": {"rope_type": "default", "mrope_section": [4, 2, 2],
+                                 "mrope_interleaved": True},
+            },
+            "vision_config": {
+                "depth": 2, "hidden_size": 32, "intermediate_size": 48, "num_heads": 4,
+                "patch_size": 4, "spatial_merge_size": 2, "temporal_patch_size": 2,
+                "out_hidden_size": 64, "num_position_embeddings": 16,
+                "deepstack_visual_indexes": [0, 1], "in_channels": 3,
+            },
+            "image_token_id": 120, "video_token_id": 122, "vision_start_token_id": 121,
+        }
+        return AutoModelForImageTextToText.from_config(
+            hf, BackendConfig(dtype="float32")
+        )
+
+    def test_batch_shapes_and_forward(self):
+        import jax
+
+        model = self._model()
+        rng = np.random.RandomState(0)
+        exs = [
+            {"prompt": "<image> describe", "answer": "a cat",
+             "image": rng.rand(16, 16, 3).astype(np.float32)}
+            for _ in range(2)
+        ]
+        batch = qwen_vl_collate(exs, WordTok(), model, seq_len=48, image_size=(4, 4))
+        n_merged = 4  # (4/2)*(4/2)
+        assert batch["pixel_values"].shape == (2 * 16, 3 * 2 * 16)
+        assert batch["positions3"].shape == (3, 2, 48)
+        assert (batch["input_ids"] == 120).sum() == 2 * n_merged
+        assert batch["visual_coords_b"].shape[0] == 2 * n_merged
+        # answer tokens supervised, image tokens not
+        assert (batch["labels"] != -100).sum() > 0
+        img_positions = batch["input_ids"] == 120
+        assert (batch["labels"][img_positions] == -100).all()
+
+        params = model.init(jax.random.key(0), jnp.float32)
+        out, _ = model(
+            params, jnp.asarray(batch["input_ids"]),
+            pixel_values=jnp.asarray(batch["pixel_values"]),
+            vision_inputs={k: jnp.asarray(v) for k, v in batch["vision_inputs"].items()},
+            visual_coords=(jnp.asarray(batch["visual_coords_b"]),
+                           jnp.asarray(batch["visual_coords_s"])),
+            positions3=jnp.asarray(batch["positions3"]),
+            segment_ids=jnp.asarray(batch["segment_ids"]),
+            training=False,
+        )
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestLogMel:
+    def test_shapes_and_finite(self):
+        audio = np.sin(np.linspace(0, 100, 16000)).astype(np.float32)
+        mel = log_mel_spectrogram(audio, num_mel_bins=32)
+        assert mel.shape[0] == 32
+        assert mel.shape[1] == 1 + (16000 - 400) // 160
+        assert np.isfinite(mel).all()
+
+
+class TestKimiCollateForward:
+    def test_collate_and_forward(self):
+        import jax
+
+        from automodel_tpu.data.vlm.collate_fns import kimi_vl_collate
+        from automodel_tpu.models.auto import AutoModelForImageTextToText
+        from automodel_tpu.models.common.backend import BackendConfig
+
+        hf = {
+            "architectures": ["KimiVLForConditionalGeneration"],
+            "media_placeholder_token_id": 120,
+            "text_config": {
+                "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+                "moe_intermediate_size": 32, "num_hidden_layers": 2,
+                "num_attention_heads": 4, "q_lora_rank": None, "kv_lora_rank": 32,
+                "qk_nope_head_dim": 16, "qk_rope_head_dim": 8, "v_head_dim": 16,
+                "n_routed_experts": 8, "num_experts_per_tok": 2, "n_shared_experts": 1,
+                "n_group": 2, "topk_group": 1, "routed_scaling_factor": 2.5,
+                "norm_topk_prob": True, "first_k_dense_replace": 1,
+                "max_position_embeddings": 128,
+                "scoring_func": "sigmoid", "topk_method": "noaux_tc",
+            },
+            "vision_config": {
+                "patch_size": 4, "init_pos_emb_height": 8, "init_pos_emb_width": 8,
+                "num_attention_heads": 4, "num_hidden_layers": 2, "hidden_size": 32,
+                "intermediate_size": 48, "merge_kernel_size": [2, 2],
+            },
+        }
+        model = AutoModelForImageTextToText.from_config(hf, BackendConfig(dtype="float32"))
+        rng = np.random.RandomState(0)
+        exs = [{"prompt": "<image> what", "answer": "dog",
+                "image": rng.rand(16, 16, 3).astype(np.float32)}]
+        batch = kimi_vl_collate(exs, WordTok(), model, seq_len=32, image_size=(4, 4))
+        assert batch["pixel_values"].shape == (16, 3 * 16)
+        assert (batch["input_ids"] == 120).sum() == 4  # (4/2)*(4/2) merged tokens
+        params = model.init(jax.random.key(0), jnp.float32)
+        out, _ = model(
+            params, jnp.asarray(batch["input_ids"]),
+            pixel_values=jnp.asarray(batch["pixel_values"]),
+            vision_inputs={k: jnp.asarray(v) for k, v in batch["vision_inputs"].items()},
+            media_coords=(jnp.asarray(batch["media_coords_b"]),
+                          jnp.asarray(batch["media_coords_s"])),
+            positions=jnp.asarray(batch["positions"]),
+            segment_ids=jnp.asarray(batch["segment_ids"]),
+            training=False,
+        )
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestOmniCollateForward:
+    def test_audio_collate_and_forward(self):
+        import jax
+
+        from automodel_tpu.data.vlm.collate_fns import qwen3_omni_collate
+        from automodel_tpu.models.auto import AutoModelForImageTextToText
+        from automodel_tpu.models.common.backend import BackendConfig
+
+        hf = {
+            "architectures": ["Qwen3OmniMoeForConditionalGeneration"],
+            "audio_config": {
+                "d_model": 32, "encoder_layers": 2, "encoder_attention_heads": 4,
+                "encoder_ffn_dim": 48, "num_mel_bins": 32, "n_window": 8,
+                "n_window_infer": 32, "downsample_hidden_size": 16, "output_dim": 64,
+                "conv_chunksize": 500,
+            },
+            "vision_config": {
+                "depth": 2, "hidden_size": 32, "intermediate_size": 48, "num_heads": 4,
+                "patch_size": 4, "spatial_merge_size": 2, "temporal_patch_size": 2,
+                "out_hidden_size": 64, "num_position_embeddings": 16,
+                "deepstack_visual_indexes": [0, 1], "in_channels": 3,
+            },
+            "text_config": {
+                "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+                "moe_intermediate_size": 32, "num_hidden_layers": 2,
+                "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+                "num_experts": 8, "num_experts_per_tok": 2, "max_position_embeddings": 256,
+                "rope_scaling": {"rope_type": "default", "mrope_section": [4, 2, 2],
+                                 "mrope_interleaved": True},
+            },
+            "audio_token_id": 123, "image_token_id": 120, "video_token_id": 122,
+            "vision_start_token_id": 121, "audio_start_token_id": 124,
+        }
+        model = AutoModelForImageTextToText.from_config(hf, BackendConfig(dtype="float32"))
+        rng = np.random.RandomState(0)
+        exs = [{"prompt": "<audio> transcribe", "answer": "hello",
+                "audio_features": rng.randn(32, 24).astype(np.float32)}]
+        batch = qwen3_omni_collate(exs, WordTok(), model, seq_len=64)
+        n_audio_tok = int((batch["input_ids"] == 123).sum())
+        assert n_audio_tok > 0
+        assert batch["audio_coords_b"].shape[0] == n_audio_tok
+        params = model.init(jax.random.key(0), jnp.float32)
+        out, _ = model(
+            params, jnp.asarray(batch["input_ids"]),
+            audio_chunks=jnp.asarray(batch["audio_chunks"]),
+            audio_inputs={k: jnp.asarray(v) for k, v in batch["audio_inputs"].items()},
+            audio_coords=(jnp.asarray(batch["audio_coords_b"]),
+                          jnp.asarray(batch["audio_coords_s"])),
+            positions3=jnp.asarray(batch["positions3"]),
+            segment_ids=jnp.asarray(batch["segment_ids"]),
+            training=False,
+        )
+        assert np.isfinite(np.asarray(out)).all()
