@@ -119,7 +119,10 @@ def route(
 
     aux_loss = None
     if cfg.aux_loss_coeff > 0 and training:
-        context_length = valid.sum()
+        # max(count, 1): an all-masked batch (e.g. a pipeline warmup/drain tick
+        # carrying garbage) must yield aux 0, not 0/0 = NaN — which would poison
+        # the whole loss even after the schedule masks the tick out (0 * NaN)
+        context_length = jnp.maximum(valid.sum(), 1.0)
         expert_scores = (original_scores * valid[:, None]).sum(0)  # (E,)
         f_i = expert_load * E / (K * context_length)
         p_i = expert_scores / context_length

@@ -133,13 +133,15 @@ def pipeline_spmd(
             lambda f, s: jnp.where((idx == 0) & (v == 0), f, s), feed, state
         )
         y, aux = _apply(_round_params(v), x)
-        valid = real.astype(jnp.float32)
+        # where (not multiply-by-0): 0 * nan = nan would survive a multiply mask.
+        # Forward finiteness on garbage ticks is owned by the aux math itself
+        # (gate.py clamps its token count so all-masked batches give 0, not 0/0);
+        # this where is the schedule-level backstop for the primal values
+        aux = jax.tree.map(lambda a: jnp.where(real, a, jnp.zeros_like(a)), aux)
         if V == 1:
-            aux_acc = jax.tree.map(lambda acc, a: acc + a * valid, aux_acc, aux)
+            aux_acc = jax.tree.map(jnp.add, aux_acc, aux)
         else:
-            aux_acc = jax.tree.map(
-                lambda acc, a: acc.at[v].add(a * valid), aux_acc, aux
-            )
+            aux_acc = jax.tree.map(lambda acc, a: acc.at[v].add(a), aux_acc, aux)
         # last stage emits microbatch mb when it finishes the final round; writes
         # are unconditional and time-ordered — slot mb's ticks ascend in round, so
         # the final-round write always lands last and intermediate/garbage writes
@@ -364,10 +366,6 @@ def make_moe_pp_loss(model, mesh: Mesh, *, pp_axis: str = "pp", loss_name: str =
     from automodel_tpu.models.common.moe_transformer import make_moe_layer_fns
 
     cfg, backend = model.config, model.backend
-    if cfg.moe.aux_loss_coeff > 0:
-        raise NotImplementedError(
-            "pp + aux-loss balancing is not wired; use gate-bias (loss-free) balancing"
-        )
     dtype = backend.jnp_dtype
     pp = mesh.shape[pp_axis]
     V = circular_repeats
@@ -377,9 +375,13 @@ def make_moe_pp_loss(model, mesh: Mesh, *, pp_axis: str = "pp", loss_name: str =
         seq_len_hint=seq_len_hint,
     )
     k_dense = cfg.first_k_dense_replace
+    emit_aux = cfg.moe.aux_loss_coeff > 0 and not backend.fake_balanced_gate
     load_spec = P(None, pp_axis) if V > 1 else P(pp_axis)
+    aux_specs = {"load": load_spec}
+    if emit_aux:
+        aux_specs["aux"] = load_spec
     pipeline = make_pipeline_forward(
-        mesh, pp_axis=pp_axis, with_aux=True, aux_out_specs={"load": load_spec},
+        mesh, pp_axis=pp_axis, with_aux=True, aux_out_specs=aux_specs,
         circular_repeats=V,
     )
 
@@ -400,10 +402,14 @@ def make_moe_pp_loss(model, mesh: Mesh, *, pp_axis: str = "pp", loss_name: str =
 
     def layer_apply(stage, state):
         lp_stack, sliding = stage
-        state, (_auxs, loads) = jax.lax.scan(
+        state, (auxs, loads) = jax.lax.scan(
             backend.layer_remat(moe_layer_fn), state, (lp_stack, sliding)
         )
-        return state, {"load": loads}
+        out = {"load": loads}
+        if emit_aux:
+            # (1,)-shaped so the per-stage scalars gather along pp
+            out["aux"] = auxs.sum()[None]
+        return state, out
 
     head_loss = _make_head_loss(cfg, dtype, loss_name)
 
@@ -421,6 +427,15 @@ def make_moe_pp_loss(model, mesh: Mesh, *, pp_axis: str = "pp", loss_name: str =
         if V > 1:
             # (V, pp*Lb, E) round-major -> (L, E) global layer order
             load = load.reshape(-1, *load.shape[2:])
-        return loss / num_label_tokens, {"expert_load": load}
+        loss = loss / num_label_tokens
+        if emit_aux:
+            # microbatch aux terms are summed unweighted inside the schedule;
+            # the non-pp contract weights each by its token fraction
+            # (train_ft.py _forward_loss), which averages to 1/n_micro when
+            # microbatch label counts are equal — exact for packed/mock data,
+            # a close approximation otherwise
+            n_micro = jax.tree.leaves(batch_stack)[0].shape[0]
+            loss = loss + cfg.moe.aux_loss_coeff * aux["aux"].sum() / n_micro
+        return loss, {"expert_load": load}
 
     return forward_loss

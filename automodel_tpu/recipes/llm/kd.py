@@ -39,12 +39,6 @@ __all__ = ["KnowledgeDistillationRecipe", "main"]
 
 
 class KnowledgeDistillationRecipe(TrainFinetuneRecipeForNextTokenPrediction):
-    def setup(self):
-        super().setup()
-        if self.peft is not None:
-            raise NotImplementedError("kd + peft composition is not wired yet")
-        return self
-
     def _build_teacher(self):
         cfg = self.cfg
         t_cfg = cfg.get("teacher_model")
@@ -75,9 +69,9 @@ class KnowledgeDistillationRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         temperature = float(self.cfg.get("kd.temperature", 1.0))
         kd_ratio = float(self.cfg.get("kd.kd_ratio", 0.5))
 
-        def kd_forward(params, teacher_params, batch, num_label_tokens):
+        def kd_core(student_params, teacher_params, batch, num_label_tokens):
             student_logits = self.model(
-                params, batch["input_ids"], positions=batch["positions"],
+                student_params, batch["input_ids"], positions=batch["positions"],
                 segment_ids=batch["segment_ids"], rules=self.rules,
             )
             teacher_logits = jax.lax.stop_gradient(
@@ -93,14 +87,39 @@ class KnowledgeDistillationRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             )
             return (1.0 - kd_ratio) * ce + kd_ratio * kd
 
-        step = make_train_step(kd_forward, self.optimizer, with_frozen=True)
+        if self.peft is not None:
+            # kd + peft (reference composes them, infrastructure.py:303): the
+            # frozen slot carries BOTH the teacher and the student's lora base
+            if self.peft.dropout:
+                raise NotImplementedError(
+                    "kd + lora dropout is not wired (the KD step does not thread "
+                    "a dropout rng); set peft.dropout: 0"
+                )
+            from automodel_tpu.peft.lora import merge_lora_params
+
+            def kd_forward(lora, frozen, batch, num_label_tokens):
+                merged = merge_lora_params(frozen["base"], lora, self.peft)
+                return kd_core(merged, frozen["teacher"], batch, num_label_tokens)
+        else:
+            def kd_forward(params, frozen, batch, num_label_tokens):
+                return kd_core(params, frozen["teacher"], batch, num_label_tokens)
+
+        step = make_train_step(kd_forward, self.optimizer, with_frozen=True,
+                               guard_nonfinite=self._check_nan_grads)
         return jax.jit(step, donate_argnums=(0, 1))
 
+    @property
+    def _kd_frozen_arg(self):
+        frozen = {"teacher": self.teacher_params}
+        if self.peft is not None:
+            frozen["base"] = self.params
+        return frozen
+
     def run_train_validation_loop(self):
-        # thread the teacher through as the frozen tree (the same slot PEFT uses
-        # for the base model; mutually exclusive by the setup() guard)
+        # thread the teacher (and, under peft, the student base) through the
+        # frozen slot; *_ swallows the base loop's peft extra
         jitted = self._train_step
-        self._train_step = lambda p, o, stack: jitted(p, o, stack, self.teacher_params)
+        self._train_step = lambda p, o, stack, *_: jitted(p, o, stack, self._kd_frozen_arg)
         super().run_train_validation_loop()
 
 

@@ -405,8 +405,6 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             )
             from automodel_tpu.training.train_step import make_pp_train_step
 
-            if self.peft is not None:
-                raise NotImplementedError("peft + pp composition is not wired yet")
             if self.cfg.get("qat") is not None:
                 raise NotImplementedError("qat + pp composition is not wired yet")
             virtual = int(self.cfg.get("distributed.pp_virtual_stages", 1))
@@ -415,15 +413,33 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     self.model, self.mesh, loss_name=self.loss_name,
                     seq_len_hint=self.seq_len, circular_repeats=virtual,
                 )
-                step = make_pp_train_step(pp_loss, self.optimizer,
-                                          post_update=self._post_update(),
-                                          guard_nonfinite=self._check_nan_grads)
+                pp_post_update = self._post_update() if self.peft is None else None
+                if self.peft is not None and self._post_update() is not None:
+                    logger.warning("moe gate-bias update disabled under peft (base is frozen)")
             else:
                 pp_loss = make_dense_decoder_pp_loss(
                     self.model, self.mesh, self.rules, loss_name=self.loss_name,
                     circular_repeats=virtual,
                 )
+                pp_post_update = None
+            if self.peft is not None:
+                # peft + pp (reference composes them, infrastructure.py:303): the
+                # LoRA merge happens OUTSIDE the pp-manual region in plain GSPMD —
+                # merged layer stacks stay (L, ...) and shard over pp as usual;
+                # grads flow only to the rank-r adapter (the frozen base rides in
+                # the undifferentiated slot).
+                from automodel_tpu.peft.lora import merge_lora_params
+
+                def pp_peft_loss(lora, base, batch_stack, n):
+                    merged = merge_lora_params(base, lora, self.peft)
+                    return pp_loss(merged, batch_stack, n)
+
+                step = make_pp_train_step(pp_peft_loss, self.optimizer,
+                                          guard_nonfinite=self._check_nan_grads,
+                                          with_frozen=True)
+            else:
                 step = make_pp_train_step(pp_loss, self.optimizer,
+                                          post_update=pp_post_update,
                                           guard_nonfinite=self._check_nan_grads)
         elif self.peft is not None:
             from automodel_tpu.peft.lora import merge_lora_params

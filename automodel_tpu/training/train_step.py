@@ -140,22 +140,28 @@ def make_pp_train_step(
     optimizer: optax.GradientTransformation,
     post_update: Callable[[dict, dict], dict] | None = None,
     guard_nonfinite: bool = False,
+    with_frozen: bool = False,
 ):
     """Train step for pipeline parallelism: ``forward_loss`` consumes the WHOLE
     (n_micro, ...) batch stack at once — microbatching happens inside the pipeline
     schedule (parallel/pipeline.py), not an outer grad-accum scan (the reference's
     PP path does the same: the schedule owns the microbatch loop,
     recipes/llm/train_ft.py:1234). ``forward_loss`` may return ``(loss, aux)``
-    (MoE expert-load stats); ``post_update`` then runs after the optimizer step."""
+    (MoE expert-load stats); ``post_update`` then runs after the optimizer step.
+    ``with_frozen``: PEFT shape — ``forward_loss(trainable, frozen, batch, n)``
+    with the frozen base undifferentiated."""
 
-    def _call(params, batch_stack, num_label_tokens):
-        out = forward_loss(params, batch_stack, num_label_tokens)
+    def _call(params, batch_stack, num_label_tokens, frozen=None):
+        if with_frozen:
+            out = forward_loss(params, frozen, batch_stack, num_label_tokens)
+        else:
+            out = forward_loss(params, batch_stack, num_label_tokens)
         return out if isinstance(out, tuple) else (out, {})
 
-    def train_step(params, opt_state, batch_stack):
+    def train_step(params, opt_state, batch_stack, frozen=None):
         num_label_tokens = count_label_tokens(batch_stack["labels"])
         (loss, aux), grads = jax.value_and_grad(_call, has_aux=True)(
-            params, batch_stack, num_label_tokens
+            params, batch_stack, num_label_tokens, frozen
         )
         grad_norm = optax.global_norm(grads)
         new_updates, new_opt_state = optimizer.update(grads, opt_state, params)

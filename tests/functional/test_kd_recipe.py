@@ -75,3 +75,120 @@ def test_kd_loss_decreases(tmp_path, cpu_devices):
     assert losses[-1] < losses[0]
     # teacher params were never touched by the optimizer
     assert recipe.teacher_params is not None
+
+
+def test_kd_peft_adapter_trains(tmp_path, cpu_devices):
+    """kd + peft (a round-1 fence): the frozen slot carries teacher AND lora
+    base; only the adapter gets optimizer state, and the blended loss falls."""
+    student = """
+        architectures: [LlamaForCausalLM]
+        vocab_size: 128
+        hidden_size: 32
+        intermediate_size: 64
+        num_hidden_layers: 2
+        num_attention_heads: 4
+        num_key_value_heads: 2
+        max_position_embeddings: 128
+    """
+    cfg_text = f"""
+    seed: 7
+    output_dir: {tmp_path}/out
+    model:
+      config:
+{textwrap.indent(textwrap.dedent(student), "        ")}
+    teacher_model:
+      config:
+{textwrap.indent(textwrap.dedent(student), "        ")}
+    kd:
+      temperature: 2.0
+      kd_ratio: 0.2
+    peft:
+      dim: 8
+      alpha: 32
+      match_all_linear: true
+    distributed:
+      dp_shard: 4
+      tp: 2
+    backend:
+      dtype: float32
+    dataset:
+      _target_: automodel_tpu.data.llm.mock.MockSFTDataset
+      vocab_size: 128
+      seq_len: 32
+      num_samples: 256
+      seed: 0
+      pattern: arith
+    micro_batch_size: 8
+    seq_len: 32
+    step_scheduler:
+      grad_acc_steps: 2
+      max_steps: 20
+      num_epochs: 10
+      handle_sigterm: false
+    optimizer:
+      lr: 1.0e-2
+      max_grad_norm: 1.0
+    lr_scheduler:
+      lr_warmup_steps: 2
+    checkpoint:
+      enabled: false
+    """
+    p = tmp_path / "cfg.yaml"
+    p.write_text(textwrap.dedent(cfg_text))
+    recipe = KnowledgeDistillationRecipe(load_config(p)).setup()
+    assert recipe.peft is not None
+    from automodel_tpu.peft.lora import count_lora_params
+
+    assert count_lora_params(recipe.train_params) < 100_000
+    base_before = np.asarray(recipe.params["layers"]["wq"]).copy()
+    adapter_before = np.asarray(recipe.train_params["layers"]["wq"]["lora_b"]).copy()
+    recipe.run_train_validation_loop()
+    rows = [json.loads(line) for line in open(tmp_path / "out" / "training.jsonl")]
+    losses = [r["loss"] for r in rows]
+    assert np.isfinite(losses).all()
+    # the blended objective (CE + KL to a random teacher) conflicts at rank-8
+    # capacity, so assert the mechanism: adapter trains, base frozen, loss improves
+    assert min(losses) < losses[0] - 0.05, f"kd+peft must improve at some point: {losses}"
+    assert not np.allclose(np.asarray(recipe.train_params["layers"]["wq"]["lora_b"]), adapter_before)
+    np.testing.assert_array_equal(np.asarray(recipe.params["layers"]["wq"]), base_before)
+
+
+def test_kd_pp_is_an_explicit_error(tmp_path, cpu_devices):
+    student = """
+        architectures: [LlamaForCausalLM]
+        vocab_size: 128
+        hidden_size: 32
+        intermediate_size: 64
+        num_hidden_layers: 2
+        num_attention_heads: 4
+        num_key_value_heads: 2
+        max_position_embeddings: 128
+    """
+    cfg_text = f"""
+    seed: 7
+    output_dir: {tmp_path}/out
+    model:
+      config:
+{textwrap.indent(textwrap.dedent(student), "        ")}
+    teacher_model:
+      config:
+{textwrap.indent(textwrap.dedent(student), "        ")}
+    distributed: {{dp_shard: 2, tp: 2, pp: 2}}
+    backend: {{dtype: float32}}
+    dataset:
+      _target_: automodel_tpu.data.llm.mock.MockSFTDataset
+      vocab_size: 128
+      seq_len: 32
+      num_samples: 64
+    micro_batch_size: 8
+    seq_len: 32
+    step_scheduler: {{grad_acc_steps: 2, max_steps: 2, handle_sigterm: false}}
+    optimizer: {{lr: 1.0e-3}}
+    checkpoint: {{enabled: false}}
+    """
+    import pytest
+
+    p = tmp_path / "cfg.yaml"
+    p.write_text(textwrap.dedent(cfg_text))
+    with pytest.raises(NotImplementedError, match="kd \\+ pp"):
+        KnowledgeDistillationRecipe(load_config(p)).setup()

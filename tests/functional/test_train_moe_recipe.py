@@ -160,3 +160,26 @@ class TestMoERecipeE2E:
         assert bias1.dtype == np.float32
         rows = _read_jsonl(tmp_path / "out" / "training.jsonl")
         assert np.isfinite([r["loss"] for r in rows]).all()
+
+
+class TestPPAuxLoss:
+    def test_pp_aux_loss_balancing(self, tmp_path, cpu_devices):
+        """pp + router aux-loss (a round-1 fence): the aux term now rides the
+        pipeline's per-stage accumulators and joins the loss; trajectory stays
+        finite and falls with balancing on."""
+        cfg = load_config(_write_cfg(
+            tmp_path,
+            extra_model="num_experts: 8\n        num_experts_per_tok: 2\n        "
+                        "norm_topk_prob: true\n        router_aux_loss_coef: 0.01",
+            max_steps=6,
+        ))
+        cfg.set_by_path("model.config.num_hidden_layers", 4)
+        cfg.set_by_path("distributed.pp", 2)
+        cfg.set_by_path("distributed.tp", 1)
+        cfg.set_by_path("step_scheduler.grad_acc_steps", 4)
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        recipe.run_train_validation_loop()
+        rows = _read_jsonl(tmp_path / "out" / "training.jsonl")
+        losses = [r["loss"] for r in rows]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] - 0.3
