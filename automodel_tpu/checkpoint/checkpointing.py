@@ -110,6 +110,9 @@ class Checkpointer:
             with open(os.path.join(d, "client.json"), "w") as f:
                 json.dump({k: _jsonify(v.state_dict() if hasattr(v, "state_dict") else v)
                            for k, v in client_states.items()}, f)
+        if jax.process_index() == 0:
+            with open(os.path.join(d, "signature.json"), "w") as f:
+                json.dump(_model_signature(params), f)
         if self.config.save_consolidated and self.state_dict_adapter is not None:
             self.save_hf(os.path.join(d, "hf"), params if hf_params is None else hf_params)
         # async: the array write may still be in flight — defer the latest symlink
@@ -122,7 +125,14 @@ class Checkpointer:
         return d
 
     def save_hf(self, out_dir: str, params: Any) -> None:
-        """Consolidated HF-layout safetensors export (any rank count -> one HF dir)."""
+        """Consolidated HF-layout safetensors export (any rank count -> one HF dir).
+
+        The host gather runs on EVERY process (process_allgather is a collective;
+        gating it on rank 0 would deadlock the pod), then adapters mostly view
+        into the gathered tree and rank 0 streams the result out one <=5GB shard
+        at a time. Peak host use ~= one full model copy + one shard — true
+        per-tensor streaming needs adapter-level iteration (reference
+        consolidate_hf_safetensors.py) and is future work."""
         from automodel_tpu.checkpoint.safetensors_io import save_safetensors
 
         host = jax.tree.map(_full_host_array, params)
@@ -158,6 +168,25 @@ class Checkpointer:
         import orbax.checkpoint as ocp
 
         d = self.step_dir(step)
+        # model-signature compat check (reference base_recipe.py:768-846): fail
+        # with a diff instead of orbax's opaque tree-mismatch errors when the
+        # config changed between save and resume
+        sig_path = os.path.join(d, "signature.json")
+        if os.path.exists(sig_path):
+            with open(sig_path) as f:
+                saved = json.load(f)
+            current = _model_signature(params_template)
+            if saved != current:
+                missing = sorted(set(saved) - set(current))[:5]
+                added = sorted(set(current) - set(saved))[:5]
+                changed = sorted(
+                    k for k in set(saved) & set(current) if saved[k] != current[k]
+                )[:5]
+                raise ValueError(
+                    f"checkpoint at {d!r} was saved from a different model signature: "
+                    f"missing={missing} added={added} changed={changed} "
+                    f"(first 5 each; did the model config change between save and resume?)"
+                )
 
         def _resharded(restored, template):
             # orbax can land scalars/small leaves on a single device; force every
@@ -186,6 +215,54 @@ class Checkpointer:
                 client = json.load(f)
         return params, opt_state, client
 
+    # -- best tracking -------------------------------------------------------
+    def _read_best(self) -> dict | None:
+        best_path = os.path.join(self.config.checkpoint_dir, "best.json")
+        if not os.path.exists(best_path):
+            return None
+        try:
+            with open(best_path) as f:
+                return json.load(f)
+        except (ValueError, OSError):
+            # a crash mid-write left a truncated file; treat as no record
+            logger.warning("unreadable best.json at %s; ignoring", best_path)
+            return None
+
+    def is_best(self, val_loss: float) -> bool:
+        """Would this validation loss improve on the recorded best? (read-only.
+        On multi-host runs decide on process 0 and broadcast — filesystem
+        visibility can skew across hosts.)"""
+        best = self._read_best()
+        return best is None or float(val_loss) < best["val_loss"]
+
+    def mark_best(self, step: int, val_loss: float) -> bool:
+        """Record a validation result; when it improves on the best so far,
+        persist it and point the ``best`` symlink at the step's directory
+        (reference base_recipe.py:383-425 best-checkpoint tracking). Returns
+        True when this step became the new best. Call after the step is saved."""
+        if not self.config.enabled or not self.is_best(val_loss):
+            return False
+        if jax.process_index() == 0:
+            root = self.config.checkpoint_dir
+            os.makedirs(root, exist_ok=True)
+            best_path = os.path.join(root, "best.json")
+            tmp_json = best_path + ".tmp"
+            with open(tmp_json, "w") as f:
+                json.dump({"step": step, "val_loss": float(val_loss)}, f)
+            os.replace(tmp_json, best_path)
+            link = os.path.join(root, "best")
+            tmp = link + ".tmp"
+            if os.path.islink(tmp) or os.path.exists(tmp):
+                os.remove(tmp)
+            os.symlink(f"step_{step}", tmp)
+            os.replace(tmp, link)
+            logger.info("new best checkpoint: step=%d val_loss=%.6f", step, val_loss)
+        return True
+
+    def best_step(self) -> int | None:
+        best = self._read_best()
+        return None if best is None else int(best["step"])
+
     # -- internals ----------------------------------------------------------
     def _update_latest(self, step: int) -> None:
         link = os.path.join(self.config.checkpoint_dir, "latest")
@@ -205,8 +282,20 @@ class Checkpointer:
             for d in os.listdir(root)
             if d.startswith("step_") and os.path.isdir(os.path.join(root, d))
         )
+        best = self.best_step()
         for s in steps[:-k]:
+            if s == best:
+                continue  # the best checkpoint survives pruning (reference contract)
             shutil.rmtree(self.step_dir(s), ignore_errors=True)
+
+
+def _model_signature(params: Any) -> dict[str, str]:
+    """path -> "shape/dtype" for every param leaf (sharding-independent)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    return {
+        jax.tree_util.keystr(path): f"{tuple(leaf.shape)}/{np.dtype(leaf.dtype).name}"
+        for path, leaf in flat
+    }
 
 
 def _full_host_array(a: Any) -> np.ndarray:

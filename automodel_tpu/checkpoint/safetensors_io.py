@@ -87,12 +87,13 @@ def save_safetensors(
 
     os.makedirs(out_dir, exist_ok=True)
     items = list(tensors.items())
-    # greedy sharding by byte size
+    # greedy sharding by byte size WITHOUT materializing: jax arrays, numpy, and
+    # lazy host leaves all expose nbytes; tensors only land on host one shard at
+    # a time inside _to_numpy_dict below (then the shard buffer is dropped)
     shards: list[list[tuple[str, np.ndarray]]] = [[]]
     size = 0
     for k, v in items:
-        v = np.asarray(v)
-        nbytes = v.nbytes
+        nbytes = int(getattr(v, "nbytes", 0)) or np.asarray(v).nbytes
         if size + nbytes > max_shard_bytes and shards[-1]:
             shards.append([])
             size = 0
@@ -112,11 +113,13 @@ def save_safetensors(
     for idx, shard in enumerate(shards, start=1):
         name = f"model-{idx:05d}-of-{n:05d}.safetensors"
         fp = os.path.join(out_dir, name)
-        save_file(_to_numpy_dict(dict(shard)), fp, metadata=meta)
-        written.append(fp)
-        for k, v in shard:
+        buf = _to_numpy_dict(dict(shard))
+        save_file(buf, fp, metadata=meta)
+        for k, v in buf.items():
             weight_map[k] = name
-            total += np.asarray(v).nbytes
+            total += v.nbytes
+        del buf  # free the shard before materializing the next
+        written.append(fp)
     with open(os.path.join(out_dir, _INDEX_NAME), "w") as f:
         json.dump({"metadata": {"total_size": total}, "weight_map": weight_map}, f, indent=2)
     return written

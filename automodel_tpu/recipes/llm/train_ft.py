@@ -621,7 +621,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     )
                 if self.val_dataloader is not None and self.step_scheduler.is_val_step:
                     self._run_validation(step)
-                if self.checkpointer.config.enabled and self.step_scheduler.is_ckpt_step:
+                if (
+                    self.checkpointer.config.enabled
+                    and self.step_scheduler.is_ckpt_step
+                    and getattr(self, "_last_saved_step", None) != step
+                ):
+                    # the best-tracking path may have just saved this very step
                     self._save(step)
                 if self.step_scheduler.sigterm_received:
                     logger.warning("SIGTERM received; checkpointing and exiting")
@@ -680,11 +685,28 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             for lg in self.experiment_loggers:
                 lg.log(step, val_loss=val_loss)
             logger.info("validation @ step %d: loss %.4f", step, val_loss)
+            # best-checkpoint tracking (reference base_recipe.py:383-425): save
+            # the improving step and point the `best` symlink at it. The
+            # improvement decision is made on process 0 and broadcast — per-host
+            # filesystem reads can skew, and orbax save is a collective, so a
+            # split decision would deadlock the pod.
+            if self.checkpointer.config.enabled and bool(self.cfg.get("checkpoint.save_best", True)):
+                improved = self.checkpointer.is_best(val_loss)
+                if jax.process_count() > 1:
+                    from jax.experimental import multihost_utils
+
+                    improved = bool(
+                        multihost_utils.broadcast_one_to_all(jnp.asarray(improved))
+                    )
+                if improved:
+                    self._save(step)
+                    self.checkpointer.mark_best(step, val_loss)
 
     def _save(self, step: int):
         """PEFT saves are adapter-only (reference PEFT checkpoint addon,
         checkpoint/addons.py); consolidated HF export merges the adapter so the
         output is a plain HF model either way."""
+        self._last_saved_step = step
         client = {
             "rng": self.rng,
             "step_scheduler": self.step_scheduler,

@@ -1,0 +1,91 @@
+"""Checkpointer hardening: best-symlink tracking, model-signature compat check,
+lazy/sharded consolidated export (reference base_recipe.py:383-425,768-846 +
+consolidate_hf_safetensors.py)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.checkpoint.checkpointing import (
+    Checkpointer, CheckpointingConfig, _model_signature,
+)
+
+
+def _params(seed=0, d=8):
+    rng = np.random.RandomState(seed)
+    return {
+        "embed": jnp.asarray(rng.randn(16, d), jnp.float32),
+        "layers": {"wq": jnp.asarray(rng.randn(2, d, d), jnp.float32)},
+    }
+
+
+class TestBestTracking:
+    def test_best_symlink_follows_improvement(self, tmp_path):
+        ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")))
+        p = _params()
+        ck.save(1, p)
+        assert ck.mark_best(1, 2.0)
+        assert ck.best_step() == 1
+        ck.save(2, p)
+        assert not ck.mark_best(2, 2.5)  # worse: best stays
+        assert ck.best_step() == 1
+        ck.save(3, p)
+        assert ck.is_best(1.5)
+        assert ck.mark_best(3, 1.5)
+        link = os.readlink(tmp_path / "ck" / "best")
+        assert link == "step_3"
+
+    def test_prune_spares_best(self, tmp_path):
+        ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck"), keep_last_k=2))
+        p = _params()
+        ck.save(1, p)
+        ck.mark_best(1, 1.0)
+        for s in (2, 3, 4):
+            ck.save(s, p)
+        assert os.path.isdir(ck.step_dir(1))  # best survives keep_last_k=2
+        assert not os.path.isdir(ck.step_dir(2))
+
+
+class TestSignature:
+    def test_mismatch_raises_with_diff(self, tmp_path):
+        ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")))
+        ck.save(1, _params(d=8))
+        wrong = _params(d=16)
+        with pytest.raises(ValueError, match="different model signature"):
+            ck.load(wrong, step=1)
+
+    def test_match_loads(self, tmp_path):
+        ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")))
+        p = _params()
+        ck.save(1, p)
+        restored, _, _ = ck.load(jax.tree.map(jnp.zeros_like, p), step=1)
+        np.testing.assert_array_equal(np.asarray(restored["embed"]), np.asarray(p["embed"]))
+
+    def test_signature_is_sharding_independent(self):
+        sig = _model_signature(_params())
+        assert all("/" in v for v in sig.values())
+        assert len(sig) == 2
+
+
+class TestShardedExport:
+    def test_sharded_write_sizes_without_upfront_copy(self, tmp_path):
+        from automodel_tpu.checkpoint.safetensors_io import load_safetensors, save_safetensors
+
+        tensors = {f"w{i}": jnp.full((64, 64), i, jnp.float32) for i in range(4)}
+        written = save_safetensors(tensors, str(tmp_path), max_shard_bytes=40_000)
+        assert len(written) > 1  # sharded + index.json
+        back = load_safetensors(str(tmp_path))
+        assert set(back) == set(tensors)
+        np.testing.assert_array_equal(back["w2"], np.full((64, 64), 2, np.float32))
+
+    def test_corrupt_best_json_is_tolerated(self, tmp_path):
+        ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")))
+        os.makedirs(tmp_path / "ck", exist_ok=True)
+        (tmp_path / "ck" / "best.json").write_text("{truncated")
+        assert ck.best_step() is None
+        assert ck.is_best(1.0)
