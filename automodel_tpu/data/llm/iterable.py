@@ -1,0 +1,123 @@
+"""Streaming / iterable dataset variants
+(reference datasets/llm/column_mapped_text_instruction_iterable_dataset.py +
+mock_iterable_dataset.py behavior).
+
+For corpora too large to index up front: rows stream from JSONL files or HF
+streaming datasets, shard per process, and tokenize on the fly. The TPU
+dataloader contract stays the same (dict SFT examples) — only __len__ is
+unavailable, so drive training by ``step_scheduler.max_steps``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterator, Mapping
+
+import numpy as np
+
+__all__ = ["ColumnMappedTextInstructionIterableDataset", "MockIterableDataset"]
+
+
+class ColumnMappedTextInstructionIterableDataset:
+    """Streaming version of ColumnMappedTextInstructionDataset.
+
+    ``shard(num_shards, index)`` and ``shuffle(buffer_size, seed)`` mirror the
+    reference's surface; sharding is strided over the stream so every process
+    sees a disjoint subset without indexing the corpus."""
+
+    def __init__(
+        self,
+        path_or_dataset_id: str,
+        column_mapping: Mapping[str, str],
+        tokenizer=None,
+        split: str | None = None,
+        answer_only_loss_mask: bool = True,
+    ):
+        if "answer" not in column_mapping:
+            raise ValueError("column_mapping must include an 'answer' role")
+        self.source = path_or_dataset_id
+        self.split = split
+        self.mapping = dict(column_mapping)
+        self.tokenizer = tokenizer
+        self.answer_only = answer_only_loss_mask
+        self._num_shards, self._index = 1, 0
+        self._buffer_size, self._seed = 0, 0
+        self._epoch = 0
+
+    # reference surface ----------------------------------------------------
+    def shard(self, num_shards: int, index: int) -> "ColumnMappedTextInstructionIterableDataset":
+        self._num_shards, self._index = int(num_shards), int(index)
+        return self
+
+    def shuffle(self, buffer_size: int = 1000, seed: int | None = None
+                ) -> "ColumnMappedTextInstructionIterableDataset":
+        self._buffer_size = int(buffer_size)
+        self._seed = int(seed or 0)
+        return self
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+
+    # stream ---------------------------------------------------------------
+    def _raw_rows(self) -> Iterator[dict]:
+        if os.path.exists(self.source):
+            with open(self.source) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield json.loads(line)
+            return
+        import datasets as hf_datasets
+
+        ds = hf_datasets.load_dataset(self.source, split=self.split or "train", streaming=True)
+        yield from ds
+
+    def _format(self, row: Mapping[str, Any]) -> dict:
+        from automodel_tpu.data.llm.column_mapped import format_and_tokenize
+
+        return format_and_tokenize(row, self.mapping, self.tokenizer, self.answer_only)
+
+    def __iter__(self) -> Iterator[dict]:
+        rows = (
+            r for i, r in enumerate(self._raw_rows())
+            if i % self._num_shards == self._index
+        )
+        if not self._buffer_size:
+            for r in rows:
+                yield self._format(r)
+            return
+        # reservoir-style buffer shuffle (the reference delegates to HF's
+        # buffer shuffle; same semantics: random within a sliding window)
+        rng = np.random.default_rng(self._seed + self._epoch)
+        buf: list[dict] = []
+        for r in rows:
+            if len(buf) < self._buffer_size:
+                buf.append(r)
+                continue
+            j = int(rng.integers(0, self._buffer_size))
+            yield self._format(buf[j])
+            buf[j] = r
+        rng.shuffle(buf)
+        for r in buf:
+            yield self._format(r)
+
+
+class MockIterableDataset:
+    """Unbounded synthetic SFT stream (reference mock_iterable_dataset.py):
+    exercises the iterable path without a corpus."""
+
+    def __init__(self, vocab_size: int = 128, seq_len: int = 32, seed: int = 0,
+                 num_samples: int | None = None):
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self.seed = seed
+        self.num_samples = num_samples
+
+    def __iter__(self) -> Iterator[dict]:
+        rng = np.random.default_rng(self.seed)
+        i = 0
+        while self.num_samples is None or i < self.num_samples:
+            ids = rng.integers(0, self.vocab_size, self.seq_len).astype(np.int32)
+            yield {"input_ids": ids.tolist(), "prompt_len": self.seq_len // 2}
+            i += 1

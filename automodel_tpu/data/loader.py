@@ -46,6 +46,9 @@ class DataLoader:
         self.process_count = process_count
         self.epoch = 0
         self._cursor = 0  # global-batch index within the epoch
+        # iterable (unsized) datasets stream: sharding via .shard() or striding,
+        # resume by skipping consumed batches (reference iterable-dataset path)
+        self._sized = hasattr(dataset, "__len__")
 
     def _epoch_order(self) -> np.ndarray:
         n = len(self.dataset)
@@ -54,10 +57,46 @@ class DataLoader:
         return np.arange(n)
 
     def __len__(self) -> int:
+        if not self._sized:
+            # unbounded stream: drive training with step_scheduler.max_steps
+            return 2**31
         n = len(self.dataset)
         return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
 
+    def _iter_stream(self) -> Iterator[Any]:
+        ds = self.dataset
+        if hasattr(ds, "set_epoch"):
+            ds.set_epoch(self.epoch)
+        if self.process_count > 1:
+            if hasattr(ds, "shard"):
+                ds = ds.shard(self.process_count, self.process_index)
+                it = iter(ds)
+            else:
+                it = (
+                    x for i, x in enumerate(iter(ds))
+                    if i % self.process_count == self.process_index
+                )
+        else:
+            it = iter(ds)
+        for _ in range(self._cursor * self.local_batch_size):  # resume skip
+            next(it, None)
+        buf: list[Any] = []
+        for ex in it:
+            buf.append(ex)
+            if len(buf) == self.local_batch_size:
+                self._cursor += 1
+                yield self.collate_fn(buf)
+                buf = []
+        if buf and not self.drop_last:
+            self._cursor += 1
+            yield self.collate_fn(buf)
+        self.epoch += 1
+        self._cursor = 0
+
     def __iter__(self) -> Iterator[Any]:
+        if not self._sized:
+            yield from self._iter_stream()
+            return
         order = self._epoch_order()
         nb = len(self)
         while self._cursor < nb:

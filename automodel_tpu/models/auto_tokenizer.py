@@ -21,6 +21,25 @@ class AutoTokenizer:
         ensure_eos: bool = False,
         **kwargs,
     ):
+        # mistral-common routing (reference tokenization/registry.py): repos that
+        # ship tekken.json / tokenizer.model.v* use Mistral's official tokenizer —
+        # HF artifacts for those repos are absent or drift from the real template
+        from automodel_tpu.models.tokenization_mistral import (
+            MistralCommonTokenizer, find_mistral_tokenizer_file, mistral_common_available,
+        )
+
+        import os
+
+        if find_mistral_tokenizer_file(path):
+            has_hf = os.path.isfile(os.path.join(path, "tokenizer.json")) or os.path.isfile(
+                os.path.join(path, "tokenizer_config.json")
+            )
+            if mistral_common_available():
+                return MistralCommonTokenizer.from_pretrained(path)
+            if not has_hf:
+                # no fallback possible: fail with the actionable message
+                return MistralCommonTokenizer.from_pretrained(path)
+
         import transformers
 
         tok = transformers.AutoTokenizer.from_pretrained(path, **kwargs)

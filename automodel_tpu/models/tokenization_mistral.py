@@ -1,0 +1,149 @@
+"""mistral-common tokenizer adapter
+(reference tokenization/tokenization_mistral_common.py:169 MistralCommonBackend +
+tokenization/registry.py).
+
+Mistral ships its official tokenizers (tekken.json / tokenizer.model.v*) through
+the ``mistral_common`` package rather than HF tokenizer.json files; several
+Mistral repos have no (or stale) HF tokenizer artifacts. This adapter wraps a
+``mistral_common`` tokenizer in the minimal HF-compatible surface the recipes
+use (encode / decode / __call__ / apply_chat_template / special-token ids), and
+the registry decides per checkpoint dir whether to route to it.
+
+``mistral_common`` is an optional dependency (gated import, like wandb/mlflow):
+with it absent, Mistral repos that still carry HF tokenizer files fall back to
+``transformers.AutoTokenizer`` as before; repos without them raise with an
+actionable message.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["MistralCommonTokenizer", "find_mistral_tokenizer_file", "mistral_common_available"]
+
+# the file names mistral_common knows how to load, in preference order
+# (registry.py probes the same set). Deliberately NOT the bare "tokenizer.model":
+# that name is generic sentencepiece (llama-2, gemma, ...) and would mis-route
+# ordinary HF checkpoints here.
+_TOKENIZER_FILES = (
+    "tekken.json",
+    "tokenizer.model.v11",
+    "tokenizer.model.v7",
+    "tokenizer.model.v3",
+    "tokenizer.model.v2",
+    "tokenizer.model.v1",
+)
+
+
+def mistral_common_available() -> bool:
+    try:
+        import mistral_common  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def find_mistral_tokenizer_file(path: str) -> str | None:
+    """The mistral-common tokenizer file in a checkpoint dir, if any."""
+    if not os.path.isdir(path):
+        return None
+    for name in _TOKENIZER_FILES:
+        fp = os.path.join(path, name)
+        if os.path.isfile(fp):
+            return fp
+    return None
+
+
+class MistralCommonTokenizer:
+    """HF-shaped wrapper over mistral_common's MistralTokenizer.
+
+    Covers the contract the data pipeline relies on: ``encode(text,
+    add_special_tokens=...)``, ``decode``, ``apply_chat_template(messages)``,
+    ``bos/eos/pad_token_id``, ``vocab_size``/``__len__``. Instruct-style
+    tokenization goes through mistral_common's own ChatCompletionRequest
+    encoding, which is the entire point of using the official tokenizer
+    (reference MistralCommonBackend.apply_chat_template)."""
+
+    def __init__(self, mistral_tokenizer):
+        self._mt = mistral_tokenizer
+        self._inner = mistral_tokenizer.instruct_tokenizer.tokenizer
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "MistralCommonTokenizer":
+        try:
+            from mistral_common.tokens.tokenizers.mistral import MistralTokenizer
+        except ImportError as exc:  # pragma: no cover - env without the extra
+            raise ImportError(
+                "this checkpoint ships a mistral-common tokenizer "
+                f"({find_mistral_tokenizer_file(path)}); install the "
+                "`mistral-common` extra to load it"
+            ) from exc
+        fp = find_mistral_tokenizer_file(path)
+        if fp is None:
+            raise FileNotFoundError(f"no mistral tokenizer file under {path!r}")
+        return cls(MistralTokenizer.from_file(fp))
+
+    # ---- special tokens -------------------------------------------------
+    @property
+    def bos_token_id(self) -> int:
+        return self._inner.bos_id
+
+    @property
+    def eos_token_id(self) -> int:
+        return self._inner.eos_id
+
+    @property
+    def pad_token_id(self) -> int:
+        # mistral pads with its dedicated pad id when present, else eos
+        pad = getattr(self._inner, "pad_id", None)
+        if pad is None or pad < 0:
+            return self.eos_token_id
+        return pad
+
+    @property
+    def unk_token_id(self) -> int | None:
+        unk = getattr(self._inner, "unk_id", None)
+        return None if unk is None or unk < 0 else unk
+
+    @property
+    def vocab_size(self) -> int:
+        return self._inner.n_words
+
+    def __len__(self) -> int:
+        return self.vocab_size
+
+    # ---- text path ------------------------------------------------------
+    def encode(self, text: str, add_special_tokens: bool = True, **_) -> list[int]:
+        return list(self._inner.encode(text, bos=add_special_tokens, eos=False))
+
+    def decode(self, ids, skip_special_tokens: bool = True, **_) -> str:
+        ids = [int(i) for i in ids]
+        if skip_special_tokens:
+            special = {self.bos_token_id, self.eos_token_id, self.pad_token_id}
+            ids = [i for i in ids if i not in special]
+        return self._inner.decode(ids)
+
+    def __call__(self, text, **kwargs):
+        if isinstance(text, str):
+            ids = self.encode(text, add_special_tokens=kwargs.get("add_special_tokens", True))
+            return {"input_ids": ids, "attention_mask": [1] * len(ids)}
+        out = [self.encode(t, add_special_tokens=kwargs.get("add_special_tokens", True)) for t in text]
+        return {"input_ids": out, "attention_mask": [[1] * len(o) for o in out]}
+
+    # ---- chat -----------------------------------------------------------
+    def apply_chat_template(self, messages, tokenize: bool = True,
+                            add_generation_prompt: bool = False, **_):
+        """Official instruct encoding via ChatCompletionRequest (the reason this
+        adapter exists: HF chat templates for Mistral drift from the real one)."""
+        from mistral_common.protocol.instruct.messages import (
+            AssistantMessage, SystemMessage, UserMessage,
+        )
+        from mistral_common.protocol.instruct.request import ChatCompletionRequest
+
+        roles = {"system": SystemMessage, "user": UserMessage, "assistant": AssistantMessage}
+        ms = [roles[m["role"]](content=m["content"]) for m in messages]
+        tokenized = self._mt.encode_chat_completion(ChatCompletionRequest(messages=ms))
+        if tokenize:
+            return list(tokenized.tokens)
+        return tokenized.text

@@ -346,12 +346,12 @@ def _flash_bwd(scale, causal, softcap, block_q, block_k, groups, interpret,
     segmented = seg_q is not None
     delta = _q_lanes((o.astype(jnp.float32) * do.astype(jnp.float32)).sum(-1))
 
-    def row_specs(index_q):
+    def row_specs(index_q, bq):
         # do / lse / delta blocks, all q-oriented
         return [
-            pl.BlockSpec((1, block_q, d), index_q),
-            pl.BlockSpec((1, block_q, LANES), index_q),
-            pl.BlockSpec((1, block_q, LANES), index_q),
+            pl.BlockSpec((1, bq, d), index_q),
+            pl.BlockSpec((1, bq, LANES), index_q),
+            pl.BlockSpec((1, bq, LANES), index_q),
         ]
 
     dq_kernel = functools.partial(
@@ -370,7 +370,7 @@ def _flash_bwd(scale, causal, softcap, block_q, block_k, groups, interpret,
 
     specs, args = _filter_specs(
         _specs(lambda b: b // groups, d, block_q, block_k, segmented, False, windowed)
-        + row_specs(lambda b, i, j: (b, i, 0)),
+        + row_specs(lambda b, i, j: (b, i, 0), block_q),
         [q, k, v, seg_q, seg_kv, None, warr, do, lse, delta],  # None: no sink input in bwd
     )
     dq = pl.pallas_call(
@@ -394,9 +394,14 @@ def _flash_bwd(scale, causal, softcap, block_q, block_k, groups, interpret,
         if (segmented and groups > 1)
         else seg_kv
     )
+    # the dkv kernel carries TWO f32 accumulators + the recompute tile; at
+    # block_q 1024 it sits ~44KB over the 16MB scoped-VMEM line in some remat
+    # contexts — cap ITS q block while dq (one accumulator) keeps the bigger one
+    block_q_kv = min(block_q, 512)
+    num_q_kv = sq // block_q_kv
     dkv_kernel = functools.partial(
         _dkv_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_q=num_q, segmented=segmented,
+        block_q=block_q_kv, block_k=block_k, num_q=num_q_kv, segmented=segmented,
         softcap=softcap, windowed=windowed,
     )
 
@@ -410,20 +415,20 @@ def _flash_bwd(scale, causal, softcap, block_q, block_k, groups, interpret,
 
     # grid order here is (bn, kv, q): q/do/lse/delta index with the LAST grid dim
     qkv_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q_kv, d), lambda b, j, i: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)) if segmented else None,
+        pl.BlockSpec((1, block_q_kv, LANES), lambda b, j, i: (b, i, 0)) if segmented else None,
         pl.BlockSpec((1, SUBLANES, block_k), lambda b, j, i: (b, 0, j)) if segmented else None,
         pl.BlockSpec(memory_space=pltpu.SMEM) if windowed else None,
     ]
     specs, args = _filter_specs(
-        qkv_specs + row_specs(lambda b, j, i: (b, i, 0)),
+        qkv_specs + row_specs(lambda b, j, i: (b, i, 0), block_q_kv),
         [q, kx, vx, seg_q, skx, warr, do, lse, delta],
     )
     dk, dv = pl.pallas_call(
         dkv_entry,
-        grid=(bn, num_kv, num_q),
+        grid=(bn, num_kv, num_q_kv),
         in_specs=specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -484,9 +489,10 @@ def flash_attention(
     if softmax_scale is None:
         softmax_scale = d**-0.5
     groups = n // nk
-    # measured on v5e at (B4, S2048, H32/KV8, d64): (512, 1024) runs ~2x faster
-    # than (128, 128) fwd+bwd; fall back to the largest power-of-two block that
-    # divides the sequence so the grid stays exact
+    # measured on v5e at (B4, S2048, H32/KV8, d64): (1024, 1024) beats (512,
+    # 1024) by ~2% end-to-end and (128, 128) by ~2x fwd+bwd; (1024, 2048)+ blows
+    # scoped VMEM. Fall back to the largest power-of-two block that divides the
+    # sequence so the grid stays exact
     def _pick(seq, target):
         # largest power-of-two block <= target that divides seq (>= 8); if none
         # divides, return 8 so the kernel's divisibility check raises clearly
@@ -495,7 +501,7 @@ def flash_attention(
             b //= 2
         return b
 
-    block_q = _pick(sq, block_q or 512)
+    block_q = _pick(sq, block_q or 1024)
     block_k = _pick(skv, block_k or 1024)
     if sq % block_q or skv % block_k:
         raise ValueError(

@@ -107,6 +107,19 @@ def build_optimizer(
         if weight_decay:
             chain.append(optax.add_decayed_weights(weight_decay, mask=no_decay_mask))
         chain.append(optax.scale_by_learning_rate(lr))
+    elif optimizer == "adafactor_momentum":
+        # factored second moment (rows+cols instead of a full tensor: ~zero HBM)
+        # + bf16 momentum — the lightest stateful optimizer here. The ~2.5GB it
+        # frees vs even bf16-nu adam buys remat_policy "mlp_dots" on memory-tight
+        # configs, which is worth far more throughput than the moment precision.
+        # betas -> (momentum decay, second-moment decay); eps is NOT wired: the
+        # factored-rms epsilon (1e-30 inside the rms) has different semantics
+        # than adam's denominator eps and its default is the right one
+        chain.append(optax.scale_by_factored_rms(decay_rate=betas[1]))
+        chain.append(optax.trace(decay=betas[0], accumulator_dtype=jax.numpy.bfloat16))
+        if weight_decay:
+            chain.append(optax.add_decayed_weights(weight_decay, mask=no_decay_mask))
+        chain.append(optax.scale_by_learning_rate(lr))
     elif optimizer == "adamw":
         chain.append(
             optax.adamw(

@@ -118,6 +118,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         # step scheduler
         ss = (cfg.get("step_scheduler") or ConfigNode()).to_dict()
         ss.setdefault("grad_acc_steps", 1)
+        if not getattr(self.dataloader, "_sized", True) and not ss.get("max_steps"):
+            raise ValueError(
+                "streaming (unsized) datasets need step_scheduler.max_steps: "
+                "epoch length is unknown, so num_epochs cannot bound training"
+            )
         self.step_scheduler = StepScheduler(dataloader=self.dataloader, **ss)
 
         # optimizer + schedule

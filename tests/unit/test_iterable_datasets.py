@@ -1,0 +1,128 @@
+"""Iterable/streaming datasets + loader streaming path + mistral tokenizer
+adapter + delta-lake gating (reference iterable/delta_lake dataset behavior)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from automodel_tpu.data.llm.iterable import (
+    ColumnMappedTextInstructionIterableDataset, MockIterableDataset,
+)
+from automodel_tpu.data.loader import DataLoader
+
+
+class WordTok:
+    bos_token_id = 1
+    eos_token_id = 2
+
+    def encode(self, text, add_special_tokens=True):
+        return [3 + (hash(w) % 90) for w in text.split()]
+
+
+def _jsonl(tmp_path, n=20):
+    p = tmp_path / "rows.jsonl"
+    with open(p, "w") as f:
+        for i in range(n):
+            f.write(json.dumps({"q": f"question {i}", "a": f"answer {i}"}) + "\n")
+    return str(p)
+
+
+class TestIterableColumnMapped:
+    def test_streams_and_tokenizes(self, tmp_path):
+        ds = ColumnMappedTextInstructionIterableDataset(
+            _jsonl(tmp_path), {"question": "q", "answer": "a"}, tokenizer=WordTok(),
+        )
+        rows = list(ds)
+        assert len(rows) == 20
+        assert all("input_ids" in r and "prompt_len" in r for r in rows)
+
+    def test_shard_is_disjoint_and_covering(self, tmp_path):
+        src = _jsonl(tmp_path)
+        a = list(ColumnMappedTextInstructionIterableDataset(
+            src, {"question": "q", "answer": "a"}, tokenizer=WordTok()).shard(2, 0))
+        b = list(ColumnMappedTextInstructionIterableDataset(
+            src, {"question": "q", "answer": "a"}, tokenizer=WordTok()).shard(2, 1))
+        assert len(a) == len(b) == 10
+
+    def test_buffer_shuffle_changes_order_not_content(self, tmp_path):
+        src = _jsonl(tmp_path)
+        plain = [tuple(r["input_ids"]) for r in ColumnMappedTextInstructionIterableDataset(
+            src, {"question": "q", "answer": "a"}, tokenizer=WordTok())]
+        shuf = [tuple(r["input_ids"]) for r in ColumnMappedTextInstructionIterableDataset(
+            src, {"question": "q", "answer": "a"}, tokenizer=WordTok()).shuffle(8, seed=3)]
+        assert sorted(plain) == sorted(shuf)
+        assert plain != shuf
+
+
+class TestLoaderStreaming:
+    def test_batches_and_resume_skip(self):
+        ds = MockIterableDataset(seq_len=8, num_samples=16, seed=0)
+        dl = DataLoader(ds, batch_size=4, shuffle=False)
+        batches = list(dl)
+        assert len(batches) == 4
+        assert len(batches[0]) == 4
+        # resume mid-epoch: cursor skip reproduces the remaining batches
+        dl2 = DataLoader(MockIterableDataset(seq_len=8, num_samples=16, seed=0),
+                         batch_size=4, shuffle=False)
+        dl2.load_state_dict({"epoch": 0, "cursor": 2, "seed": 0})
+        rest = list(dl2)
+        assert len(rest) == 2
+        np.testing.assert_array_equal(
+            np.asarray(rest[0][0]["input_ids"]), np.asarray(batches[2][0]["input_ids"])
+        )
+
+    def test_len_sentinel_for_unsized(self):
+        dl = DataLoader(MockIterableDataset(num_samples=None), batch_size=2)
+        assert len(dl) == 2**31
+
+
+class TestMistralTokenizerAdapter:
+    def test_file_probe_and_gated_import(self, tmp_path):
+        from automodel_tpu.models.tokenization_mistral import (
+            MistralCommonTokenizer, find_mistral_tokenizer_file, mistral_common_available,
+        )
+
+        assert find_mistral_tokenizer_file(str(tmp_path)) is None
+        (tmp_path / "tekken.json").write_text("{}")
+        assert find_mistral_tokenizer_file(str(tmp_path)).endswith("tekken.json")
+        if not mistral_common_available():
+            with pytest.raises(ImportError, match="mistral-common"):
+                MistralCommonTokenizer.from_pretrained(str(tmp_path))
+
+    def test_adapter_surface_with_fake_backend(self):
+        from automodel_tpu.models.tokenization_mistral import MistralCommonTokenizer
+
+        class FakeInner:
+            bos_id, eos_id, pad_id, n_words = 1, 2, -1, 100
+
+            def encode(self, text, bos=True, eos=False):
+                ids = [10 + len(w) for w in text.split()]
+                return ([self.bos_id] if bos else []) + ids
+
+            def decode(self, ids):
+                return " ".join(str(i) for i in ids)
+
+        class FakeIT:
+            tokenizer = FakeInner()
+
+        class FakeMT:
+            instruct_tokenizer = FakeIT()
+
+        tok = MistralCommonTokenizer(FakeMT())
+        assert tok.bos_token_id == 1 and tok.eos_token_id == 2
+        assert tok.pad_token_id == 2  # -1 pad falls back to eos
+        assert len(tok) == 100
+        ids = tok.encode("hello world")
+        assert ids[0] == 1
+        assert tok.decode([1, 15, 2]) == "15"  # specials stripped
+
+
+class TestDeltaLakeGating:
+    def test_missing_reader_raises_actionable(self, tmp_path):
+        from automodel_tpu.data.llm.delta_lake import DeltaLakeDataset, delta_reader_available
+
+        if delta_reader_available():
+            pytest.skip("a delta reader is installed")
+        with pytest.raises(ImportError, match="deltalake"):
+            DeltaLakeDataset(str(tmp_path / "tbl"), {"answer": "a"})
