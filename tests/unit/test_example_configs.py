@@ -1,0 +1,16 @@
+"""Every example YAML must parse through the config loader (reference keeps its
+examples loadable the same way; this catches config-schema rot)."""
+
+import glob
+
+import pytest
+
+from automodel_tpu.config.loader import load_config
+
+EXAMPLES = sorted(glob.glob("examples/**/*.yaml", recursive=True))
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.split("examples/")[-1])
+def test_example_parses(path):
+    cfg = load_config(path)
+    assert cfg.get("model") is not None or cfg.get("dataset") is not None
