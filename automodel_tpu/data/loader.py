@@ -58,10 +58,20 @@ class DataLoader:
 
     def __len__(self) -> int:
         if not self._sized:
-            # unbounded stream: drive training with step_scheduler.max_steps
-            return 2**31
+            # Iterator-protocol convention: unsized streams have no length.
+            # A sentinel here (2**31) silently poisons any len()-based epoch or
+            # progress math downstream; raising makes the consumer handle it.
+            raise TypeError(
+                "streaming (unsized) dataset has no __len__; drive training with "
+                "step_scheduler.max_steps and bound validation with validation_max_batches"
+            )
         n = len(self.dataset)
         return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
+
+    @property
+    def num_batches(self) -> int | None:
+        """Batches per epoch, or None for an unbounded stream."""
+        return len(self) if self._sized else None
 
     def _iter_stream(self) -> Iterator[Any]:
         ds = self.dataset

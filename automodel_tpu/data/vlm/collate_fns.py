@@ -118,6 +118,15 @@ def _encode_with_media(
         if pos:
             ids.extend(tokenizer.encode(rest[:pos], add_special_tokens=first))
             first = False
+        elif first:
+            # prompt begins with a media placeholder: still emit the tokenizer's
+            # sequence-start prefix ahead of the vision tokens — HF Qwen-VL/Kimi
+            # processors keep it before media, and dropping it drifts the token
+            # layout vs the pretrained checkpoint. encode("") reproduces the
+            # tokenizer's ACTUAL prefix (empty for families like Qwen2 that
+            # define bos_token_id but never emit it), keeping media-first and
+            # text-first prompts consistent.
+            ids.extend(tokenizer.encode("", add_special_tokens=True))
         ids.extend(next(cursor[ph]))
         rest = rest[pos + len(ph):]
         first = False

@@ -117,9 +117,18 @@ def fused_linear_ce_tokens(
 
 
 def pallas_linear_ce_supported(embed: int, vocab_local: int) -> bool:
-    from automodel_tpu.ops.pallas.linear_ce import pick_blocks
+    """True only when BOTH the forward and backward kernels can tile the shape.
 
-    return pick_blocks(embed, vocab_local) is not None
+    The backward adds an f32 accumulator to the VMEM budget, so some shapes
+    (e.g. embed>=12288 with 128k vocab) tile forward but not backward; checking
+    only the forward would run training straight into the backward's fallback
+    (or, before it existed, a trace-time crash)."""
+    from automodel_tpu.ops.pallas.linear_ce import pick_blocks, pick_bwd_blocks
+
+    fwd = pick_blocks(embed, vocab_local)
+    if fwd is None:
+        return False
+    return pick_bwd_blocks(embed, vocab_local, fwd[1], None) is not None
 
 
 def linear_cross_entropy(

@@ -65,10 +65,11 @@ def pick_blocks(e: int, v: int) -> tuple[int, int] | None:
     return _pick(e, v, acc=False)
 
 
-def pick_bwd_blocks(e: int, v: int, bv_fwd: int, n: int) -> tuple[int, int]:
-    """Backward blocks: the f32 accumulator joins the VMEM budget, and block_v
-    must divide the forward's (so the forward's per-block maxima pool exactly
-    onto backward blocks for the gradient filter)."""
+def pick_bwd_blocks(e: int, v: int, bv_fwd: int, n: int | None) -> tuple[int, int] | None:
+    """Backward blocks, or None if no tile fits: the f32 accumulator joins the
+    VMEM budget, and block_v must divide the forward's (so the forward's
+    per-block maxima pool exactly onto backward blocks for the gradient filter).
+    ``n=None`` skips the token-divisibility constraint (feasibility probe)."""
     return _pick(e, v, acc=True, bv_divides=bv_fwd, n=n)
 
 
@@ -250,11 +251,42 @@ def _fwd_rule(h, w, block_n, block_v, interpret, filter_eps):
     return z, (h, w, z, bmax)
 
 
+def _bwd_xla_fallback(h, w, z, dz, block_v):
+    """Blockwise-vocab XLA backward for shapes whose bwd tiles don't fit VMEM.
+
+    Same math as the kernels (softmax recompute against the saved logsumexp),
+    logits exist one (N, block_v) f32 block at a time in HBM instead of VMEM."""
+    n, e = h.shape
+    v = w.shape[1]
+    num_v = v // block_v
+    h32 = h.astype(jnp.float32)
+    dz32 = dz.astype(jnp.float32)
+    w_blocks = jnp.moveaxis(w.reshape(e, num_v, block_v), 1, 0)  # (num_v, E, bv)
+
+    def body(dh_acc, wb):
+        s = h32 @ wb.astype(jnp.float32)  # (N, bv)
+        p = jnp.exp(s - z[:, None]) * dz32[:, None]
+        dh_acc = dh_acc + p @ wb.astype(jnp.float32).T
+        # cast per block: each dw block is fully accumulated in f32 here, so
+        # casting now is precision-free and keeps the stacked (num_v, E, bv)
+        # buffer in w.dtype — an f32 stack at DSv3 scale (E=12k, V=128k) would
+        # be a 6.4GB transient in the exact path meant to dodge the memory wall
+        dw_b = (h32.T @ p).astype(w.dtype)  # (E, bv)
+        return dh_acc, dw_b
+
+    dh, dw_blocks = jax.lax.scan(body, jnp.zeros((n, e), jnp.float32), w_blocks)
+    dw = jnp.moveaxis(dw_blocks, 0, 1).reshape(e, v)
+    return dh.astype(h.dtype), dw
+
+
 def _bwd_rule(block_n, block_v, interpret, filter_eps, res, dz):
     h, w, z, bmax = res
     n, e = h.shape
     v = w.shape[1]
-    block_n, block_v = pick_bwd_blocks(e, v, block_v, n)  # fwd blocks shadowed
+    bwd_blocks = pick_bwd_blocks(e, v, block_v, n)  # fwd blocks shadowed
+    if bwd_blocks is None:
+        return _bwd_xla_fallback(h, w, z, dz, block_v)
+    block_n, block_v = bwd_blocks
     vb_ratio = (v // block_v) // bmax.shape[0]  # bwd blocks per fwd block
     num_t, num_v = n // block_n, v // block_v
     z2 = _row_vec(z)

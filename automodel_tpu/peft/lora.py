@@ -76,6 +76,12 @@ class PeftConfig:
     dim: int = 8
     alpha: int = 32
     use_dora: bool = False
+    # NOTE semantic difference vs the reference: reference nn.Dropout acts per
+    # activation element of x (per token, per feature, per step); here the merged-
+    # delta formulation draws ONE mask over A's input-feature rows per step
+    # (varying per layer-stack entry via a.shape[:-1]), shared across all tokens
+    # in the step. Expectation matches, regularization is coarser — configs
+    # ported from the reference may want a smaller value.
     dropout: float = 0.0
     lora_A_init: str = "xavier"  # "xavier" | "uniform" | "gaussian"
     lora_dtype: str | None = None  # None = base-weight dtype

@@ -114,6 +114,15 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self.dataloader = self._build_dataloader(cfg.get("dataset"), is_train=True)
         val_cfg = cfg.get("validation_dataset")
         self.val_dataloader = self._build_dataloader(val_cfg, is_train=False) if val_cfg else None
+        # unsized validation streams would hang the val loop without a bound
+        self.max_val_batches = cfg.get("validation_max_batches")
+        if self.max_val_batches is not None:
+            self.max_val_batches = int(self.max_val_batches)
+        elif self.val_dataloader is not None and self.val_dataloader.num_batches is None:
+            raise ValueError(
+                "streaming (unsized) validation datasets need validation_max_batches: "
+                "the validation loop would otherwise never terminate"
+            )
 
         # step scheduler
         ss = (cfg.get("step_scheduler") or ConfigNode()).to_dict()
@@ -130,8 +139,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         lr_cfg = (cfg.get("lr_scheduler") or ConfigNode()).to_dict()
         max_lr = float(opt_cfg.pop("lr", 1e-5))
         # decay horizon is in OPTIMIZER steps: microbatches / grad_acc_steps
-        steps_per_epoch = max(len(self.dataloader) // int(ss["grad_acc_steps"]), 1)
-        total_steps = ss.get("max_steps") or (steps_per_epoch * int(ss.get("num_epochs", 1)))
+        n_batches = self.dataloader.num_batches
+        if n_batches is None:  # unsized stream: max_steps guarded above
+            total_steps = ss["max_steps"]
+        else:
+            steps_per_epoch = max(n_batches // int(ss["grad_acc_steps"]), 1)
+            total_steps = ss.get("max_steps") or (steps_per_epoch * int(ss.get("num_epochs", 1)))
         lr_cfg.setdefault("lr_decay_steps", total_steps)
         self.lr_schedule = build_lr_schedule(max_lr=max_lr, **lr_cfg)
         betas = opt_cfg.pop("betas", (0.9, 0.95))
@@ -666,11 +679,31 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 self._eval_step = jax.jit(make_eval_step(eval_loss))
         total, count = 0.0, 0
         extra = (self.params,) if self.peft is not None else ()
-        for batch in self.val_dataloader:
+        for batch in self._iter_val_batches():
             n = int((batch["labels"] != -100).sum())
             total += float(self._eval_step(self.train_params, batch, n, *extra)) * n
             count += n
         self._log_val_loss(step, total, count)
+
+    def _iter_val_batches(self):
+        """Bounded, state-neutral pass over the validation loader.
+
+        Restores the loader's resume cursor afterwards so every validation pass
+        evaluates the SAME window: breaking out of a streaming loader at
+        validation_max_batches would otherwise leave the cursor advanced, and
+        each later pass would skip-drain all previously consumed examples and
+        score a different (ever further) slice of the stream."""
+        import itertools
+
+        dl = self.val_dataloader
+        state = dl.state_dict() if hasattr(dl, "state_dict") else None
+        try:
+            # islice stops BEFORE pulling batch max_val_batches+1: no wasted
+            # fetch+collate (expensive for VLM patchify/mel collators)
+            yield from itertools.islice(dl, self.max_val_batches)
+        finally:
+            if state is not None and hasattr(dl, "load_state_dict"):
+                dl.load_state_dict(state)
 
     def _log_val_loss(self, step: int, total: float, count: float):
         """Token-weighted mean aggregated across the pod: each process sees a

@@ -267,7 +267,7 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
                 )
             self._eval_step = jax.jit(make_eval_step(eval_loss, with_frozen=True))
         total, count = 0.0, 0
-        for batch in self.val_dataloader:
+        for batch in self._iter_val_batches():
             n = int((batch["labels"] != -100).sum())
             total += float(self._eval_step(self.train_params, batch, n, self._frozen_arg)) * n
             count += n

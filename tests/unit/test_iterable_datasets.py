@@ -72,9 +72,13 @@ class TestLoaderStreaming:
             np.asarray(rest[0][0]["input_ids"]), np.asarray(batches[2][0]["input_ids"])
         )
 
-    def test_len_sentinel_for_unsized(self):
+    def test_len_raises_for_unsized(self):
+        import pytest
+
         dl = DataLoader(MockIterableDataset(num_samples=None), batch_size=2)
-        assert len(dl) == 2**31
+        with pytest.raises(TypeError, match="no __len__"):
+            len(dl)
+        assert dl.num_batches is None
 
 
 class TestMistralTokenizerAdapter:

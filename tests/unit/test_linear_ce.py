@@ -102,3 +102,36 @@ class TestFusedLinearCE:
         ref = masked_cross_entropy(h @ w, labels, num_label_tokens=16)
         got = linear_cross_entropy(h, w, labels, num_label_tokens=16, impl="pallas")
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+class TestBwdFeasibility:
+    def test_supported_requires_backward_tiling(self):
+        """Shapes whose forward tiles but whose backward accumulator blows the
+        VMEM budget must NOT pass the supported check (advisor r2: embed 12288
+        with 128k vocab picked (64,128) forward then crashed tracing grad)."""
+        from automodel_tpu.ops.losses import pallas_linear_ce_supported
+        from automodel_tpu.ops.pallas.linear_ce import pick_blocks, pick_bwd_blocks
+
+        e, v = 12288, 131072
+        fwd = pick_blocks(e, v)
+        assert fwd is not None  # forward alone tiles...
+        assert pick_bwd_blocks(e, v, fwd[1], None) is None  # ...backward cannot
+        assert not pallas_linear_ce_supported(e, v)
+
+    def test_bwd_xla_fallback_matches_autodiff(self):
+        """The blockwise-XLA backward fallback gives the exact logsumexp grads."""
+        from automodel_tpu.ops.pallas.linear_ce import _bwd_xla_fallback
+
+        rng = np.random.RandomState(7)
+        h = jnp.asarray(rng.randn(16, 64).astype(np.float32) * 0.3)
+        w = jnp.asarray(rng.randn(64, 256).astype(np.float32) * 0.1)
+        dz = jnp.asarray(rng.randn(16).astype(np.float32))
+
+        def ref(h, w):
+            return (jax.nn.logsumexp(h @ w, axis=-1) * dz).sum()
+
+        dh_ref, dw_ref = jax.grad(ref, argnums=(0, 1))(h, w)
+        z = jax.nn.logsumexp(h @ w, axis=-1)
+        dh, dw = _bwd_xla_fallback(h, w, z, dz, block_v=128)
+        np.testing.assert_allclose(np.asarray(dh), np.asarray(dh_ref), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(dw), np.asarray(dw_ref), rtol=1e-5, atol=1e-5)

@@ -220,3 +220,44 @@ class TestOmniCollateForward:
             training=False,
         )
         assert np.isfinite(np.asarray(out)).all()
+
+
+class TestLeadingMediaBOS:
+    def test_bos_emitted_before_leading_media_span(self):
+        """A prompt that STARTS with a media placeholder still gets BOS ahead of
+        the vision tokens (HF Qwen-VL/Kimi keep sequence-start tokens before
+        media; advisor r2)."""
+        from automodel_tpu.data.vlm.collate_fns import _encode_with_media
+
+        class BosTok:
+            bos_token_id = 7
+            eos_token_id = 1
+
+            def encode(self, text, add_special_tokens=True):
+                ids = [10 + (hash(w) % 90) for w in text.split()]
+                return ([self.bos_token_id] + ids) if add_special_tokens else ids
+
+        media_span = [100, 101, 102]
+        ex = {"prompt": "<image> describe it", "answer": "a cat"}
+        inp, tgt = _encode_with_media(
+            BosTok(), ex, 64, {"<image>": [media_span]}
+        )
+        # inputs are shifted by one: inp[0] is the first token of the sequence
+        assert inp[0] == 7, f"expected BOS first, got {inp[:6]}"
+        assert list(inp[1:4]) == media_span
+
+    def test_no_double_bos_with_text_prefix(self):
+        from automodel_tpu.data.vlm.collate_fns import _encode_with_media
+
+        class BosTok:
+            bos_token_id = 7
+            eos_token_id = 1
+
+            def encode(self, text, add_special_tokens=True):
+                ids = [10 + (hash(w) % 90) for w in text.split()]
+                return ([self.bos_token_id] + ids) if add_special_tokens else ids
+
+        ex = {"prompt": "look <image> now", "answer": "ok"}
+        inp, _ = _encode_with_media(BosTok(), ex, 64, {"<image>": [[100, 101]]})
+        assert list(inp).count(7) == 1
+        assert inp[0] == 7
