@@ -125,8 +125,17 @@ def _encode_with_media(
             # layout vs the pretrained checkpoint. encode("") reproduces the
             # tokenizer's ACTUAL prefix (empty for families like Qwen2 that
             # define bos_token_id but never emit it), keeping media-first and
-            # text-first prompts consistent.
-            ids.extend(tokenizer.encode("", add_special_tokens=True))
+            # text-first prompts consistent. Templates of the '<bos> $A <eos>'
+            # shape also emit their sequence-END suffix on empty input — strip
+            # trailing end markers so no eos/sep lands ahead of the vision span.
+            prefix = tokenizer.encode("", add_special_tokens=True)
+            enders = {
+                t for t in (getattr(tokenizer, "eos_token_id", None),
+                            getattr(tokenizer, "sep_token_id", None)) if t is not None
+            }
+            while prefix and prefix[-1] in enders:
+                prefix.pop()
+            ids.extend(prefix)
         ids.extend(next(cursor[ph]))
         rest = rest[pos + len(ph):]
         first = False

@@ -250,7 +250,15 @@ def moe_decoder_forward(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
     dtype = backend.jnp_dtype
-    h = params["embed"].astype(dtype)[input_ids] if inputs_embeds is None else inputs_embeds.astype(dtype)
+    if inputs_embeds is None:
+        # unshard the table's FSDP (embed-dim) axes before the lookup — a plain
+        # all-gather — so the gather output doesn't inherit hidden-dim sharding
+        # and trigger an involuntary-full-remat reshard to the activation layout
+        # (same fix as transformer.decoder_forward; seen in the ep-cp dryrun HLO)
+        table = _constrain(params["embed"].astype(dtype), rules, ("vocab", None))
+        h = table[input_ids]
+    else:
+        h = inputs_embeds.astype(dtype)
     h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
 
     sliding_flags = jnp.asarray(cfg.sliding_flags, dtype=jnp.int32)

@@ -329,7 +329,17 @@ def decoder_forward(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
     dtype = backend.jnp_dtype
-    h = inputs_embeds if inputs_embeds is not None else params["embed"].astype(dtype)[input_ids]
+    if inputs_embeds is not None:
+        h = inputs_embeds
+    else:
+        # Unshard the table's FSDP (embed-dim) axes BEFORE the lookup: a plain
+        # all-gather (FSDP's param-on-use collective). Without this the gather
+        # output inherits the table's hidden-dim sharding and the partitioner
+        # falls back to involuntary full rematerialization resharding it to the
+        # (batch, act_seq) activation layout (seen in the cp-ring dryrun HLO).
+        # "vocab" stays: under TP the vocab-parallel local-gather+psum path holds.
+        table = _constrain(params["embed"].astype(dtype), rules, ("vocab", None))
+        h = table[input_ids]
     h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
 
     state = {"h": h, "positions": positions}

@@ -27,9 +27,10 @@ V*(n+pp-1) to V*n + pp - 1, and the bubble fraction drops ~V-fold to
 
 Composition: shard_map is manual over ``pp`` only; FSDP/TP shardings on other mesh
 axes stay GSPMD-managed inside (same partial-manual pattern as moe.dispatch).
-Embedding runs *outside* the manual region in plain GSPMD (so the token gather
-partitions over tp/fsdp normally), and the head/loss params keep their native
-shardings inside.
+Embedding AND the final-norm/head/loss run *outside* the manual region in plain
+GSPMD: the token gather and the head matmul partition over tp/fsdp normally and
+the head/embed params are never replicated per pp rank. The last stage's hidden
+states reach the head via one activation-sized psum broadcast.
 """
 
 from __future__ import annotations
@@ -190,8 +191,18 @@ def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp", with_aux: bool = F
         ``P(pp_axis)`` so per-stage layer stats reassemble in layer order; with
         circular repeats the aux carries a leading round dim -> P(None, pp_axis))
       - ``head_loss_fn(params, y, microbatch) -> scalar`` final-norm + head + loss
-        (additive across microbatches); head params keep their native tp/fsdp
-        shardings (GSPMD manages non-pp axes inside the manual region)
+        (additive across microbatches)
+
+    The manual region contains ONLY the layer pipeline. The last stage's output
+    stack is psum-broadcast over ``pp`` (non-last ranks contribute zeros) and the
+    head+loss run OUTSIDE in plain GSPMD: head/embed params never enter the
+    region, so they keep their native tp/fsdp shardings (no per-rank replica —
+    the r2 design paid ~1.8GB/rank at DSv3 scale) and the head matmul partitions
+    over tp normally. This also sidesteps an XLA SpmdPartitioner CHECK-abort
+    (spmd_partitioner_util.cc:495 device-group mismatch, jax 0.9) on
+    full-logit CE reductions over a tp-sharded vocab inside partial-manual(pp).
+    The extra psum of the (n_micro, b, s, d) output stack is one activation-sized
+    all-reduce per step — the same order as the schedule's own ppermute traffic.
 
     Layer params must be stacked (L, ...) with the layer dim sharded over ``pp``
     (sharding rule "layers" -> pp). With ``circular_repeats=V`` the caller
@@ -202,7 +213,7 @@ def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp", with_aux: bool = F
     V = circular_repeats
 
     def fn(layer_params, other_params, x_stack, batch_stack, layer_apply, head_loss_fn):
-        def body(layer_params, other_params, x_stack, batch_stack):
+        def body(layer_params, x_stack):
             if V > 1:
                 # (V, 1, Lb, ...) local slice -> (V, Lb, ...)
                 layer_params = jax.tree.map(lambda p: p[:, 0], layer_params)
@@ -212,40 +223,37 @@ def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp", with_aux: bool = F
             )
             outs, aux = outs if with_aux else (outs, None)
             is_last = jax.lax.axis_index(pp_axis) == pp - 1
-            # sequential over microbatches: only one microbatch's logits live at a
-            # time (vmap would materialize n_micro full logits tensors at once,
-            # forfeiting exactly the peak-memory win pipelining exists for)
-            losses = jax.lax.map(
-                lambda ymb: head_loss_fn(other_params, ymb[0], ymb[1]),
-                (outs, batch_stack),
-            )
-            loss = jax.lax.psum(jnp.where(is_last, losses.sum(), 0.0), pp_axis)
-            return (loss, aux) if with_aux else loss
+            # broadcast the last stage's hidden states to every rank (backward:
+            # the psum transposes to identity and the where-mask routes the head
+            # cotangent to the last stage only); positions/segment-ids that rode
+            # along the ring are dropped — the head only needs h
+            h = outs["h"]
+            h = jax.lax.psum(jnp.where(is_last, h, jnp.zeros_like(h)), pp_axis)
+            return (h, aux) if with_aux else h
 
-        # Head/final-norm params are replicated at region entry: XLA's
-        # SpmdPartitioner hard-aborts on tp-sharded operands of the head einsum
-        # inside a partial-manual(pp) region (jax 0.8 era). The *embedding
-        # gather* — the expensive tp-sharded op — already runs outside in plain
-        # GSPMD; the head matmul inside re-partitions over the batch dims anyway.
-        from jax.sharding import NamedSharding
-
-        other_params = jax.lax.with_sharding_constraint(
-            other_params, NamedSharding(mesh, P())
-        )
         layer_specs = jax.tree.map(
             lambda _: P(None, pp_axis) if V > 1 else P(pp_axis), layer_params
         )
-        other_specs = jax.tree.map(lambda _: P(), other_params)
         x_specs = jax.tree.map(lambda _: P(), x_stack)
-        batch_specs = jax.tree.map(lambda _: P(), batch_stack)
         out_specs = (P(), aux_out_specs) if with_aux else P()
-        return jax.shard_map(
+        outs = jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(layer_specs, other_specs, x_specs, batch_specs),
+            in_specs=(layer_specs, x_specs),
             out_specs=out_specs,
             axis_names={pp_axis},
-        )(layer_params, other_params, x_stack, batch_stack)
+        )(layer_params, x_stack)
+        h_stack, aux = outs if with_aux else (outs, None)
+        # head + loss in plain GSPMD. Sequential over microbatches: only one
+        # microbatch's logits live at a time (vmap would materialize n_micro
+        # full logits tensors at once, forfeiting exactly the peak-memory win
+        # pipelining exists for).
+        losses = jax.lax.map(
+            lambda ymb: head_loss_fn(other_params, {"h": ymb[0]}, ymb[1]),
+            (h_stack, batch_stack),
+        )
+        loss = losses.sum()
+        return (loss, aux) if with_aux else loss
 
     return fn
 
@@ -254,9 +262,11 @@ def _make_head_loss(cfg, dtype, loss_name: str = "masked_ce"):
     """Final-norm + unembed + additive CE, shared by both pp loss builders.
 
     ``linear_ce`` (the default for the big models PP exists for) never
-    materializes the (tokens, vocab) logits — the XLA blockwise path (pallas
-    cannot be partitioned inside the manual region); ``chunked_ce`` bounds the
-    fp32 logits working set; ``masked_ce`` materializes per-microbatch logits.
+    materializes the (tokens, vocab) logits — the XLA blockwise scan, which
+    GSPMD partitions cleanly over tp/fsdp now that the head runs outside the
+    pp-manual region (pallas stays single-device-only, like the non-pp recipe).
+    ``chunked_ce`` bounds the fp32 logits working set; ``masked_ce``
+    materializes per-microbatch logits.
     """
     from automodel_tpu.ops.losses import (
         chunked_cross_entropy, linear_cross_entropy, masked_cross_entropy,
@@ -276,6 +286,11 @@ def _make_head_loss(cfg, dtype, loss_name: str = "masked_ce"):
         unembed = jnp.asarray(unembed).astype(dtype)
         # additive (sum/num) microbatch losses, same contract as make_train_step
         if loss_name == "linear_ce":
+            # impl="xla": pp implies a multi-device mesh, and GSPMD cannot
+            # partition a pallas_call — impl="auto" on TPU would force the
+            # partitioner to all-gather the full (E,V) unembed around the kernel,
+            # reinstating the per-rank head replication this design removes (the
+            # recipe gates its non-pp loss on mesh.size==1 for the same reason)
             return linear_cross_entropy(h, unembed, mb["labels"], 1.0, impl="xla")
         logits = jnp.einsum("bsd,dv->bsv", h, unembed)
         if loss_name == "chunked_ce":
@@ -283,6 +298,17 @@ def _make_head_loss(cfg, dtype, loss_name: str = "masked_ce"):
         return masked_cross_entropy(logits, mb["labels"], 1.0)
 
     return head_loss
+
+
+def _embed_lookup(table, input_ids, dtype, rules):
+    """Token-embedding gather with the table's fsdp (hidden-dim) axes unsharded
+    first: a plain all-gather (FSDP param-on-use), instead of the partitioner's
+    involuntary-full-remat reshard of a hidden-sharded gather output to the
+    (batch, seq) activation layout. Runs OUTSIDE the pp-manual region."""
+    table = table.astype(dtype)
+    if rules is not None:
+        table = jax.lax.with_sharding_constraint(table, rules.sharding(("vocab", None)))
+    return table[input_ids]
 
 
 def _circular_reshape(tree, V: int, pp: int):
@@ -321,7 +347,7 @@ def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "
     # NB: no sharding-constraint rules inside the pp-manual region —
     # with_sharding_constraint over the full mesh clashes with manual pp axes;
     # GSPMD propagates dp/tp activation shardings from the params instead.
-    del rules
+    # ``rules`` is used only OUTSIDE the region (the embedding lookup below).
 
     def layer_apply(stage, x):
         lp, sliding = stage
@@ -335,10 +361,11 @@ def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "
         if V > 1:
             layer_params = _circular_reshape(layer_params, V, pp)
         other = {k: v for k, v in params.items() if k != "layers"}
-        # embedding in plain GSPMD land (partitions over tp/fsdp normally)
-        embed = other["embed"].astype(dtype)
+        # embedding in plain GSPMD land (partitions over tp/fsdp normally);
+        # unshard the table's fsdp (hidden-dim) axes first — same
+        # involuntary-full-remat dodge as transformer.decoder_forward
         x_stack = {
-            "h": embed[batch_stack["input_ids"]],
+            "h": _embed_lookup(other["embed"], batch_stack["input_ids"], dtype, rules),
             "positions": batch_stack["positions"],
             "segment_ids": batch_stack["segment_ids"],
         }
@@ -349,8 +376,9 @@ def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "
     return forward_loss
 
 
-def make_moe_pp_loss(model, mesh: Mesh, *, pp_axis: str = "pp", loss_name: str = "masked_ce",
-                     seq_len_hint: int = 0, circular_repeats: int = 1):
+def make_moe_pp_loss(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
+                     loss_name: str = "masked_ce", seq_len_hint: int = 0,
+                     circular_repeats: int = 1):
     """Pipelined forward+loss for MoE decoders: the dense prefix + embedding run
     replicated on every rank (cheap, avoids a ragged first stage), the MoE layer
     stack pipelines over ``pp``, and expert-load stats accumulate per stage with
@@ -386,7 +414,7 @@ def make_moe_pp_loss(model, mesh: Mesh, *, pp_axis: str = "pp", loss_name: str =
     )
 
     def embed_fn(other, mb):
-        h = other["embed"].astype(dtype)[mb["input_ids"]]
+        h = _embed_lookup(other["embed"], mb["input_ids"], dtype, rules)
         state = {
             "h": h,
             "positions": mb["positions"],
@@ -402,13 +430,20 @@ def make_moe_pp_loss(model, mesh: Mesh, *, pp_axis: str = "pp", loss_name: str =
 
     def layer_apply(stage, state):
         lp_stack, sliding = stage
+        aux_weight = state.pop("aux_weight", None)
         state, (auxs, loads) = jax.lax.scan(
             backend.layer_remat(moe_layer_fn), state, (lp_stack, sliding)
         )
         out = {"load": loads}
         if emit_aux:
-            # (1,)-shaped so the per-stage scalars gather along pp
-            out["aux"] = auxs.sum()[None]
+            # weight this stage's aux by the CURRENT microbatch's label-token
+            # fraction (rides the ring with the activation, see forward_loss) —
+            # the exact non-pp contract (train_ft._forward_loss weights each
+            # microbatch's aux by mb_tokens/num_label_tokens); (1,)-shaped so
+            # the per-stage scalars gather along pp
+            out["aux"] = (auxs.sum() * aux_weight)[None]
+        if aux_weight is not None:
+            state["aux_weight"] = aux_weight
         return state, out
 
     head_loss = _make_head_loss(cfg, dtype, loss_name)
@@ -421,6 +456,14 @@ def make_moe_pp_loss(model, mesh: Mesh, *, pp_axis: str = "pp", loss_name: str =
         other = {k: v for k, v in params.items() if k != "moe_layers"}
         # embedding + dense prefix in plain GSPMD land, vmapped over microbatches
         x_stack = jax.vmap(lambda mb: embed_fn(other, mb))(batch_stack)
+        if emit_aux:
+            # per-microbatch label-token fractions ride the ring as (n_micro,)
+            # scalars so each stage weights its aux by the microbatch it is
+            # actually holding — exact parity with the non-pp objective even
+            # when microbatch label counts are uneven (real SFT batches are)
+            mb_tokens = (batch_stack["labels"] != -100).sum(axis=tuple(
+                range(1, batch_stack["labels"].ndim))).astype(jnp.float32)
+            x_stack["aux_weight"] = mb_tokens / jnp.asarray(num_label_tokens, jnp.float32)
         loss, aux = pipeline(layer_params, other, x_stack, batch_stack,
                              layer_apply, head_loss)
         load = aux["load"]
@@ -429,13 +472,7 @@ def make_moe_pp_loss(model, mesh: Mesh, *, pp_axis: str = "pp", loss_name: str =
             load = load.reshape(-1, *load.shape[2:])
         loss = loss / num_label_tokens
         if emit_aux:
-            # microbatch aux terms are summed unweighted inside the schedule;
-            # the non-pp contract weights each by its token fraction
-            # (train_ft.py _forward_loss), which averages to 1/n_micro when
-            # microbatch label counts are equal — exact for packed/mock data,
-            # a close approximation otherwise
-            n_micro = jax.tree.leaves(batch_stack)[0].shape[0]
-            loss = loss + cfg.moe.aux_loss_coeff * aux["aux"].sum() / n_micro
+            loss = loss + cfg.moe.aux_loss_coeff * aux["aux"].sum()
         return loss, {"expert_load": load}
 
     return forward_loss

@@ -428,7 +428,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             virtual = int(self.cfg.get("distributed.pp_virtual_stages", 1))
             if self._moe_config is not None:
                 pp_loss = make_moe_pp_loss(
-                    self.model, self.mesh, loss_name=self.loss_name,
+                    self.model, self.mesh, self.rules, loss_name=self.loss_name,
                     seq_len_hint=self.seq_len, circular_repeats=virtual,
                 )
                 pp_post_update = self._post_update() if self.peft is None else None

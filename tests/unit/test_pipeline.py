@@ -142,3 +142,60 @@ class TestPipeline:
             got = jax.jit(pp_loss)(params, batch, n)
         want = _ref_loss(cfg, backend, model, params, batch, n)
         np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+class TestMoEPPAuxExactWeighting:
+    def test_aux_matches_nonpp_with_uneven_labels(self):
+        """Per-microbatch aux terms are weighted by each microbatch's OWN
+        label-token fraction (riding the ring with the activation), matching the
+        non-pp objective exactly even when label counts are uneven — the r2
+        design divided by n_micro, exact only for equal counts."""
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+        from automodel_tpu.parallel.pipeline import make_moe_pp_loss
+
+        mesh = MeshContext(pp=2, dp_shard=2, ep=2, world_size=8).build_mesh(jax.devices())
+        hf_cfg = {
+            "architectures": ["Qwen3MoeForCausalLM"],
+            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+            "moe_intermediate_size": 32, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+            "num_experts": 8, "num_experts_per_tok": 2, "norm_topk_prob": True,
+            "router_aux_loss_coef": 0.05, "max_position_embeddings": 64,
+        }
+        model = AutoModelForCausalLM.from_config(hf_cfg, BackendConfig(dtype="float32"))
+        params = model.init(jax.random.key(1), jnp.float32)
+
+        rng = np.random.RandomState(3)
+        n_micro, b, s = 2, 2, 16
+        ids = rng.randint(0, 128, (n_micro, b, s)).astype(np.int32)
+        labels = ids.copy()
+        # sharply uneven label counts: microbatch 0 keeps 4 labels, 1 keeps all
+        labels[0, :, :-2] = -100
+        batch_stack = {
+            "input_ids": jnp.asarray(ids),
+            "labels": jnp.asarray(labels),
+            "positions": jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), ids.shape),
+            "segment_ids": jnp.ones((n_micro, b, s), jnp.int32),
+        }
+        n = float((labels != -100).sum())
+
+        with mesh:
+            pp_loss = make_moe_pp_loss(model, mesh)
+            got, aux = jax.jit(lambda p, bs: pp_loss(p, bs, jnp.float32(n)))(
+                params, batch_stack
+            )
+
+        # non-pp reference: per-microbatch CE + aux * (mb_tokens / n)
+        want = 0.0
+        coeff = model.config.moe.aux_loss_coeff
+        for i in range(n_micro):
+            mb = jax.tree.map(lambda a: a[i], batch_stack)
+            logits, stats = model(
+                params, mb["input_ids"], positions=mb["positions"],
+                segment_ids=mb["segment_ids"], training=True,
+            )
+            mb_tokens = float((np.asarray(mb["labels"]) != -100).sum())
+            want += float(masked_cross_entropy(logits, mb["labels"], n))
+            want += coeff * float(stats["aux_loss"]) * (mb_tokens / n)
+        np.testing.assert_allclose(float(got), want, rtol=2e-5)
+        assert aux["expert_load"].shape == (2, 8)
