@@ -106,39 +106,33 @@ def _encode_with_media(
             )
         if missing:
             prompt = ph * missing + "\n" + prompt
-    ids: list[int] = []
+    # Sequence-start prefix FIRST, then every text chunk encoded raw: encoding
+    # chunks with add_special_tokens=True would splice a '<bos> $A <eos>'-style
+    # template's END suffix between text and vision spans (and before the
+    # answer), drifting the layout vs HF processors. encode("") reproduces the
+    # tokenizer's ACTUAL start prefix (empty for families like Qwen2 that define
+    # bos_token_id but never emit it); trailing end markers are stripped so no
+    # eos/sep from the empty-input template leaks in.
+    prefix = tokenizer.encode("", add_special_tokens=True)
+    enders = {
+        t for t in (getattr(tokenizer, "eos_token_id", None),
+                    getattr(tokenizer, "sep_token_id", None)) if t is not None
+    }
+    while prefix and prefix[-1] in enders:
+        prefix.pop()
+    ids: list[int] = list(prefix)
     cursor = {ph: iter(media) for ph, media in spans.items()}
-    rest, first = prompt, True
+    rest = prompt
     while rest:
         hits = [(rest.find(ph), ph) for ph in spans if ph in rest]
         if not hits:
-            ids.extend(tokenizer.encode(rest, add_special_tokens=first))
+            ids.extend(tokenizer.encode(rest, add_special_tokens=False))
             break
         pos, ph = min(hits)
         if pos:
-            ids.extend(tokenizer.encode(rest[:pos], add_special_tokens=first))
-            first = False
-        elif first:
-            # prompt begins with a media placeholder: still emit the tokenizer's
-            # sequence-start prefix ahead of the vision tokens — HF Qwen-VL/Kimi
-            # processors keep it before media, and dropping it drifts the token
-            # layout vs the pretrained checkpoint. encode("") reproduces the
-            # tokenizer's ACTUAL prefix (empty for families like Qwen2 that
-            # define bos_token_id but never emit it), keeping media-first and
-            # text-first prompts consistent. Templates of the '<bos> $A <eos>'
-            # shape also emit their sequence-END suffix on empty input — strip
-            # trailing end markers so no eos/sep lands ahead of the vision span.
-            prefix = tokenizer.encode("", add_special_tokens=True)
-            enders = {
-                t for t in (getattr(tokenizer, "eos_token_id", None),
-                            getattr(tokenizer, "sep_token_id", None)) if t is not None
-            }
-            while prefix and prefix[-1] in enders:
-                prefix.pop()
-            ids.extend(prefix)
+            ids.extend(tokenizer.encode(rest[:pos], add_special_tokens=False))
         ids.extend(next(cursor[ph]))
         rest = rest[pos + len(ph):]
-        first = False
     prompt_len = len(ids)
     answer_ids = tokenizer.encode(str(ex["answer"]), add_special_tokens=False)
     eos = getattr(tokenizer, "eos_token_id", None)

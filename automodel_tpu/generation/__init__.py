@@ -1,0 +1,188 @@
+"""KV-cache generation for the dense + MoE (GQA) decoder stacks.
+
+The reference gets generation from the wrapped HF modules' ``.generate()``
+(its factory returns torch models — see examples/vlm_generate/vlm_generate.py:1);
+here decode is TPU-native: a static-shape KV cache pytree, one ``lax.scan`` over
+decode steps inside a single jit (no per-token host round-trips — a host-driven
+loop pays the device-sync latency every token), and position/validity-masked
+attention so right-padded prompts of uneven length batch together.
+
+Cache layout: ``k``/``v`` are (L, B, S_max, KH, D) stacked per layer — the same
+stacked-stream convention as the layer params, so the layer scan consumes the
+cache as scan-xs and emits the updated slices as scan-ys. ``positions`` /
+``valid`` / ``write_idx`` are shared across layers and advanced by the loop
+here, not by the model.
+
+Hybrid recurrences (mamba/DeltaNet state caching) and MLA latent caches are not
+wired yet: models that plug a custom ``attention_fn`` into the MoE stack raise
+with a pointer at HF export.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_kv_cache", "generate", "sample_token"]
+
+
+def init_kv_cache(cfg, batch_size: int, max_len: int, dtype=jnp.bfloat16) -> dict[str, Any]:
+    """Zeroed cache for ``cfg.num_hidden_layers`` GQA layers.
+
+    ``valid`` doubles as kv segment ids (0 = empty slot, masked); ``positions``
+    feed the position-causal mask, so cache slot order never has to match
+    position order.
+    """
+    kh = cfg.num_key_value_heads
+    d = cfg.head_dim
+    L = cfg.num_hidden_layers
+    return {
+        "k": jnp.zeros((L, batch_size, max_len, kh, d), dtype),
+        "v": jnp.zeros((L, batch_size, max_len, kh, d), dtype),
+        "positions": jnp.zeros((batch_size, max_len), jnp.int32),
+        "valid": jnp.zeros((batch_size, max_len), jnp.int32),
+        "write_idx": jnp.zeros((batch_size,), jnp.int32),
+    }
+
+
+def sample_token(logits: jnp.ndarray, rng: jax.Array, *, temperature: float = 1.0,
+                 top_k: int | None = None, top_p: float | None = None) -> jnp.ndarray:
+    """One token per row from (B, V) logits. temperature==0 -> greedy."""
+    if temperature == 0.0:
+        return logits.argmax(-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative mass > top_p (the first token
+        # always survives: cum - probs < top_p holds at index 0)
+        keep_sorted = (cum - probs) < top_p
+        cutoff = jnp.where(keep_sorted, sorted_logits, jnp.inf).min(-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+
+def generate(
+    model,
+    params,
+    input_ids,  # (B, S_prompt) int32, right-padded
+    *,
+    attention_mask=None,  # (B, S_prompt) 1 = real token; default all-real
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,  # 0 = greedy
+    top_k: int | None = None,
+    top_p: float | None = None,
+    eos_token_id: int | None = None,
+    pad_token_id: int = 0,
+    seed: int = 0,
+    inputs_embeds=None,  # (B, S_prompt, D) VLM path: pre-merged media embeddings
+    cache_dtype=None,
+    decode_config=None,  # cache-shape config override (VLM wrappers pass their text config)
+):
+    """Prefill + scan-decode; returns ``{"sequences", "tokens", "lengths"}``.
+
+    ``sequences`` is (B, S_prompt + max_new_tokens) with the prompt's padding
+    compacted away is NOT attempted — generated tokens start at each row's
+    ``prompt_len`` slot in the cache but are returned densely in ``tokens``
+    (B, max_new_tokens), ``pad_token_id``-filled after eos. The whole decode
+    runs inside one jit (cache donated through the scan carry).
+    """
+    cfg = decode_config if decode_config is not None else model.config
+    if hasattr(model, "make_attention_fn") or not hasattr(cfg, "num_key_value_heads"):
+        raise NotImplementedError(
+            "KV-cache decode is wired for the GQA attention stack; this model "
+            "uses a custom attention (MLA-style latent cache / hybrid recurrence) "
+            "without a cache path yet — export to HF for generation instead"
+        )
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    b, s_prompt = input_ids.shape
+    mask = (jnp.ones_like(input_ids) if attention_mask is None
+            else jnp.asarray(attention_mask, jnp.int32))
+    if cache_dtype is None:
+        cache_dtype = model.backend.jnp_dtype
+    max_len = s_prompt + max_new_tokens
+    prompt_lens = mask.sum(-1).astype(jnp.int32)
+
+    import inspect
+
+    call_params = inspect.signature(model.__call__).parameters
+    accepts_training = "training" in call_params
+    accepts_embeds = "inputs_embeds" in call_params
+
+    def _model_call(p, ids, positions, segment_ids, cache, embeds=None):
+        kw = dict(positions=positions, segment_ids=segment_ids, cache=cache)
+        if embeds is not None:
+            if not accepts_embeds:
+                raise TypeError(f"{type(model).__name__} does not accept inputs_embeds")
+            kw["inputs_embeds"] = embeds
+        if accepts_training:  # MoE stacks: eval-mode gating (no exploration noise)
+            kw["training"] = False
+        return model(p, ids, **kw)
+
+    def _run(params, input_ids, mask, prompt_lens, inputs_embeds, rng):
+        rows = jnp.arange(b)
+        cache = init_kv_cache(cfg, b, max_len, cache_dtype)
+        prefill_pos = jnp.broadcast_to(jnp.arange(s_prompt, dtype=jnp.int32), (b, s_prompt))
+        cache["positions"] = cache["positions"].at[:, :s_prompt].set(prefill_pos)
+        cache["valid"] = cache["valid"].at[:, :s_prompt].set(mask)
+        # cache-mode forwards return next-token logits only, (B, 1, V)
+        logits, cache = _model_call(params, input_ids, prefill_pos, mask, cache,
+                                    inputs_embeds)
+        last_logits = logits[:, 0]
+
+        def step(carry, rng_t):
+            cache, last_logits, cur_idx, cur_pos, done = carry
+            tok = sample_token(last_logits, rng_t, temperature=temperature,
+                               top_k=top_k, top_p=top_p)
+            if eos_token_id is not None:
+                tok = jnp.where(done, pad_token_id, tok)
+                done = done | (tok == eos_token_id)
+            else:
+                done = jnp.zeros_like(done)
+            cache = dict(
+                cache,
+                positions=cache["positions"].at[rows, cur_idx].set(cur_pos),
+                valid=cache["valid"].at[rows, cur_idx].set(1),
+                write_idx=cur_idx,
+            )
+            logits, cache = _model_call(
+                params, tok[:, None], cur_pos[:, None],
+                jnp.ones((b, 1), jnp.int32), cache,
+            )
+            return (cache, logits[:, 0], cur_idx + 1, cur_pos + 1, done), tok
+
+        rngs = jax.random.split(rng, max_new_tokens)
+        init = (cache, last_logits, prompt_lens, prompt_lens,
+                jnp.zeros((b,), bool))
+        (_, _, _, _, done), tokens = jax.lax.scan(step, init, rngs)
+        tokens = tokens.T  # (B, max_new_tokens)
+        if eos_token_id is not None:
+            # pad everything after (and excluding) the first eos
+            is_eos = jnp.asarray(tokens == eos_token_id, jnp.int32)
+            after = (jnp.cumsum(is_eos, axis=1) - is_eos) > 0
+            tokens = jnp.where(after, pad_token_id, tokens)
+            lengths = (max_new_tokens - after.sum(-1)).astype(jnp.int32)
+        else:
+            lengths = jnp.full((b,), max_new_tokens, jnp.int32)
+        return tokens, lengths
+
+    # jit once per (model, shapes, sampling settings): a fresh jit per call
+    # would recompile the whole prefill+decode program on EVERY generate()
+    # (jax keys its cache on function identity)
+    jit_key = (b, s_prompt, max_new_tokens, temperature, top_k, top_p,
+               eos_token_id, pad_token_id, str(cache_dtype),
+               inputs_embeds is not None, id(cfg))
+    jit_cache = model.__dict__.setdefault("_generate_jit_cache", {})
+    if jit_key not in jit_cache:
+        jit_cache[jit_key] = jax.jit(_run)
+    rng = jax.random.key(seed)
+    tokens, lengths = jit_cache[jit_key](params, input_ids, mask, prompt_lens,
+                                         inputs_embeds, rng)
+    sequences = jnp.concatenate([input_ids, tokens], axis=1)
+    return {"sequences": sequences, "tokens": tokens, "lengths": lengths}

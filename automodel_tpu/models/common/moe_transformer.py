@@ -24,6 +24,7 @@ from automodel_tpu.models.common.transformer import (
     _constrain,
     _layer_shapes,
     _mlp_block,
+    embed_lookup,
 )
 from automodel_tpu.moe.config import MoEConfig
 from automodel_tpu.moe.layers import (
@@ -179,6 +180,7 @@ def make_moe_layer_fns(
     """
     dtype = backend.jnp_dtype
     emit_aux = cfg.moe.aux_loss_coeff > 0 and training and not backend.fake_balanced_gate
+    custom_attention = attention_fn is not None
 
     if attention_fn is None:
         inv_freq = rope_frequencies(
@@ -186,35 +188,63 @@ def make_moe_layer_fns(
             partial_rotary_factor=cfg.partial_rotary_factor,
         )
         attn_scale = rope_attention_scaling(cfg.rope_scaling)
-        big_window = jnp.int32(cfg.max_position_embeddings + seq_len_hint)
         window = jnp.int32(cfg.sliding_window or 0)
         any_sliding = any(cfg.sliding_flags)
 
-        def attention_fn(lp, x, positions, segment_ids, is_sliding, rules):
-            eff_window = jnp.where(is_sliding > 0, window, big_window) if any_sliding else None
+        def attention_fn(lp, x, positions, segment_ids, is_sliding, rules, cache=None,
+                         cache_meta=None):
+            # "disabled" window must exceed every causal q-kv distance; under
+            # cached decode that distance is bounded by the CACHE length, not
+            # the (length-1) decode chunk — seq_len_hint would silently turn
+            # full-attention layers into max_pos-window ones past the config
+            # length (same derivation as the dense stack's layer_fn)
+            kv_len = x.shape[1] if cache is None else cache[0].shape[1]
+            big = jnp.int32(cfg.max_position_embeddings + max(seq_len_hint, kv_len))
+            eff_window = jnp.where(is_sliding > 0, window, big) if any_sliding else None
             return _attention_block(cfg, backend, lp, x, positions, segment_ids,
-                                    inv_freq, attn_scale, eff_window, rules)
+                                    inv_freq, attn_scale, eff_window, rules,
+                                    cache=cache, cache_meta=cache_meta)
 
-    def attn(state, lp, is_sliding):
+    def attn(state, lp, is_sliding, kv=None):
         h = state["h"]
         x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-        h = h + attention_fn(lp, x, state["positions"], state.get("segment_ids"),
-                             is_sliding, rules)
-        return _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+        if kv is None:
+            out, kv_out = attention_fn(lp, x, state["positions"],
+                                       state.get("segment_ids"), is_sliding, rules), None
+        else:
+            if custom_attention:
+                raise NotImplementedError(
+                    "KV-cache decode is wired for the GQA attention stack; this "
+                    "model plugs in a custom attention_fn (MLA-style) without a "
+                    "cache path yet — export to HF for generation instead"
+                )
+            cache_meta = {"write_idx": state["write_idx"], "valid": state["valid"],
+                          "positions": state["kv_positions"]}
+            out, kv_out = attention_fn(lp, x, state["positions"],
+                                       state.get("segment_ids"), is_sliding, rules,
+                                       cache=kv, cache_meta=cache_meta)
+        h = h + out
+        return _constrain(h, rules, ("batch", "act_seq", "act_embed")), kv_out
+
+    def _split(layer_inputs):
+        if len(layer_inputs) == 3:
+            return layer_inputs
+        return (*layer_inputs, None)
 
     def dense_layer_fn(state, layer_inputs):
-        lp, is_sliding = layer_inputs
+        lp, is_sliding, kv = _split(layer_inputs)
         lp = jax.tree.map(lambda a: a.astype(dtype), lp)
-        h = attn(state, lp, is_sliding)
+        h, kv_out = attn(state, lp, is_sliding, kv)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
         h = h + _mlp_block(backend, lp, x, rules)
-        return dict(state, h=_constrain(h, rules, ("batch", "act_seq", "act_embed"))), None
+        state = dict(state, h=_constrain(h, rules, ("batch", "act_seq", "act_embed")))
+        return state, kv_out
 
     def moe_layer_fn(state, layer_inputs):
-        lp, is_sliding = layer_inputs
+        lp, is_sliding, kv = _split(layer_inputs)
         moe_params = lp["moe"]
         lp = jax.tree.map(lambda a: a.astype(dtype), {k: v for k, v in lp.items() if k != "moe"})
-        h = attn(state, lp, is_sliding)
+        h, kv_out = attn(state, lp, is_sliding, kv)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
         moe_params = cast_moe_compute_params(moe_params, dtype)
         y, aux, load = moe_forward(
@@ -226,7 +256,10 @@ def make_moe_layer_fns(
         )
         h = h + y
         h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
-        return dict(state, h=h), (aux if emit_aux else jnp.float32(0), load)
+        # decode (kv given) swaps the aux/load ys for the updated kv cache —
+        # inference never consumes balance stats
+        ys = kv_out if kv is not None else (aux if emit_aux else jnp.float32(0), load)
+        return dict(state, h=h), ys
 
     return dense_layer_fn, moe_layer_fn
 
@@ -244,21 +277,18 @@ def moe_decoder_forward(
     training: bool = True,
     attention_fn=None,
     inputs_embeds: jnp.ndarray | None = None,  # (B, S, D) overrides the embed lookup (VLM merge)
+    cache=None,  # generation.init_kv_cache dict -> returns (logits, cache)
 ) -> tuple[jnp.ndarray, dict[str, Any]]:
     """Returns ``(logits_or_hidden, stats)``; stats has ``aux_loss`` (scalar or None)
-    and ``expert_load`` (num_moe_layers, E)."""
+    and ``expert_load`` (num_moe_layers, E). With ``cache`` (decode path, GQA
+    stacks only) returns ``(logits, cache)`` instead."""
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+    if cache is not None and segment_ids is None:
+        raise ValueError("cache decoding requires segment_ids (1 = real token)")
     dtype = backend.jnp_dtype
-    if inputs_embeds is None:
-        # unshard the table's FSDP (embed-dim) axes before the lookup — a plain
-        # all-gather — so the gather output doesn't inherit hidden-dim sharding
-        # and trigger an involuntary-full-remat reshard to the activation layout
-        # (same fix as transformer.decoder_forward; seen in the ep-cp dryrun HLO)
-        table = _constrain(params["embed"].astype(dtype), rules, ("vocab", None))
-        h = table[input_ids]
-    else:
-        h = inputs_embeds.astype(dtype)
+    h = (inputs_embeds.astype(dtype) if inputs_embeds is not None
+         else embed_lookup(params["embed"], input_ids, dtype, rules))
     h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
 
     sliding_flags = jnp.asarray(cfg.sliding_flags, dtype=jnp.int32)
@@ -269,6 +299,10 @@ def moe_decoder_forward(
         state["segment_ids"] = segment_ids
     if token_mask is not None:
         state["token_mask"] = token_mask
+    if cache is not None:
+        state["kv_positions"] = cache["positions"]
+        state["valid"] = cache["valid"]
+        state["write_idx"] = cache["write_idx"]
     dense_layer_fn, moe_layer_fn = make_moe_layer_fns(
         cfg, backend, rules, attention_fn, training, seq_len_hint=input_ids.shape[1]
     )
@@ -276,7 +310,12 @@ def moe_decoder_forward(
     k_dense = cfg.first_k_dense_replace
     if k_dense > 0:
         body = backend.layer_remat(dense_layer_fn)
-        if backend.scan_layers:
+        if cache is not None:
+            kv_dense = (cache["k"][:k_dense], cache["v"][:k_dense])
+            state, (dk, dv) = jax.lax.scan(
+                body, state, (params["dense_layers"], sliding_flags[:k_dense], kv_dense)
+            )
+        elif backend.scan_layers:
             state, _ = jax.lax.scan(body, state, (params["dense_layers"], sliding_flags[:k_dense]))
         else:
             for i in range(k_dense):
@@ -285,7 +324,15 @@ def moe_decoder_forward(
 
     moe_sliding = sliding_flags[k_dense:]
     body = backend.layer_remat(moe_layer_fn)
-    if backend.scan_layers:
+    if cache is not None:
+        kv_moe = (cache["k"][k_dense:], cache["v"][k_dense:])
+        state, (mk, mv) = jax.lax.scan(
+            body, state, (params["moe_layers"], moe_sliding, kv_moe)
+        )
+        k_new = jnp.concatenate([dk, mk], 0) if k_dense > 0 else mk
+        v_new = jnp.concatenate([dv, mv], 0) if k_dense > 0 else mv
+        cache = dict(cache, k=k_new, v=v_new)
+    elif backend.scan_layers:
         state, (auxs, loads) = jax.lax.scan(body, state, (params["moe_layers"], moe_sliding))
     else:
         auxs, loads = [], []
@@ -297,12 +344,21 @@ def moe_decoder_forward(
         auxs = jnp.stack(auxs)
         loads = jnp.stack(loads)
 
+    h = rms_norm(state["h"], params["final_norm"].astype(dtype), cfg.rms_norm_eps)
+    if cache is not None:
+        # next-token logits only (B, 1, V) — see transformer.decoder_forward
+        last = jnp.maximum(segment_ids.sum(-1) - 1, 0).astype(jnp.int32)
+        h = jnp.take_along_axis(h, last[:, None, None], axis=1)
+        unembed = params.get("lm_head")
+        if unembed is None:
+            unembed = params["embed"].T
+        logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
+        return logits, cache
+
     stats = {
         "aux_loss": auxs.sum() if emit_aux else None,
         "expert_load": loads,
     }
-
-    h = rms_norm(state["h"], params["final_norm"].astype(dtype), cfg.rms_norm_eps)
     if return_hidden:
         return h, stats
     unembed = params.get("lm_head")

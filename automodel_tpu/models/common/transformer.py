@@ -188,8 +188,36 @@ def _constrain(x, rules, names):
     return jax.lax.with_sharding_constraint(x, rules.sharding(names))
 
 
+def embed_lookup(table, input_ids, dtype, rules):
+    """Token-embedding gather with the table's FSDP (hidden-dim) axes unsharded
+    FIRST — a plain all-gather (FSDP's param-on-use collective). Without it the
+    gather output inherits the table's hidden-dim sharding and the partitioner
+    falls back to involuntary full rematerialization resharding it to the
+    (batch, act_seq) activation layout (seen in the r2 cp-ring dryrun HLO).
+    "vocab" stays: under TP the vocab-parallel local-gather+psum path holds.
+    Shared by the dense/MoE forwards and the pipeline's stage-0 embedding."""
+    table = _constrain(table.astype(dtype), rules, ("vocab", None))
+    return table[input_ids]
+
+
+def _cache_write(cache, new, idx):
+    """Write ``new (B, s, ...)`` into ``cache (B, S_max, ...)`` at per-row slot
+    ``idx (B,)`` — a vmapped dynamic_update_slice (rows decode at different
+    lengths when prompts are right-padded unevenly)."""
+    zeros = (0,) * (cache.ndim - 2)
+    return jax.vmap(
+        lambda c, n, i: jax.lax.dynamic_update_slice(c, n, (i, *zeros))
+    )(cache, new, idx)
+
+
 def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, x, positions,
-                     segment_ids, inv_freq, attn_scale, sliding, rules):
+                     segment_ids, inv_freq, attn_scale, sliding, rules,
+                     cache=None, cache_meta=None):
+    """Self-attention block. With ``cache=(k_cache, v_cache)`` (decode path) the
+    freshly projected k/v are written into the cache at ``cache_meta["write_idx"]``
+    and attention runs against the whole cache (masked by ``cache_meta["valid"]``
+    as kv segment ids + position-causal masking); returns ``(out, (k, v))``.
+    Training path (cache=None) returns just ``out``."""
     from jax.ad_checkpoint import checkpoint_name
 
     lin = backend.linear
@@ -211,6 +239,24 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
             jnp.floor(positions.astype(jnp.float32) / orig)
         )
         q = q * scale[..., None, None].astype(q.dtype)
+    if cache is not None:
+        k_cache = _cache_write(cache[0], k.astype(cache[0].dtype), cache_meta["write_idx"])
+        v_cache = _cache_write(cache[1], v.astype(cache[1].dtype), cache_meta["write_idx"])
+        out = dot_product_attention(
+            q, k_cache.astype(q.dtype), v_cache.astype(q.dtype),
+            causal=cfg.causal,
+            segment_ids_q=segment_ids,
+            segment_ids_kv=cache_meta["valid"],
+            positions_q=positions,
+            positions_kv=cache_meta["positions"],
+            sliding_window=sliding,
+            sinks=lp.get("sinks"),
+            backend="xla",  # q_len 1 / position-masked: the flash kernel doesn't apply
+        )
+        o = project(out, lp["wo"], 2, lin)
+        if cfg.attention_out_bias:
+            o = o + lp["bo"]
+        return o, (k_cache, v_cache)
     q = _constrain(q, rules, ("batch", "act_attn_seq", "act_heads", None))
     k = _constrain(k, rules, ("batch", "act_attn_seq", "act_heads", None))
     mesh = rules.mesh if rules is not None else None
@@ -270,27 +316,41 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
     window = jnp.int32(cfg.sliding_window or 0)
 
     def layer_fn(state, layer_inputs):
-        lp, is_sliding = layer_inputs
+        if len(layer_inputs) == 3:
+            lp, is_sliding, kv = layer_inputs  # decode: per-layer kv cache rides as xs
+        else:
+            (lp, is_sliding), kv = layer_inputs, None
         lp = jax.tree.map(lambda a: a.astype(dtype), lp)
         h = state["h"]
         # "disabled" window must exceed every causal q-kv distance for the actual
         # (static at trace time) sequence length, even when S > max_position_embeddings
-        big_window = jnp.int32(cfg.max_position_embeddings + h.shape[1])
+        kv_len = h.shape[1] if kv is None else kv[0].shape[1]
+        big_window = jnp.int32(cfg.max_position_embeddings + kv_len)
         # traced per-layer window (scan-compatible); None disables the mask entirely
         eff_window = jnp.where(is_sliding > 0, window, big_window) if any_sliding else None
         # named scopes label the profiler trace per block (the reference gets the
         # same from autonvtx module hooks, autonvtx/__init__.py:33)
         with jax.named_scope("attention"):
             x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-            h = h + _attention_block(cfg, backend, lp, x, state["positions"],
-                                     state.get("segment_ids"),
-                                     inv_freq, attn_scale, eff_window, rules)
+            if kv is None:
+                attn_out, kv_out = _attention_block(
+                    cfg, backend, lp, x, state["positions"], state.get("segment_ids"),
+                    inv_freq, attn_scale, eff_window, rules), None
+            else:
+                cache_meta = {k_: state[k_] for k_ in ("write_idx", "valid")}
+                cache_meta["positions"] = state["kv_positions"]
+                attn_out, kv_out = _attention_block(
+                    cfg, backend, lp, x, state["positions"], state.get("segment_ids"),
+                    inv_freq, attn_scale, eff_window, rules,
+                    cache=kv, cache_meta=cache_meta,
+                )
+            h = h + attn_out
             h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
         with jax.named_scope("mlp"):
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
             h = h + _mlp_block(backend, lp, x, rules)
             h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
-        return dict(state, h=h), None
+        return dict(state, h=h), kv_out
 
     return layer_fn
 
@@ -302,8 +362,23 @@ def apply_layer_stack(
     sliding_flags: jnp.ndarray,  # (L,) int32
     state: dict,  # {"h": (B,S,D), "positions": (B,S), ["segment_ids": (B,S)]}
     rules=None,
-) -> dict:
+    cache=None,  # decode: {"k"/"v": (L,B,S_max,KH,D), ...} -> returns (state, cache)
+):
     body = backend.layer_remat(make_layer_body(cfg, backend, rules))
+    if cache is not None:
+        xs = (lp_stack, sliding_flags, (cache["k"], cache["v"]))
+        if backend.scan_layers:
+            state, (k_new, v_new) = jax.lax.scan(body, state, xs)
+        else:
+            num_layers = jax.tree.leaves(lp_stack)[0].shape[0]
+            ks, vs = [], []
+            for i in range(num_layers):
+                sliced = jax.tree.map(lambda a: a[i], xs)
+                state, (k_l, v_l) = body(state, sliced)
+                ks.append(k_l)
+                vs.append(v_l)
+            k_new, v_new = jnp.stack(ks), jnp.stack(vs)
+        return state, dict(cache, k=k_new, v=v_new)
     if backend.scan_layers:
         state, _ = jax.lax.scan(body, state, (lp_stack, sliding_flags))
     else:
@@ -324,32 +399,53 @@ def decoder_forward(
     rules=None,
     return_hidden: bool = False,
     inputs_embeds: jnp.ndarray | None = None,  # VLM path: pre-merged embeddings
+    cache=None,  # generation.init_kv_cache dict -> returns (logits, cache)
 ):
-    """Forward pass -> logits (B, S, V), or final hidden states for fused linear-CE."""
+    """Forward pass -> logits (B, S, V), or final hidden states for fused linear-CE.
+
+    With ``cache`` (a :func:`automodel_tpu.generation.init_kv_cache` dict whose
+    positions/valid/write_idx the generation loop has already advanced for this
+    chunk) the pass serves prefill (S = prompt length) and decode (S = 1) and
+    returns ``(logits, cache)``; ``segment_ids`` is then REQUIRED (it doubles as
+    the q-validity mask against unfilled cache slots).
+    """
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+    if cache is not None and segment_ids is None:
+        raise ValueError("cache decoding requires segment_ids (1 = real token)")
     dtype = backend.jnp_dtype
-    if inputs_embeds is not None:
-        h = inputs_embeds
-    else:
-        # Unshard the table's FSDP (embed-dim) axes BEFORE the lookup: a plain
-        # all-gather (FSDP's param-on-use collective). Without this the gather
-        # output inherits the table's hidden-dim sharding and the partitioner
-        # falls back to involuntary full rematerialization resharding it to the
-        # (batch, act_seq) activation layout (seen in the cp-ring dryrun HLO).
-        # "vocab" stays: under TP the vocab-parallel local-gather+psum path holds.
-        table = _constrain(params["embed"].astype(dtype), rules, ("vocab", None))
-        h = table[input_ids]
+    h = (inputs_embeds if inputs_embeds is not None
+         else embed_lookup(params["embed"], input_ids, dtype, rules))
     h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
 
     state = {"h": h, "positions": positions}
     if segment_ids is not None:
         state["segment_ids"] = segment_ids
+    if cache is not None:
+        state["kv_positions"] = cache["positions"]
+        state["valid"] = cache["valid"]
+        state["write_idx"] = cache["write_idx"]
     sliding_flags = jnp.asarray(cfg.sliding_flags, dtype=jnp.int32)
-    state = apply_layer_stack(cfg, backend, params["layers"], sliding_flags, state, rules)
+    out = apply_layer_stack(cfg, backend, params["layers"], sliding_flags, state, rules,
+                            cache=cache)
+    state, cache = out if cache is not None else (out, None)
     h = state["h"]
 
     h = rms_norm(h, params["final_norm"].astype(dtype), cfg.rms_norm_eps)
+    if cache is not None:
+        # next-token logits ONLY (B, 1, V): unembedding the whole prefill chunk
+        # would materialize a (B, S_prompt, V) tensor — an HBM spike at exactly
+        # the long-prompt scales the KV cache exists for. Right-padded contract:
+        # each row's last valid position is segment_ids.sum()-1.
+        last = jnp.maximum(segment_ids.sum(-1) - 1, 0).astype(jnp.int32)
+        h = jnp.take_along_axis(h, last[:, None, None], axis=1)  # (B, 1, D)
+        if return_hidden:
+            return h, cache
+        unembed = params.get("lm_head")
+        if unembed is None:
+            unembed = params["embed"].T
+        logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
+        return logits, cache
     if return_hidden:
         return h
     unembed = params.get("lm_head")

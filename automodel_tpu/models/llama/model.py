@@ -79,12 +79,18 @@ class LlamaForCausalLM:
 
     # -- forward ------------------------------------------------------------
     def __call__(self, params, input_ids, positions=None, segment_ids=None, rules=None,
-                 return_hidden=False):
+                 return_hidden=False, cache=None):
         return decoder_forward(
             self.config, self.backend, params, input_ids,
             positions=positions, segment_ids=segment_ids, rules=rules,
-            return_hidden=return_hidden,
+            return_hidden=return_hidden, cache=cache,
         )
+
+    def generate(self, params, input_ids, **kw):
+        """Sample from the model with a KV cache (see :func:`automodel_tpu.generation.generate`)."""
+        from automodel_tpu.generation import generate
+
+        return generate(self, params, input_ids, **kw)
 
     # -- HF interop ---------------------------------------------------------
     def state_dict_adapter(self):

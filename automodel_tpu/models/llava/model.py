@@ -102,8 +102,9 @@ class LlavaForConditionalGeneration:
         x = jax.nn.gelu(x, approximate=False)
         return x @ p["linear_2"].astype(dtype) + p["linear_2_b"].astype(dtype)
 
-    def __call__(self, params, input_ids, pixel_values=None, positions=None,
-                 segment_ids=None, rules=None, return_hidden=False):
+    def merged_embeds(self, params, input_ids, pixel_values=None):
+        """Token embeddings with image placeholders swapped for projected vision
+        features (B, S, D) — the prefill input for generation."""
         cfg = self.config
         lm_params = params["language_model"]
         dtype = self.backend.jnp_dtype
@@ -115,13 +116,34 @@ class LlavaForConditionalGeneration:
             idx = jnp.clip(jnp.cumsum(mask, axis=1) - 1, 0, feats.shape[1] - 1)
             gathered = jnp.take_along_axis(feats, idx[..., None], axis=1)
             embeds = jnp.where(mask[..., None], gathered.astype(dtype), embeds)
+        return embeds
+
+    def __call__(self, params, input_ids, pixel_values=None, positions=None,
+                 segment_ids=None, rules=None, return_hidden=False, cache=None,
+                 inputs_embeds=None):
+        cfg = self.config
+        if inputs_embeds is None:
+            inputs_embeds = self.merged_embeds(params, input_ids, pixel_values)
         from automodel_tpu.models.common.transformer import decoder_forward
 
         return decoder_forward(
-            cfg.text, self.backend, lm_params, input_ids,
+            cfg.text, self.backend, params["language_model"], input_ids,
             positions=positions, segment_ids=segment_ids, rules=rules,
-            return_hidden=return_hidden, inputs_embeds=embeds,
+            return_hidden=return_hidden, inputs_embeds=inputs_embeds, cache=cache,
         )
+
+    def generate(self, params, input_ids, pixel_values=None, **kw):
+        """Image-conditioned sampling: vision features merge into the prompt's
+        prefill embeddings, decode is the plain text KV-cache loop (the
+        reference's vlm_generate example does the same through HF .generate)."""
+        from automodel_tpu.generation import generate
+
+        embeds = None
+        if pixel_values is not None:
+            embeds = self.merged_embeds(params, jnp.asarray(input_ids, jnp.int32),
+                                        pixel_values)
+        return generate(self, params, input_ids, inputs_embeds=embeds,
+                        decode_config=self.config.text, **kw)
 
     # -- HF interop ---------------------------------------------------------
     def state_dict_adapter(self):

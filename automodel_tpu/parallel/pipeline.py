@@ -300,17 +300,6 @@ def _make_head_loss(cfg, dtype, loss_name: str = "masked_ce"):
     return head_loss
 
 
-def _embed_lookup(table, input_ids, dtype, rules):
-    """Token-embedding gather with the table's fsdp (hidden-dim) axes unsharded
-    first: a plain all-gather (FSDP param-on-use), instead of the partitioner's
-    involuntary-full-remat reshard of a hidden-sharded gather output to the
-    (batch, seq) activation layout. Runs OUTSIDE the pp-manual region."""
-    table = table.astype(dtype)
-    if rules is not None:
-        table = jax.lax.with_sharding_constraint(table, rules.sharding(("vocab", None)))
-    return table[input_ids]
-
-
 def _circular_reshape(tree, V: int, pp: int):
     """(L, ...) layer stacks -> (V, pp, L/(V*pp), ...) round-major blocks."""
 
@@ -336,7 +325,7 @@ def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "
     ``batch_stack`` leaves are (n_micro, ...) — the pipeline consumes all
     microbatches in one call (grad accum *is* the pipeline schedule).
     """
-    from automodel_tpu.models.common.transformer import apply_layer_stack
+    from automodel_tpu.models.common.transformer import apply_layer_stack, embed_lookup
 
     cfg, backend = model.config, model.backend
     dtype = backend.jnp_dtype
@@ -365,7 +354,7 @@ def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "
         # unshard the table's fsdp (hidden-dim) axes first — same
         # involuntary-full-remat dodge as transformer.decoder_forward
         x_stack = {
-            "h": _embed_lookup(other["embed"], batch_stack["input_ids"], dtype, rules),
+            "h": embed_lookup(other["embed"], batch_stack["input_ids"], dtype, rules),
             "positions": batch_stack["positions"],
             "segment_ids": batch_stack["segment_ids"],
         }
@@ -392,6 +381,7 @@ def make_moe_pp_loss(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
     training sequence length, needed for the sliding-window disable bound.
     """
     from automodel_tpu.models.common.moe_transformer import make_moe_layer_fns
+    from automodel_tpu.models.common.transformer import embed_lookup
 
     cfg, backend = model.config, model.backend
     dtype = backend.jnp_dtype
@@ -414,7 +404,7 @@ def make_moe_pp_loss(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
     )
 
     def embed_fn(other, mb):
-        h = _embed_lookup(other["embed"], mb["input_ids"], dtype, rules)
+        h = embed_lookup(other["embed"], mb["input_ids"], dtype, rules)
         state = {
             "h": h,
             "positions": mb["positions"],

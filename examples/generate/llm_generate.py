@@ -1,0 +1,41 @@
+"""Text generation from any supported GQA-stack checkpoint (llama/qwen/mistral
+lineages, qwen3-moe) with the framework's jitted KV-cache decode loop.
+
+Usage:
+    python examples/generate/llm_generate.py --checkpoint-path /path/to/ckpt \
+        --prompt "The capital of France is" --max-new-tokens 32
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--checkpoint-path", required=True)
+    ap.add_argument("--prompt", default="Hello")
+    ap.add_argument("--max-new-tokens", type=int, default=32)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-p", type=float, default=None)
+    ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from automodel_tpu.models.auto import AutoModelForCausalLM
+    from automodel_tpu.models.auto_tokenizer import AutoTokenizer
+
+    model, params = AutoModelForCausalLM.from_pretrained(args.checkpoint_path)
+    tokenizer = AutoTokenizer.from_pretrained(args.checkpoint_path)
+    ids = np.asarray([tokenizer.encode(args.prompt, add_special_tokens=True)], np.int32)
+    out = model.generate(
+        params, ids, max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature, top_p=args.top_p, top_k=args.top_k,
+        eos_token_id=getattr(tokenizer, "eos_token_id", None), seed=args.seed,
+    )
+    tokens = np.asarray(out["tokens"])[0][: int(out["lengths"][0])]
+    print(tokenizer.decode(tokens.tolist()))
+
+
+if __name__ == "__main__":
+    main()
