@@ -127,21 +127,23 @@ class Checkpointer:
     def save_hf(self, out_dir: str, params: Any) -> None:
         """Consolidated HF-layout safetensors export (any rank count -> one HF dir).
 
-        The host gather runs on EVERY process (process_allgather is a collective;
-        gating it on rank 0 would deadlock the pod), then adapters mostly view
-        into the gathered tree and rank 0 streams the result out one <=5GB shard
-        at a time. Peak host use ~= one full model copy + one shard — true
-        per-tensor streaming needs adapter-level iteration (reference
-        consolidate_hf_safetensors.py) and is future work."""
+        STREAMING: the adapter yields lazy per-tensor views (to_hf_lazy), so each
+        layer/expert slice is gathered to host, transformed, written, and dropped
+        one at a time — peak host memory is one <=5GB shard on the writing rank
+        and one tensor elsewhere, never the model (the r2 design pulled the full
+        tree to host first, capping exports at one host's RAM; the reference
+        ships an 858-LoC consolidation engine for the same reason,
+        consolidate_hf_safetensors.py:1). Every process walks the tensors in the
+        SAME order because the per-slice gathers are collectives; only rank 0
+        writes."""
         from automodel_tpu.checkpoint.safetensors_io import save_safetensors
 
-        host = jax.tree.map(_full_host_array, params)
-        tensors = self.state_dict_adapter.to_hf(host)
-        if jax.process_index() == 0:
-            save_safetensors(tensors, out_dir)
-            if self.hf_config is not None:
-                with open(os.path.join(out_dir, "config.json"), "w") as f:
-                    json.dump(self.hf_config, f, indent=2)
+        lazy = self.state_dict_adapter.to_hf_lazy(params, host_fn=_full_host_array)
+        is_writer = jax.process_index() == 0
+        save_safetensors(lazy, out_dir, write=is_writer)
+        if is_writer and self.hf_config is not None:
+            with open(os.path.join(out_dir, "config.json"), "w") as f:
+                json.dump(self.hf_config, f, indent=2)
 
     def wait(self) -> None:
         """Block until an in-flight async save lands, then commit its ``latest``

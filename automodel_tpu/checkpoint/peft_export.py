@@ -1,0 +1,116 @@
+"""HF PEFT-format adapter export: adapter_model.safetensors + adapter_config.json.
+
+The consolidated export merges LoRA into the base weights; this writes the
+ADAPTER ALONE in the layout the ``peft`` library loads
+(``PeftModel.from_pretrained``), so a TPU finetune hands its adapter to any
+torch/HF deployment without shipping base weights (reference PEFT checkpoint
+addon, checkpoint/addons.py — its DCP save keeps adapter state separate the
+same way).
+
+Key mapping rides the model's state-dict Entry table: our LoRA tree mirrors the
+param tree (e.g. ``layers.wq``), each matching single-key Entry names the HF
+module (``model.layers.{i}.self_attn.q_proj``), and factors transpose to torch
+Linear layout (A: (r, in_features), B: (out_features, r)).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["save_peft_adapter"]
+
+
+def _hf_layer_ids(e, n_stack: int):
+    """Stack index -> HF layer index, honoring Entry.layer_indices/layer_range
+    (interleaved-hybrid and ranged entries: stack slot 2 may be HF layer 11)."""
+    if e.layer_indices is not None:
+        return list(e.layer_indices)
+    if e.layer_range is not None:
+        return list(range(*e.layer_range))
+    return list(range(n_stack))
+
+
+def save_peft_adapter(
+    out_dir: str,
+    lora_tree: Any,
+    peft_cfg,
+    entries,
+    *,
+    host_fn=np.asarray,
+    base_model_name: str | None = None,
+    write: bool = True,
+) -> dict[str, np.ndarray]:
+    """Write the HF PEFT adapter dir; returns the flat tensor dict.
+
+    ``host_fn`` gathers a (possibly sharded) leaf to host — under multi-host
+    meshes it is collective, so call on EVERY process with ``write`` true only
+    on rank 0. Adapter factors are rank-r small, so a dense dict is fine."""
+    from automodel_tpu.peft.lora import _flatten_lora
+
+    by_ours = {}
+    for e in entries:
+        if isinstance(e.hf, str):
+            by_ours[e.ours] = e
+
+    tensors: dict[str, np.ndarray] = {}
+    modules: set[str] = set()
+    for path, leaf in sorted(_flatten_lora(lora_tree)):
+        e = by_ours.get(path)
+        if e is None:
+            logger.warning(
+                "peft export: no single-key HF mapping for %r (merged/tuple "
+                "entries can't split a low-rank delta) — skipped", path,
+            )
+            continue
+        module_tmpl = e.hf.removesuffix(".weight")
+        a = host_fn(leaf["lora_a"])  # (*stack, fan_in, r)
+        b = host_fn(leaf["lora_b"])  # (*stack, r, fan_out)
+        mag = host_fn(leaf["magnitude"]) if "magnitude" in leaf else None
+        n_stack = a.ndim - 2
+        hf_ids = _hf_layer_ids(e, a.shape[0]) if n_stack >= 1 else [None]
+        for li, i in enumerate(hf_ids):
+            fmt = {"i": i} if i is not None else {}
+            a_l = a[li] if i is not None else a
+            b_l = b[li] if i is not None else b
+            if a_l.ndim != 2:  # expert-stacked adapters: flatten extra stack dims out of scope
+                logger.warning("peft export: %r has extra stack dims — skipped", path)
+                break
+            module = module_tmpl.format(**fmt)
+            modules.add(module.rsplit(".", 1)[-1])
+            key = f"base_model.model.{module}"
+            # torch Linear layout: A.weight (r, in), B.weight (out, r)
+            tensors[f"{key}.lora_A.weight"] = np.ascontiguousarray(a_l.T)
+            tensors[f"{key}.lora_B.weight"] = np.ascontiguousarray(b_l.T)
+            if mag is not None:
+                m_l = mag[li] if i is not None else mag
+                tensors[f"{key}.lora_magnitude_vector"] = np.ascontiguousarray(m_l)
+
+    if write:
+        from safetensors.numpy import save_file
+
+        os.makedirs(out_dir, exist_ok=True)
+        save_file(tensors, os.path.join(out_dir, "adapter_model.safetensors"),
+                  metadata={"format": "pt"})
+        cfg = {
+            "peft_type": "LORA",
+            "r": int(peft_cfg.dim),
+            "lora_alpha": int(peft_cfg.alpha),
+            "lora_dropout": float(peft_cfg.dropout),
+            "use_dora": bool(peft_cfg.use_dora),
+            "target_modules": sorted(modules),
+            "bias": "none",
+            "task_type": "CAUSAL_LM",
+            "base_model_name_or_path": base_model_name or "",
+            # our scaling is alpha/r (PeftConfig.scaling) — peft's non-rslora default
+            "use_rslora": False,
+        }
+        with open(os.path.join(out_dir, "adapter_config.json"), "w") as f:
+            json.dump(cfg, f, indent=2)
+    return tensors

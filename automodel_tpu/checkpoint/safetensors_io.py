@@ -81,11 +81,21 @@ def save_safetensors(
     out_dir: str,
     max_shard_bytes: int = 5 * 1024**3,
     metadata: dict[str, str] | None = None,
+    write: bool = True,
 ) -> list[str]:
-    """Write tensors as HF-sharded safetensors (+ index.json when sharded)."""
+    """Write tensors as HF-sharded safetensors (+ index.json when sharded).
+
+    Values may be dense arrays OR lazy leaves (jax arrays, LazyHFTensor): each
+    lands on host only while its shard is being written, so peak host memory is
+    one shard (<= ``max_shard_bytes``), not the checkpoint. ``write=False`` runs
+    the identical materialization sequence WITHOUT writing — non-zero ranks of a
+    multi-host pod call it this way so the per-tensor host gathers (collectives)
+    stay in lockstep with the writing rank, one tensor in flight at a time.
+    """
     from safetensors.numpy import save_file
 
-    os.makedirs(out_dir, exist_ok=True)
+    if write:
+        os.makedirs(out_dir, exist_ok=True)
     items = list(tensors.items())
     # greedy sharding by byte size WITHOUT materializing: jax arrays, numpy, and
     # lazy host leaves all expose nbytes; tensors only land on host one shard at
@@ -104,8 +114,10 @@ def save_safetensors(
     written: list[str] = []
     if len(shards) == 1:
         fp = os.path.join(out_dir, "model.safetensors")
-        save_file(_to_numpy_dict(dict(shards[0])), fp, metadata=meta)
-        return [fp]
+        buf = _to_numpy_dict(dict(shards[0]), keep=write)
+        if write:
+            save_file(buf, fp, metadata=meta)
+        return [fp] if write else []
 
     weight_map: dict[str, str] = {}
     total = 0
@@ -113,18 +125,27 @@ def save_safetensors(
     for idx, shard in enumerate(shards, start=1):
         name = f"model-{idx:05d}-of-{n:05d}.safetensors"
         fp = os.path.join(out_dir, name)
-        buf = _to_numpy_dict(dict(shard))
-        save_file(buf, fp, metadata=meta)
-        for k, v in buf.items():
-            weight_map[k] = name
-            total += v.nbytes
+        buf = _to_numpy_dict(dict(shard), keep=write)
+        if write:
+            save_file(buf, fp, metadata=meta)
+            for k, v in buf.items():
+                weight_map[k] = name
+                total += v.nbytes
+            written.append(fp)
         del buf  # free the shard before materializing the next
-        written.append(fp)
-    with open(os.path.join(out_dir, _INDEX_NAME), "w") as f:
-        json.dump({"metadata": {"total_size": total}, "weight_map": weight_map}, f, indent=2)
+    if write:
+        with open(os.path.join(out_dir, _INDEX_NAME), "w") as f:
+            json.dump({"metadata": {"total_size": total}, "weight_map": weight_map}, f, indent=2)
     return written
 
 
-def _to_numpy_dict(d: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
-    # np.asarray on a jax array device-gets to host; ml_dtypes covers bf16
+def _to_numpy_dict(d: dict[str, np.ndarray], keep: bool = True) -> dict[str, np.ndarray]:
+    # np.asarray on a jax array device-gets to host (LazyHFTensor gathers +
+    # transforms); ml_dtypes covers bf16. keep=False (non-writing ranks) still
+    # materializes every tensor IN ORDER — the gathers are collectives — but
+    # drops each immediately, bounding peak host use to one tensor.
+    if not keep:
+        for v in d.values():
+            np.asarray(v)
+        return {}
     return {k: np.ascontiguousarray(np.asarray(v)) for k, v in d.items()}

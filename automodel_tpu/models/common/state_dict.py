@@ -169,3 +169,84 @@ class MappingAdapter:
             else:
                 self._store_one(e, value, out, dtype)
         return out
+
+    def to_hf_lazy(self, params: dict, dtype=None, host_fn=None) -> "dict[str, LazyHFTensor]":
+        """Our param tree -> flat dict of DEFERRED HF tensors.
+
+        Nothing is gathered here: each value is a :class:`LazyHFTensor` that
+        pulls ONE layer/expert slice to host (via ``host_fn``, e.g. a multihost
+        allgather) and applies the Entry transform only when materialized — the
+        streaming-export contract (reference consolidate_hf_safetensors.py
+        holds at most one tensor in flight the same way). Under a multi-host
+        mesh ``host_fn`` is collective, so every process must materialize the
+        mapping's values in the SAME order (the safetensors writer does).
+        ``params`` leaves may be live (sharded) jax arrays."""
+        host_fn = host_fn if host_fn is not None else np.asarray
+        # one-slot memo: tuple-key entries (e.g. gate+up merged) produce several
+        # HF tensors from one transform; adjacent consumption hits the memo
+        # instead of re-gathering and re-transforming per key
+        memo: dict = {"tag": None, "results": None}
+
+        def make(e: Entry, slicer, cast, key_idx, n_keys, tag):
+            def thunk():
+                if memo["tag"] != tag:
+                    arr = host_fn(slicer())
+                    results = e.to_hf(np.asarray(arr))
+                    if isinstance(results, np.ndarray):
+                        results = (results,)
+                    memo["tag"], memo["results"] = tag, results
+                t = memo["results"][key_idx]
+                return t if cast is None else t.astype(cast)
+
+            return thunk
+
+        out: dict[str, LazyHFTensor] = {}
+        for e in self.entries:
+            try:
+                value = get_path(params, e.ours)
+            except KeyError:
+                if e.optional:
+                    continue
+                raise
+            cast = dtype if not e.keep_dtype else None
+            n_keys = len(e.hf_keys)
+            itemsize = np.dtype(cast).itemsize if cast is not None else (
+                np.dtype(value.dtype).itemsize)
+
+            def add(slicer, slice_size, tag, **fmt):
+                nbytes = (slice_size * itemsize) // n_keys  # shard-planning estimate
+                for key_idx, tmpl in enumerate(e.hf_keys):
+                    out[tmpl.format(**fmt)] = LazyHFTensor(
+                        make(e, slicer, cast, key_idx, n_keys, tag), nbytes
+                    )
+
+            if e.per_layer:
+                per_layer_size = int(np.prod(value.shape[1:]))
+                for li, i in enumerate(self._layers(e)):
+                    if e.per_expert:
+                        for x in range(self.num_experts):
+                            add((lambda v=value, a=li, b=x: v[a, b]),
+                                per_layer_size // self.num_experts,
+                                (id(e), li, x), i=i, e=x)
+                    else:
+                        add((lambda v=value, a=li: v[a]), per_layer_size,
+                            (id(e), li), i=i)
+            else:
+                add((lambda v=value: v), int(np.prod(value.shape)), (id(e),))
+        return out
+
+
+class LazyHFTensor:
+    """A deferred HF-layout tensor: ``nbytes`` is known up front (shard
+    planning), the data exists only while being consumed (``np.asarray``)."""
+
+    def __init__(self, thunk, nbytes: int):
+        self._thunk = thunk
+        self.nbytes = int(nbytes)
+
+    def materialize(self) -> np.ndarray:
+        return np.asarray(self._thunk())
+
+    def __array__(self, dtype=None, copy=None):
+        arr = self.materialize()
+        return arr.astype(dtype) if dtype is not None else arr

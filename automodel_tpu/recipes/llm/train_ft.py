@@ -755,9 +755,22 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             client["peft_config"] = self.peft.to_dict()
             if self.checkpointer.config.save_consolidated:
                 hf_params = self._merge_lora(self.params, self.train_params)
-        self.checkpointer.save(
+        d = self.checkpointer.save(
             step, self.train_params, self.opt_state, client_states=client, hf_params=hf_params
         )
+        if d and self.peft is not None and self.checkpointer.config.save_consolidated:
+            # adapter-only HF PEFT export alongside the merged model: deployable
+            # via peft.PeftModel without shipping base weights
+            from automodel_tpu.checkpoint.checkpointing import _full_host_array
+            from automodel_tpu.checkpoint.peft_export import save_peft_adapter
+
+            save_peft_adapter(
+                os.path.join(d, "hf_adapter"), self.train_params, self.peft,
+                self.model.state_dict_adapter().entries,
+                host_fn=_full_host_array,
+                base_model_name=self.cfg.get("model.pretrained_model_name_or_path"),
+                write=jax.process_index() == 0,
+            )
 
 
 def main(cfg: ConfigNode | None = None, argv=None):

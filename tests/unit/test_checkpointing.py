@@ -89,3 +89,160 @@ class TestShardedExport:
         (tmp_path / "ck" / "best.json").write_text("{truncated")
         assert ck.best_step() is None
         assert ck.is_best(1.0)
+
+
+class TestStreamingHFExport:
+    """save_hf must never hold more than one tensor per gather + one shard: the
+    adapter yields lazy views, the writer materializes shard by shard."""
+
+    def _model_and_params(self, n_layers=3):
+        from automodel_tpu.models.common.backend import BackendConfig
+        from automodel_tpu.models.llama.model import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=n_layers, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=32, tie_word_embeddings=False,
+        )
+        model = LlamaForCausalLM(cfg, BackendConfig(dtype="float32"))
+        params = model.init(jax.random.key(0), jnp.float32)
+        return model, params
+
+    def test_lazy_view_defers_and_memoizes(self):
+        model, params = self._model_and_params()
+        adapter = model.state_dict_adapter()
+        calls = []
+
+        def spy_host(x):
+            arr = np.asarray(x)
+            calls.append(arr.nbytes)
+            return arr
+
+        lazy = adapter.to_hf_lazy(params, host_fn=spy_host)
+        assert calls == []  # building the view gathers NOTHING
+        dense = adapter.to_hf(jax.tree.map(np.asarray, params))
+        assert set(lazy) == set(dense)
+        for k in lazy:
+            np.testing.assert_array_equal(np.asarray(lazy[k]), dense[k])
+        # one gather per (entry, layer) slice — tuple-key entries must hit the
+        # memo, and nothing may pull the full stacked tree
+        assert len(calls) == len(
+            [1 for e in adapter.entries for _ in (range(model.config.num_hidden_layers)
+                                                  if e.per_layer else [0])]
+        )
+
+    def test_roundtrip_multi_shard_loads_in_transformers(self, tmp_path):
+        transformers = pytest.importorskip("transformers")
+        torch = pytest.importorskip("torch")
+        from automodel_tpu.checkpoint.safetensors_io import save_safetensors
+
+        model, params = self._model_and_params()
+        adapter = model.state_dict_adapter()
+        lazy = adapter.to_hf_lazy(params)
+        out = str(tmp_path / "hf")
+        # tiny shard cap -> many shards + index.json (the multi-host layout)
+        files = save_safetensors(lazy, out, max_shard_bytes=40_000)
+        assert len(files) > 1
+        assert os.path.exists(os.path.join(out, "model.safetensors.index.json"))
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48, num_hidden_layers=3,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=32,
+            tie_word_embeddings=False,
+        )
+        # transformers' own sharded loader must read the dir
+        loaded = transformers.LlamaForCausalLM.from_pretrained(
+            out, config=hf_cfg, torch_dtype=torch.float32
+        )
+        ours = np.asarray(params["layers"]["wq"][1])  # (D, H, hd)
+        theirs = loaded.model.layers[1].self_attn.q_proj.weight.detach().numpy()
+        np.testing.assert_allclose(
+            ours.reshape(32, -1).T, theirs, rtol=1e-6, atol=1e-6
+        )
+
+    def test_nonwriter_materializes_without_writing(self, tmp_path):
+        from automodel_tpu.checkpoint.safetensors_io import save_safetensors
+
+        model, params = self._model_and_params()
+        adapter = model.state_dict_adapter()
+        calls = []
+
+        def spy_host(x):
+            calls.append(1)
+            return np.asarray(x)
+
+        lazy = adapter.to_hf_lazy(params, host_fn=spy_host)
+        out = str(tmp_path / "nonwriter")
+        files = save_safetensors(lazy, out, max_shard_bytes=40_000, write=False)
+        assert files == []
+        assert not os.path.exists(out)  # nothing written...
+        assert len(calls) > 0  # ...but every collective gather still ran
+
+    def test_checkpointer_save_hf_streaming(self, tmp_path):
+        model, params = self._model_and_params()
+        ck = Checkpointer(
+            CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")),
+            state_dict_adapter=model.state_dict_adapter(),
+            hf_config={"architectures": ["LlamaForCausalLM"], "vocab_size": 64},
+        )
+        out = str(tmp_path / "hf")
+        ck.save_hf(out, params)
+        from automodel_tpu.checkpoint.safetensors_io import load_safetensors
+
+        tensors = load_safetensors(out)
+        dense = model.state_dict_adapter().to_hf(jax.tree.map(np.asarray, params))
+        assert set(tensors) == set(dense)
+        np.testing.assert_array_equal(
+            tensors["model.embed_tokens.weight"], dense["model.embed_tokens.weight"]
+        )
+        assert json.load(open(os.path.join(out, "config.json")))["vocab_size"] == 64
+
+
+class TestPeftAdapterExport:
+    def test_adapter_loads_in_peft_and_matches_merged(self, tmp_path):
+        """Gold test: export our LoRA adapter in HF PEFT format, load it with the
+        peft library on the HF base model, and require logits to match OUR
+        merged-adapter forward."""
+        transformers = pytest.importorskip("transformers")
+        torch = pytest.importorskip("torch")
+        peft_lib = pytest.importorskip("peft")
+        from automodel_tpu.checkpoint.peft_export import save_peft_adapter
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+        from automodel_tpu.models.common.backend import BackendConfig
+        from automodel_tpu.peft.lora import (
+            PeftConfig, init_lora_params, merge_lora_params,
+        )
+
+        cfg = transformers.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=32,
+        )
+        torch.manual_seed(0)
+        hf = transformers.LlamaForCausalLM(cfg).eval()
+        d = str(tmp_path / "base")
+        hf.save_pretrained(d, safe_serialization=True)
+        model, params = AutoModelForCausalLM.from_pretrained(
+            d, dtype=jnp.float32, backend=BackendConfig(dtype="float32")
+        )
+        pc = PeftConfig(target_modules=["*wq", "*wv"], dim=4, alpha=8)
+        lora = init_lora_params(params, model.logical_axes(), pc, jax.random.key(0))
+        # B starts at zero (delta = 0, trivially equal) — randomize both factors
+        lora = jax.tree.map(
+            lambda a: jax.random.normal(jax.random.key(1), a.shape, a.dtype) * 0.05, lora
+        )
+        out = str(tmp_path / "adapter")
+        tensors = save_peft_adapter(
+            out, lora, pc, model.state_dict_adapter().entries, base_model_name=d
+        )
+        assert "base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight" in tensors
+        assert tensors[
+            "base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight"
+        ].shape == (4, 32)
+
+        ids = np.random.RandomState(0).randint(0, 64, (2, 8))
+        merged = merge_lora_params(params, lora, pc)
+        ours = np.asarray(model(params=merged, input_ids=jnp.asarray(ids)))
+
+        peft_model = peft_lib.PeftModel.from_pretrained(hf, out).eval()
+        with torch.no_grad():
+            theirs = peft_model(torch.tensor(ids)).logits.float().numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=1e-3)
