@@ -43,7 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = [
     "pipeline_spmd", "pipeline_ticks", "make_pipeline_forward",
-    "make_dense_decoder_pp_loss", "make_moe_pp_loss",
+    "make_dense_decoder_pp_loss", "make_dense_decoder_pp_hidden", "make_moe_pp_loss",
 ]
 
 
@@ -244,6 +244,10 @@ def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp", with_aux: bool = F
             axis_names={pp_axis},
         )(layer_params, x_stack)
         h_stack, aux = outs if with_aux else (outs, None)
+        if head_loss_fn is None:
+            # hidden-state mode: the caller owns the head (KD needs full student
+            # logits next to teacher logits; VLM heads differ per family)
+            return (h_stack, aux) if with_aux else h_stack
         # head + loss in plain GSPMD. Sequential over microbatches: only one
         # microbatch's logits live at a time (vmap would materialize n_micro
         # full logits tensors at once, forfeiting exactly the peak-memory win
@@ -271,7 +275,6 @@ def _make_head_loss(cfg, dtype, loss_name: str = "masked_ce"):
     from automodel_tpu.ops.losses import (
         chunked_cross_entropy, linear_cross_entropy, masked_cross_entropy,
     )
-    from automodel_tpu.ops.norms import rms_norm
 
     if loss_name not in ("masked_ce", "linear_ce", "chunked_ce"):
         raise NotImplementedError(
@@ -279,11 +282,7 @@ def _make_head_loss(cfg, dtype, loss_name: str = "masked_ce"):
         )
 
     def head_loss(other, y, mb):
-        h = rms_norm(y["h"], other["final_norm"].astype(dtype), cfg.rms_norm_eps)
-        unembed = other.get("lm_head")
-        if unembed is None:
-            unembed = other["embed"].T
-        unembed = jnp.asarray(unembed).astype(dtype)
+        h, unembed = _head_pre(cfg, dtype, other, y["h"])
         # additive (sum/num) microbatch losses, same contract as make_train_step
         if loss_name == "linear_ce":
             # impl="xla": pp implies a multi-device mesh, and GSPMD cannot
@@ -298,6 +297,29 @@ def _make_head_loss(cfg, dtype, loss_name: str = "masked_ce"):
         return masked_cross_entropy(logits, mb["labels"], 1.0)
 
     return head_loss
+
+
+def _head_pre(cfg, dtype, other, h):
+    """Final-norm + unembed resolution (tied-embedding fallback) — the ONE copy
+    of the decoder head shared by every pp loss/composition."""
+    from automodel_tpu.ops.norms import rms_norm
+
+    h = rms_norm(h, other["final_norm"].astype(dtype), cfg.rms_norm_eps)
+    unembed = other.get("lm_head")
+    if unembed is None:
+        unembed = other["embed"].T
+    return h, jnp.asarray(unembed).astype(dtype)
+
+
+def make_head_logits(cfg, dtype):
+    """(other_params, h) -> logits; for compositions that need raw logits next
+    to the hidden-state pipeline (KD's KL term)."""
+
+    def head_logits(other, h):
+        h, unembed = _head_pre(cfg, dtype, other, h)
+        return jnp.einsum("bsd,dv->bsv", h, unembed)
+
+    return head_logits
 
 
 def _circular_reshape(tree, V: int, pp: int):
@@ -363,6 +385,36 @@ def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "
         return total / num_label_tokens
 
     return forward_loss
+
+
+def make_dense_decoder_pp_hidden(cfg, backend, mesh: Mesh, *,
+                                 circular_repeats: int = 1):
+    """Pipelined dense layer stack -> FINAL HIDDEN STATES (no head).
+
+    Returns ``hidden_fn(layer_stack, x_stack) -> h_stack (n_micro, B, S, D)``
+    where ``x_stack`` holds already-embedded stage-0 inputs — the building block
+    for compositions that own their head: KD (student logits must meet teacher
+    logits in one loss) and VLM (per-family heads). The caller computes
+    embeddings/final-norm/unembed OUTSIDE, in plain GSPMD.
+    """
+    from automodel_tpu.models.common.transformer import apply_layer_stack
+
+    pp = mesh.shape["pp"]
+    V = circular_repeats
+    pipeline = make_pipeline_forward(mesh, circular_repeats=V)
+
+    def layer_apply(stage, x):
+        lp, sliding = stage
+        return apply_layer_stack(cfg, backend, lp, sliding, x, None)
+
+    def hidden_fn(layer_stack, x_stack):
+        sliding = jnp.asarray(cfg.sliding_flags, jnp.int32)
+        layer_params = (layer_stack, sliding)
+        if V > 1:
+            layer_params = _circular_reshape(layer_params, V, pp)
+        return pipeline(layer_params, None, x_stack, None, layer_apply, None)
+
+    return hidden_fn
 
 
 def make_moe_pp_loss(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",

@@ -63,11 +63,11 @@ class KnowledgeDistillationRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         logger.info("teacher: %s (%.1fM params)", type(self.teacher).__name__, n / 1e6)
 
     def _build_train_step(self):
-        if self.mesh_ctx.pp > 1:
-            raise NotImplementedError("kd + pp composition is not wired yet")
         self._build_teacher()
         temperature = float(self.cfg.get("kd.temperature", 1.0))
         kd_ratio = float(self.cfg.get("kd.kd_ratio", 0.5))
+        if self.mesh_ctx.pp > 1:
+            return self._build_pp_train_step(temperature, kd_ratio)
 
         def kd_core(student_params, teacher_params, batch, num_label_tokens):
             student_logits = self.model(
@@ -106,6 +106,75 @@ class KnowledgeDistillationRecipe(TrainFinetuneRecipeForNextTokenPrediction):
 
         step = make_train_step(kd_forward, self.optimizer, with_frozen=True,
                                guard_nonfinite=self._check_nan_grads)
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _build_pp_train_step(self, temperature: float, kd_ratio: float):
+        """kd x pp (reference composes them through its one sequencing path,
+        infrastructure.py:303): the STUDENT's layer stack pipelines over pp and
+        yields final hidden states outside the manual region; the student head,
+        the teacher forward, and the blended CE+KL loss then run per microbatch
+        in plain GSPMD (lax.map — one microbatch's logits pair live at a time).
+        The teacher is not pipelined: its layer stacks stay sharded by the rules
+        (the pp axis acts as an extra FSDP axis for it), gathered per layer
+        during its forward-only pass."""
+        from automodel_tpu.models.common.transformer import embed_lookup
+        from automodel_tpu.parallel.pipeline import (
+            make_dense_decoder_pp_hidden, make_head_logits,
+        )
+        from automodel_tpu.training.train_step import make_pp_train_step
+
+        if self._moe_config is not None:
+            raise NotImplementedError("kd + pp is wired for dense students only")
+        if self.peft is not None and self.peft.dropout:
+            raise NotImplementedError(
+                "kd + lora dropout is not wired (the KD step does not thread "
+                "a dropout rng); set peft.dropout: 0"
+            )
+        cfg, backend = self.model.config, self.model.backend
+        dtype = backend.jnp_dtype
+        virtual = int(self.cfg.get("distributed.pp_virtual_stages", 1))
+        hidden_fn = make_dense_decoder_pp_hidden(
+            cfg, backend, self.mesh, circular_repeats=virtual
+        )
+        head_logits = make_head_logits(cfg, dtype)
+
+        def kd_pp_core(student_params, teacher_params, batch_stack, n):
+            other = {k: v for k, v in student_params.items() if k != "layers"}
+            x_stack = {
+                "h": embed_lookup(other["embed"], batch_stack["input_ids"], dtype, self.rules),
+                "positions": batch_stack["positions"],
+                "segment_ids": batch_stack["segment_ids"],
+            }
+            h_stack = hidden_fn(student_params["layers"], x_stack)
+
+            def mb_loss(args):
+                h_mb, mb = args
+                s_logits = head_logits(other, h_mb)
+                t_logits = jax.lax.stop_gradient(
+                    self.teacher(
+                        teacher_params, mb["input_ids"], positions=mb["positions"],
+                        segment_ids=mb["segment_ids"], rules=self.rules,
+                    )
+                )
+                ce = masked_cross_entropy(s_logits, mb["labels"], n)
+                kd = kd_loss(s_logits, t_logits, mb["labels"],
+                             temperature=temperature, num_label_tokens=n)
+                return (1.0 - kd_ratio) * ce + kd_ratio * kd
+
+            return jax.lax.map(mb_loss, (h_stack, batch_stack)).sum()
+
+        if self.peft is not None:
+            from automodel_tpu.peft.lora import merge_lora_params
+
+            def kd_forward(lora, frozen, batch_stack, n):
+                merged = merge_lora_params(frozen["base"], lora, self.peft)
+                return kd_pp_core(merged, frozen["teacher"], batch_stack, n)
+        else:
+            def kd_forward(params, frozen, batch_stack, n):
+                return kd_pp_core(params, frozen["teacher"], batch_stack, n)
+
+        step = make_pp_train_step(kd_forward, self.optimizer, with_frozen=True,
+                                  guard_nonfinite=self._check_nan_grads)
         return jax.jit(step, donate_argnums=(0, 1))
 
     @property

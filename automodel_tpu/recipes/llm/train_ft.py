@@ -215,7 +215,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
 
         # the jitted step
         self._train_step = self._build_train_step()
-        self._eval_step = None
+        self._eval_step = None  # VLM/seq-cls overrides use the single-slot form
+        self._eval_steps = {}  # base: keyed by qat-active (delayed-start switch)
         return self
 
     def _build_model_and_params(self):
@@ -416,97 +417,113 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self._pre_qat_step = None
         self._qat_start_step = 0
         self._step_needs_rng = False
-        if self.mesh_ctx.pp > 1:
-            from automodel_tpu.parallel.pipeline import (
-                make_dense_decoder_pp_loss,
-                make_moe_pp_loss,
-            )
-            from automodel_tpu.training.train_step import make_pp_train_step
+        qfn = self._qat_param_fn()
+        qat_cfg = self.cfg.get("qat")
+        qat_start = int(qat_cfg.get("fake_quant_after_n_steps") or 0) if qat_cfg else 0
 
-            if self.cfg.get("qat") is not None:
-                raise NotImplementedError("qat + pp composition is not wired yet")
-            virtual = int(self.cfg.get("distributed.pp_virtual_stages", 1))
-            if self._moe_config is not None:
-                pp_loss = make_moe_pp_loss(
-                    self.model, self.mesh, self.rules, loss_name=self.loss_name,
-                    seq_len_hint=self.seq_len, circular_repeats=virtual,
+        def build(with_qat: bool):
+            """One step builder covering every composition; QAT is a param-level
+            transform so it threads through pp / peft / plain identically."""
+            q = qfn if (with_qat and qfn is not None) else (lambda p: p)
+            if self.mesh_ctx.pp > 1:
+                from automodel_tpu.parallel.pipeline import (
+                    make_dense_decoder_pp_loss,
+                    make_moe_pp_loss,
                 )
-                pp_post_update = self._post_update() if self.peft is None else None
-                if self.peft is not None and self._post_update() is not None:
-                    logger.warning("moe gate-bias update disabled under peft (base is frozen)")
-            else:
-                pp_loss = make_dense_decoder_pp_loss(
-                    self.model, self.mesh, self.rules, loss_name=self.loss_name,
-                    circular_repeats=virtual,
-                )
-                pp_post_update = None
-            if self.peft is not None:
-                # peft + pp (reference composes them, infrastructure.py:303): the
-                # LoRA merge happens OUTSIDE the pp-manual region in plain GSPMD —
-                # merged layer stacks stay (L, ...) and shard over pp as usual;
-                # grads flow only to the rank-r adapter (the frozen base rides in
-                # the undifferentiated slot).
-                from automodel_tpu.peft.lora import merge_lora_params
+                from automodel_tpu.training.train_step import make_pp_train_step
 
-                def pp_peft_loss(lora, base, batch_stack, n):
-                    merged = merge_lora_params(base, lora, self.peft)
-                    return pp_loss(merged, batch_stack, n)
+                virtual = int(self.cfg.get("distributed.pp_virtual_stages", 1))
+                if self._moe_config is not None:
+                    pp_loss = make_moe_pp_loss(
+                        self.model, self.mesh, self.rules, loss_name=self.loss_name,
+                        seq_len_hint=self.seq_len, circular_repeats=virtual,
+                    )
+                    pp_post_update = self._post_update() if self.peft is None else None
+                    if self.peft is not None and self._post_update() is not None:
+                        logger.warning("moe gate-bias update disabled under peft (base is frozen)")
+                else:
+                    pp_loss = make_dense_decoder_pp_loss(
+                        self.model, self.mesh, self.rules, loss_name=self.loss_name,
+                        circular_repeats=virtual,
+                    )
+                    pp_post_update = None
+                if self.peft is not None:
+                    # peft + pp (reference composes them, infrastructure.py:303):
+                    # the LoRA merge happens OUTSIDE the pp-manual region in plain
+                    # GSPMD — merged layer stacks stay (L, ...) and shard over pp
+                    # as usual; grads flow only to the rank-r adapter. qat x peft
+                    # x pp: the BASE quantizes before the merge (the adapter
+                    # trains in full precision on a quantized base, reference
+                    # QLoRA-style qat semantics).
+                    if self.peft.dropout:
+                        raise NotImplementedError(
+                            "peft dropout + pp is not wired (the pp step does not "
+                            "thread a dropout rng); set peft.dropout: 0"
+                        )
+                    from automodel_tpu.peft.lora import merge_lora_params
 
-                step = make_pp_train_step(pp_peft_loss, self.optimizer,
-                                          guard_nonfinite=self._check_nan_grads,
-                                          with_frozen=True)
-            else:
-                step = make_pp_train_step(pp_loss, self.optimizer,
+                    def pp_peft_loss(lora, base, batch_stack, n):
+                        merged = merge_lora_params(q(base), lora, self.peft)
+                        return pp_loss(merged, batch_stack, n)
+
+                    return make_pp_train_step(pp_peft_loss, self.optimizer,
+                                              guard_nonfinite=self._check_nan_grads,
+                                              with_frozen=True)
+                # qat x pp: quantize the stacked layer params (and head/embed)
+                # BEFORE the manual region — fake-quant is elementwise, GSPMD
+                # partitions it over the pp-sharded layer dim like any other op
+                return make_pp_train_step(lambda p, bs, n: pp_loss(q(p), bs, n),
+                                          self.optimizer,
                                           post_update=pp_post_update,
                                           guard_nonfinite=self._check_nan_grads)
-        elif self.peft is not None:
-            from automodel_tpu.peft.lora import merge_lora_params
+            if self.peft is not None:
+                from automodel_tpu.peft.lora import merge_lora_params
 
-            if self.cfg.get("qat") is not None:
-                raise NotImplementedError("qat + peft composition is not wired yet")
-            if self._post_update() is not None:
-                logger.warning("moe gate-bias update disabled under peft (base is frozen)")
+                if self._post_update() is not None:
+                    logger.warning("moe gate-bias update disabled under peft (base is frozen)")
 
-            use_dropout = self.peft.dropout > 0.0
+                use_dropout = self.peft.dropout > 0.0
 
-            if use_dropout:
-                def peft_loss(lora, base, batch, num_label_tokens, rng):
-                    merged = merge_lora_params(base, lora, self.peft, dropout_rng=rng)
-                    return self._forward_loss(merged, batch, num_label_tokens)
-            else:
-                def peft_loss(lora, base, batch, num_label_tokens):
-                    merged = merge_lora_params(base, lora, self.peft)
-                    return self._forward_loss(merged, batch, num_label_tokens)
+                if use_dropout:
+                    def peft_loss(lora, base, batch, num_label_tokens, rng):
+                        merged = merge_lora_params(q(base), lora, self.peft, dropout_rng=rng)
+                        return self._forward_loss(merged, batch, num_label_tokens)
+                else:
+                    def peft_loss(lora, base, batch, num_label_tokens):
+                        merged = merge_lora_params(q(base), lora, self.peft)
+                        return self._forward_loss(merged, batch, num_label_tokens)
 
-            self._step_needs_rng = use_dropout
-            step = make_train_step(peft_loss, self.optimizer, with_frozen=True,
-                                   guard_nonfinite=self._check_nan_grads,
-                                   pass_rng=use_dropout)
-        else:
-            forward = self._qat_wrap(self._forward_loss)
-            step = make_train_step(forward, self.optimizer, post_update=self._post_update(),
-                                   guard_nonfinite=self._check_nan_grads)
-            # QAT delayed start (reference qat.py:46 fake_quant_after_n_steps): two
-            # compiled steps, python-level switch on the scheduler step — zero
-            # per-step overhead vs a lax.cond inside jit
-            qat_cfg = self.cfg.get("qat")
-            start = int(qat_cfg.get("fake_quant_after_n_steps") or 0) if qat_cfg else 0
-            if start > 0:
-                plain = make_train_step(
-                    self._forward_loss, self.optimizer, post_update=self._post_update(),
-                    guard_nonfinite=self._check_nan_grads,
-                )
-                self._pre_qat_step = jax.jit(plain, donate_argnums=(0, 1))
-                self._qat_start_step = start
+                self._step_needs_rng = use_dropout
+                return make_train_step(peft_loss, self.optimizer, with_frozen=True,
+                                       guard_nonfinite=self._check_nan_grads,
+                                       pass_rng=use_dropout)
+            return make_train_step(
+                lambda p, b, n: self._forward_loss(q(p), b, n),
+                self.optimizer, post_update=self._post_update(),
+                guard_nonfinite=self._check_nan_grads,
+            )
+
+        step = build(with_qat=True)
+        # QAT delayed start (reference qat.py:46 fake_quant_after_n_steps): two
+        # compiled steps, python-level switch on the scheduler step — zero
+        # per-step overhead vs a lax.cond inside jit. Applies to every
+        # composition since build() is uniform.
+        if qfn is not None and qat_start > 0:
+            self._pre_qat_step = jax.jit(build(with_qat=False), donate_argnums=(0, 1))
+            self._qat_start_step = qat_start
         return jax.jit(step, donate_argnums=(0, 1))
 
-    def _qat_wrap(self, forward):
-        """QAT (reference quantization/qat.py + train_ft.py:1092): fake-quantize
-        matched weights in the forward so training sees post-quantization rounding;
-        gradients pass straight through."""
+    def _qat_param_fn(self):
+        """params -> fake-quantized params, or None when QAT is off.
+
+        The param-level transform is what makes QAT compose: the pp loss, the
+        LoRA base, and the plain forward all consume a param tree, so one
+        transform serves qat, qat x pp, and qat x peft (reference threads the
+        same module-swap through its one sequencing path, infrastructure.py:303).
+        """
         qat_cfg = self.cfg.get("qat")
         if qat_cfg is None or not qat_cfg.get("enabled", True):
-            return forward
+            return None
         import dataclasses
 
         from automodel_tpu.peft.lora import PeftConfig as _MatchCfg, match_lora_paths
@@ -519,9 +536,18 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                             match_all_linear=qat.target_modules == ["*"])
         paths = sorted(match_lora_paths(self.model.logical_axes(), matcher))
         logger.info("qat: int%d fake-quant on %d weight tensors", qat.weight_bits, len(paths))
+        return lambda params: fake_quant_params(params, paths, qat)
+
+    def _qat_wrap(self, forward):
+        """QAT (reference quantization/qat.py + train_ft.py:1092): fake-quantize
+        matched weights in the forward so training sees post-quantization rounding;
+        gradients pass straight through."""
+        qfn = self._qat_param_fn()
+        if qfn is None:
+            return forward
 
         def qat_forward(params, batch, num_label_tokens):
-            return forward(fake_quant_params(params, paths, qat), batch, num_label_tokens)
+            return forward(qfn(params), batch, num_label_tokens)
 
         return qat_forward
 
@@ -660,28 +686,34 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             lg.close()
 
     def _run_validation(self, step: int):
-        if self._eval_step is None:
+        # validate on the SAME weights training currently sees: before a delayed
+        # QAT start the train step runs un-quantized, so validation must too —
+        # a quantized eval there would measure a different model than is being
+        # trained and fake a train/val gap until fake_quant_after_n_steps
+        qat_active = self._qat_param_fn() is not None and step >= self._qat_start_step
+        eval_step = self._eval_steps.get(qat_active)
+        if eval_step is None:
             from automodel_tpu.training.train_step import make_eval_step
 
             # training=False: no aux balance term in validation loss, pure CE
             if self.peft is not None:
                 from automodel_tpu.peft.lora import merge_lora_params
 
+                qfn = (self._qat_param_fn() or (lambda p: p)) if qat_active else (lambda p: p)
                 eval_loss = lambda lora, base, b, n: self._forward_loss(
-                    merge_lora_params(base, lora, self.peft), b, n, training=False
+                    merge_lora_params(qfn(base), lora, self.peft), b, n, training=False
                 )
-                self._eval_step = jax.jit(make_eval_step(eval_loss, with_frozen=True))
+                eval_step = jax.jit(make_eval_step(eval_loss, with_frozen=True))
             else:
-                # QAT: validate with the same fake-quantized weights training sees
-                eval_loss = self._qat_wrap(
-                    lambda p, b, n: self._forward_loss(p, b, n, training=False)
-                )
-                self._eval_step = jax.jit(make_eval_step(eval_loss))
+                plain = lambda p, b, n: self._forward_loss(p, b, n, training=False)
+                eval_loss = self._qat_wrap(plain) if qat_active else plain
+                eval_step = jax.jit(make_eval_step(eval_loss))
+            self._eval_steps[qat_active] = eval_step
         total, count = 0.0, 0
         extra = (self.params,) if self.peft is not None else ()
         for batch in self._iter_val_batches():
             n = int((batch["labels"] != -100).sum())
-            total += float(self._eval_step(self.train_params, batch, n, *extra)) * n
+            total += float(eval_step(self.train_params, batch, n, *extra)) * n
             count += n
         self._log_val_loss(step, total, count)
 

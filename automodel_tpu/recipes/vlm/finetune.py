@@ -58,6 +58,13 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         with self.mesh:
             if pretrained:
                 self.hf_config = load_hf_config(pretrained)
+                # fence BEFORE the rules-applied load: an unsupported family
+                # would otherwise die on vision-block sharding divisibility
+                # with an opaque pjit error instead of the clean fence
+                self.model = AutoModelForImageTextToText.from_config(
+                    self.hf_config, backend=self.backend
+                )
+                self._check_pp_support()
                 self.model, self.params = AutoModelForImageTextToText.from_pretrained(
                     pretrained, backend=self.backend, dtype=jnp.float32, rules=self.rules
                 )
@@ -67,11 +74,24 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
                     raise ValueError("config needs model.pretrained_model_name_or_path or model.config")
                 self.hf_config = model_cfg.to_dict() if isinstance(model_cfg, ConfigNode) else dict(model_cfg)
                 self.model = AutoModelForImageTextToText.from_config(self.hf_config, backend=self.backend)
+                self._check_pp_support()
                 shardings = self.rules.tree_sharding(self.model.logical_axes())
                 init_fn = jax.jit(lambda k: self.model.init(k, jnp.float32), out_shardings=shardings)
                 self.params = init_fn(self.rng.key("model_init"))
         n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
         logger.info("model: %s (%.1fM params)", type(self.model).__name__, n_params / 1e6)
+
+    def _check_pp_support(self):
+        """Fence BEFORE param init: under pp the sharding rules put the layer
+        axis on pp, which only makes sense for families whose text stack we
+        pipeline (vision-tower blocks of an unsupported family would otherwise
+        fail sharding-divisibility first with an opaque pjit error)."""
+        if self.mesh_ctx.pp > 1 and not hasattr(self.model, "merged_embeds"):
+            raise NotImplementedError(
+                "vlm + pp is wired for models exposing merged_embeds over a dense "
+                "text stack (LLaVA lineage); mrope/deepstack families interleave "
+                "vision state into the layer stream and are not pipelined yet"
+            )
 
     def _build_peft(self):
         # freeze split (reference freeze_config, vlm/finetune.py:86-113)
@@ -210,7 +230,7 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
 
     def _build_train_step(self):
         if self.mesh_ctx.pp > 1:
-            raise NotImplementedError("vlm + pp composition is not wired yet")
+            return self._build_pp_train_step()
         if self.peft is not None:
             from automodel_tpu.peft.lora import merge_lora_params
 
@@ -226,6 +246,69 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
                 )
 
         step = make_train_step(split_loss, self.optimizer, with_frozen=True)
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _build_pp_train_step(self):
+        """vlm x pp (reference pipelines the wrapped VLM module the same way,
+        infrastructure.py:303): the vision tower + embed merge run per microbatch
+        in plain GSPMD (lax.map — one microbatch's vision activations at a
+        time), the TEXT layer stack pipelines over pp via the shared dense
+        hidden-states pipeline, and the head+CE close outside the manual region.
+        Wired for families exposing ``merged_embeds`` over a standard dense text
+        stack (LLaVA lineage); mrope/deepstack families (qwen-vl, kimi, omni)
+        interleave vision state into the layer stream and stay fenced."""
+        from automodel_tpu.parallel.pipeline import (
+            _make_head_loss, make_dense_decoder_pp_hidden,
+        )
+        from automodel_tpu.training.train_step import make_pp_train_step
+
+        model = self.model
+        self._check_pp_support()
+        cfg_t = model.config.text
+        backend = model.backend
+        dtype = backend.jnp_dtype
+        virtual = int(self.cfg.get("distributed.pp_virtual_stages", 1))
+        hidden_fn = make_dense_decoder_pp_hidden(
+            cfg_t, backend, self.mesh, circular_repeats=virtual
+        )
+        # honors loss_name (linear_ce for big-vocab VLMs — the scale pp exists
+        # for); additive per-microbatch contract, divided by n below
+        head_loss = _make_head_loss(cfg_t, dtype, self.loss_name)
+
+        def pp_core(full, batch_stack, n):
+            lm = full["language_model"]
+
+            def embed_mb(mb):
+                return model.merged_embeds(full, mb["input_ids"], mb.get("pixel_values"))
+
+            embed_keys = {
+                k: batch_stack[k] for k in ("input_ids", "pixel_values")
+                if k in batch_stack
+            }
+            x_stack = {
+                "h": jax.lax.map(embed_mb, embed_keys),
+                "positions": batch_stack["positions"],
+                "segment_ids": batch_stack["segment_ids"],
+            }
+            h_stack = hidden_fn(lm["layers"], x_stack)
+            losses = jax.lax.map(
+                lambda args: head_loss(lm, {"h": args[0]}, {"labels": args[1]}),
+                (h_stack, batch_stack["labels"]),
+            )
+            return losses.sum() / n
+
+        if self.peft is not None:
+            from automodel_tpu.peft.lora import merge_lora_params
+
+            def split_loss(lora, frozen, batch_stack, n):
+                merged = merge_lora_params(frozen["lora_base"], lora, self.peft)
+                return pp_core({**frozen["frozen"], **merged}, batch_stack, n)
+        else:
+            def split_loss(trainable, frozen, batch_stack, n):
+                return pp_core({**frozen["frozen"], **trainable}, batch_stack, n)
+
+        step = make_pp_train_step(split_loss, self.optimizer, with_frozen=True,
+                                  guard_nonfinite=self._check_nan_grads)
         return jax.jit(step, donate_argnums=(0, 1))
 
     @property

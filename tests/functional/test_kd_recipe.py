@@ -153,7 +153,10 @@ def test_kd_peft_adapter_trains(tmp_path, cpu_devices):
     np.testing.assert_array_equal(np.asarray(recipe.params["layers"]["wq"]), base_before)
 
 
-def test_kd_pp_is_an_explicit_error(tmp_path, cpu_devices):
+def test_kd_pp_matches_unpipelined_trajectory(tmp_path, cpu_devices):
+    """kd x pp (a round-2 fence): the student pipelines to hidden states, the
+    student head + teacher forward + blended loss close outside the manual
+    region — the pp=2 trajectory must reproduce the unpipelined one exactly."""
     student = """
         architectures: [LlamaForCausalLM]
         vocab_size: 128
@@ -164,31 +167,42 @@ def test_kd_pp_is_an_explicit_error(tmp_path, cpu_devices):
         num_key_value_heads: 2
         max_position_embeddings: 128
     """
-    cfg_text = f"""
-    seed: 7
-    output_dir: {tmp_path}/out
-    model:
-      config:
-{textwrap.indent(textwrap.dedent(student), "        ")}
-    teacher_model:
-      config:
-{textwrap.indent(textwrap.dedent(student), "        ")}
-    distributed: {{dp_shard: 2, tp: 2, pp: 2}}
-    backend: {{dtype: float32}}
-    dataset:
-      _target_: automodel_tpu.data.llm.mock.MockSFTDataset
-      vocab_size: 128
-      seq_len: 32
-      num_samples: 64
-    micro_batch_size: 8
-    seq_len: 32
-    step_scheduler: {{grad_acc_steps: 2, max_steps: 2, handle_sigterm: false}}
-    optimizer: {{lr: 1.0e-3}}
-    checkpoint: {{enabled: false}}
-    """
-    import pytest
 
-    p = tmp_path / "cfg.yaml"
-    p.write_text(textwrap.dedent(cfg_text))
-    with pytest.raises(NotImplementedError, match="kd \\+ pp"):
-        KnowledgeDistillationRecipe(load_config(p)).setup()
+    def run(tag, dist):
+        cfg_text = f"""
+        seed: 7
+        output_dir: {tmp_path}/{tag}
+        model:
+          config:
+{textwrap.indent(textwrap.dedent(student), "            ")}
+        teacher_model:
+          config:
+{textwrap.indent(textwrap.dedent(student), "            ")}
+        distributed: {dist}
+        backend: {{dtype: float32}}
+        kd: {{temperature: 2.0, kd_ratio: 0.5}}
+        dataset:
+          _target_: automodel_tpu.data.llm.mock.MockSFTDataset
+          vocab_size: 128
+          seq_len: 32
+          num_samples: 128
+          seed: 0
+          pattern: arith
+        micro_batch_size: 8
+        seq_len: 32
+        step_scheduler: {{grad_acc_steps: 2, max_steps: 6, handle_sigterm: false}}
+        optimizer: {{lr: 1.0e-2, weight_decay: 0.0, max_grad_norm: 1.0}}
+        lr_scheduler: {{lr_warmup_steps: 2}}
+        checkpoint: {{enabled: false}}
+        """
+        p = tmp_path / f"cfg_{tag}.yaml"
+        p.write_text(textwrap.dedent(cfg_text))
+        r = KnowledgeDistillationRecipe(load_config(p))
+        r.setup()
+        r.run_train_validation_loop()
+        return [json.loads(l)["loss"] for l in open(tmp_path / tag / "training.jsonl")]
+
+    ref = run("kd_pp1", "{dp_shard: 4, tp: 2}")
+    got = run("kd_pp2", "{dp_shard: 2, tp: 2, pp: 2}")
+    assert np.isfinite(ref).all() and ref[-1] < ref[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-4)

@@ -162,8 +162,9 @@ class TestPeftRecipeE2E:
 
 
 class TestCompositions:
-    """The reference composes peft/kd/pp freely (infrastructure.py:303); round-1
-    fences reduced to qat+pp / qat+peft / kd+pp, each an explicit error."""
+    """The reference composes peft/qat/kd/pp through one sequencing path
+    (infrastructure.py:303); every former fence now has a bit-exact
+    pipelined-vs-unpipelined trajectory test."""
 
     def test_peft_pp_matches_unpipelined_trajectory(self, tmp_path, cpu_devices):
         """peft + pp gradient correctness: the pp=2 LoRA training trajectory must
@@ -193,19 +194,81 @@ class TestCompositions:
         got = run("pp2", "dp_shard: 2\n  tp: 2\n  pp: 2")
         np.testing.assert_allclose(got, ref, rtol=1e-4)
 
-    def test_qat_pp_is_an_explicit_error(self, tmp_path, cpu_devices):
-        cfg_text = _write_cfg(tmp_path).read_text()
-        cfg_text = cfg_text.replace("peft:\n  dim: 8\n  alpha: 32", "qat:\n  weight_bits: 8")
-        cfg_text = cfg_text.replace("dp_shard: 4\n  tp: 2", "dp_shard: 2\n  tp: 2\n  pp: 2")
-        p = tmp_path / "cfg_qatpp.yaml"
-        p.write_text(cfg_text)
-        r = TrainFinetuneRecipeForNextTokenPrediction(load_config(str(p)))
-        with pytest.raises(NotImplementedError, match="qat \\+ pp"):
+    def test_qat_pp_matches_unpipelined_trajectory(self, tmp_path, cpu_devices):
+        """qat x pp (a round-2 fence): fake-quant is a param-level transform
+        applied before the manual region, so the pp=2 trajectory must reproduce
+        the unpipelined one step for step."""
+        def run(tag, dist):
+            cfg_text = _write_cfg(tmp_path, max_steps=6, lr="1.0e-2").read_text()
+            cfg_text = cfg_text.replace("peft:\n  dim: 8\n  alpha: 32",
+                                        "qat:\n  weight_bits: 8")
+            cfg_text = cfg_text.replace("dp_shard: 4\n  tp: 2", dist)
+            cfg_text = cfg_text.replace(f"output_dir: {tmp_path}/out",
+                                        f"output_dir: {tmp_path}/{tag}")
+            p = tmp_path / f"cfg_{tag}.yaml"
+            p.write_text(cfg_text)
+            r = TrainFinetuneRecipeForNextTokenPrediction(load_config(str(p)))
             r.setup()
+            assert r.cfg.get("qat") is not None
+            r.run_train_validation_loop()
+            return [row["loss"] for row in _read_jsonl(tmp_path / tag / "training.jsonl")]
 
-    def test_qat_peft_is_an_explicit_error(self, tmp_path, cpu_devices):
-        cfg = load_config(_write_cfg(tmp_path, peft_extra="dim: 4"))
+        ref = run("qat_pp1", "dp_shard: 4\n  tp: 2")
+        got = run("qat_pp2", "dp_shard: 2\n  tp: 2\n  pp: 2")
+        assert ref[-1] < ref[0]
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_qat_peft_composes_and_matches_pipelined(self, tmp_path, cpu_devices):
+        """qat x peft (and x pp — the full stack of round-2 fences): the adapter
+        trains in full precision over a fake-quantized base; pp=2 must match the
+        unpipelined trajectory exactly."""
+
+        def run(tag, dist):
+            cfg_text = _write_cfg(
+                tmp_path, max_steps=6, lr="5.0e-3",
+                peft_extra="match_all_linear: true",
+            ).read_text()
+            cfg_text = cfg_text.replace("backend:", "qat:\n  weight_bits: 8\nbackend:")
+            cfg_text = cfg_text.replace("dp_shard: 4\n  tp: 2", dist)
+            cfg_text = cfg_text.replace(f"output_dir: {tmp_path}/out",
+                                        f"output_dir: {tmp_path}/{tag}")
+            p = tmp_path / f"cfg_{tag}.yaml"
+            p.write_text(cfg_text)
+            r = TrainFinetuneRecipeForNextTokenPrediction(load_config(str(p)))
+            r.setup()
+            assert r.peft is not None and r.cfg.get("qat") is not None
+            r.run_train_validation_loop()
+            return [row["loss"] for row in _read_jsonl(tmp_path / tag / "training.jsonl")]
+
+        ref = run("qp_pp1", "dp_shard: 4\n  tp: 2")
+        got = run("qp_pp2", "dp_shard: 2\n  tp: 2\n  pp: 2")
+        assert np.isfinite(ref).all()
+        assert ref[-1] < ref[0] + 0.1  # quantization noise: not destabilized
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_qat_peft_quantizes_base_not_adapter(self, tmp_path, cpu_devices):
+        """Semantic pin: the qat x peft step-0 loss equals CE on
+        merge(fake_quant(base), adapter) — quantized base, full-precision
+        adapter (reference QLoRA-style QAT semantics)."""
+        cfg = load_config(_write_cfg(tmp_path, max_steps=1, peft_extra="match_all_linear: true"))
         cfg["qat"] = {"weight_bits": 8}
         r = TrainFinetuneRecipeForNextTokenPrediction(cfg)
-        with pytest.raises(NotImplementedError, match="qat \\+ peft"):
-            r.setup()
+        r.setup()
+        import jax
+
+        from automodel_tpu.peft.lora import merge_lora_params
+
+        mb = next(iter(r.dataloader))
+        n = int((np.asarray(mb["labels"]) != -100).sum())
+        qfn = r._qat_param_fn()
+        merged_q = merge_lora_params(qfn(r.params), r.train_params, r.peft)
+        want = float(r._forward_loss(merged_q, jax.tree.map(np.asarray, mb), n))
+        merged_plain = merge_lora_params(r.params, r.train_params, r.peft)
+        plain = float(r._forward_loss(merged_plain, jax.tree.map(np.asarray, mb), n))
+        assert want != plain  # quantization must actually bite
+        # the compiled step must see the quantized-base loss
+        got = r._train_step(
+            r.train_params, r.opt_state,
+            {k: np.asarray(v)[None] for k, v in mb.items()}, r.params,
+        )[2]["loss"]
+        np.testing.assert_allclose(float(got), want, rtol=2e-5)

@@ -195,3 +195,54 @@ def test_qwen3_vl_finetune_with_lora(tmp_path, cpu_devices):
 
     losses = [json.loads(l)["loss"] for l in open(tmp_path / "out" / "training.jsonl")]
     assert losses[-1] < losses[0] - 0.2, f"lora+vlm loss must fall: {losses}"
+
+
+def test_vlm_pp_matches_unpipelined_trajectory(tmp_path, cpu_devices):
+    """vlm x pp (a round-2 fence): the vision tower + embed merge run per
+    microbatch outside the manual region, the text stack pipelines — the pp=2
+    trajectory must reproduce the unpipelined one exactly (LLaVA lineage)."""
+
+    def run(tag, dist):
+        p = _write_cfg(tmp_path, max_steps=6)
+        text = p.read_text().replace("dp_shard: 8", dist)
+        text = text.replace(f"output_dir: {tmp_path}/out", f"output_dir: {tmp_path}/{tag}")
+        text = text.replace("grad_acc_steps: 1", "grad_acc_steps: 2")
+        pt = tmp_path / f"cfg_{tag}.yaml"
+        pt.write_text(text)
+        r = FinetuneRecipeForVLM(load_config(pt))
+        r.setup()
+        r.run_train_validation_loop()
+        return [json.loads(l)["loss"] for l in open(tmp_path / tag / "training.jsonl")]
+
+    ref = run("vlm_pp1", "dp_shard: 8")
+    got = run("vlm_pp2", "dp_shard: 4\n  pp: 2")
+    assert np.isfinite(ref).all() and ref[-1] < ref[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_vlm_pp_mrope_family_fence_is_precise(tmp_path, cpu_devices):
+    """qwen-vl (mrope/deepstack) under pp raises the narrowed fence, naming why."""
+    import pytest
+
+    p = _write_cfg(tmp_path, max_steps=2)
+    text = p.read_text().replace("dp_shard: 8", "dp_shard: 4\n  pp: 2")
+    text = text.replace("architectures: [LlavaForConditionalGeneration]",
+                        "architectures: [Qwen3VLMoeForConditionalGeneration]")
+    text = text.replace("image_token_index: 2000",
+                        "image_token_index: 2000\n    vision_start_token_id: 2001")
+    text = text.replace("""    text_config:
+      vocab_size: 2048
+      hidden_size: 48
+      intermediate_size: 96""", """    text_config:
+      vocab_size: 2048
+      hidden_size: 48
+      intermediate_size: 96
+      moe_intermediate_size: 32
+      head_dim: 16
+      num_experts: 4
+      num_experts_per_tok: 2""")
+    pt = tmp_path / "cfg_fence.yaml"
+    pt.write_text(text)
+    r = FinetuneRecipeForVLM(load_config(pt))
+    with pytest.raises(NotImplementedError, match="mrope/deepstack|merged_embeds"):
+        r.setup()
