@@ -29,7 +29,7 @@ from automodel_tpu.data.vlm.collate import IMAGE_PLACEHOLDER, _MEAN, _STD
 
 __all__ = [
     "qwen_patchify", "qwen_vl_collate", "kimi_patchify", "kimi_vl_collate",
-    "qwen3_omni_collate", "log_mel_spectrogram", "AUDIO_PLACEHOLDER",
+    "qwen3_omni_collate", "phi4_mm_collate", "log_mel_spectrogram", "AUDIO_PLACEHOLDER",
 ]
 
 AUDIO_PLACEHOLDER = "<audio>"
@@ -461,4 +461,72 @@ def qwen3_omni_collate(
         }
     if patches or audio_inputs is not None:
         batch["positions3"] = np.asarray(model.get_mrope_positions(input_ids, grids))
+    return batch
+
+
+def phi4_mm_collate(
+    examples: Sequence[Mapping[str, Any]],
+    tokenizer,
+    seq_len: int,
+    pad_token_id: int = 0,
+    *,
+    audio_token_id: int,
+    num_mel_bins: int = 80,
+    compression_rate: int = 8,
+    qformer_compression_rate: int = 1,
+) -> dict[str, np.ndarray]:
+    """Phi-4-multimodal audio collate (reference collate_fns.py:148 phi4_mm path).
+
+    The reference hands text+audio to the HF Phi4MM processor; here the audio is
+    featurized host-side (``log_mel_spectrogram``) and each ``<audio>``
+    placeholder expands to the number of post-encoder embedding slots HF's
+    ``_compute_audio_embed_size`` would produce: mel frames compressed by
+    ``compression_rate`` then ``qformer_compression_rate`` (both ceil-divided).
+    Examples carry "audio" (16kHz waveform) or "audio_features" ((mel, T)), plus
+    the prompt/answer or messages text keys shared with the other collators.
+
+    Returns input_ids/labels/positions/segment_ids plus ``audio_features``
+    (clips, mel, T_max), ``audio_frames`` (true frame counts), and the
+    placeholder coordinates (``audio_coords_b/s``) for embedding merge.
+    """
+    per_ex_feats: list[list[np.ndarray]] = []
+    for ex in examples:
+        feats = []
+        if "audio_features" in ex:
+            feats.append(np.asarray(ex["audio_features"], np.float32))
+        elif "audio" in ex:
+            feats.append(log_mel_spectrogram(ex["audio"], num_mel_bins=num_mel_bins))
+        per_ex_feats.append(feats)
+    _check_uniform_media([len(f) for f in per_ex_feats], "audio clips")
+
+    def _n_tokens(mel: np.ndarray) -> int:
+        t = -(-mel.shape[1] // compression_rate)
+        return -(-t // qformer_compression_rate)
+
+    per_ex_spans = [
+        {AUDIO_PLACEHOLDER: [[audio_token_id] * _n_tokens(f) for f in feats]}
+        for feats in per_ex_feats
+    ]
+    input_ids, labels, positions, segment_ids = _text_batch(
+        examples, tokenizer, seq_len, pad_token_id, per_ex_spans
+    )
+    batch = {
+        "input_ids": input_ids,
+        "labels": labels,
+        "positions": positions,
+        "segment_ids": segment_ids,
+    }
+    all_feats = [f for feats in per_ex_feats for f in feats]
+    if all_feats:
+        t_max = max(f.shape[1] for f in all_feats)
+        padded = np.zeros((len(all_feats), num_mel_bins, t_max), np.float32)
+        for i, f in enumerate(all_feats):
+            padded[i, :, : f.shape[1]] = f
+        ab, as_ = np.nonzero(input_ids == audio_token_id)
+        batch |= {
+            "audio_features": padded,
+            "audio_frames": np.asarray([f.shape[1] for f in all_feats], np.int32),
+            "audio_coords_b": ab.astype(np.int32),
+            "audio_coords_s": as_.astype(np.int32),
+        }
     return batch

@@ -63,7 +63,10 @@ class BackendConfig:
     # jax.lax.ragged_dot lowers to XLA's native ragged matmul (the megablocks/gmm
     # equivalent); a hand-written Pallas grouped GEMM would duplicate it.
     experts_backend: str = "ragged_dot"  # "ragged_dot" | "dense"
-    dispatcher: str = "dense"  # "dense" (one-hot matmul) | "a2a" (EP all_to_all)
+    dispatcher: str = "dense"  # "dense" (GSPMD ragged/one-hot) | "a2a" (EP all_to_all)
+    # a2a only: per-destination-rank send capacity = ep_capacity_factor * T * K / ep.
+    # Overflow copies are dropped AND reported (stats["dropped_token_frac"]).
+    ep_capacity_factor: float = 1.5
     fake_balanced_gate: bool = False  # benchmark mode: uniform routing, no gate math
     fake_gate_noise: float = 0.0
 

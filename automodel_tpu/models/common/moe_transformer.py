@@ -27,10 +27,10 @@ from automodel_tpu.models.common.transformer import (
     embed_lookup,
 )
 from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.dispatch import make_moe_block_forward
 from automodel_tpu.moe.layers import (
     cast_moe_compute_params,
     init_moe_params,
-    moe_forward,
     moe_logical_axes,
 )
 from automodel_tpu.ops.norms import rms_norm
@@ -172,7 +172,8 @@ def make_moe_layer_fns(
     Returns ``(dense_layer_fn, moe_layer_fn)`` over a carried state
     ``{"h", "positions", ["segment_ids"], ["token_mask"]}``:
     ``dense_layer_fn(state, (lp, is_sliding)) -> (state, None)``;
-    ``moe_layer_fn(state, (lp, is_sliding)) -> (state, (aux, load))``.
+    ``moe_layer_fn(state, (lp, is_sliding)) -> (state, (aux, load, dropped_frac))``
+    (``dropped_frac`` is a constant 0 unless ``backend.dispatcher == "a2a"``).
 
     ``attention_fn(lp, x, positions, segment_ids, is_sliding, rules) -> attn_out``
     overrides the default GQA block — the hook MLA-style families plug into (so the
@@ -240,6 +241,8 @@ def make_moe_layer_fns(
         state = dict(state, h=_constrain(h, rules, ("batch", "act_seq", "act_embed")))
         return state, kv_out
 
+    moe_block = make_moe_block_forward(cfg.moe, backend, rules, training=training)
+
     def moe_layer_fn(state, layer_inputs):
         lp, is_sliding, kv = _split(layer_inputs)
         moe_params = lp["moe"]
@@ -247,18 +250,12 @@ def make_moe_layer_fns(
         h, kv_out = attn(state, lp, is_sliding, kv)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
         moe_params = cast_moe_compute_params(moe_params, dtype)
-        y, aux, load = moe_forward(
-            cfg.moe, moe_params, x, state.get("token_mask"),
-            training=training,
-            dispatcher="capacity" if backend.experts_backend == "dense" else "ragged",
-            fake_balanced_gate=backend.fake_balanced_gate,
-            fake_gate_noise=backend.fake_gate_noise,
-        )
+        y, aux, load, dropped = moe_block(moe_params, x, state.get("token_mask"))
         h = h + y
         h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
         # decode (kv given) swaps the aux/load ys for the updated kv cache —
         # inference never consumes balance stats
-        ys = kv_out if kv is not None else (aux if emit_aux else jnp.float32(0), load)
+        ys = kv_out if kv is not None else (aux if emit_aux else jnp.float32(0), load, dropped)
         return dict(state, h=h), ys
 
     return dense_layer_fn, moe_layer_fn
@@ -279,8 +276,9 @@ def moe_decoder_forward(
     inputs_embeds: jnp.ndarray | None = None,  # (B, S, D) overrides the embed lookup (VLM merge)
     cache=None,  # generation.init_kv_cache dict -> returns (logits, cache)
 ) -> tuple[jnp.ndarray, dict[str, Any]]:
-    """Returns ``(logits_or_hidden, stats)``; stats has ``aux_loss`` (scalar or None)
-    and ``expert_load`` (num_moe_layers, E). With ``cache`` (decode path, GQA
+    """Returns ``(logits_or_hidden, stats)``; stats has ``aux_loss`` (scalar or None),
+    ``expert_load`` (num_moe_layers, E), and — under ``backend.dispatcher == "a2a"`` —
+    ``dropped_token_frac`` (mean over MoE layers). With ``cache`` (decode path, GQA
     stacks only) returns ``(logits, cache)`` instead."""
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
@@ -333,16 +331,20 @@ def moe_decoder_forward(
         v_new = jnp.concatenate([dv, mv], 0) if k_dense > 0 else mv
         cache = dict(cache, k=k_new, v=v_new)
     elif backend.scan_layers:
-        state, (auxs, loads) = jax.lax.scan(body, state, (params["moe_layers"], moe_sliding))
+        state, (auxs, loads, droppeds) = jax.lax.scan(
+            body, state, (params["moe_layers"], moe_sliding)
+        )
     else:
-        auxs, loads = [], []
+        auxs, loads, droppeds = [], [], []
         for i in range(cfg.num_moe_layers):
             lp = jax.tree.map(lambda a: a[i], params["moe_layers"])
-            state, (aux, load) = body(state, (lp, moe_sliding[i]))
+            state, (aux, load, dropped) = body(state, (lp, moe_sliding[i]))
             auxs.append(aux)
             loads.append(load)
+            droppeds.append(dropped)
         auxs = jnp.stack(auxs)
         loads = jnp.stack(loads)
+        droppeds = jnp.stack(droppeds)
 
     h = rms_norm(state["h"], params["final_norm"].astype(dtype), cfg.rms_norm_eps)
     if cache is not None:
@@ -359,6 +361,8 @@ def moe_decoder_forward(
         "aux_loss": auxs.sum() if emit_aux else None,
         "expert_load": loads,
     }
+    if backend.dispatcher == "a2a":
+        stats["dropped_token_frac"] = droppeds.mean()
     if return_hidden:
         return h, stats
     unembed = params.get("lm_head")

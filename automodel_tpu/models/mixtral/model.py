@@ -1,0 +1,109 @@
+"""Mixtral family (Mixtral-8x7B / 8x22B) — TPU-native.
+
+The reference serves Mixtral through its generic HF factory
+(_transformers/model_init.py:89); here it rides the shared MoE decoder stack:
+Mixtral is llama-lineage GQA attention (no qk-norm) + every-layer top-2 MoE.
+HF's "topk logits then softmax" routing is mathematically identical to
+"softmax all, topk, renormalize" (softmax is monotonic and the renormalized
+selected probabilities equal the softmax over the selected logits), which is
+the stack's softmax_before_topk + norm_topk_prob path — the full-softmax
+scores also feed the aux load-balancing loss exactly as HF's router does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.common.moe_transformer import (
+    MoEDecoderConfig,
+    init_moe_decoder_params,
+    moe_decoder_forward,
+    moe_decoder_logical_axes,
+)
+from automodel_tpu.moe.config import MoEConfig
+
+__all__ = ["MixtralConfig", "MixtralForCausalLM"]
+
+
+@dataclasses.dataclass
+class MixtralConfig(MoEDecoderConfig):
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "MixtralConfig":
+        moe = MoEConfig(
+            n_routed_experts=hf["num_local_experts"],
+            n_activated_experts=hf.get("num_experts_per_tok", 2),
+            dim=hf["hidden_size"],
+            moe_inter_dim=hf["intermediate_size"],
+            score_func="softmax",
+            softmax_before_topk=True,
+            norm_topk_prob=True,
+            aux_loss_coeff=hf.get("router_aux_loss_coef", 0.02),
+        )
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_hidden_layers=hf["num_hidden_layers"],
+            num_attention_heads=hf["num_attention_heads"],
+            num_key_value_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get("head_dim"),
+            max_position_embeddings=hf.get("max_position_embeddings", 32768),
+            rope_theta=hf.get("rope_theta", 1e6),
+            rope_scaling=hf.get("rope_scaling"),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            sliding_window=hf.get("sliding_window"),
+            initializer_range=hf.get("initializer_range", 0.02),
+            moe=moe,
+            first_k_dense_replace=0,
+        )
+
+
+class MixtralForCausalLM:
+    """Functional model: holds config + backend, operates on param pytrees."""
+
+    config_class = MixtralConfig
+    hf_architectures = ("MixtralForCausalLM",)
+
+    def __init__(self, config: MixtralConfig, backend: BackendConfig | None = None):
+        self.config = config
+        self.backend = backend or BackendConfig()
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> dict:
+        return init_moe_decoder_params(self.config, key, dtype)
+
+    def logical_axes(self) -> dict:
+        return moe_decoder_logical_axes(self.config)
+
+    def abstract_params(self, dtype=jnp.bfloat16) -> dict:
+        return jax.eval_shape(lambda k: self.init(k, dtype), jax.random.key(0))
+
+    def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
+                 rules=None, return_hidden=False, training=True, cache=None):
+        return moe_decoder_forward(
+            self.config, self.backend, params, input_ids,
+            positions=positions, segment_ids=segment_ids, token_mask=token_mask,
+            rules=rules, return_hidden=return_hidden, training=training, cache=cache,
+        )
+
+    def generate(self, params, input_ids, **kw):
+        """Sample with a KV cache (see :func:`automodel_tpu.generation.generate`)."""
+        from automodel_tpu.generation import generate
+
+        return generate(self, params, input_ids, **kw)
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.mixtral.state_dict_adapter import MixtralStateDictAdapter
+
+        return MixtralStateDictAdapter(self.config)
+
+    @classmethod
+    def from_config(cls, config, backend: BackendConfig | None = None):
+        if isinstance(config, dict):
+            config = MixtralConfig.from_hf(config)
+        return cls(config, backend)

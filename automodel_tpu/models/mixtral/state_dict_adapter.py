@@ -1,0 +1,48 @@
+"""Mixtral HF key/layout mapping.
+
+Same stacked-expert layout as the Qwen3-MoE adapter, but HF Mixtral names the MoE
+block ``block_sparse_moe`` and its expert projections w1 (gate) / w3 (up) / w2 (down)
+(transformers MixtralSparseMoeBlock).
+"""
+
+from __future__ import annotations
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.qwen3_moe.state_dict_adapter import (
+    _gate_up_in,
+    _gate_up_out,
+    _t,
+    attention_entries,
+)
+from automodel_tpu.models.common.moe_transformer import MoEDecoderConfig
+
+__all__ = ["MixtralStateDictAdapter"]
+
+
+class MixtralStateDictAdapter(MappingAdapter):
+    def __init__(self, cfg: MoEDecoderConfig, scan_layers: bool = True):
+        L = cfg.num_hidden_layers
+        pre = "model.layers.{i}.block_sparse_moe"
+        entries = [
+            Entry("model.embed_tokens.weight", "embed"),
+            Entry("model.norm.weight", "final_norm"),
+            *attention_entries(cfg, "moe_layers", layer_range=(0, L)),
+            Entry(f"{pre}.gate.weight", "moe_layers.moe.gate.weight", layer_range=(0, L)),
+            Entry(
+                (f"{pre}.experts.{{e}}.w1.weight", f"{pre}.experts.{{e}}.w3.weight"),
+                "moe_layers.moe.experts.gate_up_proj",
+                _gate_up_in,
+                _gate_up_out,
+                layer_range=(0, L),
+            ),
+            Entry(
+                f"{pre}.experts.{{e}}.w2.weight",
+                "moe_layers.moe.experts.down_proj",
+                _t,
+                _t,
+                layer_range=(0, L),
+            ),
+        ]
+        if not cfg.tie_word_embeddings:
+            entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
+        super().__init__(entries, L, scan_layers, num_experts=cfg.moe.n_routed_experts)

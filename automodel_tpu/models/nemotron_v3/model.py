@@ -29,7 +29,8 @@ import jax.numpy as jnp
 from automodel_tpu.models.common.backend import BackendConfig
 from automodel_tpu.models.common.transformer import _constrain
 from automodel_tpu.moe.config import MoEConfig
-from automodel_tpu.moe.layers import cast_moe_compute_params, init_moe_params, moe_forward, moe_logical_axes
+from automodel_tpu.moe.dispatch import make_moe_block_forward
+from automodel_tpu.moe.layers import cast_moe_compute_params, init_moe_params, moe_logical_axes
 from automodel_tpu.ops.attention import dot_product_attention
 from automodel_tpu.ops.gated_delta import causal_conv1d
 from automodel_tpu.ops.mamba2 import group_rms_norm_gated, mamba_chunk_scan, softplus_dt
@@ -356,21 +357,20 @@ class NemotronHForCausalLM:
                 out = out + lp["b_down"]
             return h + out, _zero_stats()
 
+        moe_fwd = (
+            make_moe_block_forward(cfg.moe, backend, rules, training=training)
+            if cfg.moe is not None else None
+        )
+
         def moe_block(lp, h):
             x = rms_norm(h, lp["norm"], eps).astype(dtype)
             moe_params = cast_moe_compute_params(lp["moe"], dtype)
-            y, aux, load = moe_forward(
-                cfg.moe, moe_params, x, token_mask,
-                training=training,
-                dispatcher="capacity" if backend.experts_backend == "dense" else "ragged",
-                fake_balanced_gate=backend.fake_balanced_gate,
-                fake_gate_noise=backend.fake_gate_noise,
-            )
-            return h + y, (jnp.float32(0) if aux is None else aux, load)
+            y, aux, load, dropped = moe_fwd(moe_params, x, token_mask)
+            return h + y, (jnp.float32(0) if aux is None else aux, load, dropped)
 
         def _zero_stats():
             E = cfg.moe.n_routed_experts if cfg.moe else 1
-            return jnp.float32(0), jnp.zeros((E,), jnp.float32)
+            return jnp.float32(0), jnp.zeros((E,), jnp.float32), jnp.float32(0)
 
         block_fns = {"mamba": mamba_block, "attention": attn_block, "mlp": mlp_block, "moe": moe_block}
 
@@ -382,7 +382,7 @@ class NemotronHForCausalLM:
         h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
 
         offsets = dict.fromkeys(BLOCK_TYPES, 0)
-        auxs, loads, load_is_moe = [], [], []
+        auxs, loads, droppeds, load_is_moe = [], [], [], []
         for t, n in cfg.runs:
             stream = params[_STREAM_KEY[t]]
             o = offsets[t]
@@ -402,19 +402,22 @@ class NemotronHForCausalLM:
 
             body = backend.layer_remat(body)
             if backend.scan_layers and n > 1:
-                h, (aux_r, load_r) = jax.lax.scan(body, h, run_params)
+                h, (aux_r, load_r, drop_r) = jax.lax.scan(body, h, run_params)
                 auxs.append(aux_r)
                 loads.append(load_r)
+                droppeds.append(drop_r)
             else:
                 for i in range(n):
                     lp = jax.tree.map(lambda a: a[i], run_params)
-                    h, (aux, load) = body(h, lp)
+                    h, (aux, load, dropped) = body(h, lp)
                     auxs.append(aux[None])
                     loads.append(load[None])
+                    droppeds.append(dropped[None])
             load_is_moe += [t == "moe"] * n
 
         aux_all = jnp.concatenate(auxs)
         load_all = jnp.concatenate(loads)
+        drop_all = jnp.concatenate(droppeds)
         moe_sel = np.asarray(load_is_moe, bool)  # static layer pattern: concrete mask
         emit_aux = (
             cfg.moe is not None and cfg.moe.aux_loss_coeff > 0 and training
@@ -424,6 +427,8 @@ class NemotronHForCausalLM:
             "aux_loss": aux_all.sum() if emit_aux else None,
             "expert_load": load_all[moe_sel] if cfg.moe is not None else load_all[:0],
         }
+        if backend.dispatcher == "a2a" and cfg.moe is not None:
+            stats["dropped_token_frac"] = drop_all[moe_sel].mean()
 
         h = rms_norm(h, params["final_norm"].astype(dtype), eps)
         if return_hidden:

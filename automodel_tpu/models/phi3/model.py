@@ -1,0 +1,70 @@
+"""Phi-3 / Phi-3.5 / Phi-4 family — TPU-native.
+
+The reference serves Phi through its generic HF factory
+(_transformers/model_init.py:89). Architecturally Phi-3 IS the llama decoder —
+silu-gated MLP, GQA rotate-half rope, RMSNorm — with three packaging deltas:
+fused qkv_proj / gate_up_proj checkpoint tensors (split/merged in the adapter),
+all-layer sliding-window attention, and "longrope" scaling (ops/rope.py) for the
+128k variants. So the family rides LlamaForCausalLM directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from automodel_tpu.models.llama.model import LlamaConfig, LlamaForCausalLM
+
+__all__ = ["Phi3Config", "Phi3ForCausalLM"]
+
+
+@dataclasses.dataclass
+class Phi3Config(LlamaConfig):
+    @classmethod
+    def from_hf(cls, hf: dict[str, Any]) -> "Phi3Config":
+        rope_scaling = hf.get("rope_scaling")
+        if rope_scaling:
+            # longrope reads the original/current windows (both top-level Phi-3
+            # config keys) to pick factors and the attention scale
+            rope_scaling = dict(
+                rope_scaling,
+                original_max_position_embeddings=hf.get(
+                    "original_max_position_embeddings",
+                    hf.get("max_position_embeddings", 4096),
+                ),
+                max_position_embeddings=hf.get("max_position_embeddings", 4096),
+            )
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_hidden_layers=hf["num_hidden_layers"],
+            num_attention_heads=hf["num_attention_heads"],
+            num_key_value_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get("head_dim"),
+            max_position_embeddings=hf.get("max_position_embeddings", 4096),
+            original_max_position_embeddings=hf.get("original_max_position_embeddings"),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rope_scaling=rope_scaling,
+            partial_rotary_factor=hf.get("partial_rotary_factor", 1.0),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            sliding_window=hf.get("sliding_window"),
+            initializer_range=hf.get("initializer_range", 0.02),
+        )
+
+
+class Phi3ForCausalLM(LlamaForCausalLM):
+    config_class = Phi3Config
+    hf_architectures = ("Phi3ForCausalLM", "Phi4MMForCausalLM")
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.phi3.state_dict_adapter import Phi3StateDictAdapter
+
+        return Phi3StateDictAdapter(self.config, scan_layers=self.backend.scan_layers)
+
+    @classmethod
+    def from_config(cls, config, backend=None):
+        if isinstance(config, dict):
+            config = Phi3Config.from_hf(config)
+        return cls(config, backend)

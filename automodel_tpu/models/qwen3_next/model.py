@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from automodel_tpu.models.common.backend import BackendConfig
 from automodel_tpu.models.common.transformer import _constrain
 from automodel_tpu.moe.config import MoEConfig
-from automodel_tpu.moe.layers import cast_moe_compute_params, init_moe_params, moe_forward, moe_logical_axes
+from automodel_tpu.moe.dispatch import make_moe_block_forward
+from automodel_tpu.moe.layers import cast_moe_compute_params, init_moe_params, moe_logical_axes
 from automodel_tpu.ops.attention import dot_product_attention
 from automodel_tpu.ops.gated_delta import causal_conv1d, chunk_gated_delta_rule, gated_rms_norm
 from automodel_tpu.ops.norms import rms_norm
@@ -315,18 +316,14 @@ class Qwen3NextForCausalLM:
         )
         attn_scale = rope_attention_scaling(cfg.rope_scaling)
 
+        moe_fwd = make_moe_block_forward(cfg.moe, backend, rules, training=training)
+
         def moe_block(lp, h):
             x = rms_norm(h, lp["mlp_norm"].astype(dtype), cfg.rms_norm_eps, offset=1.0)
             moe_params = cast_moe_compute_params(lp["moe"], dtype)
-            y, aux, load = moe_forward(
-                cfg.moe, moe_params, x, token_mask,
-                training=training,
-                dispatcher="capacity" if backend.experts_backend == "dense" else "ragged",
-                fake_balanced_gate=backend.fake_balanced_gate,
-                fake_gate_noise=backend.fake_gate_noise,
-            )
+            y, aux, load, dropped = moe_fwd(moe_params, x, token_mask)
             h = _constrain(h + y, rules, ("batch", "act_seq", "act_embed"))
-            return h, (aux if emit_aux else jnp.float32(0), load)
+            return h, (aux if emit_aux else jnp.float32(0), load, dropped)
 
         def linear_block(lp, h):
             x = rms_norm(h, lp["attn_norm"].astype(dtype), cfg.rms_norm_eps, offset=1.0)
@@ -357,37 +354,38 @@ class Qwen3NextForCausalLM:
 
             def group_body(h, lp_group):
                 gl, gf = lp_group
-                auxs, loads = [], []
+                ys = []
                 for j in range(P - 1):
-                    h, (aux, load) = linear_block(jax.tree.map(lambda a: a[j], gl), h)
-                    auxs.append(aux)
-                    loads.append(load)
-                h, (aux, load) = full_block(gf, h)
-                auxs.append(aux)
-                loads.append(load)
-                return h, (jnp.stack(auxs), jnp.stack(loads))
+                    h, y = linear_block(jax.tree.map(lambda a: a[j], gl), h)
+                    ys.append(y)
+                h, y = full_block(gf, h)
+                ys.append(y)
+                return h, jax.tree.map(lambda *a: jnp.stack(a), *ys)
 
-            h, (auxs, loads) = jax.lax.scan(backend.layer_remat(group_body), h, (glin, gfull))
+            h, (auxs, loads, droppeds) = jax.lax.scan(
+                backend.layer_remat(group_body), h, (glin, gfull)
+            )
             auxs = auxs.reshape(-1)
             loads = loads.reshape(-1, *loads.shape[2:])
+            droppeds = droppeds.reshape(-1)
         else:
             lin_i, full_i = 0, 0
-            auxs, loads = [], []
+            ys = []
             for t in cfg.layer_types:
                 if t == LINEAR:
                     lp = jax.tree.map(lambda a: a[lin_i], lin_params)
-                    h, (aux, load) = backend.layer_remat(linear_block)(lp, h)
+                    h, y = backend.layer_remat(linear_block)(lp, h)
                     lin_i += 1
                 else:
                     lp = jax.tree.map(lambda a: a[full_i], full_params)
-                    h, (aux, load) = backend.layer_remat(full_block)(lp, h)
+                    h, y = backend.layer_remat(full_block)(lp, h)
                     full_i += 1
-                auxs.append(aux)
-                loads.append(load)
-            auxs = jnp.stack(auxs)
-            loads = jnp.stack(loads)
+                ys.append(y)
+            auxs, loads, droppeds = (jnp.stack(a) for a in zip(*ys))
 
         stats = {"aux_loss": auxs.sum() if emit_aux else None, "expert_load": loads}
+        if backend.dispatcher == "a2a":
+            stats["dropped_token_frac"] = droppeds.mean()
 
         h = rms_norm(h, params["final_norm"].astype(dtype), cfg.rms_norm_eps, offset=1.0)
         if return_hidden:

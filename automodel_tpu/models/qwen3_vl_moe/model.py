@@ -273,31 +273,36 @@ class Qwen3VLMoeForConditionalGeneration:
 
         sliding = jnp.zeros((cfg.num_hidden_layers,), jnp.int32)
         n_ds = 0 if ds is None else ds.shape[0]
-        auxs, loads = [], []
+        auxs, loads, droppeds = [], [], []
         # deepstack: unrolled first n_ds layers, each followed by a visual-feature add
         for i in range(n_ds):
             lp = jax.tree.map(lambda a: a[i], params["moe_layers"])
-            state, (aux, load) = body(state, (lp, sliding[i]))
+            state, (aux, load, dropped) = body(state, (lp, sliding[i]))
             b_idx, s_idx = visual_coords
             state["h"] = state["h"].at[b_idx, s_idx].add(ds[i].astype(dtype))
             auxs.append(aux)
             loads.append(load)
+            droppeds.append(dropped)
         rest = jax.tree.map(lambda a: a[n_ds:], params["moe_layers"])
         if backend.scan_layers:
-            state, (aux_s, load_s) = jax.lax.scan(body, state, (rest, sliding[n_ds:]))
+            state, (aux_s, load_s, drop_s) = jax.lax.scan(body, state, (rest, sliding[n_ds:]))
         else:
-            aux_l, load_l = [], []
+            aux_l, load_l, drop_l = [], [], []
             for i in range(cfg.num_hidden_layers - n_ds):
                 lp = jax.tree.map(lambda a: a[i], rest)
-                state, (aux, load) = body(state, (lp, sliding[n_ds + i]))
+                state, (aux, load, dropped) = body(state, (lp, sliding[n_ds + i]))
                 aux_l.append(aux)
                 load_l.append(load)
-            aux_s, load_s = jnp.stack(aux_l), jnp.stack(load_l)
+                drop_l.append(dropped)
+            aux_s, load_s, drop_s = jnp.stack(aux_l), jnp.stack(load_l), jnp.stack(drop_l)
         if auxs:
             aux_s = jnp.concatenate([jnp.stack(auxs), aux_s])
             load_s = jnp.concatenate([jnp.stack(loads), load_s])
+            drop_s = jnp.concatenate([jnp.stack(droppeds), drop_s])
 
         stats = {"aux_loss": aux_s.sum() if emit_aux else None, "expert_load": load_s}
+        if backend.dispatcher == "a2a":
+            stats["dropped_token_frac"] = drop_s.mean()
 
         h = rms_norm(state["h"], params["final_norm"].astype(dtype), cfg.rms_norm_eps)
         if return_hidden:

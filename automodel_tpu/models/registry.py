@@ -16,6 +16,9 @@ MODEL_REGISTRY: dict[str, str] = {
     "Qwen2ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
     "Qwen3ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
     "MistralForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
+    "MixtralForCausalLM": "automodel_tpu.models.mixtral.model:MixtralForCausalLM",
+    # Phi-3 lineage is llama-shaped with fused checkpoint tensors + longrope
+    "Phi3ForCausalLM": "automodel_tpu.models.phi3.model:Phi3ForCausalLM",
     "Ministral3ForCausalLM": "automodel_tpu.models.mistral3.model:Ministral3ForCausalLM",
     "Qwen3MoeForCausalLM": "automodel_tpu.models.qwen3_moe.model:Qwen3MoeForCausalLM",
     "GptOssForCausalLM": "automodel_tpu.models.gpt_oss.model:GptOssForCausalLM",
@@ -53,8 +56,19 @@ def register_model(architecture: str, target: str) -> None:
 def resolve_model_class(architecture: str):
     target = MODEL_REGISTRY.get(architecture)
     if target is None:
+        import difflib
+
+        near = difflib.get_close_matches(architecture, MODEL_REGISTRY, n=3, cutoff=0.5)
+        hint = (
+            f" Closest supported: {near} — if the architecture is a config-level "
+            "variant of one of these, register an alias with "
+            "automodel_tpu.models.registry.register_model(arch, target)."
+            if near
+            else ""
+        )
         raise KeyError(
-            f"architecture {architecture!r} is not supported; known: {sorted(MODEL_REGISTRY)}"
+            f"architecture {architecture!r} is not supported; known: "
+            f"{sorted(MODEL_REGISTRY)}.{hint}"
         )
     mod_name, cls_name = target.split(":")
     return getattr(importlib.import_module(mod_name), cls_name)

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 from automodel_tpu.models.common.backend import BackendConfig
 from automodel_tpu.models.common.transformer import _constrain
 from automodel_tpu.moe.config import MoEConfig
-from automodel_tpu.moe.layers import cast_moe_compute_params, init_moe_params, moe_forward, moe_logical_axes
+from automodel_tpu.moe.dispatch import make_moe_block_forward
+from automodel_tpu.moe.layers import cast_moe_compute_params, init_moe_params, moe_logical_axes
 from automodel_tpu.ops.attention import dot_product_attention
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rope import apply_rope_angles, rope_frequencies
@@ -289,6 +290,10 @@ class Step3p5ForCausalLM:
             cfg.moe is not None and cfg.moe.aux_loss_coeff > 0 and training
             and not backend.fake_balanced_gate
         )
+        moe_fwd = (
+            make_moe_block_forward(cfg.moe, backend, rules, training=training)
+            if cfg.moe is not None else None
+        )
 
         # per-distinct-rope-meta angle tables, computed once
         angle_cache: dict = {}
@@ -333,19 +338,17 @@ class Step3p5ForCausalLM:
                 x = rms_norm(h, lp["mlp_norm"], eps, offset=1.0)
                 if fkind == "mlp":
                     h = h + _clamped_swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"], limit)
-                    stats = (jnp.float32(0), jnp.zeros((cfg.moe.n_routed_experts if cfg.moe else 1,), jnp.float32))
+                    stats = (
+                        jnp.float32(0),
+                        jnp.zeros((cfg.moe.n_routed_experts if cfg.moe else 1,), jnp.float32),
+                        jnp.float32(0),
+                    )
                 else:
                     share = _clamped_swiglu(x, lp["sh_gate"], lp["sh_up"], lp["sh_down"], limit)
                     moe_params = cast_moe_compute_params(moe_params, dtype)
-                    y, aux, load = moe_forward(
-                        cfg.moe, moe_params, x, token_mask,
-                        training=training,
-                        dispatcher="capacity" if backend.experts_backend == "dense" else "ragged",
-                        fake_balanced_gate=backend.fake_balanced_gate,
-                        fake_gate_noise=backend.fake_gate_noise,
-                    )
+                    y, aux, load, dropped = moe_fwd(moe_params, x, token_mask)
                     h = h + share + y
-                    stats = (aux if (aux is not None and emit_aux) else jnp.float32(0), load)
+                    stats = (aux if (aux is not None and emit_aux) else jnp.float32(0), load, dropped)
                 h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
                 return h, stats
 
@@ -355,7 +358,7 @@ class Step3p5ForCausalLM:
         h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
 
         stream_offsets = dict.fromkeys(cfg.stream_indices(), 0)
-        auxs, loads, load_is_moe = [], [], []
+        auxs, loads, droppeds, load_is_moe = [], [], [], []
         layer_ids = range(cfg.num_hidden_layers)
         for mkey, group in itertools.groupby(layer_ids, key=cfg.meta_key):
             group = list(group)
@@ -367,24 +370,31 @@ class Step3p5ForCausalLM:
             stream_offsets[skey] = o + n
             body = make_body(i0)
             if backend.scan_layers and n > 1:
-                h, (aux_r, load_r) = jax.lax.scan(lambda hh, lp: body(hh, dict(lp)), h, run_params)
+                h, (aux_r, load_r, drop_r) = jax.lax.scan(
+                    lambda hh, lp: body(hh, dict(lp)), h, run_params
+                )
                 auxs.append(aux_r)
                 loads.append(load_r)
+                droppeds.append(drop_r)
             else:
                 for j in range(n):
                     lp = jax.tree.map(lambda a: a[j], run_params)
-                    h, (aux, load) = body(h, dict(lp))
+                    h, (aux, load, dropped) = body(h, dict(lp))
                     auxs.append(aux[None])
                     loads.append(load[None])
+                    droppeds.append(dropped[None])
             load_is_moe += [cfg.ffn_kind(i) == "moe" for i in group]
 
         aux_all = jnp.concatenate(auxs)
         load_all = jnp.concatenate(loads)
+        drop_all = jnp.concatenate(droppeds)
         moe_sel = np.asarray(load_is_moe, bool)
         stats = {
             "aux_loss": aux_all.sum() if emit_aux else None,
             "expert_load": load_all[moe_sel] if cfg.moe is not None else load_all[:0],
         }
+        if backend.dispatcher == "a2a" and cfg.moe is not None:
+            stats["dropped_token_frac"] = drop_all[moe_sel].mean()
 
         h = rms_norm(h, params["final_norm"].astype(dtype), eps, offset=1.0)
         if return_hidden:

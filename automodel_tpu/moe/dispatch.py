@@ -12,6 +12,9 @@ Protocol per ep-shard (capacity-bucketed, static shapes):
   ``ragged_dot`` -> all_to_all (combine) -> weighted scatter-add at origin.
 Copies beyond capacity are dropped (standard capacity-factor trade-off; DeepEP is
 dropless, the dropless path here is ``grouped_experts_apply`` under plain GSPMD).
+The dispatch *accounts* for every drop: it returns ``dropped_frac`` (dropped copies /
+valid copies, globally summed) so a mis-set ``capacity_factor`` is visible in the
+training metrics instead of silently changing the loss.
 """
 
 from __future__ import annotations
@@ -23,9 +26,50 @@ from jax.sharding import Mesh, PartitionSpec as P
 from automodel_tpu.moe.config import MoEConfig
 from automodel_tpu.moe.experts import sorted_ragged_ffn
 from automodel_tpu.moe.gate import fake_balanced_route, route
-from automodel_tpu.moe.layers import _shared_experts_forward
+from automodel_tpu.moe.layers import _shared_experts_forward, moe_forward
 
-__all__ = ["make_ep_moe_forward"]
+__all__ = ["make_ep_moe_forward", "make_moe_block_forward"]
+
+
+def make_moe_block_forward(cfg: MoEConfig, backend, rules=None, *, training: bool = True):
+    """Dispatcher-aware MoE block shared by every MoE model family.
+
+    Returns ``fn(moe_params, x, token_mask) -> (y, aux_loss, expert_load, dropped_frac)``
+    with ``x`` (B, S, D). ``backend.dispatcher``:
+
+    - ``"a2a"``: explicit EP all-to-all dispatch over the mesh's ``ep`` axis
+      (:func:`make_ep_moe_forward`; the DeepEP deployment shape, reference
+      fused_a2a.py:250). ``dropped_frac`` reports capacity overflow.
+    - ``"dense"`` (default): GSPMD-managed :func:`moe_forward` — ``ragged_dot``
+      sorted path is dropless, so ``dropped_frac`` is a constant 0.
+    """
+    if backend.dispatcher == "a2a":
+        mesh = getattr(rules, "mesh", None)
+        if mesh is None or "ep" not in mesh.axis_names:
+            raise ValueError(
+                "backend.dispatcher='a2a' requires sharding rules bound to a mesh "
+                f"with an 'ep' axis (MeshContext(ep=...)); got mesh={mesh!r}"
+            )
+        return make_ep_moe_forward(
+            cfg,
+            mesh,
+            capacity_factor=backend.ep_capacity_factor,
+            training=training,
+            fake_balanced_gate=backend.fake_balanced_gate,
+            fake_gate_noise=backend.fake_gate_noise,
+        )
+
+    def fn(moe_params, x, token_mask=None):
+        y, aux, load = moe_forward(
+            cfg, moe_params, x, token_mask,
+            training=training,
+            dispatcher="capacity" if backend.experts_backend == "dense" else "ragged",
+            fake_balanced_gate=backend.fake_balanced_gate,
+            fake_gate_noise=backend.fake_gate_noise,
+        )
+        return y, aux, load, jnp.float32(0)
+
+    return fn
 
 
 def _local_grouped_gemm(cfg: MoEConfig, expert_params: dict, x, expert_ids, n_local_experts):
@@ -48,9 +92,11 @@ def make_ep_moe_forward(
     fake_gate_noise: float = 0.0,
     ep_axis: str = "ep",
 ):
-    """Build ``fn(params, x, token_mask) -> (y, aux_loss, expert_load)`` with explicit
-    EP a2a dispatch. ``x`` is (B, S, D) with batch sharded over data axes (incl. ep);
-    expert params are sharded over ``ep`` on their leading dim.
+    """Build ``fn(params, x, token_mask) -> (y, aux_loss, expert_load, dropped_frac)``
+    with explicit EP a2a dispatch. ``x`` is (B, S, D) with batch sharded over data axes
+    (incl. ep); expert params are sharded over ``ep`` on their leading dim.
+    ``dropped_frac`` is a global fp32 scalar: token copies dropped over capacity /
+    valid token copies.
     """
     ep = mesh.shape[ep_axis]
     if cfg.n_routed_experts % ep != 0:
@@ -113,7 +159,12 @@ def make_ep_moe_forward(
         if aux_loss is not None:
             aux_loss = jax.lax.pmean(aux_loss, ep_axis)
         expert_load = jax.lax.psum(expert_load, ep_axis)
-        return y.reshape(B, S, D), aux_loss, expert_load
+        n_valid = jax.lax.psum(valid_copy.sum().astype(jnp.float32), ep_axis)
+        n_dropped = jax.lax.psum(
+            (valid_copy & ~keep).sum().astype(jnp.float32), ep_axis
+        )
+        dropped_frac = n_dropped / jnp.maximum(n_valid, 1.0)
+        return y.reshape(B, S, D), aux_loss, expert_load, dropped_frac
 
     # Manual specs cover only the ep axis; everything else stays auto/GSPMD.
     def param_specs(params):
@@ -136,7 +187,7 @@ def make_ep_moe_forward(
         if token_mask is None:
             token_mask = jnp.ones(x.shape[:2], bool)
         aux_spec = P() if (cfg.aux_loss_coeff > 0 and training and not fake_balanced_gate) else None
-        out_specs = (P(ep_axis), aux_spec, P())
+        out_specs = (P(ep_axis), aux_spec, P(), P())
         mapped = jax.shard_map(
             shard_fn,
             mesh=mesh,

@@ -2,7 +2,7 @@
 
 Matches transformers' Llama rotary layout (first half / second half split, not
 interleaved) so HF checkpoints produce identical activations. Supports the scaling
-variants the reference gets from HF configs (llama3, linear, yarn) — the reference
+variants the reference gets from HF configs (llama3, linear, yarn, longrope) — the reference
 keeps per-family rope_utils.py files; here one module serves all families.
 """
 
@@ -55,6 +55,28 @@ def rope_frequencies(
             jnp.where(wavelen < high_wl, inv_freq, (1 - smooth) * inv_freq / factor + smooth * inv_freq),
         )
         return scaled
+    if rope_type == "longrope":
+        # transformers _compute_longrope_parameters (Phi-3 lineage): per-frequency
+        # rescale factors, short for within the original window, long beyond it.
+        # The choice is static under jit; default to short_factor (training inside
+        # the original window) — set rope_scaling["use_long_factor"]: true for
+        # long-context runs past original_max_position_embeddings.
+        orig = float(rope_scaling.get("original_max_position_embeddings", 4096))
+        max_pos = float(rope_scaling.get("max_position_embeddings", orig))
+        use_long = bool(rope_scaling.get("use_long_factor", False)) and max_pos > orig
+        if not use_long and max_pos > orig:
+            import warnings
+
+            warnings.warn(
+                "longrope: using short_factor frequencies; HF switches to "
+                "long_factor for sequences past original_max_position_embeddings "
+                f"({orig:.0f}) — set rope_scaling.use_long_factor: true for "
+                "long-context runs so exported checkpoints match HF inference",
+                stacklevel=2,
+            )
+        ext = rope_scaling["long_factor"] if use_long else rope_scaling["short_factor"]
+        ext = jnp.asarray(ext, jnp.float32)
+        return inv_freq / ext
     if rope_type == "yarn":
         factor = float(rope_scaling["factor"])
         orig_len = float(rope_scaling.get("original_max_position_embeddings", 4096))
@@ -96,6 +118,16 @@ def rope_attention_scaling(rope_scaling: dict[str, Any] | None) -> float:
         if mscale and mscale_all_dim:
             return get_mscale(factor, float(mscale)) / get_mscale(factor, float(mscale_all_dim))
         return get_mscale(factor)
+    if rope_type == "longrope":
+        attention_factor = rope_scaling.get("attention_factor")
+        if attention_factor is not None:
+            return float(attention_factor)
+        orig = float(rope_scaling.get("original_max_position_embeddings", 4096))
+        max_pos = float(rope_scaling.get("max_position_embeddings", orig))
+        factor = max_pos / orig
+        # applied on BOTH the short and long paths (transformers scales cos/sin
+        # by this regardless of which ext_factors were selected)
+        return math.sqrt(1 + math.log(factor) / math.log(orig)) if factor > 1 else 1.0
     return 1.0
 
 

@@ -439,6 +439,12 @@ def make_moe_pp_loss(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
     dtype = backend.jnp_dtype
     pp = mesh.shape[pp_axis]
     V = circular_repeats
+    if backend.dispatcher == "a2a":
+        raise ValueError(
+            "dispatcher='a2a' cannot run inside the pp manual region (nested "
+            "shard_map over ep); use the default GSPMD dispatcher under pp — the "
+            "ep mesh axis still shards the expert GEMMs"
+        )
     attention_fn = model.make_attention_fn() if hasattr(model, "make_attention_fn") else None
     dense_layer_fn, moe_layer_fn = make_moe_layer_fns(
         cfg, backend, rules=None, attention_fn=attention_fn, training=True,
@@ -473,7 +479,8 @@ def make_moe_pp_loss(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
     def layer_apply(stage, state):
         lp_stack, sliding = stage
         aux_weight = state.pop("aux_weight", None)
-        state, (auxs, loads) = jax.lax.scan(
+        # droppeds discarded: a2a is rejected above, so the channel is always 0
+        state, (auxs, loads, _droppeds) = jax.lax.scan(
             backend.layer_remat(moe_layer_fn), state, (lp_stack, sliding)
         )
         out = {"load": loads}

@@ -393,6 +393,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         if stats is None:
             return loss
         aux = {"expert_load": stats["expert_load"]}
+        if "dropped_token_frac" in stats:
+            # a2a dispatch: capacity-overflow rate, summed across microbatches in
+            # the step carry -> divide by grad-accum steps at log time
+            aux["dropped_token_frac"] = stats["dropped_token_frac"]
         if stats["aux_loss"] is not None:
             # reference scales aux by token count to undo 1/num_label_tokens grad
             # normalization (layers.py:367-372 MoEAuxLossAutoScaler); additive across
@@ -520,7 +524,14 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         LoRA base, and the plain forward all consume a param tree, so one
         transform serves qat, qat x pp, and qat x peft (reference threads the
         same module-swap through its one sequencing path, infrastructure.py:303).
+        Memoized: the path match never changes after setup and validation calls
+        this every pass.
         """
+        if not hasattr(self, "_qat_fn_memo"):
+            self._qat_fn_memo = self._build_qat_param_fn()
+        return self._qat_fn_memo
+
+    def _build_qat_param_fn(self):
         qat_cfg = self.cfg.get("qat")
         if qat_cfg is None or not qat_cfg.get("enabled", True):
             return None
@@ -647,6 +658,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                         extra = compute_load_balance_metrics(
                             np.asarray(metrics["expert_load"]), mode=self.moe_metrics_mode
                         )
+                    if "dropped_token_frac" in metrics:
+                        # summed over the step's microbatches in the train-step carry
+                        extra["moe_load/dropped_token_frac"] = float(
+                            np.asarray(metrics["dropped_token_frac"])
+                        ) / max(1, self.step_scheduler.grad_acc_steps)
                     row = dict(
                         loss=loss,
                         grad_norm=gnorm,

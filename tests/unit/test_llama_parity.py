@@ -137,3 +137,62 @@ class TestShardedLoad:
         wq = params["layers"]["wq"]
         # (L, D, N, H): embed dim sharded over dp_shard*cp = 4, heads over tp = 2
         assert wq.sharding.shard_shape(wq.shape) == (2, 16, 2, 16)
+
+
+class TestPhi3Parity:
+    def _tiny_cfg(self, **kw):
+        base = dict(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, sliding_window=16, rope_scaling=None,
+            pad_token_id=0, bos_token_id=1, eos_token_id=2,
+        )
+        base.update(kw)
+        return transformers.Phi3Config(**base)
+
+    def test_logits_match_hf(self, tmp_path):
+        """Fused qkv/gate_up split + llama stack reproduce HF Phi-3 logits."""
+        torch.manual_seed(7)
+        hf = transformers.Phi3ForCausalLM(self._tiny_cfg())
+        hf.eval()
+        d = str(tmp_path / "phi3")
+        hf.save_pretrained(d, safe_serialization=True)
+        model, params = AutoModelForCausalLM.from_pretrained(
+            d, dtype=jnp.float32,
+            backend=BackendConfig(dtype="float32", remat_policy="full"),
+        )
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (2, 12))
+        ours = model(params, jnp.asarray(ids))
+        with torch.no_grad():
+            theirs = hf(torch.tensor(ids)).logits.float().numpy()
+        # noise floor at hidden=64 on CPU XLA-vs-torch is ~2e-3 max (an identical
+        # tiny-LLAMA control shows the same magnitude), so 5e-3 here
+        np.testing.assert_allclose(np.asarray(ours), theirs, atol=5e-3, rtol=1e-3)
+
+    def test_fused_roundtrip_and_lazy_export(self, tmp_path):
+        torch.manual_seed(8)
+        hf = transformers.Phi3ForCausalLM(self._tiny_cfg())
+        d = str(tmp_path / "phi3")
+        hf.save_pretrained(d, safe_serialization=True)
+        model, params = AutoModelForCausalLM.from_pretrained(
+            d, dtype=jnp.float32,
+            backend=BackendConfig(dtype="float32", remat_policy="full"),
+        )
+        adapter = model.state_dict_adapter()
+        hf_dict = adapter.to_hf(params)
+        theirs = {k for k in hf.state_dict() if "rotary_emb" not in k}
+        assert set(hf_dict) == theirs
+        # the streaming-export lazy path fuses qkv/gate_up identically
+        lazy = adapter.to_hf_lazy(params)
+        assert set(lazy) == theirs
+        for k in ("model.layers.0.self_attn.qkv_proj.weight",
+                  "model.layers.1.mlp.gate_up_proj.weight"):
+            np.testing.assert_array_equal(lazy[k].materialize(), hf_dict[k])
+        import jax
+
+        params2 = adapter.from_hf(hf_dict)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            params, jax.tree.map(jnp.asarray, params2),
+        )

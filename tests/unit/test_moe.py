@@ -287,8 +287,9 @@ class TestEPDispatch:
         # generous capacity -> no drops -> exact match with the dropless GSPMD path
         fn = make_ep_moe_forward(cfg, mesh, capacity=64)
         with jax.sharding.set_mesh(mesh):
-            y, aux, load = fn(params, x)
+            y, aux, load, dropped = fn(params, x)
         ref_y, _, ref_load = moe_forward(cfg, params, x)
+        assert float(dropped) == 0.0
         np.testing.assert_allclose(np.asarray(y), np.asarray(ref_y), atol=2e-4)
         np.testing.assert_allclose(np.asarray(load), np.asarray(ref_load))
 
@@ -304,7 +305,7 @@ class TestEPDispatch:
         token_mask = jnp.ones((8, 4), bool).at[:, 2:].set(False)
         fn = make_ep_moe_forward(cfg, mesh, capacity=64)
         with jax.sharding.set_mesh(mesh):
-            y, _, load = fn(params, x, token_mask)
+            y, _, load, _ = fn(params, x, token_mask)
         # masked positions produce zero routed output (no shared experts configured)
         assert np.abs(np.asarray(y[:, 2:])).max() == 0.0
         assert np.abs(np.asarray(y[:, :2])).max() > 0.0
@@ -322,10 +323,84 @@ class TestEPDispatch:
         fn = make_ep_moe_forward(cfg, mesh, capacity=64)
 
         def loss(params):
-            y, _, _ = fn(params, x)
+            y, _, _, _ = fn(params, x)
             return (y**2).sum()
 
         with jax.sharding.set_mesh(mesh):
             g = jax.jit(jax.grad(loss))(params)
         assert np.isfinite(np.asarray(g["experts"]["gate_up_proj"])).all()
         assert np.abs(np.asarray(g["experts"]["down_proj"])).max() > 0
+
+
+class TestEPDispatchDropAccounting:
+    def test_ample_capacity_reports_zero(self, cpu_devices):
+        from automodel_tpu.moe.dispatch import make_ep_moe_forward
+        from automodel_tpu.parallel.mesh import MeshContext
+
+        ctx = MeshContext(ep=4, dp_shard=2, world_size=8)
+        mesh = ctx.build_mesh(cpu_devices)
+        cfg = small_cfg()
+        params = init_moe_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (8, 4, cfg.dim))
+        fn = make_ep_moe_forward(cfg, mesh, capacity=64)
+        with jax.sharding.set_mesh(mesh):
+            _, _, _, dropped = fn(params, x)
+        assert float(dropped) == 0.0
+
+    def test_tight_capacity_reports_drops(self, cpu_devices):
+        from automodel_tpu.moe.dispatch import make_ep_moe_forward
+        from automodel_tpu.parallel.mesh import MeshContext
+
+        ctx = MeshContext(ep=4, dp_shard=2, world_size=8)
+        mesh = ctx.build_mesh(cpu_devices)
+        cfg = small_cfg()
+        params = init_moe_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (8, 4, cfg.dim))
+        fn = make_ep_moe_forward(cfg, mesh, capacity=1)
+        with jax.sharding.set_mesh(mesh):
+            _, _, load, dropped = fn(params, x)
+        # per ep-shard: 8 tokens x K=2 copies but each of 4 destinations keeps <=1
+        assert 0.0 < float(dropped) <= 1.0
+        # kept copies = valid - dropped: the load psum counts ROUTED (pre-drop) tokens
+        assert float(load.sum()) == 8 * 4 * cfg.n_activated_experts
+
+    def test_model_level_a2a_wiring(self, cpu_devices):
+        """backend.dispatcher='a2a' routes the common MoE stack through EP a2a
+        dispatch and surfaces stats['dropped_token_frac']; with ample capacity the
+        logits match the GSPMD dense-dispatcher path."""
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+        from automodel_tpu.models.common.backend import BackendConfig
+        from automodel_tpu.parallel.mesh import MeshContext, default_sharding_rules
+
+        hf_cfg = {
+            "architectures": ["Qwen3MoeForCausalLM"],
+            "vocab_size": 128, "hidden_size": 32, "intermediate_size": 48,
+            "moe_intermediate_size": 16, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 8,
+            "num_experts": 8, "num_experts_per_tok": 2, "norm_topk_prob": True,
+            "max_position_embeddings": 32,
+        }
+        ctx = MeshContext(ep=4, dp_shard=2, world_size=8)
+        mesh = ctx.build_mesh(cpu_devices)
+        rules = default_sharding_rules().with_mesh(mesh)
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (8, 8)), jnp.int32)
+
+        ref_model = AutoModelForCausalLM.from_config(
+            hf_cfg, BackendConfig(dtype="float32")
+        )
+        params = ref_model.init(jax.random.key(1), jnp.float32)
+        ref_logits, ref_stats = ref_model(params, ids, training=True)
+
+        a2a_model = AutoModelForCausalLM.from_config(
+            hf_cfg, BackendConfig(dtype="float32", dispatcher="a2a",
+                                  ep_capacity_factor=8.0)
+        )
+        with jax.sharding.set_mesh(mesh):
+            logits, stats = a2a_model(params, ids, rules=rules, training=True)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref_logits), atol=2e-4
+        )
+        assert float(stats["dropped_token_frac"]) == 0.0
+        np.testing.assert_allclose(
+            np.asarray(stats["expert_load"]), np.asarray(ref_stats["expert_load"])
+        )

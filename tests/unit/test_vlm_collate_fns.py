@@ -261,3 +261,36 @@ class TestLeadingMediaBOS:
         inp, _ = _encode_with_media(BosTok(), ex, 64, {"<image>": [[100, 101]]})
         assert list(inp).count(7) == 1
         assert inp[0] == 7
+
+
+class TestPhi4MMCollate:
+    def test_audio_span_sizes_and_features(self):
+        from automodel_tpu.data.vlm.collate_fns import phi4_mm_collate
+
+        rng = np.random.RandomState(0)
+        exs = [
+            {"prompt": "<audio> transcribe", "answer": "hello",
+             "audio_features": rng.randn(80, 33).astype(np.float32)},
+            {"prompt": "<audio> transcribe", "answer": "bye",
+             "audio_features": rng.randn(80, 17).astype(np.float32)},
+        ]
+        batch = phi4_mm_collate(exs, WordTok(), seq_len=64, audio_token_id=99)
+        # HF _compute_audio_embed_size: ceil(T / 8) (qformer rate 1)
+        assert int((batch["input_ids"][0] == 99).sum()) == -(-33 // 8)
+        assert int((batch["input_ids"][1] == 99).sum()) == -(-17 // 8)
+        assert batch["audio_features"].shape == (2, 80, 33)
+        assert list(batch["audio_frames"]) == [33, 17]
+        n_tok = int((batch["input_ids"] == 99).sum())
+        assert batch["audio_coords_b"].shape[0] == n_tok
+        # audio placeholder tokens never contribute to the loss
+        assert (batch["labels"][batch["input_ids"] == 99] == -100).all()
+
+    def test_raw_waveform_path(self):
+        from automodel_tpu.data.vlm.collate_fns import phi4_mm_collate
+
+        rng = np.random.RandomState(1)
+        exs = [{"prompt": "<audio> what", "answer": "x",
+                "audio": rng.randn(16000).astype(np.float32)}]
+        batch = phi4_mm_collate(exs, WordTok(), seq_len=64, audio_token_id=99)
+        assert batch["audio_features"].shape[1] == 80
+        assert int((batch["input_ids"] == 99).sum()) > 0
