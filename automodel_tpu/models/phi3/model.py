@@ -55,8 +55,13 @@ class Phi3Config(LlamaConfig):
 
 
 class Phi3ForCausalLM(LlamaForCausalLM):
+    """Phi-3/3.5 text checkpoints. (Phi-4-multimodal is NOT claimed here: its
+    checkpoints wrap projections in LoRA `base_layer` names and carry audio/vision
+    towers this adapter does not map — the phi4-mm collator ships for dataset
+    parity, usable once those towers exist.)"""
+
     config_class = Phi3Config
-    hf_architectures = ("Phi3ForCausalLM", "Phi4MMForCausalLM")
+    hf_architectures = ("Phi3ForCausalLM",)
 
     def state_dict_adapter(self):
         from automodel_tpu.models.phi3.state_dict_adapter import Phi3StateDictAdapter
