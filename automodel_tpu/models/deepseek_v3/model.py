@@ -293,8 +293,9 @@ def make_mla_attention_fn(cfg: DeepseekV3Config, backend: BackendConfig, bias_fn
 
     def mla_attention(lp, x, positions, segment_ids, is_sliding, rules):
         del is_sliding
-        return _mla_block(cfg, backend, lp, x, positions, segment_ids, inv_freq, rules,
-                          bias_fn=bias_fn)
+        with jax.named_scope("mla_attention"):
+            return _mla_block(cfg, backend, lp, x, positions, segment_ids, inv_freq, rules,
+                              bias_fn=bias_fn)
 
     return mla_attention
 

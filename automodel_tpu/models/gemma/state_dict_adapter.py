@@ -1,0 +1,68 @@
+"""Gemma 2/3 HF key/layout mapping (llama-style projections + sandwich norms)."""
+
+from __future__ import annotations
+
+from automodel_tpu.models.common.state_dict import Entry, MappingAdapter
+from automodel_tpu.models.gemma.model import GemmaConfig
+from automodel_tpu.models.llama.state_dict_adapter import (
+    _o_in,
+    _o_out,
+    _proj_in,
+    _proj_out,
+    _t,
+)
+
+__all__ = ["GemmaStateDictAdapter"]
+
+
+class GemmaStateDictAdapter(MappingAdapter):
+    """Maps bare text-model keys; :meth:`from_hf` also accepts multimodal
+    Gemma3ForConditionalGeneration checkpoints by stripping the language-model
+    prefix (both the pre- and post-4.52 transformers layouts) and dropping the
+    vision tower/projector tensors — the text backbone loads, vision does not."""
+
+    _MM_PREFIXES = ("language_model.model.", "model.language_model.")
+
+    def from_hf(self, tensors, dtype=None) -> dict:
+        if "model.embed_tokens.weight" not in tensors and any(
+            k.startswith(p) for k in tensors for p in self._MM_PREFIXES
+        ):
+            remapped = {}
+            for k, v in tensors.items():
+                for p in self._MM_PREFIXES:
+                    if k.startswith(p):
+                        remapped["model." + k[len(p):]] = v
+                        break
+                else:
+                    if k in ("language_model.lm_head.weight", "lm_head.weight"):
+                        remapped["lm_head.weight"] = v
+                    # else: vision tower / multi_modal_projector — dropped
+            tensors = remapped
+        return super().from_hf(tensors, dtype)
+
+    def __init__(self, cfg: GemmaConfig, scan_layers: bool = True):
+        n, k, h = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        pre = "model.layers.{i}"
+        entries = [
+            Entry("model.embed_tokens.weight", "embed"),
+            Entry("model.norm.weight", "final_norm"),
+            Entry(f"{pre}.input_layernorm.weight", "layers.attn_norm"),
+            Entry(f"{pre}.post_attention_layernorm.weight", "layers.post_attn_norm"),
+            Entry(f"{pre}.pre_feedforward_layernorm.weight", "layers.pre_ffn_norm"),
+            Entry(f"{pre}.post_feedforward_layernorm.weight", "layers.post_ffn_norm"),
+            Entry(f"{pre}.self_attn.q_proj.weight", "layers.wq", _proj_in(n, h), _proj_out(n, h)),
+            Entry(f"{pre}.self_attn.k_proj.weight", "layers.wk", _proj_in(k, h), _proj_out(k, h)),
+            Entry(f"{pre}.self_attn.v_proj.weight", "layers.wv", _proj_in(k, h), _proj_out(k, h)),
+            Entry(f"{pre}.self_attn.o_proj.weight", "layers.wo", _o_in(n, h), _o_out(n, h)),
+            Entry(f"{pre}.mlp.gate_proj.weight", "layers.w_gate", _t, _t),
+            Entry(f"{pre}.mlp.up_proj.weight", "layers.w_up", _t, _t),
+            Entry(f"{pre}.mlp.down_proj.weight", "layers.w_down", _t, _t),
+        ]
+        if cfg.qk_norm:
+            entries += [
+                Entry(f"{pre}.self_attn.q_norm.weight", "layers.q_norm"),
+                Entry(f"{pre}.self_attn.k_norm.weight", "layers.k_norm"),
+            ]
+        if not cfg.tie_word_embeddings:
+            entries.append(Entry("lm_head.weight", "lm_head", _t, _t))
+        super().__init__(entries, cfg.num_hidden_layers, scan_layers)

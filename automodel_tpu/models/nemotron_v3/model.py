@@ -31,6 +31,7 @@ from automodel_tpu.models.common.transformer import _constrain
 from automodel_tpu.moe.config import MoEConfig
 from automodel_tpu.moe.dispatch import make_moe_block_forward
 from automodel_tpu.moe.layers import cast_moe_compute_params, init_moe_params, moe_logical_axes
+from automodel_tpu.utils.tracing import scope_blocks
 from automodel_tpu.ops.attention import dot_product_attention
 from automodel_tpu.ops.gated_delta import causal_conv1d
 from automodel_tpu.ops.mamba2 import group_rms_norm_gated, mamba_chunk_scan, softplus_dt
@@ -372,7 +373,11 @@ class NemotronHForCausalLM:
             E = cfg.moe.n_routed_experts if cfg.moe else 1
             return jnp.float32(0), jnp.zeros((E,), jnp.float32), jnp.float32(0)
 
-        block_fns = {"mamba": mamba_block, "attention": attn_block, "mlp": mlp_block, "moe": moe_block}
+        # profiler labels per block kind (autonvtx parity): mamba runs vs
+        # attention vs moe show as separate regions in the trace viewer
+        block_fns = scope_blocks(
+            {"mamba": mamba_block, "attention": attn_block, "mlp": mlp_block, "moe": moe_block}
+        )
 
         h = params["embed"].astype(dtype)[input_ids]
         if cfg.residual_in_fp32:

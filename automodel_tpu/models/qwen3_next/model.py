@@ -26,6 +26,7 @@ from automodel_tpu.models.common.backend import BackendConfig
 from automodel_tpu.models.common.transformer import _constrain
 from automodel_tpu.moe.config import MoEConfig
 from automodel_tpu.moe.dispatch import make_moe_block_forward
+from automodel_tpu.utils.tracing import scoped
 from automodel_tpu.moe.layers import cast_moe_compute_params, init_moe_params, moe_logical_axes
 from automodel_tpu.ops.attention import dot_product_attention
 from automodel_tpu.ops.gated_delta import causal_conv1d, chunk_gated_delta_rule, gated_rms_norm
@@ -318,6 +319,7 @@ class Qwen3NextForCausalLM:
 
         moe_fwd = make_moe_block_forward(cfg.moe, backend, rules, training=training)
 
+        @scoped("moe")
         def moe_block(lp, h):
             x = rms_norm(h, lp["mlp_norm"].astype(dtype), cfg.rms_norm_eps, offset=1.0)
             moe_params = cast_moe_compute_params(lp["moe"], dtype)
@@ -325,6 +327,7 @@ class Qwen3NextForCausalLM:
             h = _constrain(h + y, rules, ("batch", "act_seq", "act_embed"))
             return h, (aux if emit_aux else jnp.float32(0), load, dropped)
 
+        @scoped("delta_net")
         def linear_block(lp, h):
             x = rms_norm(h, lp["attn_norm"].astype(dtype), cfg.rms_norm_eps, offset=1.0)
             if token_mask is not None:
@@ -335,6 +338,7 @@ class Qwen3NextForCausalLM:
             h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
             return moe_block(lp, h)
 
+        @scoped("gated_attention")
         def full_block(lp, h):
             x = rms_norm(h, lp["attn_norm"].astype(dtype), cfg.rms_norm_eps, offset=1.0)
             h = h + self._gated_full_attn(lp, x, positions, segment_ids, inv_freq, attn_scale, dtype)

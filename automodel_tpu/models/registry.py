@@ -19,6 +19,9 @@ MODEL_REGISTRY: dict[str, str] = {
     "MixtralForCausalLM": "automodel_tpu.models.mixtral.model:MixtralForCausalLM",
     # Phi-3 lineage is llama-shaped with fused checkpoint tensors + longrope
     "Phi3ForCausalLM": "automodel_tpu.models.phi3.model:Phi3ForCausalLM",
+    "Gemma2ForCausalLM": "automodel_tpu.models.gemma.model:GemmaForCausalLM",
+    "Gemma3ForCausalLM": "automodel_tpu.models.gemma.model:GemmaForCausalLM",
+    "Gemma3ForConditionalGeneration": "automodel_tpu.models.gemma.model:GemmaForCausalLM",
     "Ministral3ForCausalLM": "automodel_tpu.models.mistral3.model:Ministral3ForCausalLM",
     "Qwen3MoeForCausalLM": "automodel_tpu.models.qwen3_moe.model:Qwen3MoeForCausalLM",
     "GptOssForCausalLM": "automodel_tpu.models.gpt_oss.model:GptOssForCausalLM",

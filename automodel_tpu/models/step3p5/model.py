@@ -31,6 +31,7 @@ from automodel_tpu.models.common.transformer import _constrain
 from automodel_tpu.moe.config import MoEConfig
 from automodel_tpu.moe.dispatch import make_moe_block_forward
 from automodel_tpu.moe.layers import cast_moe_compute_params, init_moe_params, moe_logical_axes
+from automodel_tpu.utils.tracing import scoped
 from automodel_tpu.ops.attention import dot_product_attention
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rope import apply_rope_angles, rope_frequencies
@@ -352,7 +353,9 @@ class Step3p5ForCausalLM:
                 h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
                 return h, stats
 
-            return backend.layer_remat(body)
+            # profiler label per behavior class (autonvtx parity): sliding vs
+            # full attention x mlp vs moe regions separate in the trace
+            return backend.layer_remat(scoped(f"{akind}_{fkind}", body))
 
         h = params["embed"].astype(dtype)[input_ids]
         h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))

@@ -135,17 +135,20 @@ def make_ep_moe_forward(
         keep = (pos < cap) & valid_copy
         slot = jnp.where(keep, pos, cap)  # cap is out-of-bounds -> scatter drops it
 
-        send_x = jnp.zeros((ep, cap, D), x.dtype).at[dest, slot].set(x2[tok], mode="drop")
-        send_eid = jnp.zeros((ep, cap), jnp.int32).at[dest, slot].set(local_eid, mode="drop")
+        with jax.named_scope("ep_dispatch"):
+            send_x = jnp.zeros((ep, cap, D), x.dtype).at[dest, slot].set(x2[tok], mode="drop")
+            send_eid = jnp.zeros((ep, cap), jnp.int32).at[dest, slot].set(local_eid, mode="drop")
 
-        recv_x = jax.lax.all_to_all(send_x, ep_axis, split_axis=0, concat_axis=0)
-        recv_eid = jax.lax.all_to_all(send_eid, ep_axis, split_axis=0, concat_axis=0)
+            recv_x = jax.lax.all_to_all(send_x, ep_axis, split_axis=0, concat_axis=0)
+            recv_eid = jax.lax.all_to_all(send_eid, ep_axis, split_axis=0, concat_axis=0)
 
-        out = _local_grouped_gemm(
-            cfg, params["experts"], recv_x.reshape(ep * cap, D), recv_eid.reshape(-1), n_local
-        ).reshape(ep, cap, D)
+        with jax.named_scope("ep_experts"):
+            out = _local_grouped_gemm(
+                cfg, params["experts"], recv_x.reshape(ep * cap, D), recv_eid.reshape(-1), n_local
+            ).reshape(ep, cap, D)
 
-        back = jax.lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0)
+        with jax.named_scope("ep_combine"):
+            back = jax.lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0)
 
         # Combine at origin: gather each copy's result, weight it, drop overflow.
         gathered = back[dest, jnp.minimum(slot, cap - 1)]  # (T*K, D)

@@ -112,23 +112,28 @@ def moe_forward(
     x2 = x.reshape(-1, cfg.dim)
     mask = None if token_mask is None else token_mask.reshape(-1)
 
-    if fake_balanced_gate:
-        weights, indices, aux_loss, expert_load = fake_balanced_route(
-            cfg, x2, noise=fake_gate_noise
-        )
-    else:
-        weights, indices, aux_loss, expert_load = route(
-            cfg, params["gate"], x2, mask, training=training
-        )
+    # named scopes label the trace's routing vs expert-GEMM vs shared regions
+    # (autonvtx parity for the MoE block internals)
+    with jax.named_scope("moe_gate"):
+        if fake_balanced_gate:
+            weights, indices, aux_loss, expert_load = fake_balanced_route(
+                cfg, x2, noise=fake_gate_noise
+            )
+        else:
+            weights, indices, aux_loss, expert_load = route(
+                cfg, params["gate"], x2, mask, training=training
+            )
 
-    if dispatcher == "capacity":
-        y = capacity_experts_apply(
-            cfg, params["experts"], x2, weights, indices, mask, capacity_factor=capacity_factor
-        )
-    else:
-        y = grouped_experts_apply(cfg, params["experts"], x2, weights, indices, mask)
+    with jax.named_scope("moe_experts"):
+        if dispatcher == "capacity":
+            y = capacity_experts_apply(
+                cfg, params["experts"], x2, weights, indices, mask, capacity_factor=capacity_factor
+            )
+        else:
+            y = grouped_experts_apply(cfg, params["experts"], x2, weights, indices, mask)
 
     if cfg.n_shared_experts > 0:
-        y = y + _shared_experts_forward(cfg, params, x2)
+        with jax.named_scope("moe_shared_experts"):
+            y = y + _shared_experts_forward(cfg, params, x2)
 
     return y.reshape(shape), aux_loss, expert_load

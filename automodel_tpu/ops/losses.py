@@ -198,12 +198,31 @@ def kd_loss(
     temperature: float = 1.0,
     ignore_index: int = IGNORE_INDEX,
     num_label_tokens: jnp.ndarray | int | None = None,
+    divergence: str = "forward_kl",
 ) -> jnp.ndarray:
-    """Forward-KL distillation on valid tokens (reference loss/kd_loss.py:21)."""
+    """Distillation divergence on valid tokens (reference loss/kd_loss.py:21 is
+    forward-KL; reverse-KL and symmetric JS ship as config options on top).
+
+    - ``forward_kl``: KL(teacher || student) — mode-covering, the reference's loss.
+    - ``reverse_kl``: KL(student || teacher) — mode-seeking, the MiniLLM-style
+      objective for generative students.
+    - ``js``: Jensen-Shannon, symmetric middle ground.
+    """
     valid = labels != ignore_index
     t = jax.nn.log_softmax(teacher_logits.astype(jnp.float32) / temperature, axis=-1)
     s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / temperature, axis=-1)
-    kl = (jnp.exp(t) * (t - s)).sum(-1) * (temperature**2)
+    if divergence == "forward_kl":
+        per_tok = (jnp.exp(t) * (t - s)).sum(-1)
+    elif divergence == "reverse_kl":
+        per_tok = (jnp.exp(s) * (s - t)).sum(-1)
+    elif divergence == "js":
+        m = jnp.logaddexp(t, s) - jnp.log(2.0)
+        per_tok = 0.5 * ((jnp.exp(t) * (t - m)).sum(-1) + (jnp.exp(s) * (s - m)).sum(-1))
+    else:
+        raise ValueError(
+            f"unknown kd divergence {divergence!r} (forward_kl | reverse_kl | js)"
+        )
+    kl = per_tok * (temperature**2)
     total = jnp.where(valid, kl, 0.0).sum()
     denom = valid.sum() if num_label_tokens is None else num_label_tokens
     return total / jnp.maximum(denom, 1).astype(jnp.float32)

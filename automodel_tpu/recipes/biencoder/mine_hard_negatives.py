@@ -35,29 +35,42 @@ __all__ = ["mine_hard_negatives", "main"]
 
 def mine_hard_negatives(recipe: TrainBiencoderRecipe, rows: list[dict],
                         num_negatives: int = 4, margin: float = 0.95,
-                        query_chunk: int = 1024) -> list[dict]:
+                        margin_type: str = "perc", query_chunk: int = 1024,
+                        query_prefix: str = "", passage_prefix: str = "") -> list[dict]:
     """rows: {"query", "pos_doc"} -> rows + {"neg_doc": [...]} via dense retrieval.
 
     Queries are processed in chunks so memory stays O(chunk x corpus), never the
-    full (Q, N) matrix. The near-duplicate filter drops candidates scoring above
-    ``margin * pos_score`` — only meaningful for positive scores, so with an
-    untrained tower (cosines can be <= 0) it degrades to "above the positive".
+    full (Q, N) matrix. The near-duplicate filter (reference hard_neg_margin /
+    hard_neg_margin_type) drops candidates scoring above the cut:
+
+    - ``margin_type="perc"``: ``margin * pos_score`` — only meaningful for
+      positive scores, so with an untrained tower (cosines can be <= 0) it
+      degrades to "above the positive".
+    - ``margin_type="abs"``: ``pos_score - margin`` — sign-safe absolute gap.
+
+    ``query_prefix``/``passage_prefix`` prepend E5-style instruction prefixes
+    before encoding (reference MINING_DEFAULTS query_prefix/passage_prefix).
     """
+    if margin_type not in ("perc", "abs"):
+        raise ValueError(f"margin_type must be perc|abs, got {margin_type!r}")
     corpus = sorted({str(r["pos_doc"]) for r in rows})
     doc_row = {d: i for i, d in enumerate(corpus)}
-    doc_emb = recipe.encode(corpus)  # (N, D) normalized
+    doc_emb = recipe.encode([passage_prefix + d for d in corpus])  # (N, D) normalized
 
     mined = []
     for lo in range(0, len(rows), query_chunk):
         chunk = rows[lo:lo + query_chunk]
-        q_emb = recipe.encode([str(r["query"]) for r in chunk])
+        q_emb = recipe.encode([query_prefix + str(r["query"]) for r in chunk])
         scores = q_emb @ doc_emb.T  # (chunk, N)
         for i, r in enumerate(chunk):
             pos_idx = doc_row[str(r["pos_doc"])]
             s = scores[i].copy()
             pos_score = s[pos_idx]
             s[pos_idx] = -np.inf
-            cut = margin * pos_score if pos_score > 0 else pos_score
+            if margin_type == "abs":
+                cut = pos_score - margin
+            else:
+                cut = margin * pos_score if pos_score > 0 else pos_score
             s[s > cut] = -np.inf
             top = np.argsort(-s)[:num_negatives]
             negs = [corpus[j] for j in top if np.isfinite(s[j])]
@@ -78,6 +91,9 @@ def main(cfg: ConfigNode | None = None, argv=None):
         recipe, rows,
         num_negatives=int(mine_cfg.get("num_negatives", 4)),
         margin=float(mine_cfg.get("margin", 0.95)),
+        margin_type=str(mine_cfg.get("margin_type", "perc")),
+        query_prefix=str(mine_cfg.get("query_prefix", "")),
+        passage_prefix=str(mine_cfg.get("passage_prefix", "")),
     )
     write_retrieval_jsonl(mined, mine_cfg["output"])
     logger.info("mined %d rows -> %s", len(mined), mine_cfg["output"])

@@ -66,8 +66,12 @@ class KnowledgeDistillationRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         self._build_teacher()
         temperature = float(self.cfg.get("kd.temperature", 1.0))
         kd_ratio = float(self.cfg.get("kd.kd_ratio", 0.5))
+        divergence = str(self.cfg.get("kd.divergence", "forward_kl"))
+        self._static_log_fields = {"kd_ratio": kd_ratio, "temperature": temperature,
+                                   "kd_divergence": divergence}
+        logger.info("kd: ratio=%s T=%s divergence=%s", kd_ratio, temperature, divergence)
         if self.mesh_ctx.pp > 1:
-            return self._build_pp_train_step(temperature, kd_ratio)
+            return self._build_pp_train_step(temperature, kd_ratio, divergence)
 
         def kd_core(student_params, teacher_params, batch, num_label_tokens):
             student_logits = self.model(
@@ -84,6 +88,7 @@ class KnowledgeDistillationRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             kd = kd_loss(
                 student_logits, teacher_logits, batch["labels"],
                 temperature=temperature, num_label_tokens=num_label_tokens,
+                divergence=divergence,
             )
             return (1.0 - kd_ratio) * ce + kd_ratio * kd
 
@@ -108,7 +113,8 @@ class KnowledgeDistillationRecipe(TrainFinetuneRecipeForNextTokenPrediction):
                                guard_nonfinite=self._check_nan_grads)
         return jax.jit(step, donate_argnums=(0, 1))
 
-    def _build_pp_train_step(self, temperature: float, kd_ratio: float):
+    def _build_pp_train_step(self, temperature: float, kd_ratio: float,
+                             divergence: str = "forward_kl"):
         """kd x pp (reference composes them through its one sequencing path,
         infrastructure.py:303): the STUDENT's layer stack pipelines over pp and
         yields final hidden states outside the manual region; the student head,
@@ -158,7 +164,8 @@ class KnowledgeDistillationRecipe(TrainFinetuneRecipeForNextTokenPrediction):
                 )
                 ce = masked_cross_entropy(s_logits, mb["labels"], n)
                 kd = kd_loss(s_logits, t_logits, mb["labels"],
-                             temperature=temperature, num_label_tokens=n)
+                             temperature=temperature, num_label_tokens=n,
+                             divergence=divergence)
                 return (1.0 - kd_ratio) * ce + kd_ratio * kd
 
             return jax.lax.map(mb_loss, (h_stack, batch_stack)).sum()

@@ -71,6 +71,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
     _pre_qat_step = None
     _qat_start_step = 0
     _step_needs_rng = False
+    # static per-run fields a subclass wants appended to every training.jsonl row
+    # (the KD recipe logs kd_ratio/temperature per row, reference kd.py:456)
+    _static_log_fields: dict = {}
 
     def __init__(self, cfg: ConfigNode):
         self.cfg = cfg
@@ -672,6 +675,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                         tps=round(step_tokens / dt, 1),
                         tps_per_chip=round(step_tokens / dt / jax.device_count(), 1),
                         **extra,
+                        **self._static_log_fields,
                     )
                     self.metric_logger.log(step, **row)
                     for lg in self.experiment_loggers:

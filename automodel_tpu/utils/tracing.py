@@ -1,0 +1,43 @@
+"""Profiler scope annotation (reference autonvtx/__init__.py:33-96).
+
+The reference walks a torch module tree and wraps every submodule's forward in an
+NVTX range so profiles are legible. The JAX equivalent is ``jax.named_scope``:
+names attach to the traced ops' metadata and surface in XLA HLO op_name paths and
+the jax.profiler / tensorboard trace viewer. Models are pure functions here, not
+module trees, so the recursive walk becomes :func:`scope_blocks` over a family's
+block-function table — one call at the layer-stream boundary annotates every
+block kind (mamba runs, DeltaNet, MoE dispatch, attention variants) without
+touching the block bodies.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Mapping
+
+import jax
+
+__all__ = ["scoped", "scope_blocks"]
+
+
+def scoped(name: str, fn: Callable | None = None):
+    """Wrap ``fn`` (or decorate) so its trace runs under ``jax.named_scope(name)``."""
+
+    def wrap(f):
+        @functools.wraps(f)
+        def inner(*args, **kwargs):
+            with jax.named_scope(name):
+                return f(*args, **kwargs)
+
+        return inner
+
+    return wrap(fn) if fn is not None else wrap
+
+
+def scope_blocks(block_fns: Mapping[str, Callable], prefix: str = "") -> dict:
+    """Wrap each block fn in a named scope after its table key.
+
+    ``{"mamba": f, "moe": g}`` -> profiles label the mamba runs and MoE layers
+    separately (the autonvtx per-module labels, at block granularity).
+    """
+    return {k: scoped(f"{prefix}{k}", fn) for k, fn in block_fns.items()}

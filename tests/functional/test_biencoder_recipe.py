@@ -91,3 +91,25 @@ def test_mine_hard_negatives(tmp_path, cpu_devices):
     for r in mined:
         assert 1 <= len(r["neg_doc"]) <= 3
         assert r["pos_doc"] not in r["neg_doc"]
+
+
+def test_mine_margin_type_abs_and_prefixes(tmp_path, cpu_devices):
+    from automodel_tpu.recipes.biencoder.mine_hard_negatives import mine_hard_negatives
+
+    pairs = _make_rows(tmp_path, n=16)
+    recipe = TrainBiencoderRecipe(load_config(_write_cfg(tmp_path, pairs, max_steps=1))).setup()
+    recipe.run_train_validation_loop()
+    rows = [json.loads(l) for l in open(pairs)]
+    # abs margin 0 drops everything scoring above the positive itself; with
+    # E5-style prefixes the encode path still runs end-to-end
+    mined = mine_hard_negatives(
+        recipe, rows, num_negatives=2, margin=0.0, margin_type="abs",
+        query_prefix="query: ", passage_prefix="passage: ",
+    )
+    assert len(mined) == 16
+    for r in mined:
+        assert r["pos_doc"] not in r["neg_doc"]
+    import pytest
+
+    with pytest.raises(ValueError, match="perc|abs"):
+        mine_hard_negatives(recipe, rows, margin_type="relative")

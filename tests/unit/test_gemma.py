@@ -1,0 +1,148 @@
+"""Gemma 2/3 logit parity vs HF transformers (torch CPU) + adapter roundtrip."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.auto import AutoModelForCausalLM
+from automodel_tpu.models.common.backend import BackendConfig
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def _fp32_backend(**kw):
+    return BackendConfig(dtype="float32", remat_policy="full", **kw)
+
+
+def _compare(hf_model, tmp_path, atol=5e-3, seq=12):
+    hf_model.eval()
+    d = str(tmp_path / "hf")
+    hf_model.save_pretrained(d, safe_serialization=True)
+    model, params = AutoModelForCausalLM.from_pretrained(
+        d, dtype=jnp.float32, backend=_fp32_backend()
+    )
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, hf_model.config.vocab_size, (2, seq))
+    ours = model(params, jnp.asarray(ids))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=atol, rtol=1e-3)
+    return model, params
+
+
+def tiny_gemma3_cfg(**kw):
+    base = dict(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        query_pre_attn_scalar=16.0, sliding_window=8,
+        layer_types=["sliding_attention", "sliding_attention", "full_attention"],
+        rope_theta=1_000_000.0, rope_local_base_freq=10_000.0,
+        max_position_embeddings=64, pad_token_id=0, bos_token_id=1, eos_token_id=2,
+        tie_word_embeddings=True,
+    )
+    base.update(kw)
+    return transformers.Gemma3TextConfig(**base)
+
+
+def tiny_gemma2_cfg(**kw):
+    base = dict(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        query_pre_attn_scalar=16.0, sliding_window=8,
+        layer_types=["sliding_attention", "full_attention"],
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        max_position_embeddings=64, pad_token_id=0, bos_token_id=1, eos_token_id=2,
+        tie_word_embeddings=True,
+    )
+    base.update(kw)
+    return transformers.Gemma2Config(**base)
+
+
+class TestGemma3Parity:
+    def test_logits_match_hf(self, tmp_path):
+        torch.manual_seed(0)
+        hf = transformers.Gemma3ForCausalLM(tiny_gemma3_cfg())
+        _compare(hf, tmp_path)
+
+    def test_roundtrip_and_key_parity(self, tmp_path):
+        torch.manual_seed(1)
+        hf = transformers.Gemma3ForCausalLM(tiny_gemma3_cfg())
+        d = str(tmp_path / "hf")
+        hf.save_pretrained(d, safe_serialization=True)
+        model, params = AutoModelForCausalLM.from_pretrained(
+            d, dtype=jnp.float32, backend=_fp32_backend()
+        )
+        adapter = model.state_dict_adapter()
+        hf_dict = adapter.to_hf(params)
+        theirs = {k for k in hf.state_dict() if "rotary_emb" not in k and k != "lm_head.weight"}
+        assert set(hf_dict) == theirs
+        params2 = adapter.from_hf(hf_dict)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            params, jax.tree.map(jnp.asarray, params2),
+        )
+
+    def test_sharded_init_and_grad(self, cpu_devices):
+        from automodel_tpu.parallel.mesh import MeshContext, default_sharding_rules
+
+        ctx = MeshContext(dp_shard=4, tp=2, world_size=8)
+        mesh = ctx.build_mesh(cpu_devices)
+        rules = default_sharding_rules().with_mesh(mesh)
+        model = AutoModelForCausalLM.from_config(
+            {"architectures": ["Gemma3ForCausalLM"], "vocab_size": 128,
+             "hidden_size": 64, "intermediate_size": 96, "num_hidden_layers": 2,
+             "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+             "query_pre_attn_scalar": 16.0, "max_position_embeddings": 64},
+            _fp32_backend(),
+        )
+        with mesh:
+            shardings = rules.tree_sharding(model.logical_axes())
+            params = jax.jit(lambda k: model.init(k, jnp.float32),
+                             out_shardings=shardings)(jax.random.key(0))
+            ids = jnp.zeros((4, 8), jnp.int32)
+
+            def loss(p):
+                lg = model(p, ids, rules=rules)
+                return (lg.astype(jnp.float32) ** 2).mean()
+
+            g = jax.jit(jax.grad(loss))(params)
+        assert np.isfinite(np.asarray(g["embed"])).all()
+
+
+class TestGemma2Parity:
+    def test_logits_match_hf_with_softcaps(self, tmp_path):
+        torch.manual_seed(2)
+        hf = transformers.Gemma2ForCausalLM(tiny_gemma2_cfg())
+        model, _ = _compare(hf, tmp_path)
+        assert model.config.attn_logit_softcapping == 50.0
+        assert model.config.qk_norm is False
+
+
+class TestGemma3MultimodalCheckpointLoad:
+    def test_prefixed_text_backbone_loads(self, tmp_path):
+        """Gemma3ForConditionalGeneration checkpoints prefix text weights
+        (language_model.model.* pre-4.52, model.language_model.* after); the
+        adapter strips either and drops the vision tower."""
+        torch.manual_seed(3)
+        hf = transformers.Gemma3ForCausalLM(tiny_gemma3_cfg())
+        d = str(tmp_path / "hf")
+        hf.save_pretrained(d, safe_serialization=True)
+        model, params = AutoModelForCausalLM.from_pretrained(
+            d, dtype=jnp.float32, backend=_fp32_backend()
+        )
+        adapter = model.state_dict_adapter()
+        flat = adapter.to_hf(params)
+        for prefix in ("language_model.model.", "model.language_model."):
+            wrapped = {prefix + k[len("model."):]: v for k, v in flat.items()
+                       if k.startswith("model.")}
+            wrapped["vision_tower.encoder.layer0.weight"] = np.zeros((2, 2), np.float32)
+            wrapped["multi_modal_projector.mm_input_projection_weight"] = np.zeros(
+                (2, 2), np.float32)
+            params2 = adapter.from_hf(wrapped, dtype=np.float32)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+                params, jax.tree.map(jnp.asarray, params2),
+            )

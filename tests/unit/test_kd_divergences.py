@@ -1,0 +1,60 @@
+"""KD divergence options: forward-KL (reference parity), reverse-KL, JS."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.ops.losses import kd_loss
+
+
+def _rand_logits(key, shape):
+    return jax.random.normal(key, shape) * 2.0
+
+
+class TestKDDivergences:
+    def setup_method(self):
+        k1, k2 = jax.random.split(jax.random.key(0))
+        self.s = _rand_logits(k1, (2, 4, 16))
+        self.t = _rand_logits(k2, (2, 4, 16))
+        self.labels = jnp.asarray([[1, 2, -100, 3], [4, -100, 5, 6]])
+
+    def test_forward_kl_zero_at_equality(self):
+        for div in ("forward_kl", "reverse_kl", "js"):
+            v = kd_loss(self.t, self.t, self.labels, divergence=div)
+            np.testing.assert_allclose(float(v), 0.0, atol=1e-5)
+
+    def test_all_nonnegative_and_distinct(self):
+        vals = {
+            div: float(kd_loss(self.s, self.t, self.labels, divergence=div))
+            for div in ("forward_kl", "reverse_kl", "js")
+        }
+        assert all(v > 0 for v in vals.values())
+        # three genuinely different objectives
+        assert len({round(v, 6) for v in vals.values()}) == 3
+        # JS is bounded by ln(2) per token (temperature 1)
+        assert vals["js"] <= np.log(2.0) + 1e-6
+
+    def test_reverse_kl_is_mirrored_forward(self):
+        fwd = float(kd_loss(self.s, self.t, self.labels, divergence="forward_kl"))
+        rev = float(kd_loss(self.t, self.s, self.labels, divergence="reverse_kl"))
+        np.testing.assert_allclose(fwd, rev, rtol=1e-5)
+
+    def test_grads_flow_to_student_only_args(self):
+        g = jax.grad(
+            lambda s: kd_loss(s, self.t, self.labels, divergence="reverse_kl")
+        )(self.s)
+        assert np.isfinite(np.asarray(g)).all()
+        # masked positions get no gradient
+        assert np.abs(np.asarray(g)[0, 2]).max() == 0.0
+
+    def test_unknown_divergence_raises(self):
+        with pytest.raises(ValueError, match="forward_kl"):
+            kd_loss(self.s, self.t, self.labels, divergence="hellinger")
+
+    def test_temperature_scaling_matches_reference_contract(self):
+        # T^2 scaling keeps gradient magnitude comparable across temperatures
+        v1 = float(kd_loss(self.s, self.t, self.labels, temperature=1.0))
+        v4 = float(kd_loss(self.s, self.t, self.labels, temperature=4.0))
+        assert v1 > 0 and v4 > 0

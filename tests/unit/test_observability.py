@@ -69,3 +69,60 @@ class TestExperimentLoggers:
         assert len(loggers) == 1
         cfg2 = ConfigNode({})
         assert build_experiment_loggers(cfg2) == []
+
+
+class TestNamedScopes:
+    """Profiler scope labels (autonvtx parity): block/region names must survive
+    into the lowered program's metadata so trace viewers can group ops."""
+
+    def test_moe_block_scopes_in_lowered_text(self):
+        import jax
+
+        from automodel_tpu.moe.config import MoEConfig
+        from automodel_tpu.moe.layers import init_moe_params, moe_forward
+
+        cfg = MoEConfig(n_routed_experts=4, n_activated_experts=2, dim=16,
+                        moe_inter_dim=32, n_shared_experts=1)
+        p = init_moe_params(cfg, jax.random.key(0))
+        x = jnp.ones((4, 16))
+        txt = jax.jit(lambda p, x: moe_forward(cfg, p, x)[0]).lower(p, x).as_text(
+            debug_info=True
+        )
+        for scope in ("moe_gate", "moe_experts", "moe_shared_experts"):
+            assert scope in txt, scope
+
+    def test_hybrid_family_block_scopes(self):
+        import jax
+        import numpy as np
+
+        from automodel_tpu.models.common.backend import BackendConfig
+        from automodel_tpu.models.nemotron_v3.model import NemotronHForCausalLM, NemotronV3Config
+        from automodel_tpu.moe.config import MoEConfig
+
+        cfg = NemotronV3Config(
+            vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=4,
+            layers_block_type=("mamba", "attention", "mlp", "moe"),
+            num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+            mamba_num_heads=4, mamba_head_dim=8, ssm_state_size=16, n_groups=2,
+            chunk_size=16, conv_kernel=4,
+            moe=MoEConfig(
+                n_routed_experts=4, n_activated_experts=2, dim=64, moe_inter_dim=32,
+                score_func="sigmoid", expert_activation="relu2",
+            ),
+        )
+        model = NemotronHForCausalLM(cfg, BackendConfig(dtype="float32", remat_policy="full"))
+        params = model.init(jax.random.key(0), jnp.float32)
+        ids = jnp.asarray(np.zeros((1, 8), np.int32))
+        txt = jax.jit(lambda p, i: model(p, i)[0]).lower(params, ids).as_text(
+            debug_info=True
+        )
+        for scope in ("mamba", "attention", "mlp"):
+            assert scope in txt, scope
+
+    def test_scoped_wrapper_preserves_fn(self):
+        from automodel_tpu.utils.tracing import scope_blocks, scoped
+
+        f = scoped("thing", lambda a, b: a + b)
+        assert f(1, 2) == 3
+        table = scope_blocks({"x": lambda v: v * 2})
+        assert table["x"](4) == 8
