@@ -13,9 +13,10 @@ cache as scan-xs and emits the updated slices as scan-ys. ``positions`` /
 ``valid`` / ``write_idx`` are shared across layers and advanced by the loop
 here, not by the model.
 
-Hybrid recurrences (mamba/DeltaNet state caching) and MLA latent caches are not
-wired yet: models that plug a custom ``attention_fn`` into the MoE stack raise
-with a pointer at HF export.
+MLA families (DeepSeek-V3/V2, Kimi-K2, GLM4-MoE-Lite) decode through an
+expanded-head cache (see :func:`init_kv_cache`). Hybrid recurrences
+(mamba/DeltaNet state caching) are not wired yet and raise with a pointer at
+HF export; so does the V3.2 sparse indexer (its bias is sequence-global).
 """
 
 from __future__ import annotations
@@ -29,18 +30,26 @@ __all__ = ["init_kv_cache", "generate", "sample_token"]
 
 
 def init_kv_cache(cfg, batch_size: int, max_len: int, dtype=jnp.bfloat16) -> dict[str, Any]:
-    """Zeroed cache for ``cfg.num_hidden_layers`` GQA layers.
+    """Zeroed cache for ``cfg.num_hidden_layers`` layers.
 
+    GQA stacks: k/v are (L, B, S, kv_heads, head_dim). MLA stacks (marked by
+    ``kv_lora_rank``): the EXPANDED per-head k/v — k head-dim is nope+rope while
+    v head-dim is ``v_head_dim``, and every head caches (no GQA grouping).
     ``valid`` doubles as kv segment ids (0 = empty slot, masked); ``positions``
     feed the position-causal mask, so cache slot order never has to match
     position order.
     """
-    kh = cfg.num_key_value_heads
-    d = cfg.head_dim
     L = cfg.num_hidden_layers
+    if getattr(cfg, "kv_lora_rank", None) is not None:  # MLA
+        kh = cfg.num_attention_heads
+        dk = cfg.qk_nope_head_dim + cfg.qk_rope_head_dim
+        dv = cfg.v_head_dim
+    else:
+        kh = cfg.num_key_value_heads
+        dk = dv = cfg.head_dim
     return {
-        "k": jnp.zeros((L, batch_size, max_len, kh, d), dtype),
-        "v": jnp.zeros((L, batch_size, max_len, kh, d), dtype),
+        "k": jnp.zeros((L, batch_size, max_len, kh, dk), dtype),
+        "v": jnp.zeros((L, batch_size, max_len, kh, dv), dtype),
         "positions": jnp.zeros((batch_size, max_len), jnp.int32),
         "valid": jnp.zeros((batch_size, max_len), jnp.int32),
         "write_idx": jnp.zeros((batch_size,), jnp.int32),
@@ -93,12 +102,19 @@ def generate(
     (B, max_new_tokens), ``pad_token_id``-filled after eos. The whole decode
     runs inside one jit (cache donated through the scan carry).
     """
+    import inspect
+
     cfg = decode_config if decode_config is not None else model.config
-    if hasattr(model, "make_attention_fn") or not hasattr(cfg, "num_key_value_heads"):
+    is_mla = getattr(cfg, "kv_lora_rank", None) is not None
+    call_params = inspect.signature(model.__call__).parameters
+    # hybrids (mamba/DeltaNet recurrences) may still carry num_key_value_heads
+    # for their full-attention layers — the real capability marker is whether
+    # the forward accepts a cache at all
+    if "cache" not in call_params or (not is_mla and not hasattr(cfg, "num_key_value_heads")):
         raise NotImplementedError(
-            "KV-cache decode is wired for the GQA attention stack; this model "
-            "uses a custom attention (MLA-style latent cache / hybrid recurrence) "
-            "without a cache path yet — export to HF for generation instead"
+            "KV-cache decode covers the GQA and MLA attention stacks; this model "
+            "uses a hybrid recurrence (mamba/DeltaNet state) without a cache "
+            "path yet — export to HF for generation instead"
         )
     input_ids = jnp.asarray(input_ids, jnp.int32)
     b, s_prompt = input_ids.shape
@@ -109,9 +125,6 @@ def generate(
     max_len = s_prompt + max_new_tokens
     prompt_lens = mask.sum(-1).astype(jnp.int32)
 
-    import inspect
-
-    call_params = inspect.signature(model.__call__).parameters
     accepts_training = "training" in call_params
     accepts_embeds = "inputs_embeds" in call_params
 

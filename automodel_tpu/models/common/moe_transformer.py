@@ -206,6 +206,11 @@ def make_moe_layer_fns(
                                     inv_freq, attn_scale, eff_window, rules,
                                     cache=cache, cache_meta=cache_meta)
 
+    if custom_attention:
+        import inspect
+
+        custom_supports_cache = "cache" in inspect.signature(attention_fn).parameters
+
     def attn(state, lp, is_sliding, kv=None):
         h = state["h"]
         x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
@@ -213,11 +218,10 @@ def make_moe_layer_fns(
             out, kv_out = attention_fn(lp, x, state["positions"],
                                        state.get("segment_ids"), is_sliding, rules), None
         else:
-            if custom_attention:
+            if custom_attention and not custom_supports_cache:
                 raise NotImplementedError(
-                    "KV-cache decode is wired for the GQA attention stack; this "
-                    "model plugs in a custom attention_fn (MLA-style) without a "
-                    "cache path yet — export to HF for generation instead"
+                    "this model plugs in a custom attention_fn without a cache "
+                    "path (hybrid recurrence) — export to HF for generation instead"
                 )
             cache_meta = {"write_idx": state["write_idx"], "valid": state["valid"],
                           "positions": state["kv_positions"]}

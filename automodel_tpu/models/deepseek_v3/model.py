@@ -190,10 +190,17 @@ def logical_axes(cfg: DeepseekV3Config) -> dict:
 
 
 def _mla_block(cfg: DeepseekV3Config, backend: BackendConfig, lp: dict, x, positions,
-               segment_ids, inv_freq, rules, bias_fn=None):
+               segment_ids, inv_freq, rules, bias_fn=None, cache=None, cache_meta=None):
     """MLA attention (reference layers.py:122-198). ``bias_fn(lp, x, q_latent,
     positions, segment_ids) -> (B, S, S) additive logit bias`` is the V3.2 sparse
-    indexer hook (reference deepseek_v32/layers.py:430-500)."""
+    indexer hook (reference deepseek_v32/layers.py:430-500).
+
+    With ``cache=(k_cache, v_cache)`` (decode): the EXPANDED per-head k/v are
+    written at ``cache_meta["write_idx"]`` and attention runs against the whole
+    cache (k head-dim = nope+rope, v head-dim = v_head_dim — they differ; the
+    XLA path handles the asymmetry). The latent-absorbed decode (caching only
+    c_kv + k_pe) is a memory optimization left on the table — sampling
+    correctness is what this path buys. Returns ``(out, (k_cache, v_cache))``."""
     q_latent = None
     if cfg.q_lora_rank is None:
         q = jnp.einsum("bsd,dnh->bsnh", x, lp["wq"])
@@ -215,6 +222,28 @@ def _mla_block(cfg: DeepseekV3Config, backend: BackendConfig, lp: dict, x, posit
     k = jnp.concatenate(
         [k_nope, jnp.broadcast_to(k_pe, (*k_nope.shape[:-1], cfg.qk_rope_head_dim))], axis=-1
     )
+
+    if cache is not None:
+        if bias_fn is not None:
+            raise NotImplementedError(
+                "V3.2 sparse-indexer decode is not wired (the indexer bias is "
+                "(S, S)-global); generate with the dense MLA families instead"
+            )
+        from automodel_tpu.models.common.transformer import _cache_write
+
+        k_cache = _cache_write(cache[0], k.astype(cache[0].dtype), cache_meta["write_idx"])
+        v_cache = _cache_write(cache[1], v.astype(cache[1].dtype), cache_meta["write_idx"])
+        out = dot_product_attention(
+            q, k_cache.astype(q.dtype), v_cache.astype(q.dtype),
+            causal=True,
+            segment_ids_q=segment_ids,
+            segment_ids_kv=cache_meta["valid"],
+            positions_q=positions,
+            positions_kv=cache_meta["positions"],
+            softmax_scale=cfg.softmax_scale,
+            backend="xla",  # q_len 1 / position-masked: the flash kernel doesn't apply
+        )
+        return jnp.einsum("bsnh,nhd->bsd", out, lp["wo"]), (k_cache, v_cache)
 
     from jax.ad_checkpoint import checkpoint_name
 
@@ -262,13 +291,16 @@ def forward(
     rules=None,
     return_hidden: bool = False,
     training: bool = True,
+    cache=None,
 ):
-    """moe_decoder_forward with the MLA attention hook; returns (out, stats)."""
+    """moe_decoder_forward with the MLA attention hook; returns (out, stats)
+    (or ``(logits, cache)`` on the decode path)."""
     return moe_decoder_forward(
         cfg, backend, params, input_ids,
         positions=positions, segment_ids=segment_ids, token_mask=token_mask,
         rules=rules, return_hidden=return_hidden, training=training,
         attention_fn=make_mla_attention_fn(cfg, backend),
+        cache=cache,
     )
 
 
@@ -291,11 +323,12 @@ def make_mla_attention_fn(cfg: DeepseekV3Config, backend: BackendConfig, bias_fn
     """MLA attention hook for moe_decoder_forward / the pp pipeline."""
     inv_freq = mla_inv_freq(cfg)
 
-    def mla_attention(lp, x, positions, segment_ids, is_sliding, rules):
+    def mla_attention(lp, x, positions, segment_ids, is_sliding, rules,
+                      cache=None, cache_meta=None):
         del is_sliding
         with jax.named_scope("mla_attention"):
             return _mla_block(cfg, backend, lp, x, positions, segment_ids, inv_freq, rules,
-                              bias_fn=bias_fn)
+                              bias_fn=bias_fn, cache=cache, cache_meta=cache_meta)
 
     return mla_attention
 
@@ -324,12 +357,18 @@ class DeepseekV3ForCausalLM:
         return make_mla_attention_fn(self.config, self.backend)
 
     def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
-                 rules=None, return_hidden=False, training=True):
+                 rules=None, return_hidden=False, training=True, cache=None):
         return forward(
             self.config, self.backend, params, input_ids,
             positions=positions, segment_ids=segment_ids, token_mask=token_mask,
-            rules=rules, return_hidden=return_hidden, training=training,
+            rules=rules, return_hidden=return_hidden, training=training, cache=cache,
         )
+
+    def generate(self, params, input_ids, **kw):
+        """Sample with an expanded-head MLA KV cache (automodel_tpu.generation)."""
+        from automodel_tpu.generation import generate
+
+        return generate(self, params, input_ids, **kw)
 
     def state_dict_adapter(self):
         from automodel_tpu.models.deepseek_v3.state_dict_adapter import DeepseekV3StateDictAdapter

@@ -185,13 +185,16 @@ class GemmaForCausalLM:
 
     # -- forward ------------------------------------------------------------
     def __call__(self, params, input_ids, positions=None, segment_ids=None,
-                 token_mask=None, rules=None, return_hidden=False, training=True):
+                 token_mask=None, rules=None, return_hidden=False, training=True,
+                 cache=None):
         cfg, backend = self.config, self.backend
         del token_mask, training
         dtype = backend.jnp_dtype
         B, S = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        if cache is not None and segment_ids is None:
+            raise ValueError("cache decoding requires segment_ids (1 = real token)")
         eps = cfg.rms_norm_eps
 
         h = params["embed"].astype(dtype)[input_ids]
@@ -213,7 +216,10 @@ class GemmaForCausalLM:
         window = cfg.sliding_window
 
         def layer_fn(h, inputs):
-            lp, is_sliding = inputs
+            if cache is not None:
+                lp, is_sliding, kv = inputs
+            else:
+                (lp, is_sliding), kv = inputs, None
             lp = jax.tree.map(lambda a: a.astype(dtype), lp)
             x = rms_norm(h, lp["attn_norm"], eps, offset=1.0)
             q = jnp.einsum("bsd,dnh->bsnh", x, lp["wq"])
@@ -227,14 +233,33 @@ class GemmaForCausalLM:
             k = apply_rope(k, positions, inv)
             eff_window = None
             if any_sliding and window is not None:
-                # "disabled" bound must exceed every causal q-kv distance
-                big = jnp.int32(cfg.max_position_embeddings + S)
+                # "disabled" bound must exceed every causal q-kv distance; under
+                # cached decode that distance is bounded by the CACHE length
+                kv_len = S if kv is None else kv[0].shape[1]
+                big = jnp.int32(cfg.max_position_embeddings + max(S, kv_len))
                 eff_window = jnp.where(is_sliding, jnp.int32(window), big)
-            out = dot_product_attention(
-                q, k, v, causal=cfg.causal, segment_ids_q=segment_ids,
-                sliding_window=eff_window, softmax_scale=scale,
-                logit_soft_cap=cfg.attn_logit_softcapping, backend=backend.attention,
-            )
+            if kv is not None:
+                from automodel_tpu.models.common.transformer import _cache_write
+
+                k_cache = _cache_write(kv[0], k.astype(kv[0].dtype), cache["write_idx"])
+                v_cache = _cache_write(kv[1], v.astype(kv[1].dtype), cache["write_idx"])
+                out = dot_product_attention(
+                    q, k_cache.astype(q.dtype), v_cache.astype(q.dtype),
+                    causal=cfg.causal, segment_ids_q=segment_ids,
+                    segment_ids_kv=cache["valid"],
+                    positions_q=positions, positions_kv=cache["positions"],
+                    sliding_window=eff_window, softmax_scale=scale,
+                    logit_soft_cap=cfg.attn_logit_softcapping,
+                    backend="xla",  # q_len 1 / position-masked
+                )
+                kv_out = (k_cache, v_cache)
+            else:
+                out = dot_product_attention(
+                    q, k, v, causal=cfg.causal, segment_ids_q=segment_ids,
+                    sliding_window=eff_window, softmax_scale=scale,
+                    logit_soft_cap=cfg.attn_logit_softcapping, backend=backend.attention,
+                )
+                kv_out = None
             attn = jnp.einsum("bsnh,nhd->bsd", out, lp["wo"])
             attn = rms_norm(attn, lp["post_attn_norm"], eps, offset=1.0)
             h = _constrain(h + attn, rules, ("batch", "act_seq", "act_embed"))
@@ -244,10 +269,15 @@ class GemmaForCausalLM:
             mlp = act @ lp["w_down"]
             mlp = rms_norm(mlp, lp["post_ffn_norm"], eps, offset=1.0)
             h = _constrain(h + mlp, rules, ("batch", "act_seq", "act_embed"))
-            return h, None
+            return h, kv_out
 
         body = backend.layer_remat(layer_fn)
-        if backend.scan_layers:
+        if cache is not None:
+            h, (k_new, v_new) = jax.lax.scan(
+                body, h, (params["layers"], sliding, (cache["k"], cache["v"]))
+            )
+            cache = dict(cache, k=k_new, v=v_new)
+        elif backend.scan_layers:
             h, _ = jax.lax.scan(body, h, (params["layers"], sliding))
         else:
             for i in range(cfg.num_hidden_layers):
@@ -255,8 +285,12 @@ class GemmaForCausalLM:
                 h, _ = body(h, (lp, sliding[i]))
 
         h = rms_norm(h, params["final_norm"].astype(dtype), eps, offset=1.0)
+        if cache is not None:
+            # next-token logits only (B, 1, V) — see transformer.decoder_forward
+            last = jnp.maximum(segment_ids.sum(-1) - 1, 0).astype(jnp.int32)
+            h = jnp.take_along_axis(h, last[:, None, None], axis=1)
         if return_hidden:
-            return h
+            return h if cache is None else (h, cache)
         unembed = params.get("lm_head")
         if unembed is None:
             unembed = params["embed"].T
@@ -264,7 +298,13 @@ class GemmaForCausalLM:
         if cfg.final_logit_softcapping:
             cap = cfg.final_logit_softcapping
             logits = jnp.tanh(logits / cap) * cap
-        return logits
+        return logits if cache is None else (logits, cache)
+
+    def generate(self, params, input_ids, **kw):
+        """Sample with a KV cache (see :func:`automodel_tpu.generation.generate`)."""
+        from automodel_tpu.generation import generate
+
+        return generate(self, params, input_ids, **kw)
 
     # -- HF interop ---------------------------------------------------------
     def state_dict_adapter(self):

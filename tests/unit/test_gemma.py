@@ -146,3 +146,33 @@ class TestGemma3MultimodalCheckpointLoad:
                 lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
                 params, jax.tree.map(jnp.asarray, params2),
             )
+
+
+class TestGemmaDecode:
+    def test_cache_matches_full_recompute(self):
+        """Greedy cache decode == full recompute, across the sliding/full mix
+        and through the sliding window boundary."""
+        model = AutoModelForCausalLM.from_config(
+            {"architectures": ["Gemma3ForCausalLM"], "vocab_size": 128,
+             "hidden_size": 64, "intermediate_size": 96, "num_hidden_layers": 3,
+             "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+             "query_pre_attn_scalar": 16.0, "sliding_window": 4,
+             "layer_types": ["sliding_attention", "sliding_attention", "full_attention"],
+             "max_position_embeddings": 64},
+            _fp32_backend(),
+        )
+        params = model.init(jax.random.key(7), jnp.float32)
+        rng = np.random.RandomState(8)
+        prompts = rng.randint(0, 128, (2, 6)).astype(np.int32)
+
+        def full(row, n_new):
+            ids = list(row)
+            for _ in range(n_new):
+                x = jnp.asarray([ids], jnp.int32)
+                logits = model(params, x, segment_ids=jnp.ones_like(x))
+                ids.append(int(np.asarray(logits)[0, -1].argmax()))
+            return ids[len(row):]
+
+        want = np.asarray([full(r, 6) for r in prompts], np.int32)
+        out = model.generate(params, prompts, max_new_tokens=6, cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), want)

@@ -136,26 +136,27 @@ class TestMoEDecode:
         got = generate(model, params, prompts, max_new_tokens=5, temperature=0.0)
         np.testing.assert_array_equal(np.asarray(got["tokens"]), want)
 
-    def test_mla_custom_attention_raises(self):
+    def test_hybrid_recurrence_raises(self):
+        """Real hybrids (qwen3-next: DeltaNet recurrence, has num_key_value_heads
+        for its full-attention layers but no cache param) point at HF export
+        instead of TypeError-ing inside jit."""
         from automodel_tpu.models.auto import AutoModelForCausalLM
 
-        hf_cfg = {
-            "architectures": ["DeepseekV3ForCausalLM"],
-            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
-            "moe_intermediate_size": 32, "num_hidden_layers": 2,
-            "num_attention_heads": 4, "q_lora_rank": 24, "kv_lora_rank": 32,
-            "qk_nope_head_dim": 16, "qk_rope_head_dim": 8, "v_head_dim": 16,
-            "n_routed_experts": 4, "num_experts_per_tok": 2, "n_shared_experts": 1,
-            "norm_topk_prob": True, "first_k_dense_replace": 1,
-            "max_position_embeddings": 64,
-        }
         model = AutoModelForCausalLM.from_config(
-            hf_cfg, BackendConfig(dtype="float32", remat_policy="none")
+            {"architectures": ["Qwen3NextForCausalLM"], "vocab_size": 128,
+             "hidden_size": 64, "intermediate_size": 96, "moe_intermediate_size": 32,
+             "shared_expert_intermediate_size": 32, "num_hidden_layers": 4,
+             "full_attention_interval": 4, "num_attention_heads": 4,
+             "num_key_value_heads": 2, "head_dim": 16,
+             "linear_num_value_heads": 4, "linear_num_key_heads": 2,
+             "linear_key_head_dim": 16, "linear_value_head_dim": 16,
+             "linear_conv_kernel_dim": 4, "num_experts": 4,
+             "num_experts_per_tok": 2, "max_position_embeddings": 64},
+            BackendConfig(dtype="float32", remat_policy="none"),
         )
         params = model.init(jax.random.key(0), jnp.float32)
-        prompts = np.zeros((1, 4), np.int32)
-        with pytest.raises(NotImplementedError, match="custom attention"):
-            generate(model, params, prompts, max_new_tokens=2)
+        with pytest.raises(NotImplementedError, match="hybrid recurrence"):
+            generate(model, params, np.zeros((1, 4), np.int32), max_new_tokens=2)
 
 
 class TestHFParity:
@@ -230,3 +231,72 @@ class TestVLMGenerate:
                 max_new_tokens=6, do_sample=False, pad_token_id=0,
             )[:, ids.shape[1]:].numpy()
         np.testing.assert_array_equal(np.asarray(got["tokens"]), theirs)
+
+
+class TestMLADecode:
+    def test_deepseek_v3_cache_matches_full(self):
+        """MLA expanded-head cache decode == full recompute, greedy."""
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+
+        hf_cfg = {
+            "architectures": ["DeepseekV3ForCausalLM"],
+            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+            "moe_intermediate_size": 32, "num_hidden_layers": 3,
+            "num_attention_heads": 4, "q_lora_rank": 24, "kv_lora_rank": 32,
+            "qk_nope_head_dim": 16, "qk_rope_head_dim": 8, "v_head_dim": 16,
+            "n_routed_experts": 8, "num_experts_per_tok": 2, "n_shared_experts": 1,
+            "norm_topk_prob": True, "first_k_dense_replace": 1,
+            "max_position_embeddings": 64, "rope_scaling": None,
+        }
+        model = AutoModelForCausalLM.from_config(
+            hf_cfg, BackendConfig(dtype="float32", remat_policy="none")
+        )
+        params = model.init(jax.random.key(3), jnp.float32)
+        rng = np.random.RandomState(5)
+        prompts = rng.randint(0, 128, (2, 6)).astype(np.int32)
+
+        def full(row, n_new):
+            ids = list(row)
+            for _ in range(n_new):
+                x = jnp.asarray([ids], jnp.int32)
+                logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
+                ids.append(int(np.asarray(logits)[0, -1].argmax()))
+            return ids[len(row):]
+
+        want = np.asarray([full(r, 5) for r in prompts], np.int32)
+        out = model.generate(params, prompts, max_new_tokens=5, cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
+
+    def test_uneven_padded_prompts(self):
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+
+        hf_cfg = {
+            "architectures": ["DeepseekV3ForCausalLM"],
+            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+            "moe_intermediate_size": 32, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "q_lora_rank": None, "kv_lora_rank": 32,
+            "qk_nope_head_dim": 16, "qk_rope_head_dim": 8, "v_head_dim": 16,
+            "n_routed_experts": 4, "num_experts_per_tok": 2, "n_shared_experts": 0,
+            "norm_topk_prob": True, "first_k_dense_replace": 0,
+            "max_position_embeddings": 64, "rope_scaling": None,
+        }
+        model = AutoModelForCausalLM.from_config(
+            hf_cfg, BackendConfig(dtype="float32", remat_policy="none")
+        )
+        params = model.init(jax.random.key(4), jnp.float32)
+        rng = np.random.RandomState(6)
+        # row 1 is shorter, right-padded
+        ids = rng.randint(1, 128, (2, 6)).astype(np.int32)
+        mask = np.ones((2, 6), np.int32)
+        ids[1, 4:] = 0
+        mask[1, 4:] = 0
+
+        def full(row):
+            x = jnp.asarray([row], jnp.int32)
+            logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
+            return int(np.asarray(logits)[0, -1].argmax())
+
+        out = model.generate(params, ids, attention_mask=mask, max_new_tokens=1,
+                             cache_dtype=jnp.float32)
+        assert int(out["tokens"][0, 0]) == full(list(ids[0]))
+        assert int(out["tokens"][1, 0]) == full(list(ids[1, :4]))
