@@ -107,14 +107,17 @@ def generate(
     cfg = decode_config if decode_config is not None else model.config
     is_mla = getattr(cfg, "kv_lora_rank", None) is not None
     call_params = inspect.signature(model.__call__).parameters
-    # hybrids (mamba/DeltaNet recurrences) may still carry num_key_value_heads
-    # for their full-attention layers — the real capability marker is whether
-    # the forward accepts a cache at all
-    if "cache" not in call_params or (not is_mla and not hasattr(cfg, "num_key_value_heads")):
+    # a model either consumes the generic GQA/MLA cache or builds its own
+    # (hybrids: conv taps + recurrent state via init_decode_cache); the
+    # capability marker is whether the forward accepts a cache at all
+    own_cache = hasattr(model, "init_decode_cache")
+    if "cache" not in call_params or (
+        not own_cache and not is_mla and not hasattr(cfg, "num_key_value_heads")
+    ):
         raise NotImplementedError(
-            "KV-cache decode covers the GQA and MLA attention stacks; this model "
-            "uses a hybrid recurrence (mamba/DeltaNet state) without a cache "
-            "path yet — export to HF for generation instead"
+            "KV-cache decode covers the GQA, MLA, and hybrid (init_decode_cache) "
+            "stacks; this model has no cache path yet — export to HF for "
+            "generation instead"
         )
     input_ids = jnp.asarray(input_ids, jnp.int32)
     b, s_prompt = input_ids.shape
@@ -140,7 +143,8 @@ def generate(
 
     def _run(params, input_ids, mask, prompt_lens, inputs_embeds, rng):
         rows = jnp.arange(b)
-        cache = init_kv_cache(cfg, b, max_len, cache_dtype)
+        cache = (model.init_decode_cache(b, max_len, cache_dtype) if own_cache
+                 else init_kv_cache(cfg, b, max_len, cache_dtype))
         prefill_pos = jnp.broadcast_to(jnp.arange(s_prompt, dtype=jnp.int32), (b, s_prompt))
         cache["positions"] = cache["positions"].at[:, :s_prompt].set(prefill_pos)
         cache["valid"] = cache["valid"].at[:, :s_prompt].set(mask)

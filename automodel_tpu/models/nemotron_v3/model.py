@@ -33,7 +33,7 @@ from automodel_tpu.moe.dispatch import make_moe_block_forward
 from automodel_tpu.moe.layers import cast_moe_compute_params, init_moe_params, moe_logical_axes
 from automodel_tpu.utils.tracing import scope_blocks
 from automodel_tpu.ops.attention import dot_product_attention
-from automodel_tpu.ops.gated_delta import causal_conv1d
+from automodel_tpu.ops.gated_delta import causal_conv1d, conv_state_from_prefill, conv_step
 from automodel_tpu.ops.mamba2 import group_rms_norm_gated, mamba_chunk_scan, softplus_dt
 from automodel_tpu.ops.norms import rms_norm
 
@@ -289,11 +289,18 @@ class NemotronHForCausalLM:
     # ---- forward ----
 
     def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
-                 rules=None, return_hidden=False, training=True):
+                 rules=None, return_hidden=False, training=True, cache=None):
         cfg, backend = self.config, self.backend
         dtype = backend.jnp_dtype
         B, S = input_ids.shape
         eps = cfg.layer_norm_epsilon
+
+        if cache is not None:
+            if segment_ids is None:
+                raise ValueError("cache decoding requires segment_ids (1 = real token)")
+            if positions is None:
+                positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+            return self._decode_forward(params, input_ids, positions, segment_ids, cache, dtype)
 
         reset_mask = None
         if segment_ids is not None:
@@ -443,6 +450,149 @@ class NemotronHForCausalLM:
             unembed = params["embed"].T
         logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
         return logits, stats
+
+    # ---- decode ----
+
+    def init_decode_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16) -> dict:
+        """Hybrid decode cache: KV for attention layers, conv taps + SSD state
+        (fp32) for mamba layers (mlp/moe layers are stateless)."""
+        cfg = self.config
+        La = len(cfg.type_indices("attention"))
+        Lm = len(cfg.type_indices("mamba"))
+        return {
+            "k": jnp.zeros((La, batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim), dtype),
+            "v": jnp.zeros((La, batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim), dtype),
+            "conv": jnp.zeros((Lm, batch_size, cfg.conv_kernel - 1, cfg.conv_dim), dtype),
+            "rec": jnp.zeros(
+                (Lm, batch_size, cfg.mamba_num_heads, cfg.mamba_head_dim, cfg.ssm_state_size),
+                jnp.float32,
+            ),
+            "positions": jnp.zeros((batch_size, max_len), jnp.int32),
+            "valid": jnp.zeros((batch_size, max_len), jnp.int32),
+            "write_idx": jnp.zeros((batch_size,), jnp.int32),
+        }
+
+    def _decode_forward(self, params, input_ids, positions, segment_ids, cache, dtype):
+        """Unrolled cached forward (prefill S>1, decode S=1). Right-padding is
+        neutralized in the recurrence by zeroing dt (decay exp(0·A)=1, write
+        dt·B·x=0) and in the conv by gathering each row's trailing VALID inputs."""
+        cfg = self.config
+        eps = cfg.layer_norm_epsilon
+        B, S = input_ids.shape
+        token_mask = segment_ids != 0
+        K = cfg.conv_kernel
+        h = params["embed"].astype(dtype)[input_ids]
+        if cfg.residual_in_fp32:
+            h = h.astype(jnp.float32)
+        k_all, v_all = cache["k"], cache["v"]
+        conv_all, rec_all = cache["conv"], cache["rec"]
+        moe_fwd = (
+            make_moe_block_forward(cfg.moe, self.backend, None, training=False)
+            if cfg.moe is not None else None
+        )
+        offsets = dict.fromkeys(BLOCK_TYPES, 0)
+        a_i = m_i = 0
+        for t in cfg.layers_block_type:
+            o = offsets[t]
+            lp = jax.tree.map(lambda a: a[o], params[_STREAM_KEY[t]])
+            offsets[t] = o + 1
+            lp = {
+                k_: v_ if k_ in ("moe", "a_log") else jax.tree.map(lambda a: a.astype(dtype), v_)
+                for k_, v_ in lp.items()
+            }
+            if t == "mamba":
+                x = rms_norm(h, lp["norm"], eps).astype(dtype)
+                x = x * token_mask[..., None].astype(x.dtype)
+                inter, hm = cfg.mamba_intermediate, cfg.mamba_num_heads
+                gns = cfg.n_groups * cfg.ssm_state_size
+                proj = jnp.einsum("bsd,dp->bsp", x, lp["in_proj"])
+                if "b_in" in lp:
+                    proj = proj + lp["b_in"]
+                gate, xbc, dt_raw = jnp.split(proj, [inter, inter + cfg.conv_dim], axis=-1)
+                if S == 1:
+                    xbc_c, new_conv = conv_step(
+                        conv_all[m_i], xbc, lp["conv_w"], bias=lp.get("b_conv")
+                    )
+                else:
+                    xbc_c = causal_conv1d(xbc, lp["conv_w"], bias=lp.get("b_conv"))
+                    new_conv = conv_state_from_prefill(xbc, token_mask.sum(-1), K)
+                xi, Bm, Cm = jnp.split(xbc_c, [inter, inter + gns], axis=-1)
+                dt = softplus_dt(dt_raw, lp["dt_bias"], cfg.time_step_limit)
+                dt = dt * token_mask[..., None].astype(dt.dtype)
+                A = -jnp.exp(lp["a_log"].astype(jnp.float32))
+                y, rec = mamba_chunk_scan(
+                    xi.reshape(B, S, hm, cfg.mamba_head_dim), dt, A,
+                    Bm.reshape(B, S, cfg.n_groups, cfg.ssm_state_size),
+                    Cm.reshape(B, S, cfg.n_groups, cfg.ssm_state_size),
+                    lp["d_skip"], chunk_size=min(cfg.chunk_size, S),
+                    initial_state=rec_all[m_i], output_final_state=True,
+                )
+                conv_all = conv_all.at[m_i].set(new_conv.astype(conv_all.dtype))
+                rec_all = rec_all.at[m_i].set(rec)
+                y = group_rms_norm_gated(
+                    y.reshape(B, S, inter), lp["gated_norm"], gate,
+                    group_size=inter // cfg.n_groups, eps=eps,
+                )
+                out = jnp.einsum("bsi,id->bsd", y, lp["out_proj"])
+                if "b_out" in lp:
+                    out = out + lp["b_out"]
+                h = h + out
+                m_i += 1
+            elif t == "attention":
+                from automodel_tpu.models.common.transformer import _cache_write
+
+                x = rms_norm(h, lp["norm"], eps).astype(dtype)
+                q = jnp.einsum("bsd,dnh->bsnh", x, lp["wq"])
+                k = jnp.einsum("bsd,dnh->bsnh", x, lp["wk"])
+                v = jnp.einsum("bsd,dnh->bsnh", x, lp["wv"])
+                if cfg.attention_bias:
+                    q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+                k_cache = _cache_write(k_all[a_i], k.astype(k_all.dtype), cache["write_idx"])
+                v_cache = _cache_write(v_all[a_i], v.astype(v_all.dtype), cache["write_idx"])
+                out = dot_product_attention(
+                    q, k_cache.astype(q.dtype), v_cache.astype(q.dtype),
+                    causal=True, segment_ids_q=segment_ids,
+                    segment_ids_kv=cache["valid"],
+                    positions_q=positions,
+                    positions_kv=cache["positions"],
+                    backend="xla",
+                )
+                k_all = k_all.at[a_i].set(k_cache)
+                v_all = v_all.at[a_i].set(v_cache)
+                o = jnp.einsum("bsnh,nhd->bsd", out, lp["wo"])
+                if cfg.attention_bias:
+                    o = o + lp["bo"]
+                h = h + o
+                a_i += 1
+            elif t == "mlp":
+                x = rms_norm(h, lp["norm"], eps).astype(dtype)
+                up = jnp.einsum("bsd,di->bsi", x, lp["w_up"])
+                if "b_up" in lp:
+                    up = up + lp["b_up"]
+                act = jnp.square(jax.nn.relu(up))
+                out = jnp.einsum("bsi,id->bsd", act, lp["w_down"])
+                if "b_down" in lp:
+                    out = out + lp["b_down"]
+                h = h + out
+            else:  # moe
+                x = rms_norm(h, lp["norm"], eps).astype(dtype)
+                moe_params = cast_moe_compute_params(lp["moe"], dtype)
+                y, _, _, _ = moe_fwd(moe_params, x, token_mask)
+                h = h + y
+        h = rms_norm(h, params["final_norm"].astype(dtype), eps)
+        last = jnp.maximum(segment_ids.sum(-1) - 1, 0).astype(jnp.int32)
+        h = jnp.take_along_axis(h, last[:, None, None], axis=1)
+        unembed = params.get("lm_head")
+        if unembed is None:
+            unembed = params["embed"].T
+        logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
+        return logits, dict(cache, k=k_all, v=v_all, conv=conv_all, rec=rec_all)
+
+    def generate(self, params, input_ids, **kw):
+        """Sample with the hybrid conv+SSD+KV cache (automodel_tpu.generation)."""
+        from automodel_tpu.generation import generate
+
+        return generate(self, params, input_ids, **kw)
 
     # ---- interop ----
 

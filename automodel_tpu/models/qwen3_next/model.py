@@ -29,7 +29,13 @@ from automodel_tpu.moe.dispatch import make_moe_block_forward
 from automodel_tpu.utils.tracing import scoped
 from automodel_tpu.moe.layers import cast_moe_compute_params, init_moe_params, moe_logical_axes
 from automodel_tpu.ops.attention import dot_product_attention
-from automodel_tpu.ops.gated_delta import causal_conv1d, chunk_gated_delta_rule, gated_rms_norm
+from automodel_tpu.ops.gated_delta import (
+    causal_conv1d,
+    chunk_gated_delta_rule,
+    conv_state_from_prefill,
+    conv_step,
+    gated_rms_norm,
+)
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rope import apply_rope, rope_attention_scaling, rope_frequencies
 
@@ -303,7 +309,7 @@ class Qwen3NextForCausalLM:
     # ---- forward ----
 
     def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
-                 rules=None, return_hidden=False, training=True):
+                 rules=None, return_hidden=False, training=True, cache=None):
         cfg, backend = self.config, self.backend
         dtype = backend.jnp_dtype
         B, S = input_ids.shape
@@ -318,6 +324,13 @@ class Qwen3NextForCausalLM:
         attn_scale = rope_attention_scaling(cfg.rope_scaling)
 
         moe_fwd = make_moe_block_forward(cfg.moe, backend, rules, training=training)
+
+        if cache is not None:
+            if segment_ids is None:
+                raise ValueError("cache decoding requires segment_ids (1 = real token)")
+            h = params["embed"].astype(dtype)[input_ids]
+            return self._decode_forward(params, h, positions, segment_ids, cache,
+                                        dtype, moe_fwd, inv_freq, attn_scale)
 
         @scoped("moe")
         def moe_block(lp, h):
@@ -400,7 +413,8 @@ class Qwen3NextForCausalLM:
         logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
         return logits, stats
 
-    def _gated_delta_attn(self, lp, x, dtype, segment_ids=None):
+    def _gated_delta_attn(self, lp, x, dtype, segment_ids=None, token_mask=None,
+                          conv_state=None, rec_state=None, return_state=False):
         """Gated DeltaNet token mixer (HF Qwen3NextGatedDeltaNet.forward,
         modeling_qwen3_next.py:660-775).
 
@@ -409,12 +423,20 @@ class Qwen3NextForCausalLM:
         differences of cumulative sums, so the injection cancels exactly there and
         zeroes every cross-segment path (state carry, intra-chunk attention, and the
         chunk-state write). The conv masks its cross-segment taps directly.
+
+        Decode: ``conv_state`` ((B, K-1, C) trailing pre-conv inputs) and
+        ``rec_state`` ((B, Hv, dk, dv) delta-rule state) continue the recurrence;
+        ``return_state=True`` (prefill) extracts both from the prompt.
+        ``token_mask`` neutralizes right-padding: pad tokens get decay 1 / write
+        strength 0, so the state each row carries out of prefill is exactly its
+        last VALID token's. Stateful calls return ``(out, (conv_state, rec_state))``.
         """
         cfg = self.config
         B, S, _ = x.shape
         Hk, dk = cfg.linear_num_key_heads, cfg.linear_key_head_dim
         Hv, dv = cfg.linear_num_value_heads, cfg.linear_value_head_dim
         r = Hv // Hk
+        K = cfg.linear_conv_kernel_dim
 
         qkvz = jnp.einsum("bsd,dhm->bshm", x, lp["wqkvz"].astype(dtype))  # (B,S,Hk,2dk+2rdv)
         ba = jnp.einsum("bsd,dhm->bshm", x, lp["wba"].astype(dtype))  # (B,S,Hk,2r)
@@ -429,7 +451,11 @@ class Qwen3NextForCausalLM:
         g = -jnp.exp(lp["a_log"].astype(jnp.float32)) * jax.nn.softplus(
             a.astype(jnp.float32) + lp["dt_bias"].astype(jnp.float32)
         )
-        if segment_ids is not None:
+        if token_mask is not None:
+            valid = token_mask.astype(jnp.float32)[..., None]
+            beta = beta * valid  # pad: no write
+            g = g * valid  # pad: decay exp(0) = 1, state passes through
+        if segment_ids is not None and token_mask is None:
             # -50 in log space ≈ exp(-50) ~ 2e-22: dead past, still fp32-cancellable
             seg_start = jnp.concatenate(
                 [jnp.zeros((B, 1), bool), segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1
@@ -439,19 +465,40 @@ class Qwen3NextForCausalLM:
         mixed = jnp.concatenate(
             [q.reshape(B, S, Hk * dk), k.reshape(B, S, Hk * dk), v.reshape(B, S, Hv * dv)], axis=-1
         )
-        mixed = causal_conv1d(mixed, lp["conv_w"].astype(dtype), segment_ids=segment_ids)
-        q, k, v = jnp.split(mixed, [Hk * dk, 2 * Hk * dk], axis=-1)
+        new_conv = None
+        if conv_state is not None:
+            conv_out, new_conv = conv_step(conv_state, mixed, lp["conv_w"].astype(dtype))
+        else:
+            conv_out = causal_conv1d(
+                mixed, lp["conv_w"].astype(dtype),
+                segment_ids=segment_ids if token_mask is None else None,
+            )
+            if return_state:
+                lens = (token_mask.sum(-1) if token_mask is not None
+                        else jnp.full((B,), S, jnp.int32))
+                new_conv = conv_state_from_prefill(mixed, lens, K)
+        q, k, v = jnp.split(conv_out, [Hk * dk, 2 * Hk * dk], axis=-1)
         q = jnp.repeat(q.reshape(B, S, Hk, dk), r, axis=2)
         k = jnp.repeat(k.reshape(B, S, Hk, dk), r, axis=2)
         v = v.reshape(B, S, Hv, dv)
 
-        core, _ = chunk_gated_delta_rule(q, k, v, g, beta, chunk_size=64)
+        stateful = return_state or rec_state is not None
+        core, final = chunk_gated_delta_rule(
+            q, k, v, g, beta, chunk_size=min(64, S),
+            initial_state=rec_state, output_final_state=stateful,
+        )
         core = gated_rms_norm(core, lp["norm"].astype(dtype), z, cfg.rms_norm_eps)
-        return jnp.einsum("bshk,hkd->bsd", core, lp["wo"].astype(dtype))
+        out = jnp.einsum("bshk,hkd->bsd", core, lp["wo"].astype(dtype))
+        if stateful:
+            return out, (new_conv, final)
+        return out
 
-    def _gated_full_attn(self, lp, x, positions, segment_ids, inv_freq, attn_scale, dtype):
+    def _gated_full_attn(self, lp, x, positions, segment_ids, inv_freq, attn_scale, dtype,
+                         kv=None, cache_meta=None):
         """Full attention with per-head sigmoid output gate (reference
-        qwen3_next/layers.py:95-153)."""
+        qwen3_next/layers.py:95-153). With ``kv=(k_cache, v_cache)`` (decode) the
+        fresh k/v write into the cache and attention runs position-masked against
+        it; returns ``(out, (k_cache, v_cache))``."""
         cfg = self.config
         dh = cfg.head_dim
         qg = jnp.einsum("bsd,dhk->bshk", x, lp["wq"].astype(dtype))
@@ -462,6 +509,22 @@ class Qwen3NextForCausalLM:
         k = rms_norm(k, lp["k_norm"].astype(dtype), cfg.rms_norm_eps, offset=1.0)
         q = apply_rope(q, positions, inv_freq, attn_scale)
         k = apply_rope(k, positions, inv_freq, attn_scale)
+        if kv is not None:
+            from automodel_tpu.models.common.transformer import _cache_write
+
+            k_cache = _cache_write(kv[0], k.astype(kv[0].dtype), cache_meta["write_idx"])
+            v_cache = _cache_write(kv[1], v.astype(kv[1].dtype), cache_meta["write_idx"])
+            attn = dot_product_attention(
+                q, k_cache.astype(q.dtype), v_cache.astype(q.dtype),
+                causal=True,
+                segment_ids_q=segment_ids,
+                segment_ids_kv=cache_meta["valid"],
+                positions_q=positions,
+                positions_kv=cache_meta["positions"],
+                backend="xla",
+            )
+            attn = attn * jax.nn.sigmoid(gate)
+            return jnp.einsum("bshk,hkd->bsd", attn, lp["wo"].astype(dtype)), (k_cache, v_cache)
         attn = dot_product_attention(
             q, k, v,
             causal=True,
@@ -471,6 +534,87 @@ class Qwen3NextForCausalLM:
         )
         attn = attn * jax.nn.sigmoid(gate)
         return jnp.einsum("bshk,hkd->bsd", attn, lp["wo"].astype(dtype))
+
+    # ---- decode ----
+
+    def init_decode_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16) -> dict:
+        """Hybrid decode cache: KV for the full-attention layers, conv taps +
+        delta-rule state (fp32 — the recurrence compounds rounding) for the
+        DeltaNet layers. positions/valid/write_idx follow the generation loop's
+        shared-contract (generation.init_kv_cache)."""
+        cfg = self.config
+        Lf = len(cfg.full_layer_indices)
+        Ll = len(cfg.linear_layer_indices)
+        Hv, dk, dv = cfg.linear_num_value_heads, cfg.linear_key_head_dim, cfg.linear_value_head_dim
+        return {
+            "k": jnp.zeros((Lf, batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim), dtype),
+            "v": jnp.zeros((Lf, batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim), dtype),
+            "conv": jnp.zeros((Ll, batch_size, cfg.linear_conv_kernel_dim - 1, cfg.conv_dim), dtype),
+            "rec": jnp.zeros((Ll, batch_size, Hv, dk, dv), jnp.float32),
+            "positions": jnp.zeros((batch_size, max_len), jnp.int32),
+            "valid": jnp.zeros((batch_size, max_len), jnp.int32),
+            "write_idx": jnp.zeros((batch_size,), jnp.int32),
+        }
+
+    def _decode_forward(self, params, h, positions, segment_ids, cache, dtype,
+                        moe_fwd, inv_freq, attn_scale):
+        """Unrolled cached forward (prefill S>1, decode S=1). Layer scanning is
+        skipped: decode shapes are tiny and the per-kind cache threading (kv vs
+        conv+rec) is simplest unrolled."""
+        cfg = self.config
+        S = h.shape[1]
+        token_mask = segment_ids != 0
+        cache_meta = {"write_idx": cache["write_idx"], "valid": cache["valid"],
+                      "positions": cache["positions"]}
+        lin_params = params.get("linear_layers")
+        full_params = params.get("full_layers")
+        k_all, v_all = cache["k"], cache["v"]
+        conv_all, rec_all = cache["conv"], cache["rec"]
+        lin_i = full_i = 0
+        for t in cfg.layer_types:
+            if t == LINEAR:
+                lp = jax.tree.map(lambda a, i=lin_i: a[i], lin_params)
+                x = rms_norm(h, lp["attn_norm"].astype(dtype), cfg.rms_norm_eps, offset=1.0)
+                x = x * token_mask[..., None].astype(x.dtype)
+                out, (nc, nr) = self._gated_delta_attn(
+                    lp, x, dtype, token_mask=token_mask,
+                    conv_state=(conv_all[lin_i] if S == 1 else None),
+                    rec_state=rec_all[lin_i], return_state=True,
+                )
+                conv_all = conv_all.at[lin_i].set(nc.astype(conv_all.dtype))
+                rec_all = rec_all.at[lin_i].set(nr)
+                h = h + out
+                lin_i += 1
+            else:
+                lp = jax.tree.map(lambda a, i=full_i: a[i], full_params)
+                x = rms_norm(h, lp["attn_norm"].astype(dtype), cfg.rms_norm_eps, offset=1.0)
+                out, (kc, vc) = self._gated_full_attn(
+                    lp, x, positions, segment_ids, inv_freq, attn_scale, dtype,
+                    kv=(k_all[full_i], v_all[full_i]), cache_meta=cache_meta,
+                )
+                k_all = k_all.at[full_i].set(kc)
+                v_all = v_all.at[full_i].set(vc)
+                h = h + out
+                full_i += 1
+            x = rms_norm(h, lp["mlp_norm"].astype(dtype), cfg.rms_norm_eps, offset=1.0)
+            moe_params = cast_moe_compute_params(lp["moe"], dtype)
+            y, _, _, _ = moe_fwd(moe_params, x, token_mask)
+            h = h + y
+        h = rms_norm(h, params["final_norm"].astype(dtype), cfg.rms_norm_eps, offset=1.0)
+        # next-token logits only (B, 1, V)
+        last = jnp.maximum(segment_ids.sum(-1) - 1, 0).astype(jnp.int32)
+        h = jnp.take_along_axis(h, last[:, None, None], axis=1)
+        unembed = params.get("lm_head")
+        if unembed is None:
+            unembed = params["embed"].T
+        logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
+        return logits, dict(cache, k=k_all, v=v_all, conv=conv_all, rec=rec_all)
+
+    def generate(self, params, input_ids, **kw):
+        """Sample with the hybrid conv+recurrence+KV cache (automodel_tpu.generation)."""
+        from automodel_tpu.generation import generate
+
+        return generate(self, params, input_ids, **kw)
 
     # ---- interop ----
 

@@ -21,7 +21,36 @@ import jax.numpy as jnp
 
 _P = jax.lax.Precision.HIGHEST  # delta-rule recurrence compounds matmul error; keep fp32 MXU passes
 
-__all__ = ["l2norm", "causal_conv1d", "gated_rms_norm", "chunk_gated_delta_rule"]
+__all__ = [
+    "l2norm", "causal_conv1d", "conv_state_from_prefill", "conv_step",
+    "gated_rms_norm", "chunk_gated_delta_rule",
+]
+
+
+def conv_state_from_prefill(x: jnp.ndarray, lens: jnp.ndarray, kernel: int) -> jnp.ndarray:
+    """Trailing ``kernel-1`` VALID pre-conv inputs per row — the decode conv state
+    after a right-padded prefill. ``x`` (B, S, C), ``lens`` (B,) valid lengths
+    (valid region contiguous from 0). Short prompts left-fill with zeros, matching
+    the causal conv's implicit left padding."""
+    padded = jnp.pad(x, ((0, 0), (kernel - 1, 0), (0, 0)))
+    return jax.vmap(
+        lambda p, n: jax.lax.dynamic_slice(p, (n, 0), (kernel - 1, p.shape[-1]))
+    )(padded, lens.astype(jnp.int32))
+
+
+def conv_step(
+    state: jnp.ndarray,  # (B, K-1, C) trailing pre-conv inputs
+    x: jnp.ndarray,  # (B, s, C) new pre-conv inputs (decode: s = 1)
+    weight: jnp.ndarray,  # (C, K)
+    bias: jnp.ndarray | None = None,
+    activation: str = "silu",
+):
+    """Continue a causal depthwise conv from carried state: returns
+    ``(out (B, s, C), new_state (B, K-1, C))``."""
+    kernel = weight.shape[-1]
+    full = jnp.concatenate([state.astype(x.dtype), x], axis=1)
+    out = causal_conv1d(full, weight, activation=activation, bias=bias)[:, kernel - 1:]
+    return out, full[:, full.shape[1] - (kernel - 1):]
 
 
 def l2norm(x: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:

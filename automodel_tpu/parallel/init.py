@@ -16,7 +16,7 @@ import jax
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["DistInfo", "initialize_distributed", "barrier", "is_main_process"]
+__all__ = ["DistInfo", "initialize_distributed", "barrier", "is_main_process", "main_process_first", "any_process_flag"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,6 +100,38 @@ def barrier(name: str = "barrier") -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(name)
+
+
+def main_process_first(name: str = "main_process_first"):
+    """Context manager: process 0 runs the body before the rest proceed
+    (reference FirstRankPerNode, distributed/utils.py:94-170). Wrap shared-FS
+    work — dataset index builds, HF snapshot downloads — so one host pays for
+    it and the others read the cache. Yields True on the process that should do
+    the work. Single-process: no-op, yields True.
+
+    Every process passes exactly ONE barrier, so control flow must not branch
+    around the ``with`` block on a per-process basis.
+    """
+    from contextlib import contextmanager
+
+    @contextmanager
+    def ctx():
+        if jax.process_count() == 1:
+            yield True
+            return
+        if jax.process_index() == 0:
+            try:
+                yield True
+            finally:
+                # release the other hosts even when the body raises — otherwise
+                # they hang forever in sync_global_devices while only process 0
+                # sees the failure
+                barrier(name)
+        else:
+            barrier(name)  # wait for process 0 to finish the body
+            yield False
+
+    return ctx()
 
 
 def any_process_flag(flag: bool) -> bool:

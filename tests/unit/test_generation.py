@@ -136,26 +136,18 @@ class TestMoEDecode:
         got = generate(model, params, prompts, max_new_tokens=5, temperature=0.0)
         np.testing.assert_array_equal(np.asarray(got["tokens"]), want)
 
-    def test_hybrid_recurrence_raises(self):
-        """Real hybrids (qwen3-next: DeltaNet recurrence, has num_key_value_heads
-        for its full-attention layers but no cache param) point at HF export
+    def test_cacheless_model_raises(self):
+        """Models whose forward has no cache path (step3p5) point at HF export
         instead of TypeError-ing inside jit."""
-        from automodel_tpu.models.auto import AutoModelForCausalLM
+        from automodel_tpu.models.step3p5.model import Step3p5Config, Step3p5ForCausalLM
 
-        model = AutoModelForCausalLM.from_config(
-            {"architectures": ["Qwen3NextForCausalLM"], "vocab_size": 128,
-             "hidden_size": 64, "intermediate_size": 96, "moe_intermediate_size": 32,
-             "shared_expert_intermediate_size": 32, "num_hidden_layers": 4,
-             "full_attention_interval": 4, "num_attention_heads": 4,
-             "num_key_value_heads": 2, "head_dim": 16,
-             "linear_num_value_heads": 4, "linear_num_key_heads": 2,
-             "linear_key_head_dim": 16, "linear_value_head_dim": 16,
-             "linear_conv_kernel_dim": 4, "num_experts": 4,
-             "num_experts_per_tok": 2, "max_position_embeddings": 64},
-            BackendConfig(dtype="float32", remat_policy="none"),
+        cfg = Step3p5Config(
+            vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+            num_attention_heads=4, num_attention_groups=2, head_dim=16,
         )
+        model = Step3p5ForCausalLM(cfg, BackendConfig(dtype="float32", remat_policy="full"))
         params = model.init(jax.random.key(0), jnp.float32)
-        with pytest.raises(NotImplementedError, match="hybrid recurrence"):
+        with pytest.raises(NotImplementedError, match="no cache path"):
             generate(model, params, np.zeros((1, 4), np.int32), max_new_tokens=2)
 
 
@@ -300,3 +292,118 @@ class TestMLADecode:
                              cache_dtype=jnp.float32)
         assert int(out["tokens"][0, 0]) == full(list(ids[0]))
         assert int(out["tokens"][1, 0]) == full(list(ids[1, :4]))
+
+
+class TestHybridDecode:
+    def _tiny_next(self):
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+
+        return AutoModelForCausalLM.from_config(
+            {"architectures": ["Qwen3NextForCausalLM"], "vocab_size": 128,
+             "hidden_size": 64, "intermediate_size": 96, "moe_intermediate_size": 32,
+             "shared_expert_intermediate_size": 32, "num_hidden_layers": 4,
+             "full_attention_interval": 4, "num_attention_heads": 4,
+             "num_key_value_heads": 2, "head_dim": 16,
+             "linear_num_value_heads": 4, "linear_num_key_heads": 2,
+             "linear_key_head_dim": 16, "linear_value_head_dim": 16,
+             "linear_conv_kernel_dim": 4, "num_experts": 4,
+             "num_experts_per_tok": 2, "norm_topk_prob": True,
+             "max_position_embeddings": 64},
+            BackendConfig(dtype="float32", remat_policy="none"),
+        )
+
+    def test_qwen3_next_cache_matches_full(self):
+        """Hybrid decode (conv taps + delta-rule state + KV for the periodic
+        full-attention layer) == full recompute, greedy."""
+        model = self._tiny_next()
+        params = model.init(jax.random.key(9), jnp.float32)
+        rng = np.random.RandomState(10)
+        prompts = rng.randint(0, 128, (2, 6)).astype(np.int32)
+
+        def full(row, n_new):
+            ids = list(row)
+            for _ in range(n_new):
+                x = jnp.asarray([ids], jnp.int32)
+                logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
+                ids.append(int(np.asarray(logits)[0, -1].argmax()))
+            return ids[len(row):]
+
+        want = np.asarray([full(r, 5) for r in prompts], np.int32)
+        out = model.generate(params, prompts, max_new_tokens=5, cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
+
+    def test_uneven_padded_prompts(self):
+        """Right-padding must not pollute the conv taps or the recurrent state."""
+        model = self._tiny_next()
+        params = model.init(jax.random.key(11), jnp.float32)
+        rng = np.random.RandomState(12)
+        ids = rng.randint(1, 128, (2, 7)).astype(np.int32)
+        mask = np.ones((2, 7), np.int32)
+        ids[1, 4:] = 0
+        mask[1, 4:] = 0
+
+        def full_next(row):
+            x = jnp.asarray([row], jnp.int32)
+            logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
+            return int(np.asarray(logits)[0, -1].argmax())
+
+        out = model.generate(params, ids, attention_mask=mask, max_new_tokens=1,
+                             cache_dtype=jnp.float32)
+        assert int(out["tokens"][0, 0]) == full_next(list(ids[0]))
+        assert int(out["tokens"][1, 0]) == full_next(list(ids[1, :4]))
+
+
+class TestNemotronDecode:
+    def _tiny(self):
+        from automodel_tpu.models.nemotron_v3.model import NemotronHForCausalLM, NemotronV3Config
+        from automodel_tpu.moe.config import MoEConfig
+
+        cfg = NemotronV3Config(
+            vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=4,
+            layers_block_type=("mamba", "attention", "mlp", "moe"),
+            num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+            mamba_num_heads=4, mamba_head_dim=8, ssm_state_size=16, n_groups=2,
+            chunk_size=16, conv_kernel=4,
+            moe=MoEConfig(
+                n_routed_experts=4, n_activated_experts=2, dim=64, moe_inter_dim=32,
+                score_func="sigmoid", expert_activation="relu2",
+            ),
+        )
+        model = NemotronHForCausalLM(cfg, BackendConfig(dtype="float32", remat_policy="full"))
+        return model, model.init(jax.random.key(13), jnp.float32)
+
+    def test_cache_matches_full(self):
+        """Mamba2 SSD state + conv taps + KV decode == full recompute, greedy."""
+        model, params = self._tiny()
+        rng = np.random.RandomState(14)
+        prompts = rng.randint(0, 128, (2, 6)).astype(np.int32)
+
+        def full(row, n_new):
+            ids = list(row)
+            for _ in range(n_new):
+                x = jnp.asarray([ids], jnp.int32)
+                logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
+                ids.append(int(np.asarray(logits)[0, -1].argmax()))
+            return ids[len(row):]
+
+        want = np.asarray([full(r, 5) for r in prompts], np.int32)
+        out = model.generate(params, prompts, max_new_tokens=5, cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
+
+    def test_uneven_padded_prompts(self):
+        model, params = self._tiny()
+        rng = np.random.RandomState(15)
+        ids = rng.randint(1, 128, (2, 7)).astype(np.int32)
+        mask = np.ones((2, 7), np.int32)
+        ids[1, 3:] = 0
+        mask[1, 3:] = 0
+
+        def full_next(row):
+            x = jnp.asarray([row], jnp.int32)
+            logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
+            return int(np.asarray(logits)[0, -1].argmax())
+
+        out = model.generate(params, ids, attention_mask=mask, max_new_tokens=1,
+                             cache_dtype=jnp.float32)
+        assert int(out["tokens"][0, 0]) == full_next(list(ids[0]))
+        assert int(out["tokens"][1, 0]) == full_next(list(ids[1, :3]))

@@ -82,3 +82,14 @@ class TestMeshAxisGroups:
     def test_groups(self):
         assert MeshAxis.DATA == ("dp_replicate", "dp_shard", "ep")
         assert MeshAxis.FSDP == ("dp_shard", "ep", "cp")
+
+
+class TestMainProcessFirst:
+    def test_single_process_yields_true(self):
+        from automodel_tpu.parallel.init import main_process_first
+
+        ran = []
+        with main_process_first("t") as should_work:
+            if should_work:
+                ran.append(1)
+        assert ran == [1]
