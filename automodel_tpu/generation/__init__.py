@@ -1,4 +1,4 @@
-"""KV-cache generation for the dense + MoE (GQA) decoder stacks.
+"""KV-cache generation for every causal decoder stack.
 
 The reference gets generation from the wrapped HF modules' ``.generate()``
 (its factory returns torch models — see examples/vlm_generate/vlm_generate.py:1);
@@ -14,9 +14,11 @@ cache as scan-xs and emits the updated slices as scan-ys. ``positions`` /
 here, not by the model.
 
 MLA families (DeepSeek-V3/V2, Kimi-K2, GLM4-MoE-Lite) decode through an
-expanded-head cache (see :func:`init_kv_cache`). Hybrid recurrences
-(mamba/DeltaNet state caching) are not wired yet and raise with a pointer at
-HF export; so does the V3.2 sparse indexer (its bias is sequence-global).
+expanded-head cache (see :func:`init_kv_cache`). Hybrids (Qwen3-Next DeltaNet,
+Nemotron Mamba2) build their own cache via ``model.init_decode_cache`` —
+conv taps + recurrent state instead of per-position KV. Models with no cache
+path (gpt2, the V3.2 sparse indexer whose bias is sequence-global) raise with
+a pointer at HF export.
 """
 
 from __future__ import annotations

@@ -94,12 +94,18 @@ class Glm4MoeForCausalLM:
         return jax.eval_shape(lambda k: self.init(k, dtype), jax.random.key(0))
 
     def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
-                 rules=None, return_hidden=False, training=True):
+                 rules=None, return_hidden=False, training=True, cache=None):
         return moe_decoder_forward(
             self.config, self.backend, params, input_ids,
             positions=positions, segment_ids=segment_ids, token_mask=token_mask,
-            rules=rules, return_hidden=return_hidden, training=training,
+            rules=rules, return_hidden=return_hidden, training=training, cache=cache,
         )
+
+    def generate(self, params, input_ids, **kw):
+        """Sample with a KV cache (see :func:`automodel_tpu.generation.generate`)."""
+        from automodel_tpu.generation import generate
+
+        return generate(self, params, input_ids, **kw)
 
     def state_dict_adapter(self):
         from automodel_tpu.models.glm4_moe.state_dict_adapter import Glm4MoeStateDictAdapter

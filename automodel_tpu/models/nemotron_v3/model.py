@@ -476,6 +476,8 @@ class NemotronHForCausalLM:
         """Unrolled cached forward (prefill S>1, decode S=1). Right-padding is
         neutralized in the recurrence by zeroing dt (decay exp(0·A)=1, write
         dt·B·x=0) and in the conv by gathering each row's trailing VALID inputs."""
+        from automodel_tpu.models.common.transformer import _cache_write
+
         cfg = self.config
         eps = cfg.layer_norm_epsilon
         B, S = input_ids.shape
@@ -539,8 +541,6 @@ class NemotronHForCausalLM:
                 h = h + out
                 m_i += 1
             elif t == "attention":
-                from automodel_tpu.models.common.transformer import _cache_write
-
                 x = rms_norm(h, lp["norm"], eps).astype(dtype)
                 q = jnp.einsum("bsd,dnh->bsnh", x, lp["wq"])
                 k = jnp.einsum("bsd,dnh->bsnh", x, lp["wk"])

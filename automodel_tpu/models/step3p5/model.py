@@ -280,13 +280,17 @@ class Step3p5ForCausalLM:
     # ---- forward ----
 
     def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
-                 rules=None, return_hidden=False, training=True):
+                 rules=None, return_hidden=False, training=True, cache=None):
         cfg, backend = self.config, self.backend
         dtype = backend.jnp_dtype
         B, S = input_ids.shape
         eps = cfg.rms_norm_eps
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        if cache is not None:
+            if segment_ids is None:
+                raise ValueError("cache decoding requires segment_ids (1 = real token)")
+            return self._decode_forward(params, input_ids, positions, segment_ids, cache, dtype)
         emit_aux = (
             cfg.moe is not None and cfg.moe.aux_loss_coeff > 0 and training
             and not backend.fake_balanced_gate
@@ -407,6 +411,103 @@ class Step3p5ForCausalLM:
             unembed = params["embed"].T
         logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
         return logits, stats
+
+    # ---- decode ----
+
+    def init_decode_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16) -> dict:
+        """Per-layer KV tuples: sliding layers may use DIFFERENT head counts
+        (attention_other_setting), so the cache is a tuple of per-layer arrays
+        rather than one stacked (L, ...) tensor."""
+        cfg = self.config
+        ks, vs = [], []
+        for i in range(cfg.num_hidden_layers):
+            _, kv = cfg.heads(i)
+            ks.append(jnp.zeros((batch_size, max_len, kv, cfg.head_dim), dtype))
+            vs.append(jnp.zeros((batch_size, max_len, kv, cfg.head_dim), dtype))
+        return {
+            "k": tuple(ks),
+            "v": tuple(vs),
+            "positions": jnp.zeros((batch_size, max_len), jnp.int32),
+            "valid": jnp.zeros((batch_size, max_len), jnp.int32),
+            "write_idx": jnp.zeros((batch_size,), jnp.int32),
+        }
+
+    def _decode_forward(self, params, input_ids, positions, segment_ids, cache, dtype):
+        """Unrolled cached forward (prefill S>1, decode S=1) across the mixed
+        attention geometries; MoE routing runs eval-mode."""
+        from automodel_tpu.models.common.transformer import _cache_write
+
+        cfg = self.config
+        eps = cfg.rms_norm_eps
+        B, S = input_ids.shape
+        token_mask = segment_ids != 0
+        moe_fwd = (
+            make_moe_block_forward(cfg.moe, self.backend, None, training=False)
+            if cfg.moe is not None else None
+        )
+        h = params["embed"].astype(dtype)[input_ids]
+        ks = list(cache["k"])
+        vs = list(cache["v"])
+        stream_offsets = dict.fromkeys(cfg.stream_indices(), 0)
+        for i in range(cfg.num_hidden_layers):
+            skey = cfg.stream_key(i)
+            o = stream_offsets[skey]
+            stream_offsets[skey] = o + 1
+            lp = jax.tree.map(lambda a: a[o], params[skey])
+            moe_params = lp.pop("moe", None)
+            lp = jax.tree.map(lambda a: a.astype(dtype), lp)
+            akind, fkind = cfg.attn_kind(i), cfg.ffn_kind(i)
+            window = cfg.sliding_window if akind == "sliding" else None
+            x = rms_norm(h, lp["attn_norm"], eps, offset=1.0)
+            q = jnp.einsum("bsd,dnh->bsnh", x, lp["wq"])
+            k = jnp.einsum("bsd,dnh->bsnh", x, lp["wk"])
+            v = jnp.einsum("bsd,dnh->bsnh", x, lp["wv"])
+            q = rms_norm(q, lp["q_norm"], eps, offset=1.0)
+            k = rms_norm(k, lp["k_norm"], eps, offset=1.0)
+            if cfg.use_rope(i):
+                inv_freq = rope_frequencies(
+                    cfg.head_dim, cfg.theta(i), None, partial_rotary_factor=cfg.prf(i)
+                )
+                angles = positions[..., None].astype(jnp.float32) * inv_freq
+                q = apply_rope_angles(q, angles)
+                k = apply_rope_angles(k, angles)
+            ks[i] = _cache_write(ks[i], k.astype(ks[i].dtype), cache["write_idx"])
+            vs[i] = _cache_write(vs[i], v.astype(vs[i].dtype), cache["write_idx"])
+            out = dot_product_attention(
+                q, ks[i].astype(q.dtype), vs[i].astype(q.dtype),
+                causal=True, segment_ids_q=segment_ids,
+                segment_ids_kv=cache["valid"],
+                positions_q=positions, positions_kv=cache["positions"],
+                sliding_window=window, backend="xla",
+            )
+            if cfg.use_head_wise_attn_gate:
+                gate = jax.nn.sigmoid(jnp.einsum("bsd,dn->bsn", x, lp["wg"]))
+                out = out * gate[..., None]
+            h = h + jnp.einsum("bsnh,nhd->bsd", out, lp["wo"])
+
+            x = rms_norm(h, lp["mlp_norm"], eps, offset=1.0)
+            limit = cfg.shared_limit(i)
+            if fkind == "mlp":
+                h = h + _clamped_swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"], limit)
+            else:
+                share = _clamped_swiglu(x, lp["sh_gate"], lp["sh_up"], lp["sh_down"], limit)
+                moe_params = cast_moe_compute_params(moe_params, dtype)
+                y, _, _, _ = moe_fwd(moe_params, x, token_mask)
+                h = h + share + y
+        h = rms_norm(h, params["final_norm"].astype(dtype), eps, offset=1.0)
+        last = jnp.maximum(segment_ids.sum(-1) - 1, 0).astype(jnp.int32)
+        h = jnp.take_along_axis(h, last[:, None, None], axis=1)
+        unembed = params.get("lm_head")
+        if unembed is None:
+            unembed = params["embed"].T
+        logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
+        return logits, dict(cache, k=tuple(ks), v=tuple(vs))
+
+    def generate(self, params, input_ids, **kw):
+        """Sample with the per-layer-geometry KV cache (automodel_tpu.generation)."""
+        from automodel_tpu.generation import generate
+
+        return generate(self, params, input_ids, **kw)
 
     # ---- interop ----
 

@@ -8,6 +8,7 @@ then ride ICI (intra-slice) / DCN (multi-slice) automatically. Host-side side-ch
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import os
@@ -102,6 +103,7 @@ def barrier(name: str = "barrier") -> None:
         multihost_utils.sync_global_devices(name)
 
 
+@contextlib.contextmanager
 def main_process_first(name: str = "main_process_first"):
     """Context manager: process 0 runs the body before the rest proceed
     (reference FirstRankPerNode, distributed/utils.py:94-170). Wrap shared-FS
@@ -112,26 +114,20 @@ def main_process_first(name: str = "main_process_first"):
     Every process passes exactly ONE barrier, so control flow must not branch
     around the ``with`` block on a per-process basis.
     """
-    from contextlib import contextmanager
-
-    @contextmanager
-    def ctx():
-        if jax.process_count() == 1:
+    if jax.process_count() == 1:
+        yield True
+        return
+    if jax.process_index() == 0:
+        try:
             yield True
-            return
-        if jax.process_index() == 0:
-            try:
-                yield True
-            finally:
-                # release the other hosts even when the body raises — otherwise
-                # they hang forever in sync_global_devices while only process 0
-                # sees the failure
-                barrier(name)
-        else:
-            barrier(name)  # wait for process 0 to finish the body
-            yield False
-
-    return ctx()
+        finally:
+            # release the other hosts even when the body raises — otherwise
+            # they hang forever in sync_global_devices while only process 0
+            # sees the failure
+            barrier(name)
+    else:
+        barrier(name)  # wait for process 0 to finish the body
+        yield False
 
 
 def any_process_flag(flag: bool) -> bool:

@@ -41,6 +41,18 @@ def _full_greedy(model, params, prompt_rows, n_new):
     return np.asarray(outs, np.int32)
 
 
+def _greedy_full_stats(model, params, row, n_new):
+    """Reference decode for (logits, stats)-returning models: full forward over
+    the growing sequence, eval-mode gating."""
+    ids = list(row)
+    for _ in range(n_new):
+        x = jnp.asarray([ids], jnp.int32)
+        logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
+        ids.append(int(np.asarray(logits)[0, -1].argmax()))
+    return ids[len(row):]
+
+
+
 class TestCacheParity:
     def test_greedy_matches_full_recompute(self):
         model, params = _tiny_llama()
@@ -124,28 +136,17 @@ class TestMoEDecode:
         rng = np.random.RandomState(4)
         prompts = rng.randint(0, 128, (2, 6)).astype(np.int32)
 
-        def full(row, n_new):
-            ids = list(row)
-            for _ in range(n_new):
-                x = jnp.asarray([ids], jnp.int32)
-                logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
-                ids.append(int(np.asarray(logits)[0, -1].argmax()))
-            return ids[len(row):]
-
-        want = np.asarray([full(r, 5) for r in prompts], np.int32)
+        want = np.asarray([_greedy_full_stats(model, params, r, 5) for r in prompts], np.int32)
         got = generate(model, params, prompts, max_new_tokens=5, temperature=0.0)
         np.testing.assert_array_equal(np.asarray(got["tokens"]), want)
 
     def test_cacheless_model_raises(self):
-        """Models whose forward has no cache path (step3p5) point at HF export
-        instead of TypeError-ing inside jit."""
-        from automodel_tpu.models.step3p5.model import Step3p5Config, Step3p5ForCausalLM
+        """Models whose forward has no cache path (gpt2: learned positions, no
+        decode wiring) point at HF export instead of TypeError-ing inside jit."""
+        from automodel_tpu.models.gpt2.model import GPT2Config, GPT2LMHeadModel
 
-        cfg = Step3p5Config(
-            vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
-            num_attention_heads=4, num_attention_groups=2, head_dim=16,
-        )
-        model = Step3p5ForCausalLM(cfg, BackendConfig(dtype="float32", remat_policy="full"))
+        cfg = GPT2Config(vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64)
+        model = GPT2LMHeadModel(cfg, BackendConfig(dtype="float32", remat_policy="full"))
         params = model.init(jax.random.key(0), jnp.float32)
         with pytest.raises(NotImplementedError, match="no cache path"):
             generate(model, params, np.zeros((1, 4), np.int32), max_new_tokens=2)
@@ -247,15 +248,7 @@ class TestMLADecode:
         rng = np.random.RandomState(5)
         prompts = rng.randint(0, 128, (2, 6)).astype(np.int32)
 
-        def full(row, n_new):
-            ids = list(row)
-            for _ in range(n_new):
-                x = jnp.asarray([ids], jnp.int32)
-                logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
-                ids.append(int(np.asarray(logits)[0, -1].argmax()))
-            return ids[len(row):]
-
-        want = np.asarray([full(r, 5) for r in prompts], np.int32)
+        want = np.asarray([_greedy_full_stats(model, params, r, 5) for r in prompts], np.int32)
         out = model.generate(params, prompts, max_new_tokens=5, cache_dtype=jnp.float32)
         np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
 
@@ -283,15 +276,10 @@ class TestMLADecode:
         ids[1, 4:] = 0
         mask[1, 4:] = 0
 
-        def full(row):
-            x = jnp.asarray([row], jnp.int32)
-            logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
-            return int(np.asarray(logits)[0, -1].argmax())
-
         out = model.generate(params, ids, attention_mask=mask, max_new_tokens=1,
                              cache_dtype=jnp.float32)
-        assert int(out["tokens"][0, 0]) == full(list(ids[0]))
-        assert int(out["tokens"][1, 0]) == full(list(ids[1, :4]))
+        assert int(out["tokens"][0, 0]) == _greedy_full_stats(model, params, list(ids[0]), 1)[0]
+        assert int(out["tokens"][1, 0]) == _greedy_full_stats(model, params, list(ids[1, :4]), 1)[0]
 
 
 class TestHybridDecode:
@@ -320,15 +308,7 @@ class TestHybridDecode:
         rng = np.random.RandomState(10)
         prompts = rng.randint(0, 128, (2, 6)).astype(np.int32)
 
-        def full(row, n_new):
-            ids = list(row)
-            for _ in range(n_new):
-                x = jnp.asarray([ids], jnp.int32)
-                logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
-                ids.append(int(np.asarray(logits)[0, -1].argmax()))
-            return ids[len(row):]
-
-        want = np.asarray([full(r, 5) for r in prompts], np.int32)
+        want = np.asarray([_greedy_full_stats(model, params, r, 5) for r in prompts], np.int32)
         out = model.generate(params, prompts, max_new_tokens=5, cache_dtype=jnp.float32)
         np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
 
@@ -342,15 +322,10 @@ class TestHybridDecode:
         ids[1, 4:] = 0
         mask[1, 4:] = 0
 
-        def full_next(row):
-            x = jnp.asarray([row], jnp.int32)
-            logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
-            return int(np.asarray(logits)[0, -1].argmax())
-
         out = model.generate(params, ids, attention_mask=mask, max_new_tokens=1,
                              cache_dtype=jnp.float32)
-        assert int(out["tokens"][0, 0]) == full_next(list(ids[0]))
-        assert int(out["tokens"][1, 0]) == full_next(list(ids[1, :4]))
+        assert int(out["tokens"][0, 0]) == _greedy_full_stats(model, params, list(ids[0]), 1)[0]
+        assert int(out["tokens"][1, 0]) == _greedy_full_stats(model, params, list(ids[1, :4]), 1)[0]
 
 
 class TestNemotronDecode:
@@ -378,15 +353,7 @@ class TestNemotronDecode:
         rng = np.random.RandomState(14)
         prompts = rng.randint(0, 128, (2, 6)).astype(np.int32)
 
-        def full(row, n_new):
-            ids = list(row)
-            for _ in range(n_new):
-                x = jnp.asarray([ids], jnp.int32)
-                logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
-                ids.append(int(np.asarray(logits)[0, -1].argmax()))
-            return ids[len(row):]
-
-        want = np.asarray([full(r, 5) for r in prompts], np.int32)
+        want = np.asarray([_greedy_full_stats(model, params, r, 5) for r in prompts], np.int32)
         out = model.generate(params, prompts, max_new_tokens=5, cache_dtype=jnp.float32)
         np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
 
@@ -398,12 +365,57 @@ class TestNemotronDecode:
         ids[1, 3:] = 0
         mask[1, 3:] = 0
 
-        def full_next(row):
-            x = jnp.asarray([row], jnp.int32)
-            logits, _ = model(params, x, segment_ids=jnp.ones_like(x), training=False)
-            return int(np.asarray(logits)[0, -1].argmax())
-
         out = model.generate(params, ids, attention_mask=mask, max_new_tokens=1,
                              cache_dtype=jnp.float32)
-        assert int(out["tokens"][0, 0]) == full_next(list(ids[0]))
-        assert int(out["tokens"][1, 0]) == full_next(list(ids[1, :3]))
+        assert int(out["tokens"][0, 0]) == _greedy_full_stats(model, params, list(ids[0]), 1)[0]
+        assert int(out["tokens"][1, 0]) == _greedy_full_stats(model, params, list(ids[1, :3]), 1)[0]
+
+
+class TestMixedGeometryDecode:
+    def test_step3p5_cache_matches_full(self):
+        """Per-layer KV tuples (sliding layers use different head counts) decode
+        == full recompute across the mixed geometries + head-wise gate + MoE."""
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_config(
+            {"architectures": ["Step3p5ForCausalLM"], "vocab_size": 128,
+             "hidden_size": 64, "intermediate_size": 96, "num_hidden_layers": 4,
+             "num_attention_heads": 4, "num_attention_groups": 2, "head_dim": 16,
+             "layer_types": ["full_attention", "sliding_attention",
+                             "full_attention", "sliding_attention"],
+             "attention_other_setting": {"num_attention_heads": 2, "num_attention_groups": 1},
+             "sliding_window": 4, "use_head_wise_attn_gate": True,
+             "moe_layers_enum": "2,3", "moe_num_experts": 4, "moe_top_k": 2,
+             "moe_intermediate_size": 32, "share_expert_dims": 48,
+             "max_position_embeddings": 64},
+            BackendConfig(dtype="float32", remat_policy="none"),
+        )
+        params = model.init(jax.random.key(16), jnp.float32)
+        rng = np.random.RandomState(17)
+        prompts = rng.randint(0, 128, (2, 6)).astype(np.int32)
+
+        want = np.asarray([_greedy_full_stats(model, params, r, 5) for r in prompts], np.int32)
+        out = model.generate(params, prompts, max_new_tokens=5, cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
+
+    def test_gpt_oss_sinks_sliding_decode(self):
+        """gpt-oss decode: sinks + alternating sliding windows through the
+        common MoE stack's cache path."""
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_config(
+            {"architectures": ["GptOssForCausalLM"], "vocab_size": 128,
+             "hidden_size": 64, "intermediate_size": 48, "num_hidden_layers": 2,
+             "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+             "num_local_experts": 4, "num_experts_per_tok": 2, "sliding_window": 4,
+             "layer_types": ["sliding_attention", "full_attention"],
+             "max_position_embeddings": 64, "swiglu_limit": 7.0},
+            BackendConfig(dtype="float32", remat_policy="none"),
+        )
+        params = model.init(jax.random.key(18), jnp.float32)
+        rng = np.random.RandomState(19)
+        prompts = rng.randint(0, 128, (2, 6)).astype(np.int32)
+
+        want = np.asarray([_greedy_full_stats(model, params, r, 6) for r in prompts], np.int32)
+        out = model.generate(params, prompts, max_new_tokens=6, cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
