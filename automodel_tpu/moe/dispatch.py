@@ -50,7 +50,7 @@ def make_moe_block_forward(cfg: MoEConfig, backend, rules=None, *, training: boo
                 "backend.dispatcher='a2a' requires sharding rules bound to a mesh "
                 f"with an 'ep' axis (MeshContext(ep=...)); got mesh={mesh!r}"
             )
-        return make_ep_moe_forward(
+        ep_fn = make_ep_moe_forward(
             cfg,
             mesh,
             capacity_factor=backend.ep_capacity_factor,
@@ -58,6 +58,19 @@ def make_moe_block_forward(cfg: MoEConfig, backend, rules=None, *, training: boo
             fake_balanced_gate=backend.fake_balanced_gate,
             fake_gate_noise=backend.fake_gate_noise,
         )
+        act_sharding = rules.sharding(("batch", "act_seq", "act_embed"))
+
+        def pinned(moe_params, x, token_mask=None):
+            # pin the activation sharding at the shard_map boundary: the
+            # partial-manual region leaves the auto dims unconstrained, and
+            # GSPMD otherwise invents a carry sharding for the layer scan that
+            # forces a replicate-then-repartition in the backward
+            x = jax.lax.with_sharding_constraint(x, act_sharding)
+            y, aux, load, dropped = ep_fn(moe_params, x, token_mask)
+            y = jax.lax.with_sharding_constraint(y, act_sharding)
+            return y, aux, load, dropped
+
+        return pinned
 
     def fn(moe_params, x, token_mask=None):
         y, aux, load = moe_forward(

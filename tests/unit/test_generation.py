@@ -419,3 +419,46 @@ class TestMixedGeometryDecode:
         want = np.asarray([_greedy_full_stats(model, params, r, 6) for r in prompts], np.int32)
         out = model.generate(params, prompts, max_new_tokens=6, cache_dtype=jnp.float32)
         np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
+
+
+class TestCommonMoEStackDecodeVariants:
+    """Decode parity for the remaining common-MoE-stack geometries: GLM4-MoE
+    (qk-norm + attention bias + partial rotary + dense prefix) and MiniMax-M2."""
+
+    def _parity(self, hf_cfg, seed):
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_config(
+            hf_cfg, BackendConfig(dtype="float32", remat_policy="none")
+        )
+        params = model.init(jax.random.key(seed), jnp.float32)
+        prompts = np.random.RandomState(seed).randint(0, 128, (2, 6)).astype(np.int32)
+        want = np.asarray(
+            [_greedy_full_stats(model, params, r, 5) for r in prompts], np.int32
+        )
+        out = model.generate(params, prompts, max_new_tokens=5, cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
+
+    def test_glm4_moe(self):
+        self._parity(
+            {"architectures": ["Glm4MoeForCausalLM"], "vocab_size": 128,
+             "hidden_size": 64, "intermediate_size": 96, "moe_intermediate_size": 32,
+             "num_hidden_layers": 2, "first_k_dense_replace": 1,
+             "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+             "partial_rotary_factor": 0.5, "use_qk_norm": True, "attention_bias": True,
+             "n_routed_experts": 4, "num_experts_per_tok": 2, "n_shared_experts": 1,
+             "norm_topk_prob": True, "max_position_embeddings": 64},
+            seed=20,
+        )
+
+    def test_minimax_m2(self):
+        self._parity(
+            {"architectures": ["MiniMaxM2ForCausalLM"], "vocab_size": 128,
+             "hidden_size": 64, "intermediate_size": 96, "moe_intermediate_size": 32,
+             "num_hidden_layers": 2, "num_attention_heads": 4,
+             "num_key_value_heads": 2, "head_dim": 16, "rotary_dim": 8,
+             "num_local_experts": 4, "num_experts_per_tok": 2,
+             "scoring_func": "sigmoid", "use_qk_norm": True,
+             "max_position_embeddings": 64},
+            seed=21,
+        )
