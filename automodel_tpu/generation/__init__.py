@@ -16,9 +16,9 @@ here, not by the model.
 MLA families (DeepSeek-V3/V2, Kimi-K2, GLM4-MoE-Lite) decode through an
 expanded-head cache (see :func:`init_kv_cache`). Hybrids (Qwen3-Next DeltaNet,
 Nemotron Mamba2) build their own cache via ``model.init_decode_cache`` —
-conv taps + recurrent state instead of per-position KV. Models with no cache
-path (gpt2, the V3.2 sparse indexer whose bias is sequence-global) raise with
-a pointer at HF export.
+conv taps + recurrent state instead of per-position KV. The one model without
+a decode path is the V3.2 sparse indexer (its bias is sequence-global); it and
+any cacheless external model raise with a pointer at HF export.
 """
 
 from __future__ import annotations

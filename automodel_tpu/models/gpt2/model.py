@@ -45,6 +45,16 @@ class GPT2Config:
         )
 
     @property
+    def num_hidden_layers(self) -> int:
+        """Alias for the generic KV-cache layout (generation.init_kv_cache)."""
+        return self.n_layer
+
+    @property
+    def num_key_value_heads(self) -> int:
+        """MHA: every head caches (GPT-2 predates GQA)."""
+        return self.n_head
+
+    @property
     def head_dim(self) -> int:
         return self.n_embd // self.n_head
 
@@ -116,43 +126,90 @@ class GPT2LMHeadModel:
 
     # -- forward ------------------------------------------------------------
     def __call__(self, params, input_ids, positions=None, segment_ids=None, rules=None,
-                 return_hidden=False):
+                 return_hidden=False, cache=None):
         cfg = self.config
         backend = self.backend
         dtype = backend.jnp_dtype
         eps = cfg.layer_norm_epsilon
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+        if cache is not None:
+            if segment_ids is None:
+                raise ValueError("cache decoding requires segment_ids (1 = real token)")
+            if cache["k"].shape[2] > cfg.n_positions:
+                raise ValueError(
+                    f"decode length {cache['k'].shape[2]} exceeds the learned position "
+                    f"table n_positions={cfg.n_positions}; out-of-range positions would "
+                    "silently clamp into wpe and degrade output"
+                )
         h = params["wte"].astype(dtype)[input_ids] + params["wpe"].astype(dtype)[positions]
 
-        def layer_fn(h, lp):
+        def layer_fn(h, inputs):
+            if cache is not None:
+                lp, kv = inputs
+            else:
+                lp, kv = inputs, None
             lp = jax.tree.map(lambda a: a.astype(dtype), lp)
             x = layer_norm(h, lp["ln1_w"], lp["ln1_b"], eps)
             qkv = x @ lp["c_attn"] + lp["c_attn_b"]
             q, k, v = jnp.split(qkv, 3, axis=-1)
             b, s, d = q.shape
             shape = (b, s, cfg.n_head, cfg.head_dim)
-            out = dot_product_attention(
-                q.reshape(shape), k.reshape(shape), v.reshape(shape),
-                causal=True, segment_ids_q=segment_ids, backend=backend.attention,
-            )
+            q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+            if kv is not None:
+                from automodel_tpu.models.common.transformer import _cache_write
+
+                k_cache = _cache_write(kv[0], k.astype(kv[0].dtype), cache["write_idx"])
+                v_cache = _cache_write(kv[1], v.astype(kv[1].dtype), cache["write_idx"])
+                out = dot_product_attention(
+                    q, k_cache.astype(q.dtype), v_cache.astype(q.dtype),
+                    causal=True, segment_ids_q=segment_ids,
+                    segment_ids_kv=cache["valid"],
+                    positions_q=positions, positions_kv=cache["positions"],
+                    backend="xla",
+                )
+                kv_out = (k_cache, v_cache)
+            else:
+                out = dot_product_attention(
+                    q, k, v,
+                    causal=True, segment_ids_q=segment_ids, backend=backend.attention,
+                )
+                kv_out = None
             h = h + (out.reshape(b, s, d) @ lp["c_proj"] + lp["c_proj_b"])
             x = layer_norm(h, lp["ln2_w"], lp["ln2_b"], eps)
             act = jax.nn.gelu(x @ lp["c_fc"] + lp["c_fc_b"], approximate=True)
             h = h + (act @ lp["c_proj2"] + lp["c_proj2_b"])
-            return h, None
+            return h, kv_out
 
-        body = backend.layer_remat(lambda h, lp: layer_fn(h, lp))
-        if backend.scan_layers:
+        body = backend.layer_remat(layer_fn)
+        if cache is not None:
+            h, (k_new, v_new) = jax.lax.scan(
+                body, h, (params["layers"], (cache["k"], cache["v"]))
+            )
+            cache = dict(cache, k=k_new, v=v_new)
+        elif backend.scan_layers:
             h, _ = jax.lax.scan(body, h, params["layers"])
         else:
             for i in range(cfg.n_layer):
                 lp = jax.tree.map(lambda a: a[i], params["layers"])
                 h, _ = body(h, lp)
         h = layer_norm(h, params["lnf_w"].astype(dtype), params["lnf_b"].astype(dtype), eps)
+        if cache is not None:
+            # next-token logits only (B, 1, V)
+            last = jnp.maximum(segment_ids.sum(-1) - 1, 0).astype(jnp.int32)
+            h = jnp.take_along_axis(h, last[:, None, None], axis=1)
+            logits = jnp.einsum("bsd,vd->bsv", h, params["wte"].astype(dtype))
+            return logits, cache
         if return_hidden:
             return h
         return jnp.einsum("bsd,vd->bsv", h, params["wte"].astype(dtype))
+
+    # -- decode -------------------------------------------------------------
+    def generate(self, params, input_ids, **kw):
+        """Sample with a KV cache (see :func:`automodel_tpu.generation.generate`)."""
+        from automodel_tpu.generation import generate
+
+        return generate(self, params, input_ids, **kw)
 
     # -- HF interop ---------------------------------------------------------
     def state_dict_adapter(self):

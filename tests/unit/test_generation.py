@@ -141,15 +141,42 @@ class TestMoEDecode:
         np.testing.assert_array_equal(np.asarray(got["tokens"]), want)
 
     def test_cacheless_model_raises(self):
-        """Models whose forward has no cache path (gpt2: learned positions, no
-        decode wiring) point at HF export instead of TypeError-ing inside jit."""
+        """Forwards without a cache parameter point at HF export instead of
+        TypeError-ing inside jit (every shipped causal family now decodes, so
+        this guards the contract for future/external models)."""
+
+        class _Cfg:
+            num_hidden_layers = 2
+
+        class _Model:
+            config = _Cfg()
+
+            def __call__(self, params, input_ids, positions=None, segment_ids=None):
+                raise AssertionError("must not be called")
+
+        with pytest.raises(NotImplementedError, match="no cache path"):
+            generate(_Model(), {}, np.zeros((1, 4), np.int32), max_new_tokens=2)
+
+    def test_gpt2_cache_matches_full(self):
+        """Learned-positional-embedding decode (GPT-2 MHA) == full recompute."""
         from automodel_tpu.models.gpt2.model import GPT2Config, GPT2LMHeadModel
 
         cfg = GPT2Config(vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64)
         model = GPT2LMHeadModel(cfg, BackendConfig(dtype="float32", remat_policy="full"))
-        params = model.init(jax.random.key(0), jnp.float32)
-        with pytest.raises(NotImplementedError, match="no cache path"):
-            generate(model, params, np.zeros((1, 4), np.int32), max_new_tokens=2)
+        params = model.init(jax.random.key(22), jnp.float32)
+        prompts = np.random.RandomState(23).randint(0, 128, (2, 6)).astype(np.int32)
+
+        def full(row, n_new):
+            ids = list(row)
+            for _ in range(n_new):
+                x = jnp.asarray([ids], jnp.int32)
+                logits = model(params, x, segment_ids=jnp.ones_like(x))
+                ids.append(int(np.asarray(logits)[0, -1].argmax()))
+            return ids[len(row):]
+
+        want = np.asarray([full(r, 5) for r in prompts], np.int32)
+        out = model.generate(params, prompts, max_new_tokens=5, cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
 
 
 class TestHFParity:
