@@ -198,6 +198,8 @@ class GPT2LMHeadModel:
             # next-token logits only (B, 1, V)
             last = jnp.maximum(segment_ids.sum(-1) - 1, 0).astype(jnp.int32)
             h = jnp.take_along_axis(h, last[:, None, None], axis=1)
+            if return_hidden:  # decoder_forward contract: (hidden, cache)
+                return h, cache
             logits = jnp.einsum("bsd,vd->bsv", h, params["wte"].astype(dtype))
             return logits, cache
         if return_hidden:
