@@ -1,5 +1,6 @@
-"""Text generation from any supported GQA-stack checkpoint (llama/qwen/mistral
-lineages, qwen3-moe) with the framework's jitted KV-cache decode loop.
+"""Text generation from any supported causal checkpoint — GQA/MoE stacks, MLA
+(DeepSeek-family), Gemma, GPT-2, Step-3.5, gpt-oss, and the DeltaNet/Mamba2
+hybrids — with the framework's jitted KV-cache decode loop.
 
 Usage:
     python examples/generate/llm_generate.py --checkpoint-path /path/to/ckpt \
