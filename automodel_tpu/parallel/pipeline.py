@@ -43,7 +43,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = [
     "pipeline_spmd", "pipeline_ticks", "make_pipeline_forward",
-    "make_dense_decoder_pp_loss", "make_dense_decoder_pp_hidden", "make_moe_pp_loss",
+    "make_dense_decoder_pp_loss", "make_dense_decoder_pp_hidden",
+    "make_moe_pp_hidden", "make_moe_pp_loss",
 ]
 
 
@@ -417,20 +418,18 @@ def make_dense_decoder_pp_hidden(cfg, backend, mesh: Mesh, *,
     return hidden_fn
 
 
-def make_moe_pp_loss(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
-                     loss_name: str = "masked_ce", seq_len_hint: int = 0,
-                     circular_repeats: int = 1):
-    """Pipelined forward+loss for MoE decoders: the dense prefix + embedding run
-    replicated on every rank (cheap, avoids a ragged first stage), the MoE layer
-    stack pipelines over ``pp``, and expert-load stats accumulate per stage with
-    warmup/drain ticks masked (reference composes PP with EP/FSDP inside each stage,
-    infrastructure.py:107 -> autopipeline; here the ep/fsdp axes stay GSPMD-managed
-    inside the pp-manual region).
+def make_moe_pp_hidden(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
+                       seq_len_hint: int = 0, circular_repeats: int = 1):
+    """Pipelined MoE decoder -> FINAL HIDDEN STATES (no head): embedding + dense
+    prefix run per microbatch in plain GSPMD, the MoE layer stack pipelines over
+    ``pp`` with per-stage expert-load/aux accumulation, and the caller owns the
+    head (KD needs full student logits next to teacher logits; train_ft adds the
+    standard CE head via :func:`make_moe_pp_loss`).
 
-    Returns ``forward_loss(params, batch_stack, num_label_tokens) ->
-    (loss, {"expert_load": (num_moe_layers, E)})`` matching the MoE train-step
-    contract (gate-bias balancing consumes expert_load). ``seq_len_hint``: the
-    training sequence length, needed for the sliding-window disable bound.
+    Returns ``hidden_fn(params, batch_stack, num_label_tokens) ->
+    (h_stack, aux_loss, {"expert_load": (num_moe_layers, E)})`` where
+    ``aux_loss`` is the already-weighted load-balance penalty (0 when disabled)
+    to ADD to the caller's data loss.
     """
     from automodel_tpu.models.common.moe_transformer import make_moe_layer_fns
     from automodel_tpu.models.common.transformer import embed_lookup
@@ -495,9 +494,7 @@ def make_moe_pp_loss(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
             state["aux_weight"] = aux_weight
         return state, out
 
-    head_loss = _make_head_loss(cfg, dtype, loss_name)
-
-    def forward_loss(params, batch_stack, num_label_tokens):
+    def hidden_fn(params, batch_stack, num_label_tokens):
         moe_sliding = jnp.asarray(cfg.sliding_flags[k_dense:], jnp.int32)
         layer_params = (params["moe_layers"], moe_sliding)
         if V > 1:
@@ -513,15 +510,53 @@ def make_moe_pp_loss(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
             mb_tokens = (batch_stack["labels"] != -100).sum(axis=tuple(
                 range(1, batch_stack["labels"].ndim))).astype(jnp.float32)
             x_stack["aux_weight"] = mb_tokens / jnp.asarray(num_label_tokens, jnp.float32)
-        loss, aux = pipeline(layer_params, other, x_stack, batch_stack,
-                             layer_apply, head_loss)
+        h_stack, aux = pipeline(layer_params, other, x_stack, None,
+                                layer_apply, None)
         load = aux["load"]
         if V > 1:
             # (V, pp*Lb, E) round-major -> (L, E) global layer order
             load = load.reshape(-1, *load.shape[2:])
-        loss = loss / num_label_tokens
-        if emit_aux:
-            loss = loss + cfg.moe.aux_loss_coeff * aux["aux"].sum()
-        return loss, {"expert_load": load}
+        aux_loss = cfg.moe.aux_loss_coeff * aux["aux"].sum() if emit_aux else 0.0
+        return h_stack, aux_loss, {"expert_load": load}
+
+    return hidden_fn
+
+
+def make_moe_pp_loss(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
+                     loss_name: str = "masked_ce", seq_len_hint: int = 0,
+                     circular_repeats: int = 1):
+    """Pipelined forward+loss for MoE decoders: the dense prefix + embedding run
+    replicated on every rank (cheap, avoids a ragged first stage), the MoE layer
+    stack pipelines over ``pp``, and expert-load stats accumulate per stage with
+    warmup/drain ticks masked (reference composes PP with EP/FSDP inside each stage,
+    infrastructure.py:107 -> autopipeline; here the ep/fsdp axes stay GSPMD-managed
+    inside the pp-manual region).
+
+    Returns ``forward_loss(params, batch_stack, num_label_tokens) ->
+    (loss, {"expert_load": (num_moe_layers, E)})`` matching the MoE train-step
+    contract (gate-bias balancing consumes expert_load). ``seq_len_hint``: the
+    training sequence length, needed for the sliding-window disable bound.
+
+    Built on :func:`make_moe_pp_hidden` — the head+CE close per microbatch
+    outside the manual region (lax.map: one microbatch's logits live at a time),
+    exactly where :func:`make_pipeline_forward` would run them.
+    """
+    cfg = model.config
+    dtype = model.backend.jnp_dtype
+    hidden_fn = make_moe_pp_hidden(
+        model, mesh, rules, pp_axis=pp_axis, seq_len_hint=seq_len_hint,
+        circular_repeats=circular_repeats,
+    )
+    head_loss = _make_head_loss(cfg, dtype, loss_name)
+
+    def forward_loss(params, batch_stack, num_label_tokens):
+        h_stack, aux_loss, extras = hidden_fn(params, batch_stack, num_label_tokens)
+        other = {k: v for k, v in params.items() if k != "moe_layers"}
+        losses = jax.lax.map(
+            lambda args: head_loss(other, {"h": args[0]}, args[1]),
+            (h_stack, batch_stack),
+        )
+        loss = losses.sum() / num_label_tokens + aux_loss
+        return loss, extras
 
     return forward_loss

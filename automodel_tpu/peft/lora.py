@@ -36,9 +36,37 @@ __all__ = [
     "match_lora_paths",
     "init_lora_params",
     "lora_logical_axes",
+    "lora_merged_loss",
     "merge_lora_params",
     "count_lora_params",
 ]
+
+
+def lora_merged_loss(core, get_base, cfg: "PeftConfig", use_dropout: bool):
+    """Close :func:`merge_lora_params` over a loss core with the right arity.
+
+    Every recipe's PEFT step is "merge the adapter into (a view of) the frozen
+    base, then call the real loss" — and with ``cfg.dropout`` the step grows a
+    trailing rng argument. This factory is the ONE place that shape lives
+    (train_ft / kd / vlm, pp and not, all route through it):
+
+    - ``core(merged, frozen, *rest)`` — the actual forward+loss;
+    - ``get_base(frozen)`` — extracts the adapter's base tree from the step's
+      frozen argument (the base itself, ``frozen["base"]``, ...).
+
+    Returns ``f(lora, frozen, *rest)`` or — when ``use_dropout`` —
+    ``f(lora, frozen, *rest, rng)`` matching ``make_train_step(pass_rng=True)``.
+    """
+    if use_dropout:
+        def f(lora, frozen, *rest_and_rng):
+            *rest, rng = rest_and_rng
+            merged = merge_lora_params(get_base(frozen), lora, cfg, dropout_rng=rng)
+            return core(merged, frozen, *rest)
+    else:
+        def f(lora, frozen, *rest):
+            merged = merge_lora_params(get_base(frozen), lora, cfg)
+            return core(merged, frozen, *rest)
+    return f
 
 # Reference YAMLs name HF modules (q_proj, ...); map them onto our leaf names so
 # `target_modules: [q_proj, v_proj]` matches `layers.wq` / `layers.wv`.

@@ -208,8 +208,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
 
         # metrics: JSONL always on; wandb/mlflow when configured (reference
         # train_ft.py:694,1024-1034)
-        out_dir = cfg.get("output_dir", ".")
+        out_dir = cfg.get("output_dir", None)
+        if out_dir is None:
+            from automodel_tpu.utils.run_dir import default_output_dir
+
+            out_dir = default_output_dir("train")
         os.makedirs(out_dir, exist_ok=True)
+        self.output_dir = out_dir  # one resolved dir for every artifact writer
         self.metric_logger = MetricLogger(os.path.join(out_dir, "training.jsonl"))
         self.val_metric_logger = MetricLogger(os.path.join(out_dir, "validation.jsonl"))
         from automodel_tpu.loggers.experiment_loggers import build_experiment_loggers
@@ -462,20 +467,21 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     # x pp: the BASE quantizes before the merge (the adapter
                     # trains in full precision on a quantized base, reference
                     # QLoRA-style qat semantics).
-                    if self.peft.dropout:
-                        raise NotImplementedError(
-                            "peft dropout + pp is not wired (the pp step does not "
-                            "thread a dropout rng); set peft.dropout: 0"
-                        )
-                    from automodel_tpu.peft.lora import merge_lora_params
+                    from automodel_tpu.peft.lora import lora_merged_loss
 
-                    def pp_peft_loss(lora, base, batch_stack, n):
-                        merged = merge_lora_params(q(base), lora, self.peft)
-                        return pp_loss(merged, batch_stack, n)
-
+                    # dropout rides the merged-delta mask (peft/lora.py:296);
+                    # the merge — and thus the mask — happens once per step
+                    # outside the pp-manual region (make_pp_train_step docs)
+                    use_dropout = self.peft.dropout > 0.0
+                    pp_peft_loss = lora_merged_loss(
+                        lambda merged, base, bs, n: pp_loss(merged, bs, n),
+                        q, self.peft, use_dropout,
+                    )
+                    self._step_needs_rng = use_dropout
                     return make_pp_train_step(pp_peft_loss, self.optimizer,
                                               guard_nonfinite=self._check_nan_grads,
-                                              with_frozen=True)
+                                              with_frozen=True,
+                                              pass_rng=use_dropout)
                 # qat x pp: quantize the stacked layer params (and head/embed)
                 # BEFORE the manual region — fake-quant is elementwise, GSPMD
                 # partitions it over the pp-sharded layer dim like any other op
@@ -484,22 +490,16 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                                           post_update=pp_post_update,
                                           guard_nonfinite=self._check_nan_grads)
             if self.peft is not None:
-                from automodel_tpu.peft.lora import merge_lora_params
+                from automodel_tpu.peft.lora import lora_merged_loss
 
                 if self._post_update() is not None:
                     logger.warning("moe gate-bias update disabled under peft (base is frozen)")
 
                 use_dropout = self.peft.dropout > 0.0
-
-                if use_dropout:
-                    def peft_loss(lora, base, batch, num_label_tokens, rng):
-                        merged = merge_lora_params(q(base), lora, self.peft, dropout_rng=rng)
-                        return self._forward_loss(merged, batch, num_label_tokens)
-                else:
-                    def peft_loss(lora, base, batch, num_label_tokens):
-                        merged = merge_lora_params(q(base), lora, self.peft)
-                        return self._forward_loss(merged, batch, num_label_tokens)
-
+                peft_loss = lora_merged_loss(
+                    lambda merged, base, b, n: self._forward_loss(merged, b, n),
+                    q, self.peft, use_dropout,
+                )
                 self._step_needs_rng = use_dropout
                 return make_train_step(peft_loss, self.optimizer, with_frozen=True,
                                        guard_nonfinite=self._check_nan_grads,

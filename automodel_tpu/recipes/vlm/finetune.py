@@ -120,11 +120,6 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
             )
 
             self.peft = PeftConfig.from_dict(peft_cfg.to_dict())
-            if self.peft.dropout:
-                raise NotImplementedError(
-                    "vlm + lora dropout is not wired (the VLM step does not thread "
-                    "a dropout rng); set peft.dropout: 0"
-                )
             axes = {k: v for k, v in self.model.logical_axes().items()
                     if k in self.train_params}
             host_lora = init_lora_params(
@@ -231,21 +226,24 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
     def _build_train_step(self):
         if self.mesh_ctx.pp > 1:
             return self._build_pp_train_step()
+        use_dropout = self.peft is not None and self.peft.dropout > 0.0
         if self.peft is not None:
-            from automodel_tpu.peft.lora import merge_lora_params
+            from automodel_tpu.peft.lora import lora_merged_loss
 
-            def split_loss(lora, frozen, batch, num_label_tokens):
-                merged = merge_lora_params(frozen["lora_base"], lora, self.peft)
-                return self._forward_loss(
-                    {**frozen["frozen"], **merged}, batch, num_label_tokens
-                )
+            split_loss = lora_merged_loss(
+                lambda merged, fr, b, n: self._forward_loss(
+                    {**fr["frozen"], **merged}, b, n),
+                lambda fr: fr["lora_base"], self.peft, use_dropout,
+            )
         else:
             def split_loss(trainable, frozen, batch, num_label_tokens):
                 return self._forward_loss(
                     {**frozen["frozen"], **trainable}, batch, num_label_tokens
                 )
 
-        step = make_train_step(split_loss, self.optimizer, with_frozen=True)
+        self._step_needs_rng = use_dropout
+        step = make_train_step(split_loss, self.optimizer, with_frozen=True,
+                               pass_rng=use_dropout)
         return jax.jit(step, donate_argnums=(0, 1))
 
     def _build_pp_train_step(self):
@@ -297,18 +295,22 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
             )
             return losses.sum() / n
 
+        use_dropout = self.peft is not None and self.peft.dropout > 0.0
         if self.peft is not None:
-            from automodel_tpu.peft.lora import merge_lora_params
+            from automodel_tpu.peft.lora import lora_merged_loss
 
-            def split_loss(lora, frozen, batch_stack, n):
-                merged = merge_lora_params(frozen["lora_base"], lora, self.peft)
-                return pp_core({**frozen["frozen"], **merged}, batch_stack, n)
+            split_loss = lora_merged_loss(
+                lambda merged, fr, bs, n: pp_core({**fr["frozen"], **merged}, bs, n),
+                lambda fr: fr["lora_base"], self.peft, use_dropout,
+            )
         else:
             def split_loss(trainable, frozen, batch_stack, n):
                 return pp_core({**frozen["frozen"], **trainable}, batch_stack, n)
 
+        self._step_needs_rng = use_dropout
         step = make_pp_train_step(split_loss, self.optimizer, with_frozen=True,
-                                  guard_nonfinite=self._check_nan_grads)
+                                  guard_nonfinite=self._check_nan_grads,
+                                  pass_rng=use_dropout)
         return jax.jit(step, donate_argnums=(0, 1))
 
     @property
@@ -320,9 +322,12 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
 
     def run_train_validation_loop(self):
         jitted = self._train_step
-        # *_ swallows the base loop's peft extra: the VLM step threads its own
-        # frozen/base trees through _frozen_arg instead
-        self._train_step = lambda p, o, stack, *_: jitted(p, o, stack, self._frozen_arg)
+        # the base loop's peft extra is replaced by _frozen_arg (the VLM step
+        # threads its own frozen/base trees); its trailing dropout rng passes
+        self._train_step = lambda p, o, stack, *extra: jitted(
+            p, o, stack, self._frozen_arg,
+            *((extra[-1],) if self._step_needs_rng else ()),
+        )
         super().run_train_validation_loop()
         # reassemble the full tree for saves/consumers
         if self.peft is not None:

@@ -141,6 +141,7 @@ def make_pp_train_step(
     post_update: Callable[[dict, dict], dict] | None = None,
     guard_nonfinite: bool = False,
     with_frozen: bool = False,
+    pass_rng: bool = False,
 ):
     """Train step for pipeline parallelism: ``forward_loss`` consumes the WHOLE
     (n_micro, ...) batch stack at once — microbatching happens inside the pipeline
@@ -149,19 +150,31 @@ def make_pp_train_step(
     recipes/llm/train_ft.py:1234). ``forward_loss`` may return ``(loss, aux)``
     (MoE expert-load stats); ``post_update`` then runs after the optimizer step.
     ``with_frozen``: PEFT shape — ``forward_loss(trainable, frozen, batch, n)``
-    with the frozen base undifferentiated."""
+    with the frozen base undifferentiated.
 
-    def _call(params, batch_stack, num_label_tokens, frozen=None):
-        if with_frozen:
-            out = forward_loss(params, frozen, batch_stack, num_label_tokens)
-        else:
-            out = forward_loss(params, batch_stack, num_label_tokens)
+    ``pass_rng=True``: the step takes a trailing ``rng`` and appends ONE derived
+    key to ``forward_loss``'s arguments. Under pp the LoRA merge happens once
+    outside the manual region, so dropout samples one mask per optimizer step
+    (shared by the schedule's microbatches — still unbiased dropout, the mask
+    just refreshes per step instead of per microbatch). The key is derived as
+    ``split(rng, n_micro)[0]`` so the n_micro=1 case is bit-exact with
+    ``make_train_step``'s per-microbatch keys."""
+
+    def _call(params, batch_stack, num_label_tokens, frozen=None, rng=None):
+        args = (params, frozen, batch_stack, num_label_tokens) if with_frozen else (
+            params, batch_stack, num_label_tokens)
+        if pass_rng:
+            args = (*args, rng)
+        out = forward_loss(*args)
         return out if isinstance(out, tuple) else (out, {})
 
-    def train_step(params, opt_state, batch_stack, frozen=None):
+    def train_step(params, opt_state, batch_stack, frozen=None, rng=None):
         num_label_tokens = count_label_tokens(batch_stack["labels"])
+        if pass_rng:
+            n_micro = jax.tree.leaves(batch_stack)[0].shape[0]
+            rng = jax.random.split(rng, n_micro)[0]
         (loss, aux), grads = jax.value_and_grad(_call, has_aux=True)(
-            params, batch_stack, num_label_tokens, frozen
+            params, batch_stack, num_label_tokens, frozen, rng
         )
         grad_norm = optax.global_norm(grads)
         new_updates, new_opt_state = optimizer.update(grads, opt_state, params)

@@ -206,3 +206,195 @@ def test_kd_pp_matches_unpipelined_trajectory(tmp_path, cpu_devices):
     got = run("kd_pp2", "{dp_shard: 2, tp: 2, pp: 2}")
     assert np.isfinite(ref).all() and ref[-1] < ref[0]
     np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_kd_moe_student_pp_matches_unpipelined_trajectory(tmp_path, cpu_devices):
+    """kd x pp for MoE students (a round-3 fence): the student rides the same
+    pipelined hidden-state path as train_ft's MoE pp loss; the pp=2 trajectory
+    must reproduce the unpipelined one, expert_load metrics included."""
+    student = """
+        architectures: [Qwen3MoeForCausalLM]
+        vocab_size: 128
+        hidden_size: 32
+        intermediate_size: 48
+        moe_intermediate_size: 24
+        num_hidden_layers: 4
+        num_attention_heads: 4
+        num_key_value_heads: 2
+        head_dim: 8
+        max_position_embeddings: 128
+        num_experts: 8
+        num_experts_per_tok: 2
+        norm_topk_prob: true
+        router_aux_loss_coef: 0.0
+    """
+    teacher = """
+        architectures: [LlamaForCausalLM]
+        vocab_size: 128
+        hidden_size: 32
+        intermediate_size: 64
+        num_hidden_layers: 2
+        num_attention_heads: 4
+        num_key_value_heads: 2
+        max_position_embeddings: 128
+    """
+
+    def run(tag, dist):
+        cfg_text = f"""
+        seed: 7
+        output_dir: {tmp_path}/{tag}
+        model:
+          config:
+{textwrap.indent(textwrap.dedent(student), "            ")}
+        teacher_model:
+          config:
+{textwrap.indent(textwrap.dedent(teacher), "            ")}
+        distributed: {dist}
+        backend: {{dtype: float32}}
+        kd: {{temperature: 2.0, kd_ratio: 0.5}}
+        dataset:
+          _target_: automodel_tpu.data.llm.mock.MockSFTDataset
+          vocab_size: 128
+          seq_len: 32
+          num_samples: 128
+          seed: 0
+          pattern: arith
+        micro_batch_size: 8
+        seq_len: 32
+        step_scheduler: {{grad_acc_steps: 2, max_steps: 6, handle_sigterm: false}}
+        optimizer: {{lr: 1.0e-2, weight_decay: 0.0, max_grad_norm: 1.0}}
+        lr_scheduler: {{lr_warmup_steps: 2}}
+        checkpoint: {{enabled: false}}
+        """
+        p = tmp_path / f"cfg_{tag}.yaml"
+        p.write_text(textwrap.dedent(cfg_text))
+        r = KnowledgeDistillationRecipe(load_config(p))
+        r.setup()
+        r.run_train_validation_loop()
+        rows = [json.loads(l) for l in open(tmp_path / tag / "training.jsonl")]
+        assert "moe_load/max_util_mean" in rows[0]
+        return [row["loss"] for row in rows]
+
+    ref = run("kdm_pp1", "{dp_shard: 4, ep: 2}")
+    got = run("kdm_pp2", "{dp_shard: 2, ep: 2, pp: 2}")
+    assert np.isfinite(ref).all() and ref[-1] < ref[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_kd_pp_moe_teacher_runs(tmp_path, cpu_devices):
+    """kd x pp with an MoE TEACHER: the pp path must unpack the teacher's
+    (logits, stats) tuple and thread token_mask, like the non-pp path."""
+    student = """
+        architectures: [LlamaForCausalLM]
+        vocab_size: 128
+        hidden_size: 32
+        intermediate_size: 64
+        num_hidden_layers: 4
+        num_attention_heads: 4
+        num_key_value_heads: 2
+        max_position_embeddings: 128
+    """
+    teacher = """
+        architectures: [Qwen3MoeForCausalLM]
+        vocab_size: 128
+        hidden_size: 32
+        intermediate_size: 48
+        moe_intermediate_size: 24
+        num_hidden_layers: 2
+        num_attention_heads: 4
+        num_key_value_heads: 2
+        head_dim: 8
+        max_position_embeddings: 128
+        num_experts: 8
+        num_experts_per_tok: 2
+        norm_topk_prob: true
+    """
+    cfg_text = f"""
+    seed: 7
+    output_dir: {tmp_path}/out
+    model:
+      config:
+{textwrap.indent(textwrap.dedent(student), "        ")}
+    teacher_model:
+      config:
+{textwrap.indent(textwrap.dedent(teacher), "        ")}
+    kd: {{temperature: 2.0, kd_ratio: 0.5}}
+    distributed: {{dp_shard: 2, ep: 2, pp: 2}}
+    backend: {{dtype: float32}}
+    dataset:
+      _target_: automodel_tpu.data.llm.mock.MockSFTDataset
+      vocab_size: 128
+      seq_len: 32
+      num_samples: 64
+      seed: 0
+      pattern: arith
+    micro_batch_size: 8
+    seq_len: 32
+    step_scheduler: {{grad_acc_steps: 2, max_steps: 2, handle_sigterm: false}}
+    optimizer: {{lr: 1.0e-2, max_grad_norm: 1.0}}
+    lr_scheduler: {{lr_warmup_steps: 2}}
+    checkpoint: {{enabled: false}}
+    """
+    p = tmp_path / "cfg.yaml"
+    p.write_text(textwrap.dedent(cfg_text))
+    recipe = KnowledgeDistillationRecipe(load_config(p)).setup()
+    recipe.run_train_validation_loop()
+    losses = [json.loads(l)["loss"] for l in open(tmp_path / "out" / "training.jsonl")]
+    assert np.isfinite(losses).all() and len(losses) == 2
+
+
+def test_kd_peft_dropout_runs(tmp_path, cpu_devices):
+    """kd + lora dropout (a round-3 fence): the KD step threads a dropout rng;
+    the run is finite and deterministic under the seeded rng stream."""
+    student = """
+        architectures: [LlamaForCausalLM]
+        vocab_size: 128
+        hidden_size: 32
+        intermediate_size: 64
+        num_hidden_layers: 2
+        num_attention_heads: 4
+        num_key_value_heads: 2
+        max_position_embeddings: 128
+    """
+    cfg_text = f"""
+    seed: 7
+    output_dir: {tmp_path}/out
+    model:
+      config:
+{textwrap.indent(textwrap.dedent(student), "        ")}
+    teacher_model:
+      config:
+{textwrap.indent(textwrap.dedent(student), "        ")}
+    kd: {{temperature: 2.0, kd_ratio: 0.2}}
+    peft:
+      dim: 8
+      alpha: 32
+      match_all_linear: true
+      dropout: 0.1
+    distributed: {{dp_shard: 4, tp: 2}}
+    backend: {{dtype: float32}}
+    dataset:
+      _target_: automodel_tpu.data.llm.mock.MockSFTDataset
+      vocab_size: 128
+      seq_len: 32
+      num_samples: 128
+      seed: 0
+      pattern: arith
+    micro_batch_size: 8
+    seq_len: 32
+    step_scheduler: {{grad_acc_steps: 2, max_steps: 4, handle_sigterm: false}}
+    optimizer: {{lr: 1.0e-2, max_grad_norm: 1.0}}
+    lr_scheduler: {{lr_warmup_steps: 2}}
+    checkpoint: {{enabled: false}}
+    """
+    p = tmp_path / "cfg.yaml"
+    p.write_text(textwrap.dedent(cfg_text))
+    recipe = KnowledgeDistillationRecipe(load_config(p)).setup()
+    assert recipe._step_needs_rng
+    adapter_before = np.asarray(recipe.train_params["layers"]["wq"]["lora_b"]).copy()
+    recipe.run_train_validation_loop()
+    losses = [json.loads(l)["loss"] for l in open(tmp_path / "out" / "training.jsonl")]
+    assert np.isfinite(losses).all()
+    assert not np.allclose(
+        np.asarray(recipe.train_params["layers"]["wq"]["lora_b"]), adapter_before
+    )
