@@ -40,7 +40,21 @@ class AutoModelForCausalLM:
     @classmethod
     def from_config(cls, config: dict, backend: BackendConfig | None = None):
         arch = (config.get("architectures") or [cls._default_architecture])[0]
-        model_cls = resolve_model_class(arch)
+        try:
+            model_cls = resolve_model_class(arch)
+        except KeyError as registry_err:
+            # day-0 coverage for unregistered llama-delta architectures
+            # (reference model_init.py:89 wraps any HF class; structural.py is
+            # the torch-free equivalent — alias or fail naming the field)
+            from automodel_tpu.models.structural import (
+                StructuralDivergence, resolve_llama_delta,
+            )
+
+            try:
+                return resolve_llama_delta(arch, config, backend)
+            except StructuralDivergence as diverged:
+                raise KeyError(f"{registry_err.args[0]} Auto-alias also failed: "
+                               f"{diverged}") from diverged
         return model_cls.from_config(config, backend)
 
     @classmethod

@@ -28,7 +28,9 @@ from automodel_tpu.models.common.backend import BackendConfig
 from automodel_tpu.ops.attention import dot_product_attention
 from automodel_tpu.ops.fp8 import project
 from automodel_tpu.ops.norms import rms_norm
-from automodel_tpu.ops.rope import apply_rope, rope_attention_scaling, rope_frequencies
+from automodel_tpu.ops.rope import (
+    apply_rope, apply_rope_interleaved, rope_attention_scaling, rope_frequencies,
+)
 
 __all__ = [
     "DenseDecoderConfig",
@@ -55,6 +57,7 @@ class DenseDecoderConfig:
     rope_theta: float = 10000.0
     rope_scaling: dict[str, Any] | None = None
     partial_rotary_factor: float = 1.0  # glm4/minimax: rope only the first fraction of head_dim
+    rope_interleaved: bool = False  # helium/ernie4.5: consecutive-pair rotation, not half-split
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     attention_bias: bool = False  # qwen2: bias on q/k/v only
@@ -231,8 +234,9 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
     if cfg.qk_norm:
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
-    q = apply_rope(q, positions, inv_freq, attn_scale)
-    k = apply_rope(k, positions, inv_freq, attn_scale)
+    rope = apply_rope_interleaved if cfg.rope_interleaved else apply_rope
+    q = rope(q, positions, inv_freq, attn_scale)
+    k = rope(k, positions, inv_freq, attn_scale)
     if cfg.llama4_attn_scale_beta is not None:
         orig = cfg.original_max_position_embeddings or cfg.max_position_embeddings
         scale = 1.0 + cfg.llama4_attn_scale_beta * jnp.log1p(

@@ -1,0 +1,215 @@
+"""Config-driven auto-aliasing for unregistered HF architectures.
+
+The reference wraps ANY HF class day-0 by instantiating the HF module itself
+(reference _transformers/model_init.py:89). Torch-free equivalent: most
+``*ForCausalLM`` architectures are *llama deltas* — same pre-norm RMSNorm +
+rope GQA attention + gated-SiLU MLP body, varying only in config-level
+geometry (head counts, rope variant, biases, norm eps). For an architecture
+the registry doesn't know, this module checks every field of its config.json
+against the dense-decoder lineage's semantics and
+
+- maps it onto :class:`automodel_tpu.models.llama.model.LlamaForCausalLM`
+  when every field is consumed, cosmetic, or pinned at the llama-equivalent
+  value, and
+- raises :class:`StructuralDivergence` naming the exact divergent field(s)
+  otherwise (never a silent wrong-math load).
+
+A curated denylist covers architectures whose config fields LOOK llama-shaped
+but whose *code* differs (norm placement, parallel blocks) — field inspection
+cannot see code, so these are pinned by hand with the reason; the logits-parity
+suite in tests/unit/test_structural_alias.py verifies both directions against
+the real transformers implementations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["StructuralDivergence", "resolve_llama_delta"]
+
+
+class StructuralDivergence(Exception):
+    """The config genuinely diverges from the llama lineage; message names the field."""
+
+
+# Architectures whose configs pass the field check but whose transformer BLOCK
+# differs in code — verified against the transformers implementations (logits
+# mismatch at identical weights). Field inspection cannot detect these.
+_DENYLIST = {
+    "Olmo2ForCausalLM": "norms apply AFTER attention/MLP (post-norm residual) and "
+                        "QK-norm spans the whole projection, not per head",
+    "Olmo3ForCausalLM": "post-norm residual placement (Olmo2 lineage)",
+    "GlmForCausalLM": "partial-rotary GLM block interleaves rope pairs differently",
+    "Glm4ForCausalLM": "extra post_self_attn/post_mlp layernorms in the block",
+    "CohereForCausalLM": "parallel attention+MLP block with LayerNorm",
+    "Cohere2ForCausalLM": "parallel attention+MLP block with LayerNorm",
+}
+
+# Code-level deltas that ARE expressible as dense-decoder config knobs but are
+# invisible in the arch's config.json — verified by the logits-parity suite.
+# (Helium/Ernie rotate consecutive element pairs where llama rotates the
+# half-split; both implementations exist in ops/rope.py.)
+_ARCH_DELTAS = {
+    "HeliumForCausalLM": {"rope_interleaved": True},
+    "Ernie4_5ForCausalLM": {"rope_interleaved": True},
+}
+
+# rope_scaling variants ops/rope.py:26 implements bit-for-bit
+_ROPE_TYPES = {None, "default", "linear", "llama3", "longrope", "yarn"}
+
+# Fields LlamaConfig.from_hf / DenseDecoderConfig consume (llama/model.py:29-51).
+_CONSUMED = {
+    "vocab_size", "hidden_size", "intermediate_size", "num_hidden_layers",
+    "num_attention_heads", "num_key_value_heads", "head_dim",
+    "max_position_embeddings", "rope_theta", "rms_norm_eps",
+    "tie_word_embeddings", "attention_bias", "qkv_bias", "sliding_window",
+    "use_sliding_window", "layer_types", "initializer_range",
+    "partial_rotary_factor",
+}
+
+# Fields that never change the computation (identity, tokenizer ids, runtime
+# knobs the jax stack doesn't have).
+_COSMETIC = {
+    "architectures", "model_type", "torch_dtype", "dtype",
+    "transformers_version", "_name_or_path", "name_or_path", "auto_map",
+    "bos_token_id", "eos_token_id", "pad_token_id", "sep_token_id",
+    "unk_token_id", "use_cache", "tokenizer_class", "chat_template",
+    "attn_implementation", "_attn_implementation",
+    "_attn_implementation_autoset", "output_attentions",
+    "output_hidden_states", "return_dict", "use_bfloat16",
+    "use_return_dict", "is_decoder", "add_cross_attention", "task_specific_params",
+    "gradient_checkpointing", "use_flash_attention_2",
+    # PretrainedConfig boilerplate (generation defaults, label maps, export
+    # knobs) that transformers serializes but that never touches the math
+    "torchscript", "pruned_heads", "chunk_size_feed_forward",
+    "is_encoder_decoder", "cross_attention_hidden_size", "tie_encoder_decoder",
+    "finetuning_task", "id2label", "label2id", "problem_type", "prefix",
+    "decoder_start_token_id", "max_length", "min_length", "do_sample",
+    "early_stopping", "num_beams", "num_beam_groups", "diversity_penalty",
+    "temperature", "top_k", "top_p", "typical_p", "repetition_penalty",
+    "length_penalty", "no_repeat_ngram_size", "encoder_no_repeat_ngram_size",
+    "bad_words_ids", "num_return_sequences", "output_scores",
+    "return_dict_in_generate", "forced_bos_token_id", "forced_eos_token_id",
+    "remove_invalid_values", "exponential_decay_length_penalty",
+    "suppress_tokens", "begin_suppress_tokens", "tf_legacy_loss",
+    "tokenizer_file", "full_vocab_size",
+}
+
+_FALSY = lambda v: not v
+_NONE = lambda v: v is None
+_ONE = lambda v: v in (None, 1, 1.0)
+
+# Fields accepted only at the value where they mean "same math as llama".
+# Each entry: (predicate, human reason used when the predicate fails).
+_GATED = {
+    "rope_scaling": (
+        lambda v: v is None or v.get("rope_type", v.get("type", "default")) in _ROPE_TYPES,
+        "rope_scaling variant is not implemented by ops/rope.py",
+    ),
+    "use_bias": (_FALSY, "linear-layer bias terms are not part of the lineage"),
+    "hidden_act": (lambda v: v in ("silu", "swish"), "MLP activation is not gated SiLU"),
+    "hidden_activation": (lambda v: v in (None, "silu", "swish"), "MLP activation is not gated SiLU"),
+    "activation_function": (lambda v: v in ("silu", "swish"), "MLP activation is not gated SiLU"),
+    "mlp_bias": (_FALSY, "llama-lineage MLP has no bias terms"),
+    "attention_dropout": (_FALSY, "attention dropout is not part of the lineage"),
+    "attn_pdrop": (_FALSY, "attention dropout is not part of the lineage"),
+    "resid_pdrop": (_FALSY, "residual dropout is not part of the lineage"),
+    "embd_pdrop": (_FALSY, "embedding dropout is not part of the lineage"),
+    "hidden_dropout": (_FALSY, "hidden dropout is not part of the lineage"),
+    "hidden_dropout_prob": (_FALSY, "hidden dropout is not part of the lineage"),
+    "dropout": (_FALSY, "dropout is not part of the lineage"),
+    "clip_qkv": (_NONE, "QKV clipping changes the attention math"),
+    "pretraining_tp": (_ONE, "pretraining_tp slicing changes the matmul order"),
+    "rope_interleaved": (_FALSY, "interleaved rope pairs differ from half-rotation rope"),
+    "logits_scaling": (_ONE, "output-logit scaling is not applied by the lineage"),
+    "logit_scale": (_ONE, "output-logit scaling is not applied by the lineage"),
+    "embedding_multiplier": (_ONE, "embedding scaling is not applied by the lineage"),
+    "residual_multiplier": (_ONE, "residual scaling is not applied by the lineage"),
+    "attention_multiplier": (_NONE, "attention-score scaling differs from 1/sqrt(head_dim)"),
+    "final_logit_softcapping": (_NONE, "logit soft-capping is the gemma lineage"),
+    "attn_logit_softcapping": (_NONE, "attention soft-capping is the gemma lineage"),
+    "no_rope_layers": (lambda v: v is None or all(v), "some layers disable rope (NoPE)"),
+    "no_rope_layer_interval": (_NONE, "some layers disable rope (NoPE)"),
+    "num_experts": (_NONE, "mixture-of-experts MLP (use a registered MoE family)"),
+    "num_local_experts": (_NONE, "mixture-of-experts MLP (use a registered MoE family)"),
+    "n_routed_experts": (_NONE, "mixture-of-experts MLP (use a registered MoE family)"),
+    "moe_intermediate_size": (_NONE, "mixture-of-experts MLP (use a registered MoE family)"),
+    "kv_lora_rank": (_NONE, "MLA latent attention (use the DeepseekV3 family)"),
+    "q_lora_rank": (_NONE, "MLA latent attention (use the DeepseekV3 family)"),
+    "ssm_cfg": (_NONE, "state-space layers (use the NemotronH family)"),
+    "layer_norm_eps": (_NONE, "LayerNorm (not RMSNorm) normalization"),
+    "layer_norm_epsilon": (_NONE, "LayerNorm (not RMSNorm) normalization"),
+    "norm_eps": (_NONE, "ambiguous norm type (rms_norm_eps is the lineage field)"),
+    "parallel_attn": (_FALSY, "parallel attention+MLP block"),
+    "qk_layernorm": (_FALSY, "whole-projection QK LayerNorm differs from per-head QK-RMSNorm"),
+    # per-head qwen3-style QK-RMSNorm IS supported — consumed below
+    "use_qk_norm": (lambda v: True, ""),
+    "qk_norm": (lambda v: True, ""),
+    "max_window_layers": (lambda v: True, ""),  # inert unless use_sliding_window, which _CONSUMED covers
+}
+
+
+def classify_config(hf: dict) -> list[str]:
+    """Return a list of human-readable divergences (empty == llama delta)."""
+    problems = []
+    for key, value in hf.items():
+        if key in _CONSUMED or key in _COSMETIC or key.startswith("_"):
+            continue
+        gate = _GATED.get(key)
+        if gate is None:
+            problems.append(f"{key}={value!r} (field unknown to the llama lineage)")
+        elif not gate[0](value):
+            problems.append(f"{key}={value!r} ({gate[1]})")
+    return problems
+
+
+def resolve_llama_delta(architecture: str, hf: dict, backend=None):
+    """Map an unregistered ``*ForCausalLM`` config onto the Llama family.
+
+    Returns a model instance, or raises :class:`StructuralDivergence` naming
+    the divergent field(s). Mirrors reference model_init.py:89's any-HF-class
+    wrapping for the (dominant) llama-delta subset of the CausalLM universe.
+    """
+    if architecture in _DENYLIST:
+        raise StructuralDivergence(
+            f"{architecture} cannot auto-alias onto the llama lineage: "
+            f"{_DENYLIST[architecture]}. Implement it as a family or register "
+            "an explicit mapping with register_model()."
+        )
+    if not architecture.endswith("ForCausalLM"):
+        raise StructuralDivergence(
+            f"{architecture} is not a causal-LM architecture; structural "
+            "auto-aliasing covers *ForCausalLM configs only."
+        )
+    problems = classify_config(hf)
+    if "rms_norm_eps" not in hf:
+        # OLMo-v1-style configs omit it because the model is NOT RMSNorm; an
+        # absent field is as structural as a wrong one
+        problems.insert(0, "rms_norm_eps missing (norm type unknown — the "
+                           "llama lineage is parametric RMSNorm)")
+    if problems:
+        raise StructuralDivergence(
+            f"{architecture} diverges from the llama lineage on: "
+            + "; ".join(problems)
+            + ". If the divergence is cosmetic for your checkpoint, register "
+            "an explicit mapping with automodel_tpu.models.registry.register_model()."
+        )
+    from automodel_tpu.models.llama.model import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.from_hf(hf)
+    overrides = dict(_ARCH_DELTAS.get(architecture, {}))
+    if hf.get("partial_rotary_factor") not in (None, 1, 1.0):
+        overrides["partial_rotary_factor"] = float(hf["partial_rotary_factor"])
+    if hf.get("qk_norm") or hf.get("use_qk_norm"):
+        overrides["qk_norm"] = True
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    logger.info(
+        "architecture %s auto-aliased onto the llama lineage (structural field "
+        "check passed%s) — verify held-out logits before trusting a large run",
+        architecture, f"; deltas: {overrides}" if overrides else "",
+    )
+    return LlamaForCausalLM(cfg, backend)

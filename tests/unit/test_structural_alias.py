@@ -1,0 +1,137 @@
+"""Structural auto-aliasing of unregistered HF architectures (VERDICT r3 #3).
+
+The reference wraps ANY HF class day-0 (_transformers/model_init.py:89); the
+torch-free equivalent maps llama-delta configs onto the dense-decoder lineage
+after a per-field structural check. Both directions are pinned here against
+the REAL transformers implementations (baked into the image):
+
+- architectures that alias must match transformers logits bit-close at fp32;
+- architectures that diverge must fail NAMING the divergent field;
+- architectures whose divergence is code-only (invisible in config fields)
+  must be caught by the curated denylist.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from automodel_tpu.models.auto import AutoModelForCausalLM
+from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.models.structural import (
+    StructuralDivergence, classify_config, resolve_llama_delta,
+)
+
+TINY = dict(vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64)
+
+
+def _hf_config(arch: str, **kw) -> dict:
+    cls = getattr(transformers, arch)
+    hf = cls.config_class(**kw).to_dict()
+    hf["architectures"] = [arch]
+    return hf
+
+
+def _parity(arch: str, **kw) -> float:
+    """Max relative logits error between the aliased jax model and transformers."""
+    cls = getattr(transformers, arch)
+    tcfg = cls.config_class(**kw)
+    hf = tcfg.to_dict()
+    hf["architectures"] = [arch]
+    torch.manual_seed(0)
+    tm = cls(tcfg).eval()
+    sd = {k: v.float().numpy() for k, v in tm.state_dict().items()}
+    am = AutoModelForCausalLM.from_config(hf, backend=BackendConfig(dtype="float32"))
+    import jax
+
+    params = jax.tree.map(np.asarray, am.state_dict_adapter().from_hf(sd, dtype=np.float32))
+    ids = np.arange(1, 17)[None, :] % hf["vocab_size"]
+    with torch.no_grad():
+        tlog = tm(torch.tensor(ids)).logits.numpy()
+    jlog = np.asarray(am(params, ids))
+    return float(np.abs(tlog - jlog).max() / (np.abs(tlog).max() + 1e-9))
+
+
+class TestAliasedParity:
+    def test_unknown_arch_with_llama_fields_aliases_and_matches(self):
+        """A brand-new arch name over pure llama fields — the day-0 case the
+        feature exists for; parity vs transformers' own LlamaForCausalLM."""
+        cls = transformers.LlamaForCausalLM
+        tcfg = cls.config_class(**TINY, rope_theta=50000.0, tie_word_embeddings=True)
+        hf = tcfg.to_dict()
+        hf["architectures"] = ["BrandNewLlamaDeltaForCausalLM"]
+        torch.manual_seed(0)
+        tm = cls(tcfg).eval()
+        sd = {k: v.float().numpy() for k, v in tm.state_dict().items()}
+        am = AutoModelForCausalLM.from_config(hf, backend=BackendConfig(dtype="float32"))
+        import jax
+
+        params = jax.tree.map(np.asarray, am.state_dict_adapter().from_hf(sd, dtype=np.float32))
+        ids = np.arange(1, 17)[None, :] % hf["vocab_size"]
+        with torch.no_grad():
+            tlog = tm(torch.tensor(ids)).logits.numpy()
+        jlog = np.asarray(am(params, ids))
+        err = np.abs(tlog - jlog).max() / np.abs(tlog).max()
+        assert err < 2e-5, f"rel logits err {err:.2e}"
+
+    def test_helium_aliases_with_interleaved_rope(self):
+        err = _parity("HeliumForCausalLM", **TINY, head_dim=8)
+        assert err < 2e-5, f"rel logits err {err:.2e}"
+
+    def test_ernie45_aliases_with_interleaved_rope(self):
+        err = _parity("Ernie4_5ForCausalLM", **TINY)
+        assert err < 2e-5, f"rel logits err {err:.2e}"
+
+
+class TestHonestDivergence:
+    """Divergent architectures fail NAMING the structural field, never silently."""
+
+    @pytest.mark.parametrize("arch,kw,expect", [
+        ("ArceeForCausalLM", {}, "hidden_act"),             # relu^2 MLP
+        ("Starcoder2ForCausalLM", {}, "hidden_act"),        # gelu + LayerNorm
+        ("GraniteForCausalLM", {}, "multiplier"),           # mup-style scalers
+        ("StableLmForCausalLM", {}, "layer_norm_eps"),      # LayerNorm
+        ("SmolLM3ForCausalLM", {}, "no_rope"),              # NoPE layers
+        ("ApertusForCausalLM", {}, "hidden_act"),           # xIELU
+        ("OlmoForCausalLM", {}, "rms_norm_eps"),            # non-parametric LN
+    ])
+    def test_divergent_arch_fails_naming_field(self, arch, kw, expect):
+        hf = _hf_config(arch, **TINY, **kw)
+        with pytest.raises(KeyError, match=expect):
+            AutoModelForCausalLM.from_config(hf)
+
+    @pytest.mark.parametrize("arch", [
+        # configs field-identical to llama but with different BLOCK code —
+        # the curated denylist is load-bearing for these
+        "Olmo2ForCausalLM",
+        "Olmo3ForCausalLM",
+        "Glm4ForCausalLM",
+    ])
+    def test_code_divergent_arch_is_denylisted(self, arch):
+        hf = _hf_config(arch, **TINY)
+        # prove the denylist is what catches it: the field check alone passes
+        assert classify_config(hf) == [] or arch == "Olmo3ForCausalLM"
+        with pytest.raises(StructuralDivergence):
+            resolve_llama_delta(arch, hf)
+
+    def test_unsupported_rope_scaling_variant_named(self):
+        hf = _hf_config("LlamaForCausalLM", **TINY)
+        hf["architectures"] = ["SomeNewForCausalLM"]
+        hf["rope_scaling"] = {"rope_type": "su_exotic", "factor": 4.0}
+        with pytest.raises(StructuralDivergence, match="rope_scaling"):
+            resolve_llama_delta("SomeNewForCausalLM", hf)
+
+    def test_non_causal_arch_refused(self):
+        with pytest.raises(StructuralDivergence, match="ForCausalLM"):
+            resolve_llama_delta("SomeBertModel", dict(TINY, rms_norm_eps=1e-5))
+
+
+def test_registry_error_carries_alias_failure():
+    """The combined error names both the registry miss and the divergent field."""
+    hf = _hf_config("ArceeForCausalLM", **TINY)
+    with pytest.raises(KeyError) as ei:
+        AutoModelForCausalLM.from_config(hf)
+    msg = str(ei.value)
+    assert "not supported" in msg and "hidden_act" in msg
