@@ -100,7 +100,9 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
             "loss": float(m["loss"]),
         }
         logger.info("benchmark: %s", result)
-        out_dir = cfg.get("output_dir", ".")
+        # setup() resolved (or generated) the run dir once — benchmark.json
+        # must land next to training.jsonl, not in a second timestamped dir
+        out_dir = getattr(self, "output_dir", None) or cfg.get("output_dir", ".")
         os.makedirs(out_dir, exist_ok=True)
         with open(os.path.join(out_dir, "benchmark.json"), "w") as f:
             json.dump(result, f, indent=2)

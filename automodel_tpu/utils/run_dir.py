@@ -1,0 +1,21 @@
+"""Default run-output directory resolution.
+
+Recipes write ``training.jsonl`` / ``benchmark.json`` / checkpoints under
+``output_dir``.  When the YAML leaves it unset we put artifacts under
+``runs/<recipe>-<timestamp>/`` instead of littering the CWD (reference keeps
+run artifacts under an explicit log dir per recipe, e.g.
+nemo_automodel/recipes/llm/train_ft.py log_dir handling).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def default_output_dir(recipe: str) -> str:
+    """Return ``runs/<recipe>-<YYYYmmdd-HHMMSS>`` (created), for unset output_dir."""
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    path = os.path.join("runs", f"{recipe}-{stamp}")
+    os.makedirs(path, exist_ok=True)
+    return path

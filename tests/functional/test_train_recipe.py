@@ -81,6 +81,39 @@ class TestTrainRecipeE2E:
         assert losses[-1] < losses[0] - 0.3
         assert all(np.isfinite(r["grad_norm"]) for r in rows)
 
+    def test_hsdp_matches_fsdp_trajectory(self, tmp_path, cpu_devices):
+        """HSDP (dp_replicate=2 x dp_shard=2 x tp=2 — reference
+        mesh_utils.py:173-190) end-to-end: params replicate across the replica
+        axis, the global batch still shards 4 ways, so the trajectory must
+        reproduce the pure-fsdp dp_shard=4 run step for step."""
+
+        def run(tag, dist):
+            cfg_text = _write_cfg(tmp_path).read_text()
+            cfg_text = cfg_text.replace("dp_shard: 4\n  tp: 2", dist)
+            cfg_text = cfg_text.replace(f"output_dir: {tmp_path}/out",
+                                        f"output_dir: {tmp_path}/{tag}")
+            p = tmp_path / f"cfg_{tag}.yaml"
+            p.write_text(cfg_text)
+            r = TrainFinetuneRecipeForNextTokenPrediction(load_config(str(p)))
+            r.setup()
+            if tag == "hsdp":
+                assert r.mesh.shape["dp_replicate"] == 2
+                # model params actually replicate over dp_replicate and shard
+                # over dp_shard: local shard = L/1 x rows/(dp_shard) x ...
+                wq = r.params["layers"]["wq"]
+                spec = wq.sharding.spec
+                flat = [a for ax in spec if ax is not None
+                        for a in ((ax,) if isinstance(ax, str) else ax)]
+                assert "dp_replicate" not in flat, spec
+                assert "dp_shard" in flat, spec
+            r.run_train_validation_loop()
+            return [row["loss"] for row in _read_jsonl(tmp_path / tag / "training.jsonl")]
+
+        ref = run("fsdp", "dp_shard: 4\n  tp: 2")
+        got = run("hsdp", "dp_replicate: 2\n  dp_shard: 2\n  tp: 2")
+        assert np.isfinite(ref).all() and ref[-1] < ref[0]
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
     def test_resume_exact(self, tmp_path, cpu_devices):
         # run 1: 6 steps with ckpt at 3 and final at 6
         cfg = load_config(_write_cfg(tmp_path, ckpt=True))
