@@ -16,9 +16,11 @@ here, not by the model.
 MLA families (DeepSeek-V3/V2, Kimi-K2, GLM4-MoE-Lite) decode through an
 expanded-head cache (see :func:`init_kv_cache`). Hybrids (Qwen3-Next DeltaNet,
 Nemotron Mamba2) build their own cache via ``model.init_decode_cache`` —
-conv taps + recurrent state instead of per-position KV. The one model without
-a decode path is the V3.2 sparse indexer (its bias is sequence-global); it and
-any cacheless external model raise with a pointer at HF export.
+conv taps + recurrent state instead of per-position KV. DeepSeek-V3.2's sparse
+indexer decodes through the same hook: each token's post-Hadamard indexer key
+is cached per layer and the top-k bias is recomputed incrementally against the
+cache (deepseek_v32.make_indexer_decode_fn). Cacheless external models raise
+with a pointer at HF export.
 """
 
 from __future__ import annotations

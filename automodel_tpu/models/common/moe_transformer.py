@@ -309,12 +309,17 @@ def moe_decoder_forward(
         cfg, backend, rules, attention_fn, training, seq_len_hint=input_ids.shape[1]
     )
 
+    # per-layer cache slots: k/v always; "idx_k" when the model adds a third
+    # slot (DSv32's indexer-key cache) — the attention fn returns the same
+    # tuple shape it received, so the slot list is uniform across layers
+    ckeys = [c for c in ("k", "v", "idx_k") if cache is not None and c in cache]
     k_dense = cfg.first_k_dense_replace
+    dense_new = ()
     if k_dense > 0:
         body = backend.layer_remat(dense_layer_fn)
         if cache is not None:
-            kv_dense = (cache["k"][:k_dense], cache["v"][:k_dense])
-            state, (dk, dv) = jax.lax.scan(
+            kv_dense = tuple(cache[c][:k_dense] for c in ckeys)
+            state, dense_new = jax.lax.scan(
                 body, state, (params["dense_layers"], sliding_flags[:k_dense], kv_dense)
             )
         elif backend.scan_layers:
@@ -327,13 +332,14 @@ def moe_decoder_forward(
     moe_sliding = sliding_flags[k_dense:]
     body = backend.layer_remat(moe_layer_fn)
     if cache is not None:
-        kv_moe = (cache["k"][k_dense:], cache["v"][k_dense:])
-        state, (mk, mv) = jax.lax.scan(
+        kv_moe = tuple(cache[c][k_dense:] for c in ckeys)
+        state, moe_new = jax.lax.scan(
             body, state, (params["moe_layers"], moe_sliding, kv_moe)
         )
-        k_new = jnp.concatenate([dk, mk], 0) if k_dense > 0 else mk
-        v_new = jnp.concatenate([dv, mv], 0) if k_dense > 0 else mv
-        cache = dict(cache, k=k_new, v=v_new)
+        cache = dict(cache, **{
+            c: (jnp.concatenate([d, m], 0) if k_dense > 0 else m)
+            for c, d, m in zip(ckeys, dense_new or (None,) * len(ckeys), moe_new)
+        })
     elif backend.scan_layers:
         state, (auxs, loads, droppeds) = jax.lax.scan(
             body, state, (params["moe_layers"], moe_sliding)

@@ -190,7 +190,8 @@ def logical_axes(cfg: DeepseekV3Config) -> dict:
 
 
 def _mla_block(cfg: DeepseekV3Config, backend: BackendConfig, lp: dict, x, positions,
-               segment_ids, inv_freq, rules, bias_fn=None, cache=None, cache_meta=None):
+               segment_ids, inv_freq, rules, bias_fn=None, bias_decode_fn=None,
+               cache=None, cache_meta=None):
     """MLA attention (reference layers.py:122-198). ``bias_fn(lp, x, q_latent,
     positions, segment_ids) -> (B, S, S) additive logit bias`` is the V3.2 sparse
     indexer hook (reference deepseek_v32/layers.py:430-500).
@@ -224,13 +225,27 @@ def _mla_block(cfg: DeepseekV3Config, backend: BackendConfig, lp: dict, x, posit
     )
 
     if cache is not None:
-        if bias_fn is not None:
-            raise NotImplementedError(
-                "V3.2 sparse-indexer decode is not wired (the indexer bias is "
-                "(S, S)-global); generate with the dense MLA families instead"
-            )
         from automodel_tpu.models.common.transformer import _cache_write
 
+        extra_bias = None
+        idx_out = ()
+        if len(cache) == 3:
+            # V3.2: third cache slot is the per-layer indexer-key cache; the
+            # decode fn writes the chunk's keys and returns the (B,s,S_max)
+            # sparse bias over the whole cache (deepseek_v32.make_indexer_decode_fn)
+            if bias_decode_fn is None:
+                raise NotImplementedError(
+                    "3-slot MLA cache needs a bias_decode_fn (V3.2 indexer)"
+                )
+            extra_bias, idx_cache = bias_decode_fn(
+                lp, x, q_latent, positions, cache[2], cache_meta
+            )
+            idx_out = (idx_cache,)
+        elif bias_fn is not None:
+            raise NotImplementedError(
+                "V3.2 sparse-indexer decode needs the indexer-key cache slot "
+                "(init_decode_cache) — got a 2-slot k/v cache"
+            )
         k_cache = _cache_write(cache[0], k.astype(cache[0].dtype), cache_meta["write_idx"])
         v_cache = _cache_write(cache[1], v.astype(cache[1].dtype), cache_meta["write_idx"])
         out = dot_product_attention(
@@ -241,9 +256,10 @@ def _mla_block(cfg: DeepseekV3Config, backend: BackendConfig, lp: dict, x, posit
             positions_q=positions,
             positions_kv=cache_meta["positions"],
             softmax_scale=cfg.softmax_scale,
+            extra_bias=extra_bias,
             backend="xla",  # q_len 1 / position-masked: the flash kernel doesn't apply
         )
-        return jnp.einsum("bsnh,nhd->bsd", out, lp["wo"]), (k_cache, v_cache)
+        return jnp.einsum("bsnh,nhd->bsd", out, lp["wo"]), (k_cache, v_cache, *idx_out)
 
     from jax.ad_checkpoint import checkpoint_name
 
@@ -319,7 +335,8 @@ def mla_inv_freq(cfg: DeepseekV3Config) -> jnp.ndarray:
     )
 
 
-def make_mla_attention_fn(cfg: DeepseekV3Config, backend: BackendConfig, bias_fn=None):
+def make_mla_attention_fn(cfg: DeepseekV3Config, backend: BackendConfig, bias_fn=None,
+                          bias_decode_fn=None):
     """MLA attention hook for moe_decoder_forward / the pp pipeline."""
     inv_freq = mla_inv_freq(cfg)
 
@@ -328,7 +345,8 @@ def make_mla_attention_fn(cfg: DeepseekV3Config, backend: BackendConfig, bias_fn
         del is_sliding
         with jax.named_scope("mla_attention"):
             return _mla_block(cfg, backend, lp, x, positions, segment_ids, inv_freq, rules,
-                              bias_fn=bias_fn, cache=cache, cache_meta=cache_meta)
+                              bias_fn=bias_fn, bias_decode_fn=bias_decode_fn,
+                              cache=cache, cache_meta=cache_meta)
 
     return mla_attention
 

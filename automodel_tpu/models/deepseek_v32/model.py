@@ -103,6 +103,41 @@ _INDEXER_AXES = {
 }
 
 
+def _indexer_features(cfg: DeepseekV32Config, lp, x, q_latent, positions, inv_freq):
+    """Per-token indexer features — q (B,S,Hi,di) and k (B,S,di), post-rope,
+    post-Hadamard. Each token's k depends only on its own x and position, which
+    is what makes the indexer CACHEABLE at decode time."""
+    nope = cfg.index_head_dim - cfg.qk_rope_head_dim
+    q = jnp.einsum("bsr,rhk->bshk", q_latent, lp["idx_wq_b"])  # (B,S,Hi,di)
+    k = layer_norm(jnp.einsum("bsd,dk->bsk", x, lp["idx_wk"]), lp["idx_k_norm"], lp["b_idx_k"])
+
+    q_nope, q_pe = jnp.split(q, [nope], axis=-1)
+    k_nope, k_pe = jnp.split(k[:, :, None, :], [nope], axis=-1)
+    q_pe = apply_rope_interleaved(q_pe, positions, inv_freq)
+    k_pe = apply_rope_interleaved(k_pe, positions, inv_freq)
+    q = jnp.concatenate([q_nope, q_pe], axis=-1)
+    k = jnp.concatenate([k_nope, k_pe], axis=-1)[:, :, 0]
+
+    q = hadamard_transform(q, cfg.index_head_dim**-0.5)
+    k = hadamard_transform(k, cfg.index_head_dim**-0.5)
+    return q, k
+
+
+def _topk_bias(cfg: DeepseekV32Config, scores, allowed, k_bound: int):
+    """Scores (B,S,T) + allowed mask -> 0/-inf additive bias keeping each
+    query's top-k allowed keys."""
+    neg = jnp.finfo(jnp.float32).min
+    scores = jnp.where(allowed, scores, neg)
+    k_sel = min(cfg.index_topk, k_bound)
+    kth = jax.lax.top_k(scores, k_sel)[0][..., -1:]
+    # Re-intersect with `allowed`: rows with < k_sel allowed keys have
+    # kth == finfo.min, and `scores >= kth` alone would then admit every
+    # position. Ties at the threshold still admit a superset of k_sel keys
+    # (all are causally valid). Masking here keeps the bias self-contained
+    # rather than relying on the downstream attention mask.
+    return jnp.where(allowed & (scores >= kth), 0.0, neg)
+
+
 def make_indexer_bias_fn(cfg: DeepseekV32Config):
     """Sparse top-k additive bias (reference DeepseekV32Indexer.forward,
     layers.py:150-265 + _build_sparse_mask :358-425).
@@ -110,49 +145,61 @@ def make_indexer_bias_fn(cfg: DeepseekV32Config):
     Causal / segment masking applies to the scores *before* top-k so selection never
     wastes slots on disallowed positions; the attention's own mask still applies.
     """
-    nope = cfg.index_head_dim - cfg.qk_rope_head_dim
     inv_freq = mla_inv_freq(cfg)  # indexer shares MLA's (possibly YaRN) frequencies
     scale = cfg.index_n_heads**-0.5 * cfg.index_head_dim**-0.5
 
     def bias_fn(lp, x, q_latent, positions, segment_ids):
         B, S, _ = x.shape
-        q = jnp.einsum("bsr,rhk->bshk", q_latent, lp["idx_wq_b"])  # (B,S,Hi,di)
-        k = layer_norm(jnp.einsum("bsd,dk->bsk", x, lp["idx_wk"]), lp["idx_k_norm"], lp["b_idx_k"])
-
-        q_nope, q_pe = jnp.split(q, [nope], axis=-1)
-        k_nope, k_pe = jnp.split(k[:, :, None, :], [nope], axis=-1)
-        q_pe = apply_rope_interleaved(q_pe, positions, inv_freq)
-        k_pe = apply_rope_interleaved(k_pe, positions, inv_freq)
-        q = jnp.concatenate([q_nope, q_pe], axis=-1)
-        k = jnp.concatenate([k_nope, k_pe], axis=-1)[:, :, 0]
-
-        q = hadamard_transform(q, cfg.index_head_dim**-0.5)
-        k = hadamard_transform(k, cfg.index_head_dim**-0.5)
-
+        q, k = _indexer_features(cfg, lp, x, q_latent, positions, inv_freq)
         weights = jnp.einsum("bsd,dh->bsh", x, lp["idx_weights"]).astype(jnp.float32) * scale
         scores = jax.nn.relu(
             jnp.einsum("bqhd,btd->bhqt", q.astype(jnp.float32), k.astype(jnp.float32))
         )  # (B,Hi,S,S)
         scores = jnp.einsum("bhqt,bqh->bqt", scores, weights)  # (B,S,S)
 
-        # mask disallowed positions before selecting top-k
-        neg = jnp.finfo(jnp.float32).min
         allowed = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
         allowed = jnp.broadcast_to(allowed[None], (B, S, S))
         if segment_ids is not None:
             allowed = allowed & (segment_ids[:, :, None] == segment_ids[:, None, :])
-        scores = jnp.where(allowed, scores, neg)
-
-        k_sel = min(cfg.index_topk, S)
-        kth = jax.lax.top_k(scores, k_sel)[0][..., -1:]
-        # Re-intersect with `allowed`: rows with < k_sel allowed keys have
-        # kth == finfo.min, and `scores >= kth` alone would then admit every
-        # position. Ties at the threshold still admit a superset of k_sel keys
-        # (all are causally valid). Masking here keeps the bias self-contained
-        # rather than relying on the downstream attention mask.
-        return jnp.where(allowed & (scores >= kth), 0.0, neg)
+        return _topk_bias(cfg, scores, allowed, S)
 
     return bias_fn
+
+
+def make_indexer_decode_fn(cfg: DeepseekV32Config):
+    """Incremental indexer for KV-cache decode (VERDICT r3 #7): each cached
+    token's post-Hadamard indexer key was computed at ITS OWN step (it depends
+    only on that token's hidden state and position — see _indexer_features), so
+    decode writes the chunk's keys into a per-layer ``idx_k`` cache and scores
+    the new queries against the whole cache. The top-k threshold then reproduces
+    the training-mode selection over the tokens seen so far exactly.
+
+    Returns ``decode_fn(lp, x, q_latent, positions, idx_cache, cache_meta) ->
+    (bias (B,s,S_max), idx_cache_new)``.
+    """
+    inv_freq = mla_inv_freq(cfg)
+    scale = cfg.index_n_heads**-0.5 * cfg.index_head_dim**-0.5
+
+    def decode_fn(lp, x, q_latent, positions, idx_cache, cache_meta):
+        from automodel_tpu.models.common.transformer import _cache_write
+
+        q, k = _indexer_features(cfg, lp, x, q_latent, positions, inv_freq)
+        idx_cache = _cache_write(idx_cache, k.astype(idx_cache.dtype),
+                                 cache_meta["write_idx"])
+        weights = jnp.einsum("bsd,dh->bsh", x, lp["idx_weights"]).astype(jnp.float32) * scale
+        scores = jax.nn.relu(
+            jnp.einsum("bqhd,btd->bhqt", q.astype(jnp.float32),
+                       idx_cache.astype(jnp.float32))
+        )  # (B,Hi,s,S_max)
+        scores = jnp.einsum("bhqt,bqh->bqt", scores, weights)  # (B,s,S_max)
+        # position-causal x written-slot mask, the same pair the MLA cache
+        # attention applies (slot order need not match position order)
+        allowed = (positions[:, :, None] >= cache_meta["positions"][:, None, :]) & (
+            cache_meta["valid"][:, None, :] != 0
+        )
+        return _topk_bias(cfg, scores, allowed, idx_cache.shape[1]), idx_cache
+
+    return decode_fn
 
 
 class DeepseekV32ForCausalLM(DeepseekV3ForCausalLM):
@@ -173,16 +220,30 @@ class DeepseekV32ForCausalLM(DeepseekV3ForCausalLM):
 
     def make_attention_fn(self):
         return make_mla_attention_fn(
-            self.config, self.backend, bias_fn=make_indexer_bias_fn(self.config)
+            self.config, self.backend, bias_fn=make_indexer_bias_fn(self.config),
+            bias_decode_fn=make_indexer_decode_fn(self.config),
         )
 
+    def init_decode_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16) -> dict:
+        """Standard MLA k/v cache + the per-layer post-Hadamard indexer-key
+        cache ``idx_k`` (L, B, S, index_head_dim) the sparse bias scores
+        against at decode time (make_indexer_decode_fn)."""
+        from automodel_tpu.generation import init_kv_cache
+
+        cfg = self.config
+        cache = init_kv_cache(cfg, batch_size, max_len, dtype)
+        cache["idx_k"] = jnp.zeros(
+            (cfg.num_hidden_layers, batch_size, max_len, cfg.index_head_dim), dtype
+        )
+        return cache
+
     def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
-                 rules=None, return_hidden=False, training=True):
+                 rules=None, return_hidden=False, training=True, cache=None):
         return moe_decoder_forward(
             self.config, self.backend, params, input_ids,
             positions=positions, segment_ids=segment_ids, token_mask=token_mask,
             rules=rules, return_hidden=return_hidden, training=training,
-            attention_fn=self.make_attention_fn(),
+            attention_fn=self.make_attention_fn(), cache=cache,
         )
 
     def state_dict_adapter(self):

@@ -92,11 +92,13 @@ class Qwen3MoeForCausalLM:
         return jax.eval_shape(lambda k: self.init(k, dtype), jax.random.key(0))
 
     def __call__(self, params, input_ids, positions=None, segment_ids=None, token_mask=None,
-                 rules=None, return_hidden=False, training=True, cache=None):
+                 rules=None, return_hidden=False, training=True, cache=None,
+                 inputs_embeds=None):
         return moe_decoder_forward(
             self.config, self.backend, params, input_ids,
             positions=positions, segment_ids=segment_ids, token_mask=token_mask,
             rules=rules, return_hidden=return_hidden, training=training, cache=cache,
+            inputs_embeds=inputs_embeds,
         )
 
     def generate(self, params, input_ids, **kw):
