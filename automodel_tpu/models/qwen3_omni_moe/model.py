@@ -63,6 +63,17 @@ class Qwen3OmniMoeThinkerForConditionalGeneration(Qwen3VLMoeForConditionalGenera
         "Qwen3OmniMoeThinkerForConditionalGeneration",
         "Qwen3OmniMoeForConditionalGeneration",
     )
+    # the layer walk is inherited from Qwen3VLMoe, so the pipelined hidden path
+    # works as-is once the audio embeds ride the per-microbatch prologue:
+    def _pp_extra_embeds(self, params, mb):
+        if "audio_chunks" not in mb:
+            return None
+        ai = mb["audio_inputs"]
+        tokens = audio_forward(
+            self.config.audio, self.backend, params["audio"],
+            mb["audio_chunks"], ai["gather_idx"], ai["segment_ids"],
+        )
+        return ((mb["audio_coords_b"], mb["audio_coords_s"]), tokens)
 
     # ---- params ----
 

@@ -201,6 +201,176 @@ class Qwen3VLMoeForConditionalGeneration:
 
     # ---- forward ----
 
+    def embed_with_vision(self, params, input_ids, pixel_values=None,
+                          vision_inputs=None, visual_coords=None, extra_embeds=None):
+        """Token embedding with visual tokens scattered in at image-token slots.
+        Returns ``(h, ds)`` — ds is the (n_ds, Tm, D) deepstack feature stack
+        (None without pixels). Shared by __call__ and the pp hidden path."""
+        dtype = self.backend.jnp_dtype
+        h = params["embed"].astype(dtype)[input_ids]
+        ds = None
+        if pixel_values is not None:
+            vis, ds = vision_forward(
+                self.config.vision, self.backend, params["visual"],
+                pixel_values, vision_inputs["pos_pairs"], vision_inputs["pos_idx"],
+                vision_inputs["pos_w"], vision_inputs["segment_ids"],
+            )
+            b_idx, s_idx = visual_coords
+            h = h.at[b_idx, s_idx].set(vis.astype(dtype))
+        if extra_embeds is not None:
+            (eb_idx, es_idx), toks = extra_embeds
+            h = h.at[eb_idx, es_idx].set(toks.astype(dtype))
+        return h, ds
+
+    # vlm x pp capability flag for the recipe's _check_pp_support
+    pp_hidden_supported = True
+
+    def _pp_extra_embeds(self, params, mb):
+        """Hook for subclasses with extra scatter modalities (omni audio): maps
+        a microbatch to ``((b_idx, s_idx), tokens)`` for embed_with_vision, or
+        None. The base family has none."""
+        del params, mb
+        return None
+
+    def make_pp_hidden(self, mesh, rules=None, *, seq_len_hint: int = 0,
+                       circular_repeats: int = 1):
+        """Pipelined text stack -> FINAL HIDDEN STATES for vlm x pp (VERDICT r3
+        #5; the reference pipelines the wrapped VLM module by FQN slicing,
+        distributed/pipelining/functional.py:289).
+
+        Per microbatch OUTSIDE the manual region (plain GSPMD): vision tower,
+        embed scatter, mrope angles. INSIDE, the per-layer deepstack features
+        ride the ring as a dense (n_ds, B, S, D) addend next to the activation
+        — side-riders over pipeline_spmd's pytree ring — and are injected at
+        their GLOBAL layer index by whichever stage owns it, so the deepstack
+        window may even straddle a stage boundary.
+
+        Returns ``hidden_fn(params, batch_stack, num_label_tokens) ->
+        (h_stack, aux_loss, {"expert_load": (L, E)})`` — the same contract as
+        :func:`parallel.pipeline.make_moe_pp_hidden`.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from automodel_tpu.parallel.pipeline import make_pipeline_forward
+
+        if circular_repeats > 1:
+            raise NotImplementedError(
+                "qwen3-vl deepstack pp is wired for V=1 (circular rounds need a "
+                "round-major layer-index remap for the deepstack injection)"
+            )
+        cfg, backend = self.config.text, self.backend
+        if backend.dispatcher == "a2a":
+            # same fence as make_moe_pp_loss (parallel/pipeline.py): the a2a
+            # dispatch is its own shard_map and cannot nest in the pp region
+            raise ValueError(
+                "dispatcher='a2a' cannot run inside the pp manual region (nested "
+                "shard_map over ep); use the default GSPMD dispatcher under pp"
+            )
+        pp = mesh.shape["pp"]
+        L = cfg.num_hidden_layers
+        if L % pp:
+            raise ValueError(f"num_hidden_layers {L} % pp {pp} != 0")
+        Lb = L // pp
+        n_ds = len(self.config.vision.deepstack_visual_indexes)
+        inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+        attn_scale = rope_attention_scaling(cfg.rope_scaling)
+        emit_aux = cfg.moe.aux_loss_coeff > 0 and not backend.fake_balanced_gate
+        mrope_section = self.config.mrope_section
+
+        def attention_fn(lp, x, angles, seg, is_sliding, rules_):
+            # the state's ``positions`` slot carries the per-microbatch mrope
+            # ANGLES through the ring (moe_layer_fn just forwards it here)
+            del is_sliding, rules_
+            q = jnp.einsum("bsd,dnh->bsnh", x, lp["wq"])
+            k = jnp.einsum("bsd,dnh->bsnh", x, lp["wk"])
+            v = jnp.einsum("bsd,dnh->bsnh", x, lp["wv"])
+            q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+            q = apply_rope_angles(q, angles, attn_scale)
+            k = apply_rope_angles(k, angles, attn_scale)
+            out = dot_product_attention(
+                q, k, v, causal=True, segment_ids_q=seg, backend=backend.attention,
+            )
+            return jnp.einsum("bsnh,nhd->bsd", out, lp["wo"])
+
+        # rules=None: no sharding constraints inside the pp-manual region (the
+        # same contract as make_moe_pp_loss)
+        _, moe_layer_fn = make_moe_layer_fns(
+            cfg, backend, None, attention_fn, True, seq_len_hint=seq_len_hint
+        )
+        body = backend.layer_remat(moe_layer_fn)
+        aux_specs = {"load": P("pp")}
+        if emit_aux:
+            aux_specs["aux"] = P("pp")
+        pipeline = make_pipeline_forward(mesh, with_aux=True, aux_out_specs=aux_specs)
+
+        def layer_apply(lp_stack, x):
+            state = {"h": x["h"], "positions": x["angles"],
+                     "segment_ids": x["segment_ids"],
+                     "token_mask": x["segment_ids"] != 0}
+            base = jax.lax.axis_index("pp") * Lb
+
+            def scan_body(st, inp):
+                lp, j = inp
+                st, (aux, load, dropped) = body(st, (lp, jnp.int32(0)))
+                if n_ds:
+                    gi = base + j
+                    inj = jnp.where(
+                        gi < n_ds,
+                        x["ds"][jnp.clip(gi, 0, n_ds - 1)].astype(st["h"].dtype),
+                        jnp.zeros_like(st["h"]),
+                    )
+                    st = dict(st, h=st["h"] + inj)
+                return st, (aux, load, dropped)
+
+            state, (auxs, loads, _dropped) = jax.lax.scan(
+                scan_body, state, (lp_stack, jnp.arange(Lb))
+            )
+            out = {"load": loads}
+            if emit_aux:
+                out["aux"] = (auxs.sum() * x["aux_weight"])[None]
+            return dict(x, h=state["h"]), out
+
+        def hidden_fn(params, batch_stack, num_label_tokens):
+            def embed_mb(mb):
+                h, ds = self.embed_with_vision(
+                    params, mb["input_ids"], mb.get("pixel_values"),
+                    mb.get("vision_inputs"),
+                    (mb["visual_coords_b"], mb["visual_coords_s"])
+                    if "visual_coords_b" in mb else None,
+                    extra_embeds=self._pp_extra_embeds(params, mb),
+                )
+                pos3 = mb.get("positions3")
+                if pos3 is None:
+                    B, S = mb["input_ids"].shape
+                    pos3 = jnp.broadcast_to(jnp.arange(S), (3, B, S))
+                entry = {
+                    "h": h,
+                    "angles": mrope_angles(pos3, inv_freq, mrope_section),
+                    "segment_ids": mb["segment_ids"],
+                }
+                if n_ds:
+                    dsd = jnp.zeros((n_ds, *h.shape), h.dtype)
+                    if ds is not None:
+                        b_idx, s_idx = mb["visual_coords_b"], mb["visual_coords_s"]
+                        dsd = dsd.at[:, b_idx, s_idx].add(ds.astype(h.dtype))
+                    entry["ds"] = dsd
+                return entry
+
+            x_stack = jax.lax.map(embed_mb, batch_stack)
+            if emit_aux:
+                mb_tokens = (batch_stack["labels"] != -100).sum(axis=tuple(
+                    range(1, batch_stack["labels"].ndim))).astype(jnp.float32)
+                x_stack["aux_weight"] = mb_tokens / jnp.asarray(
+                    num_label_tokens, jnp.float32)
+            h_stack, aux = pipeline(
+                params["moe_layers"], None, x_stack, None, layer_apply, None
+            )
+            aux_loss = (cfg.moe.aux_loss_coeff * aux["aux"].sum()) if emit_aux else 0.0
+            return h_stack, aux_loss, {"expert_load": aux["load"]}
+
+        return hidden_fn
+
     def __call__(
         self,
         params,
@@ -226,21 +396,9 @@ class Qwen3VLMoeForConditionalGeneration:
         attn_scale = rope_attention_scaling(cfg.rope_scaling)
         angles = mrope_angles(positions3, inv_freq, self.config.mrope_section)
 
-        h = params["embed"].astype(dtype)[input_ids]
-
-        ds = None
-        if pixel_values is not None:
-            vis, ds = vision_forward(
-                self.config.vision, backend, params["visual"],
-                pixel_values, vision_inputs["pos_pairs"], vision_inputs["pos_idx"],
-                vision_inputs["pos_w"], vision_inputs["segment_ids"],
-            )
-            b_idx, s_idx = visual_coords
-            h = h.at[b_idx, s_idx].set(vis.astype(dtype))
-        if extra_embeds is not None:
-            (eb_idx, es_idx), toks = extra_embeds
-            h = h.at[eb_idx, es_idx].set(toks.astype(dtype))
-
+        h, ds = self.embed_with_vision(
+            params, input_ids, pixel_values, vision_inputs, visual_coords, extra_embeds
+        )
         h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
         emit_aux = cfg.moe.aux_loss_coeff > 0 and training and not backend.fake_balanced_gate
 

@@ -86,12 +86,17 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         axis on pp, which only makes sense for families whose text stack we
         pipeline (vision-tower blocks of an unsupported family would otherwise
         fail sharding-divisibility first with an opaque pjit error)."""
-        if self.mesh_ctx.pp > 1 and not hasattr(self.model, "merged_embeds"):
-            raise NotImplementedError(
-                "vlm + pp is wired for models exposing merged_embeds over a dense "
-                "text stack (LLaVA lineage); mrope/deepstack families interleave "
-                "vision state into the layer stream and are not pipelined yet"
-            )
+        if self.mesh_ctx.pp <= 1:
+            return
+        if hasattr(self.model, "merged_embeds"):
+            return  # LLaVA lineage: dense text stack behind merged embeds
+        if getattr(self.model, "pp_hidden_supported", False):
+            return  # mrope/deepstack families with a model-provided pp hidden path
+        raise NotImplementedError(
+            "vlm + pp is wired for models exposing merged_embeds (LLaVA lineage) "
+            "or a make_pp_hidden pipelined path (qwen3-vl deepstack); this "
+            "family interleaves vision state into the layer stream without one"
+        )
 
     def _build_peft(self):
         # freeze split (reference freeze_config, vlm/finetune.py:86-113)
@@ -266,34 +271,54 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         backend = model.backend
         dtype = backend.jnp_dtype
         virtual = int(self.cfg.get("distributed.pp_virtual_stages", 1))
-        hidden_fn = make_dense_decoder_pp_hidden(
-            cfg_t, backend, self.mesh, circular_repeats=virtual
-        )
         # honors loss_name (linear_ce for big-vocab VLMs — the scale pp exists
         # for); additive per-microbatch contract, divided by n below
         head_loss = _make_head_loss(cfg_t, dtype, self.loss_name)
 
-        def pp_core(full, batch_stack, n):
-            lm = full["language_model"]
-
-            def embed_mb(mb):
-                return model.merged_embeds(full, mb["input_ids"], mb.get("pixel_values"))
-
-            embed_keys = {
-                k: batch_stack[k] for k in ("input_ids", "pixel_values")
-                if k in batch_stack
-            }
-            x_stack = {
-                "h": jax.lax.map(embed_mb, embed_keys),
-                "positions": batch_stack["positions"],
-                "segment_ids": batch_stack["segment_ids"],
-            }
-            h_stack = hidden_fn(lm["layers"], x_stack)
-            losses = jax.lax.map(
-                lambda args: head_loss(lm, {"h": args[0]}, {"labels": args[1]}),
-                (h_stack, batch_stack["labels"]),
+        if not hasattr(model, "merged_embeds"):
+            # mrope/deepstack families (qwen3-vl): the model owns the pipelined
+            # hidden path (vision per microbatch outside the manual region,
+            # deepstack features riding the ring — qwen3_vl_moe.make_pp_hidden)
+            vl_hidden = model.make_pp_hidden(
+                self.mesh, self.rules, seq_len_hint=self.seq_len,
+                circular_repeats=virtual,
             )
-            return losses.sum() / n
+
+            def pp_core(full, batch_stack, n):
+                h_stack, aux_loss, extras = vl_hidden(full, batch_stack, n)
+                other = {k: v for k, v in full.items()
+                         if k not in ("moe_layers", "visual")}
+                losses = jax.lax.map(
+                    lambda args: head_loss(other, {"h": args[0]}, {"labels": args[1]}),
+                    (h_stack, batch_stack["labels"]),
+                )
+                return losses.sum() / n + aux_loss, extras
+        else:
+            hidden_fn = make_dense_decoder_pp_hidden(
+                cfg_t, backend, self.mesh, circular_repeats=virtual
+            )
+
+            def pp_core(full, batch_stack, n):
+                lm = full["language_model"]
+
+                def embed_mb(mb):
+                    return model.merged_embeds(full, mb["input_ids"], mb.get("pixel_values"))
+
+                embed_keys = {
+                    k: batch_stack[k] for k in ("input_ids", "pixel_values")
+                    if k in batch_stack
+                }
+                x_stack = {
+                    "h": jax.lax.map(embed_mb, embed_keys),
+                    "positions": batch_stack["positions"],
+                    "segment_ids": batch_stack["segment_ids"],
+                }
+                h_stack = hidden_fn(lm["layers"], x_stack)
+                losses = jax.lax.map(
+                    lambda args: head_loss(lm, {"h": args[0]}, {"labels": args[1]}),
+                    (h_stack, batch_stack["labels"]),
+                )
+                return losses.sum() / n
 
         use_dropout = self.peft is not None and self.peft.dropout > 0.0
         if self.peft is not None:

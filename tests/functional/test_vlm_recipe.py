@@ -232,29 +232,111 @@ def test_vlm_pp_matches_unpipelined_trajectory(tmp_path, cpu_devices):
     np.testing.assert_allclose(got, ref, rtol=1e-4)
 
 
-def test_vlm_pp_mrope_family_fence_is_precise(tmp_path, cpu_devices):
-    """qwen-vl (mrope/deepstack) under pp raises the narrowed fence, naming why."""
+def _qwen3_vl_cfg(tmp_path, tag, dist, peft="", max_steps=6):
+    import textwrap
+
+    cfg_text = f"""
+    seed: 7
+    output_dir: {tmp_path}/{tag}
+    model:
+      config:
+        architectures: [Qwen3VLMoeForConditionalGeneration]
+        image_token_id: 120
+        video_token_id: 122
+        vision_start_token_id: 121
+        text_config:
+          vocab_size: 2048
+          hidden_size: 48
+          intermediate_size: 96
+          moe_intermediate_size: 32
+          num_hidden_layers: 2
+          num_attention_heads: 4
+          num_key_value_heads: 2
+          head_dim: 16
+          num_experts: 4
+          num_experts_per_tok: 2
+          max_position_embeddings: 64
+          rope_scaling:
+            rope_type: default
+            mrope_section: [4, 2, 2]
+            mrope_interleaved: true
+        vision_config:
+          depth: 2
+          hidden_size: 32
+          intermediate_size: 48
+          num_heads: 4
+          patch_size: 4
+          spatial_merge_size: 2
+          temporal_patch_size: 2
+          out_hidden_size: 48
+          num_position_embeddings: 16
+          deepstack_visual_indexes: [0, 1]
+          in_channels: 3
+    distributed: {dist}
+    backend:
+      dtype: float32
+    freeze:
+      freeze_vision_tower: true
+    {peft}
+    tokenizer:
+      _target_: tests.unit.test_datasets_llm.WordTokenizer
+    dataset:
+      _target_: automodel_tpu.data.vlm.mock.MockVLMDataset
+      num_samples: 64
+      image_hw: 16
+      num_classes: 4
+      vocab_size: 2048
+    vlm:
+      image_size: [4, 4]
+    micro_batch_size: 8
+    seq_len: 32
+    step_scheduler:
+      grad_acc_steps: 2
+      max_steps: {max_steps}
+      num_epochs: 20
+      handle_sigterm: false
+    optimizer:
+      lr: 5.0e-3
+    checkpoint:
+      enabled: false
+    """
+    p = tmp_path / f"cfg_{tag}.yaml"
+    p.write_text(textwrap.dedent(cfg_text))
+    return p
+
+
+def test_qwen3_vl_pp_matches_unpipelined_trajectory(tmp_path, cpu_devices):
+    """vlm x pp for the mrope/deepstack family (the r3 fence): vision + embed +
+    mrope angles per microbatch outside the manual region, deepstack features
+    riding the pipeline ring and injected at their global layer index. With 2
+    layers over pp=2 the deepstack window STRADDLES the stage boundary — the
+    pp=2 trajectory must reproduce the unpipelined one exactly."""
+
+    def run(tag, dist):
+        r = FinetuneRecipeForVLM(load_config(_qwen3_vl_cfg(tmp_path, tag, dist)))
+        r.setup()
+        r.run_train_validation_loop()
+        return [json.loads(l)["loss"] for l in open(tmp_path / tag / "training.jsonl")]
+
+    ref = run("qvl_pp1", "{dp_shard: 8}")
+    got = run("qvl_pp2", "{dp_shard: 4, pp: 2}")
+    assert np.isfinite(ref).all() and ref[-1] < ref[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_vlm_pp_unsupported_family_fence_is_precise(tmp_path, cpu_devices):
+    """A VLM with neither merged_embeds nor a pp hidden path raises the
+    narrowed fence naming both supported routes."""
     import pytest
 
-    p = _write_cfg(tmp_path, max_steps=2)
-    text = p.read_text().replace("dp_shard: 8", "dp_shard: 4\n  pp: 2")
-    text = text.replace("architectures: [LlavaForConditionalGeneration]",
-                        "architectures: [Qwen3VLMoeForConditionalGeneration]")
-    text = text.replace("image_token_index: 2000",
-                        "image_token_index: 2000\n    vision_start_token_id: 2001")
-    text = text.replace("""    text_config:
-      vocab_size: 2048
-      hidden_size: 48
-      intermediate_size: 96""", """    text_config:
-      vocab_size: 2048
-      hidden_size: 48
-      intermediate_size: 96
-      moe_intermediate_size: 32
-      head_dim: 16
-      num_experts: 4
-      num_experts_per_tok: 2""")
-    pt = tmp_path / "cfg_fence.yaml"
-    pt.write_text(text)
-    r = FinetuneRecipeForVLM(load_config(pt))
-    with pytest.raises(NotImplementedError, match="mrope/deepstack|merged_embeds"):
-        r.setup()
+    from automodel_tpu.models.qwen3_vl_moe.model import Qwen3VLMoeForConditionalGeneration
+
+    p = _qwen3_vl_cfg(tmp_path, "fence", "{dp_shard: 4, pp: 2}", max_steps=2)
+    r = FinetuneRecipeForVLM(load_config(p))
+    orig = Qwen3VLMoeForConditionalGeneration.pp_hidden_supported
+    Qwen3VLMoeForConditionalGeneration.pp_hidden_supported = False
+    try:
+        with pytest.raises(NotImplementedError, match="merged_embeds|make_pp_hidden"):
+            r.setup()
+    finally:
+        Qwen3VLMoeForConditionalGeneration.pp_hidden_supported = orig

@@ -142,3 +142,85 @@ class TestOmniThinkerParity:
             ids, None, video_grid_thw=grid, second_per_grids=np.array([2.0])
         )
         np.testing.assert_array_equal(ours, theirs.numpy())
+
+
+class TestOmniPPHidden:
+    def test_pp_hidden_matches_forward_with_audio(self, cpu_devices):
+        """Omni under pp (VERDICT r3 #5 follow-through): the inherited
+        make_pp_hidden path with audio embeds riding the per-microbatch
+        prologue must reproduce the unpipelined hidden states exactly."""
+        import jax
+        import jax.numpy as jnp
+
+        from automodel_tpu.data.vlm.collate_fns import qwen3_omni_collate
+        from automodel_tpu.models.auto import AutoModelForImageTextToText
+        from automodel_tpu.models.common.backend import BackendConfig
+        from automodel_tpu.parallel.mesh import MeshContext, default_sharding_rules
+        from tests.unit.test_datasets_llm import WordTokenizer
+
+        hf = {
+            "architectures": ["Qwen3OmniMoeForConditionalGeneration"],
+            "audio_config": {
+                "d_model": 32, "encoder_layers": 2, "encoder_attention_heads": 4,
+                "encoder_ffn_dim": 48, "num_mel_bins": 32, "n_window": 8,
+                "n_window_infer": 32, "downsample_hidden_size": 16, "output_dim": 64,
+                "conv_chunksize": 500,
+            },
+            "vision_config": {
+                "depth": 2, "hidden_size": 32, "intermediate_size": 48, "num_heads": 4,
+                "patch_size": 4, "spatial_merge_size": 2, "temporal_patch_size": 2,
+                "out_hidden_size": 64, "num_position_embeddings": 16,
+                "deepstack_visual_indexes": [0, 1], "in_channels": 3,
+            },
+            "text_config": {
+                "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+                "moe_intermediate_size": 32, "num_hidden_layers": 2,
+                "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+                "num_experts": 8, "num_experts_per_tok": 2,
+                "max_position_embeddings": 256,
+                "rope_scaling": {"rope_type": "default", "mrope_section": [4, 2, 2],
+                                 "mrope_interleaved": True},
+            },
+            "audio_token_id": 123, "image_token_id": 120, "video_token_id": 122,
+            "vision_start_token_id": 121, "audio_start_token_id": 124,
+        }
+        model = AutoModelForImageTextToText.from_config(hf, BackendConfig(dtype="float32"))
+        rng = np.random.RandomState(0)
+        exs = [{"prompt": "<audio> transcribe", "answer": "hello",
+                "audio_features": rng.randn(32, 24).astype(np.float32)}]
+        batch = qwen3_omni_collate(exs, WordTokenizer(), model, seq_len=64)
+
+        ctx = MeshContext(pp=2, dp_shard=1, world_size=2)
+        mesh = ctx.build_mesh(jax.devices()[:2])
+        rules = default_sharding_rules().with_mesh(mesh)
+        with mesh:
+            shardings = rules.tree_sharding(model.logical_axes())
+            params = jax.jit(lambda k: model.init(k, jnp.float32),
+                             out_shardings=shardings)(jax.random.key(0))
+            ref_h, _ = model(
+                params, jnp.asarray(batch["input_ids"]),
+                audio_chunks=jnp.asarray(batch["audio_chunks"]),
+                audio_inputs={k: jnp.asarray(v) for k, v in batch["audio_inputs"].items()},
+                audio_coords=(jnp.asarray(batch["audio_coords_b"]),
+                              jnp.asarray(batch["audio_coords_s"])),
+                positions3=jnp.asarray(batch["positions3"]),
+                segment_ids=jnp.asarray(batch["segment_ids"]),
+                token_mask=jnp.asarray(batch["segment_ids"]) != 0,
+                training=True, return_hidden=True,
+            )
+            hidden_fn = model.make_pp_hidden(mesh, rules, seq_len_hint=64)
+            stack = jax.tree.map(lambda *xs: np.stack(xs), batch, batch)  # n_micro=2
+            n = int((np.asarray(batch["labels"]) != -100).sum()) * 2
+            h_stack, aux_loss, extras = jax.jit(hidden_fn, static_argnums=())(
+                params, stack, n)
+        # final norm applies in __call__'s return_hidden but NOT in hidden_fn?
+        # both return pre-head hidden AFTER final_norm in __call__; hidden_fn
+        # returns the raw layer-stack output — compare via the head-side norm
+        from automodel_tpu.ops.norms import rms_norm
+
+        cfg_t = model.config.text
+        got = rms_norm(h_stack[0], np.asarray(params["final_norm"]).astype(np.float32),
+                       cfg_t.rms_norm_eps)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref_h),
+                                   rtol=2e-5, atol=2e-5)
+        assert extras["expert_load"].shape[-1] == 8
