@@ -83,8 +83,20 @@ class Checkpointer:
             int(d.split("_")[1])
             for d in os.listdir(root)
             if d.startswith("step_") and os.path.isdir(os.path.join(root, d))
+            and self._step_complete(os.path.join(root, d))
         ]
         return max(steps) if steps else None
+
+    @staticmethod
+    def _step_complete(d: str) -> bool:
+        """True when the step's arrays committed. Orbax renames its tmp dir onto
+        the final name only at finalize, so a crash between an async ``save``
+        and ``wait`` leaves tmp residue and/or no ``model`` tree — such a dir
+        must never win the no-symlink fallback (the symlink itself is only
+        written post-finalize, checkpointing.wait)."""
+        if not os.path.isdir(os.path.join(d, "model")):
+            return False
+        return not any(".orbax-checkpoint-tmp" in name for name in os.listdir(d))
 
     # -- save ---------------------------------------------------------------
     def save(

@@ -59,9 +59,23 @@ def load_safetensors(path: str) -> LazySafetensors:
     else:
         index = os.path.join(path, _INDEX_NAME)
         if os.path.exists(index):
-            with open(index) as f:
-                weight_map = json.load(f)["weight_map"]
+            try:
+                with open(index) as f:
+                    weight_map = json.load(f)["weight_map"]
+            except (ValueError, KeyError) as e:
+                raise ValueError(
+                    f"corrupt safetensors index at {index!r} "
+                    f"({type(e).__name__}: {e}); re-export or delete the file "
+                    "to fall back to directory scanning"
+                ) from e
             key_to_file = {k: os.path.join(path, v) for k, v in weight_map.items()}
+            missing = sorted({v for v in key_to_file.values() if not os.path.exists(v)})
+            if missing:
+                raise FileNotFoundError(
+                    f"safetensors index {index!r} references missing shard "
+                    f"file(s): {[os.path.basename(m) for m in missing[:3]]}"
+                    f"{' ...' if len(missing) > 3 else ''} — incomplete download/export?"
+                )
             return LazySafetensors(key_to_file)
         files = sorted(
             os.path.join(path, f) for f in os.listdir(path) if f.endswith(".safetensors")

@@ -246,3 +246,152 @@ class TestPeftAdapterExport:
         with torch.no_grad():
             theirs = peft_model(torch.tensor(ids)).logits.float().numpy()
         np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=1e-3)
+
+
+class TestAsyncCrashSafety:
+    """VERDICT r3 #8: the latest symlink is the COMMIT MARKER — it moves only
+    after wait_until_finished, and crash states (orbax tmp residue, missing
+    model tree) must never win the no-symlink fallback."""
+
+    def test_async_save_defers_latest_until_wait(self, tmp_path):
+        ck = Checkpointer(CheckpointingConfig(
+            checkpoint_dir=str(tmp_path / "ck"), async_save=True))
+        p = _params()
+        ck.save(3, p)
+        # arrays may be in flight: latest must NOT point anywhere yet
+        assert not os.path.islink(tmp_path / "ck" / "latest")
+        ck.wait()
+        assert os.readlink(tmp_path / "ck" / "latest") == "step_3"
+
+    def test_async_save_resume_roundtrip(self, tmp_path):
+        cfg = CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck"), async_save=True)
+        ck = Checkpointer(cfg)
+        p = _params(seed=3)
+        opt = {"mu": jnp.asarray(np.random.RandomState(1).randn(4), jnp.float32)}
+        ck.save(5, p, opt_state=opt, client_states={"step": 5})
+        ck.wait()
+        fresh = Checkpointer(CheckpointingConfig(
+            checkpoint_dir=str(tmp_path / "ck"), async_save=True))
+        assert fresh.latest_step() == 5
+        rp, ro, client = fresh.load(_params(seed=9), opt_state_template={"mu": jnp.zeros(4)})
+        np.testing.assert_array_equal(np.asarray(rp["layers"]["wq"]),
+                                      np.asarray(p["layers"]["wq"]))
+        np.testing.assert_array_equal(np.asarray(ro["mu"]), np.asarray(opt["mu"]))
+        assert client["step"] == 5
+
+    def test_crash_between_save_and_finalize_resumes_previous_step(self, tmp_path):
+        cfg = CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck"))
+        ck = Checkpointer(cfg)
+        ck.save(3, _params())
+        assert os.readlink(tmp_path / "ck" / "latest") == "step_3"
+        # simulate a crash mid-async-write of step 6: orbax tmp dir present,
+        # no committed model tree, signature.json already written (save() writes
+        # it synchronously), latest never updated (wait() never ran)
+        d6 = ck.step_dir(6)
+        os.makedirs(os.path.join(d6, "model.orbax-checkpoint-tmp-1234567"))
+        with open(os.path.join(d6, "signature.json"), "w") as f:
+            json.dump({}, f)
+        fresh = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")))
+        assert fresh.latest_step() == 3  # symlink is authoritative
+        # worst case: the symlink is ALSO gone — the fallback must skip the
+        # incomplete step_6 dir instead of resuming into half-written arrays
+        os.remove(tmp_path / "ck" / "latest")
+        assert fresh.latest_step() == 3
+
+    def test_fallback_skips_dir_without_model_tree(self, tmp_path):
+        cfg = CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck"))
+        ck = Checkpointer(cfg)
+        ck.save(2, _params())
+        os.remove(tmp_path / "ck" / "latest")
+        os.makedirs(ck.step_dir(9))  # empty dir: save() crashed immediately
+        assert Checkpointer(cfg).latest_step() == 2
+
+
+class TestExportNegativePaths:
+    """VERDICT r3 #8: corrupt/truncated HF export artifacts fail loudly with
+    the offending file named, never with an opaque downstream error."""
+
+    def _export(self, tmp_path, n=6, shard_bytes=200):
+        from automodel_tpu.checkpoint.safetensors_io import save_safetensors
+
+        rng = np.random.RandomState(0)
+        tensors = {f"t{i}": rng.randn(4, 4).astype(np.float32) for i in range(n)}
+        out = str(tmp_path / "hf")
+        save_safetensors(tensors, out, max_shard_bytes=shard_bytes)
+        return out, tensors
+
+    def test_corrupt_index_json_raises_cleanly(self, tmp_path):
+        from automodel_tpu.checkpoint.safetensors_io import load_safetensors
+
+        out, _ = self._export(tmp_path)
+        index = os.path.join(out, "model.safetensors.index.json")
+        assert os.path.exists(index)
+        with open(index, "w") as f:
+            f.write('{"weight_map": {"t0": ')  # truncated mid-write
+        with pytest.raises(ValueError, match="corrupt safetensors index"):
+            load_safetensors(out)
+
+    def test_index_missing_weight_map_raises_cleanly(self, tmp_path):
+        from automodel_tpu.checkpoint.safetensors_io import load_safetensors
+
+        out, _ = self._export(tmp_path)
+        index = os.path.join(out, "model.safetensors.index.json")
+        with open(index, "w") as f:
+            json.dump({"metadata": {}}, f)
+        with pytest.raises(ValueError, match="corrupt safetensors index"):
+            load_safetensors(out)
+
+    def test_index_referencing_missing_shard_names_it(self, tmp_path):
+        from automodel_tpu.checkpoint.safetensors_io import load_safetensors
+
+        out, _ = self._export(tmp_path)
+        shards = [f for f in os.listdir(out) if f.endswith(".safetensors")]
+        os.remove(os.path.join(out, shards[0]))
+        with pytest.raises(FileNotFoundError, match=shards[0].replace(".", r"\.")):
+            load_safetensors(out)
+
+    def test_truncated_shard_raises(self, tmp_path):
+        from automodel_tpu.checkpoint.safetensors_io import load_safetensors
+
+        out, tensors = self._export(tmp_path, n=2, shard_bytes=10**9)  # single file
+        fp = os.path.join(out, "model.safetensors")
+        data = open(fp, "rb").read()
+        with open(fp, "wb") as f:
+            f.write(data[: len(data) // 2])
+        with pytest.raises(Exception):
+            lazy = load_safetensors(out)
+            np.asarray(lazy["t0"])
+
+
+class TestLockstepMaterializationOrder:
+    def test_write_false_materializes_in_writer_order(self, tmp_path):
+        """VERDICT r3 #8: non-writing ranks must walk tensors in EXACTLY the
+        writer's order — the per-tensor host gathers are collectives, so a
+        divergent order deadlocks a real pod. Pin it with recording leaves."""
+        from automodel_tpu.checkpoint.safetensors_io import save_safetensors
+
+        class Rec:
+            def __init__(self, key, arr, log):
+                self.key, self.arr, self.log = key, arr, log
+                self.nbytes = arr.nbytes
+                self.dtype = arr.dtype
+
+            def __array__(self, dtype=None, copy=None):
+                self.log.append(self.key)
+                return self.arr
+
+        rng = np.random.RandomState(0)
+        arrays = {f"t{i}": rng.randn(8, 8).astype(np.float32) for i in range(7)}
+
+        def run(write, out):
+            log = []
+            tensors = {k: Rec(k, v, log) for k, v in arrays.items()}
+            save_safetensors(tensors, out, max_shard_bytes=600, write=write)
+            return log
+
+        writer_order = run(True, str(tmp_path / "w"))
+        lockstep_order = run(False, str(tmp_path / "nw"))
+        assert len(writer_order) >= 7  # every tensor materialized
+        # non-writer sequence must be a prefix-complete replay of the writer's
+        assert lockstep_order == writer_order
+        assert not os.path.exists(tmp_path / "nw")  # write=False writes nothing
