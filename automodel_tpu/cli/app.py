@@ -26,6 +26,7 @@ RECIPES: dict[tuple[str, str], str] = {
     ("pretrain", "llm"): "automodel_tpu.recipes.llm.train_ft:main",
     ("benchmark", "llm"): "automodel_tpu.recipes.llm.benchmark:main",
     ("kd", "llm"): "automodel_tpu.recipes.llm.kd:main",
+    ("generate", "llm"): "automodel_tpu.recipes.llm.generate:main",
     ("finetune", "seq_cls"): "automodel_tpu.recipes.llm.train_seq_cls:main",
     ("finetune", "vlm"): "automodel_tpu.recipes.vlm.finetune:main",
     ("finetune", "biencoder"): "automodel_tpu.recipes.biencoder.train_biencoder:main",

@@ -49,6 +49,12 @@ class BackendConfig:
     """
 
     attention: str = "xla"
+    # pass segment ids into the attention mask. True is always correct; False is
+    # a fast path for RIGHT-PADDED UNPACKED batches, where causal masking alone
+    # already stops real tokens from attending to pads (pads sit after every
+    # real token; pad rows' outputs are loss-masked). Packed sequences NEED it
+    # on — the recipe guards that combination.
+    attention_segments: bool = True
     # "allgather": rely on XLA SPMD to gather k/v across the cp axis (always
     # correct). "ring": ppermute ring attention over cp (overlaps comm with
     # compute; full/causal GQA attention without sinks/soft-cap/traced windows)

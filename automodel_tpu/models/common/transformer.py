@@ -280,7 +280,11 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
         out = checkpoint_name(dot_product_attention(
             q, k, v,
             causal=cfg.causal,
-            segment_ids_q=segment_ids,
+            # attention_segments=False: right-padded-unpacked fast path — causal
+            # masking alone isolates real tokens from trailing pads. The
+            # argument needs causality: bidirectional stacks keep their masking
+            segment_ids_q=(segment_ids if (backend.attention_segments or not cfg.causal)
+                           else None),
             sliding_window=sliding,
             sinks=lp.get("sinks"),
             backend=backend.attention,

@@ -29,6 +29,7 @@ the block sizes; callers fall back to the XLA path otherwise.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -396,8 +397,15 @@ def _flash_bwd(scale, causal, softcap, block_q, block_k, groups, interpret,
     )
     # the dkv kernel carries TWO f32 accumulators + the recompute tile; at
     # block_q 1024 it sits ~44KB over the 16MB scoped-VMEM line in some remat
-    # contexts — cap ITS q block while dq (one accumulator) keeps the bigger one
-    block_q_kv = min(block_q, 512)
+    # contexts — cap ITS q block while dq (one accumulator) keeps the bigger one.
+    # The env override exists for on-chip block sweeps (bench scripts); 512 is
+    # the measured best at seq 2048 AND 4096 on v5e.
+    block_q_kv = min(block_q, int(os.environ.get("AUTOMODEL_FLASH_BWD_Q_BLOCK", "512")))
+    if sq % block_q_kv:
+        raise ValueError(
+            f"AUTOMODEL_FLASH_BWD_Q_BLOCK={block_q_kv} must divide seq {sq} "
+            "(a ragged dkv grid would silently drop tail q-blocks from dk/dv)"
+        )
     num_q_kv = sq // block_q_kv
     dkv_kernel = functools.partial(
         _dkv_kernel, scale=scale, causal=causal,

@@ -1,0 +1,127 @@
+"""Generation recipe — sample from a trained/finetuned checkpoint in-framework.
+
+The reference points users at vLLM/transformers for sampling after export; here
+the KV-cache decode path (generation/__init__.py) is native, so ``automodel
+generate llm -c cfg.yaml`` closes the finetune -> sample loop without leaving
+the framework (and without exporting first).
+
+.. code-block:: yaml
+
+    model:
+      pretrained_model_name_or_path: /path/to/hf_or_exported_dir
+    generation:
+      max_new_tokens: 64
+      temperature: 0.7        # 0 = greedy
+      top_k: 50
+      top_p: 0.95
+      seed: 0
+    prompts:                  # or prompts_file: one prompt per line
+      - "The capital of France is"
+    output_file: completions.jsonl   # optional; stdout always
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.config.cli_overrides import parse_args_and_load_config
+from automodel_tpu.models.auto import AutoModelForCausalLM
+from automodel_tpu.models.auto_tokenizer import AutoTokenizer
+from automodel_tpu.models.common.backend import BackendConfig
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["GenerationRecipe", "main"]
+
+
+class GenerationRecipe:
+    def __init__(self, cfg: ConfigNode):
+        self.cfg = cfg
+
+    def setup(self):
+        cfg = self.cfg
+        path = cfg.get("model.pretrained_model_name_or_path")
+        if path is None:
+            raise ValueError("generate recipe needs model.pretrained_model_name_or_path")
+        backend_cfg = (cfg.get("backend") or ConfigNode()).to_dict()
+        backend = BackendConfig(**backend_cfg)
+        self.model, self.params = AutoModelForCausalLM.from_pretrained(
+            path, backend=backend, dtype=backend.jnp_dtype
+        )
+        tok_cfg = cfg.get("tokenizer")
+        if tok_cfg and "_target_" in tok_cfg:
+            self.tokenizer = tok_cfg.instantiate()
+        else:
+            tok_path = (tok_cfg or ConfigNode()).get(
+                "pretrained_model_name_or_path") or path
+            self.tokenizer = AutoTokenizer.from_pretrained(tok_path)
+        return self
+
+    def _prompts(self) -> list[str]:
+        prompts = self.cfg.get("prompts")
+        if prompts is not None:
+            return list(prompts)
+        pf = self.cfg.get("prompts_file")
+        if pf is None:
+            raise ValueError("generate recipe needs prompts: [...] or prompts_file")
+        with open(pf) as f:
+            return [line.rstrip("\n") for line in f if line.strip()]
+
+    def run(self) -> list[dict]:
+        cfg = self.cfg
+        prompts = self._prompts()
+        if not prompts:
+            raise ValueError("generate recipe got an empty prompt list "
+                             "(prompts: [] or a blank prompts_file)")
+        tok = self.tokenizer
+        encoded = [tok.encode(p) for p in prompts]
+        max_len = max(len(e) for e in encoded)
+        pad_id = getattr(tok, "pad_token_id", None) or 0
+        ids = np.full((len(encoded), max_len), pad_id, np.int32)
+        mask = np.zeros((len(encoded), max_len), np.int32)
+        for i, e in enumerate(encoded):  # right-padded (generation contract)
+            ids[i, : len(e)] = e
+            mask[i, : len(e)] = 1
+        g = (cfg.get("generation") or ConfigNode()).to_dict()
+        out = self.model.generate(
+            self.params, ids,
+            attention_mask=mask,
+            max_new_tokens=int(g.get("max_new_tokens", 64)),
+            temperature=float(g.get("temperature", 0.0)),
+            top_k=g.get("top_k"),
+            top_p=g.get("top_p"),
+            eos_token_id=getattr(tok, "eos_token_id", None),
+            pad_token_id=pad_id,
+            seed=int(g.get("seed", 0)),
+            cache_dtype=jnp.bfloat16 if g.get("cache_dtype", "bfloat16") == "bfloat16"
+            else jnp.float32,
+        )
+        results = []
+        for i, p in enumerate(prompts):
+            n = int(out["lengths"][i])
+            completion = tok.decode(np.asarray(out["tokens"][i][:n]).tolist())
+            results.append({"prompt": p, "completion": completion, "new_tokens": n})
+            print(f"=== {p!r}\n{completion}\n")
+        of = cfg.get("output_file")
+        if of:
+            with open(of, "w") as f:
+                for r in results:
+                    f.write(json.dumps(r) + "\n")
+            logger.info("wrote %d completions to %s", len(results), of)
+        return results
+
+
+def main(cfg: ConfigNode | None = None, argv=None):
+    if cfg is None:
+        cfg = parse_args_and_load_config(argv)
+    recipe = GenerationRecipe(cfg).setup()
+    return recipe.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -342,6 +342,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         if pack_size > 0:
             from automodel_tpu.data.llm.packed import pack_dataset, packed_collate
 
+            if not self.backend.attention_segments:
+                raise ValueError(
+                    "packed sequences need segment masking in attention; drop "
+                    "backend.attention_segments: false (it is a fast path for "
+                    "right-padded UNPACKED batches only)"
+                )
             if pack_size % self.mesh_ctx.cp != 0:
                 raise ValueError(
                     f"packed_sequence_size {pack_size} must divide by cp={self.mesh_ctx.cp}"

@@ -167,3 +167,79 @@ class TestSinksAndSoftCap:
         got = _flash(q, k, v, sinks=sinks, segment_ids_q=seg)
         want = _ref(q, k, v, sinks=sinks, segment_ids_q=seg)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+class TestAttentionSegmentsFastPath:
+    def test_unsegmented_matches_on_right_padded_real_tokens(self):
+        """backend.attention_segments=False (bench fast path): with RIGHT-padded
+        unpacked batches, causal masking alone isolates real tokens from the
+        trailing pads, so real-token logits must match the segmented path
+        exactly; pad rows are loss-masked and may diverge."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from automodel_tpu.models.common.backend import BackendConfig
+        from automodel_tpu.models.llama.model import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=32,
+        )
+        m_seg = LlamaForCausalLM(cfg, BackendConfig(dtype="float32"))
+        m_fast = LlamaForCausalLM(cfg, BackendConfig(dtype="float32",
+                                                     attention_segments=False))
+        params = m_seg.init(jax.random.key(0), jnp.float32)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(1, 64, (2, 16)).astype(np.int32)
+        seg = np.ones((2, 16), np.int32)
+        ids[1, 10:] = 0
+        seg[1, 10:] = 0
+        pos = np.broadcast_to(np.arange(16, dtype=np.int32), (2, 16))
+        a = np.asarray(m_seg(params, ids, positions=pos, segment_ids=jnp.asarray(seg)))
+        b = np.asarray(m_fast(params, ids, positions=pos, segment_ids=jnp.asarray(seg)))
+        np.testing.assert_allclose(a[seg == 1], b[seg == 1], rtol=1e-6, atol=1e-6)
+
+    def test_packing_with_fast_path_is_refused(self, tmp_path, cpu_devices):
+        import textwrap
+
+        import pytest
+
+        from automodel_tpu.config.loader import load_config
+        from automodel_tpu.recipes.llm.train_ft import (
+            TrainFinetuneRecipeForNextTokenPrediction,
+        )
+
+        cfg_text = f"""
+        seed: 7
+        output_dir: {tmp_path}/out
+        model:
+          config:
+            architectures: [LlamaForCausalLM]
+            vocab_size: 128
+            hidden_size: 32
+            intermediate_size: 64
+            num_hidden_layers: 2
+            num_attention_heads: 4
+            num_key_value_heads: 2
+            max_position_embeddings: 128
+        distributed: {{dp_shard: 8}}
+        backend: {{dtype: float32, attention_segments: false}}
+        packed_sequence: {{packed_sequence_size: 64}}
+        dataset:
+          _target_: automodel_tpu.data.llm.mock.MockSFTDataset
+          vocab_size: 128
+          seq_len: 32
+          num_samples: 64
+          seed: 0
+        micro_batch_size: 8
+        seq_len: 32
+        step_scheduler: {{grad_acc_steps: 1, max_steps: 1, handle_sigterm: false}}
+        optimizer: {{lr: 1.0e-3}}
+        checkpoint: {{enabled: false}}
+        """
+        p = tmp_path / "cfg.yaml"
+        p.write_text(textwrap.dedent(cfg_text))
+        r = TrainFinetuneRecipeForNextTokenPrediction(load_config(p))
+        with pytest.raises(ValueError, match="attention_segments"):
+            r.setup()

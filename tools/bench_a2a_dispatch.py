@@ -1,0 +1,83 @@
+"""EP-dispatch microbench: dense GSPMD path vs explicit a2a (VERDICT r3 #6).
+
+Single chip, ep=1 degenerate mesh: the all_to_all is a self-copy, so the delta
+between the two dispatchers is exactly the a2a path's bucketing overhead — the
+one-hot-cumsum queue positions + (ep, cap, D) scatter layout — with zero real
+ICI traffic in either. Run on the TPU via `python tools/bench_a2a_dispatch.py`;
+prints one JSON line per (dispatcher, shape).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def measure(dispatcher: str, *, seq_len=2048, micro_batch=4, n_steps=10):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from automodel_tpu.models.auto import AutoModelForCausalLM
+    from automodel_tpu.models.common.backend import BackendConfig
+    from automodel_tpu.ops.losses import masked_cross_entropy
+    from automodel_tpu.parallel.mesh import MeshContext, default_sharding_rules
+    from automodel_tpu.training.train_step import make_train_step
+
+    ctx = MeshContext(ep=1, dp_shard=1, world_size=1)
+    mesh = ctx.build_mesh(jax.devices()[:1])
+    rules = default_sharding_rules().with_mesh(mesh)
+    # qwen3-moe-A3B-ish proxy scaled to one 16GB chip
+    hf_cfg = {
+        "architectures": ["Qwen3MoeForCausalLM"],
+        "vocab_size": 32000, "hidden_size": 1024, "intermediate_size": 3072,
+        "moe_intermediate_size": 384, "num_hidden_layers": 12,
+        "num_attention_heads": 16, "num_key_value_heads": 4, "head_dim": 64,
+        "num_experts": 32, "num_experts_per_tok": 4, "norm_topk_prob": True,
+        "max_position_embeddings": seq_len,
+    }
+    backend = BackendConfig(dtype="bfloat16", attention="flash",
+                            remat_policy="mlp_attn_dots", dispatcher=dispatcher)
+    model = AutoModelForCausalLM.from_config(hf_cfg, backend)
+    with mesh:
+        params = model.init(jax.random.key(0), jnp.bfloat16)
+        optimizer = optax.chain(optax.scale_by_factored_rms(), optax.scale(-1e-5))
+        opt_state = jax.jit(optimizer.init)(params)
+
+        def forward_loss(p, batch, n):
+            out, stats = model(
+                p, batch["input_ids"], positions=batch["positions"],
+                segment_ids=batch["segment_ids"],
+                token_mask=batch["segment_ids"] != 0,
+                rules=rules if mesh.size > 1 else None, training=True,
+            )
+            return masked_cross_entropy(out, batch["labels"], n), {
+                "expert_load": stats["expert_load"]}
+
+        step = jax.jit(make_train_step(forward_loss, optimizer), donate_argnums=(0, 1))
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 32000, (1, micro_batch, seq_len)).astype(np.int32)
+        batch = {
+            "input_ids": jnp.asarray(ids), "labels": jnp.asarray(ids),
+            "positions": jnp.broadcast_to(jnp.arange(seq_len, dtype=jnp.int32), ids.shape),
+            "segment_ids": jnp.ones_like(jnp.asarray(ids)),
+        }
+        for _ in range(3):  # warmup + compile
+            params, opt_state, m = step(params, opt_state, batch)
+        float(m["loss"])  # sync through the tunnel (block_until_ready doesn't)
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            params, opt_state, m = step(params, opt_state, batch)
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / n_steps
+    tokens = micro_batch * seq_len
+    return {"dispatcher": dispatcher, "seq_len": seq_len,
+            "step_time_ms": round(dt * 1e3, 2),
+            "tokens_per_sec": round(tokens / dt, 1)}
+
+
+if __name__ == "__main__":
+    for disp in ("dense", "a2a"):
+        print(json.dumps(measure(disp)))

@@ -1,0 +1,110 @@
+"""seq-4096 MFU experiments (VERDICT r3 #2): one variant per invocation.
+
+Usage: python tools/bench_seq4096_sweep.py <variant>
+Variants:
+  base          current bench recipe at seq 4096 (control)
+  noseg         backend.attention_segments=False (right-padded fast path)
+  bwdq256/512/1024   dkv kernel q-block via AUTOMODEL_FLASH_BWD_Q_BLOCK
+  blk2048x1024  flash forward/dq blocks (2048, 1024)
+  blk1024x512   flash blocks (1024, 512)
+  mb8           micro_batch 8 (memory freed by noseg may admit it)
+
+Each prints one JSON line. Run variants SEQUENTIALLY (one TPU process at a time).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+SEQ = 4096
+MICRO_BATCH = 4
+STEPS = 10
+
+
+def measure(attention_segments=True, block_q=None, block_kv=None, micro_batch=MICRO_BATCH):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from automodel_tpu.models.common.backend import BackendConfig
+    from automodel_tpu.models.llama.model import LlamaConfig, LlamaForCausalLM
+    from automodel_tpu.ops.losses import masked_cross_entropy
+    from automodel_tpu.training.train_step import make_train_step
+    import bench
+
+    cfg = LlamaConfig(
+        vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+        num_hidden_layers=16, num_attention_heads=32, num_key_value_heads=8,
+        max_position_embeddings=8192, rope_theta=500000.0,
+    )
+    backend = BackendConfig(dtype="bfloat16", remat_policy="mlp_attn_dots",
+                            attention="flash", attention_segments=attention_segments)
+    if block_q is not None:
+        # patch the flash defaults (flash_attention._pick targets) for the sweep
+        import functools
+
+        from automodel_tpu.ops.pallas import flash_attention as fa
+
+        orig = fa.flash_attention
+        fa.flash_attention = functools.partial(orig, block_q=block_q, block_k=block_kv)
+        import automodel_tpu.ops.attention as attn_mod
+
+        # attention.py imports inside the function, so patching the module
+        # attribute is enough
+        assert attn_mod is not None
+    model = LlamaForCausalLM(cfg, backend)
+    params = model.init(jax.random.key(0), jnp.bfloat16)
+    optimizer = optax.chain(optax.scale_by_factored_rms(), optax.scale(-1e-5))
+    opt_state = jax.jit(optimizer.init)(params)
+
+    def forward_loss(p, batch, n):
+        logits = model(p, batch["input_ids"], positions=batch["positions"],
+                       segment_ids=batch["segment_ids"])
+        return masked_cross_entropy(logits, batch["labels"], n)
+
+    step = jax.jit(make_train_step(forward_loss, optimizer), donate_argnums=(0, 1))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (1, micro_batch, SEQ)).astype(np.int32)
+    batch = {
+        "input_ids": jnp.asarray(ids), "labels": jnp.asarray(ids),
+        "positions": jnp.broadcast_to(jnp.arange(SEQ, dtype=jnp.int32), ids.shape),
+        "segment_ids": jnp.ones_like(jnp.asarray(ids)),
+    }
+    for _ in range(3):
+        params, opt_state, m = step(params, opt_state, batch)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, opt_state, m = step(params, opt_state, batch)
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / STEPS
+    tps = micro_batch * SEQ / dt
+    fpt = bench.llama_flops_per_token(cfg, SEQ)
+    peak = 197e12
+    return {"tokens_per_sec": round(tps, 1), "mfu": round(tps * fpt / peak, 4),
+            "step_time_ms": round(dt * 1e3, 1)}
+
+
+if __name__ == "__main__":
+    variant = sys.argv[1]
+    kw = {}
+    if variant == "noseg":
+        kw = {"attention_segments": False}
+    elif variant.startswith("bwdq"):
+        os.environ["AUTOMODEL_FLASH_BWD_Q_BLOCK"] = variant[4:]
+    elif variant == "blk2048x1024":
+        kw = {"block_q": 2048, "block_kv": 1024}
+    elif variant == "blk1024x512":
+        kw = {"block_q": 1024, "block_kv": 512}
+    elif variant == "mb8":
+        kw = {"attention_segments": False, "micro_batch": 8}
+    elif variant != "base":
+        raise SystemExit(f"unknown variant {variant}")
+    out = measure(**kw)
+    out["variant"] = variant
+    print(json.dumps(out))
