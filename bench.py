@@ -48,12 +48,19 @@ def _measure(cfg, seq_len: int, micro_batch: int, n_steps: int):
     # remat "mlp_attn_dots" (save gate/up/k/v/attn-out; backward replays only the
     # q projection + elementwise) + momentum-free factored-rms (pure Adafactor,
     # the T5/PaLM optimizer — its ~zero state is what affords that remat policy
-    # on a 16GB chip) = 13.18k tok/s / 55.0% MFU at seq 2048. The ladder:
-    # fp32-nu adamw -> remat "none" 11.7k; bf16-nu -> "mlp_gate_dot" 12.0k;
-    # factored+bf16 trace -> "mlp_dots" 12.87k; momentum-free -> "mlp_attn_dots".
-    # int8-blockwise momentum fits "mlp_attn_dots" minus 8MB but its quant math
-    # costs ~10%/step — slower end-to-end (11.5k).
-    backend = BackendConfig(dtype="bfloat16", remat_policy="mlp_attn_dots", attention="flash")
+    # on a 16GB chip) + attention_segments=False (mock SFT batches are unpacked
+    # and full-length: causal masking already isolates pads, so the kernels skip
+    # the segment loads/selects — clean-run-to-clean-run +4.1% at 2048, +5.5%
+    # at 4096) = 13.68k tok/s / 57.1% MFU at 2048, 11.89k / 54.5% at 4096
+    # (stable over repeats). The ladder: fp32-nu adamw -> remat "none" 11.7k;
+    # bf16-nu -> "mlp_gate_dot" 12.0k; factored+bf16 trace -> "mlp_dots"
+    # 12.87k; momentum-free -> "mlp_attn_dots" 13.14k; segment-free attention
+    # -> 13.68k. Round-4 dead ends at 4096
+    # (tools/bench_seq4096_sweep.py): saving q too in remat (-1.3pt, bandwidth),
+    # dkv q-block 256 (-2.1pt) or 1024 (+-0), fwd blocks (2048,1024) and
+    # micro_batch 3/4 (OOM even with linear-CE — the mlp saved tensors dominate).
+    backend = BackendConfig(dtype="bfloat16", remat_policy="mlp_attn_dots",
+                            attention="flash", attention_segments=False)
     model = LlamaForCausalLM(cfg, backend)
 
     params = model.init(jax.random.key(0), jnp.bfloat16)

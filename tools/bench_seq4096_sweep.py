@@ -7,7 +7,7 @@ Variants:
   bwdq256/512/1024   dkv kernel q-block via AUTOMODEL_FLASH_BWD_Q_BLOCK
   blk2048x1024  flash forward/dq blocks (2048, 1024)
   blk1024x512   flash blocks (1024, 512)
-  mb8           micro_batch 8 (memory freed by noseg may admit it)
+  mb4           micro_batch 4 + noseg (memory freed may admit it; mb4 OOMs with segs)
 
 Each prints one JSON line. Run variants SEQUENTIALLY (one TPU process at a time).
 """
@@ -22,7 +22,7 @@ import time
 import numpy as np
 
 SEQ = 4096
-MICRO_BATCH = 4
+MICRO_BATCH = 2  # bench.py's seq-4096 condition (mb 4 OOMs 16GB)
 STEPS = 10
 
 
@@ -101,8 +101,8 @@ if __name__ == "__main__":
         kw = {"block_q": 2048, "block_kv": 1024}
     elif variant == "blk1024x512":
         kw = {"block_q": 1024, "block_kv": 512}
-    elif variant == "mb8":
-        kw = {"attention_segments": False, "micro_batch": 8}
+    elif variant == "mb4":
+        kw = {"attention_segments": False, "micro_batch": 4}
     elif variant != "base":
         raise SystemExit(f"unknown variant {variant}")
     out = measure(**kw)
