@@ -64,10 +64,21 @@ class DenseDecoderConfig:
     attention_out_bias: bool = False  # gpt-oss: bias on o_proj too
     attention_sinks: bool = False  # gpt-oss: per-head sink logits absorbing mass
     qk_norm: bool = False  # qwen3: RMSNorm on per-head q/k
+    qk_norm_whole: bool = False  # olmo2: RMSNorm over the WHOLE q/k projection (n*h)
+    norm_placement: str = "pre"  # "pre" (llama) | "post" (olmo2: norm the sublayer OUTPUT)
     sliding_window: int | None = None
     layer_types: list[str] | None = None  # "full_attention" | "sliding_attention"
+    # SmolLM3-style NoPE: per-layer rope enable (HF semantics: 1 = rope ON);
+    # None = rope everywhere
+    no_rope_layers: list | None = None
     initializer_range: float = 0.02
     causal: bool = True  # False: bidirectional encoder (llama_bidirectional)
+    # Granite mup-style static scalars (all at the llama value = identity;
+    # transformers modeling_granite.py applies exactly these four)
+    embedding_multiplier: float = 1.0
+    residual_multiplier: float = 1.0
+    attention_multiplier: float | None = None  # None = 1/sqrt(head_dim)
+    logits_scaling: float = 1.0
     # Ministral-3 llama-4-style long-context q scaling: q *= 1 + beta*log(1 + pos//orig)
     # (reference mistral3/model.py:282-284)
     llama4_attn_scale_beta: float | None = None
@@ -84,6 +95,15 @@ class DenseDecoderConfig:
         if self.sliding_window is not None:
             return [True] * self.num_hidden_layers
         return [False] * self.num_hidden_layers
+
+    @property
+    def layer_flags(self) -> list[int]:
+        """Per-layer bitfield scanned alongside the layer params: bit 0 =
+        sliding window, bit 1 = NoPE (rope disabled). One int stream keeps the
+        scan/pipeline tuple shapes unchanged as flags accrue."""
+        rope_on = self.no_rope_layers or [1] * self.num_hidden_layers
+        return [int(s) | (0 if rope_on[i] else 2)
+                for i, s in enumerate(self.sliding_flags)]
 
 
 def _layer_shapes(cfg: DenseDecoderConfig) -> dict[str, tuple[int, ...]]:
@@ -111,7 +131,9 @@ def _layer_shapes(cfg: DenseDecoderConfig) -> dict[str, tuple[int, ...]]:
         shapes |= {"bo": (d,)}
     if cfg.attention_sinks:
         shapes |= {"sinks": (n,)}
-    if cfg.qk_norm:
+    if cfg.qk_norm_whole:
+        shapes |= {"q_norm": (n, h), "k_norm": (k, h)}
+    elif cfg.qk_norm:
         shapes |= {"q_norm": (h,), "k_norm": (h,)}
     return shapes
 
@@ -175,6 +197,9 @@ def dense_decoder_logical_axes(cfg: DenseDecoderConfig, scan_layers: bool = True
     """Pytree of logical-axis tuples matching init_dense_decoder_params' layout."""
     del scan_layers  # layer params are always stacked (L, ...)
     layers = {name: ("layers",) + _LAYER_AXES[name] for name in _layer_shapes(cfg)}
+    if cfg.qk_norm_whole:  # (n, h)-shaped norm weights
+        layers["q_norm"] = ("layers", "heads", "head_dim")
+        layers["k_norm"] = ("layers", "kv_heads", "head_dim")
     axes = {
         "embed": ("vocab", "embed"),
         "layers": layers,
@@ -191,7 +216,7 @@ def _constrain(x, rules, names):
     return jax.lax.with_sharding_constraint(x, rules.sharding(names))
 
 
-def embed_lookup(table, input_ids, dtype, rules):
+def embed_lookup(table, input_ids, dtype, rules=None, scale: float = 1.0):
     """Token-embedding gather with the table's FSDP (hidden-dim) axes unsharded
     FIRST — a plain all-gather (FSDP's param-on-use collective). Without it the
     gather output inherits the table's hidden-dim sharding and the partitioner
@@ -200,7 +225,34 @@ def embed_lookup(table, input_ids, dtype, rules):
     "vocab" stays: under TP the vocab-parallel local-gather+psum path holds.
     Shared by the dense/MoE forwards and the pipeline's stage-0 embedding."""
     table = _constrain(table.astype(dtype), rules, ("vocab", None))
-    return table[input_ids]
+    h = table[input_ids]
+    if scale != 1.0:  # granite embedding_multiplier
+        h = h * jnp.asarray(scale, h.dtype)
+    return h
+
+
+def resolve_unembed(cfg, params, dtype):
+    """lm_head | tied embed.T (gpt2: wte), cast to compute dtype, with granite
+    logits_scaling folded in (logits/ls == unembed/ls) — the ONE copy every
+    head consumer (decoder_forward, pipeline._head_pre, linear-CE recipes)
+    resolves through. Returns None when the params carry no table."""
+    unembed = params.get("lm_head")
+    if unembed is None:
+        table = params.get("embed", params.get("wte"))
+        if table is None:
+            return None
+        unembed = table.T
+    unembed = jnp.asarray(unembed).astype(dtype)
+    ls = getattr(cfg, "logits_scaling", 1.0)
+    return unembed / ls if ls != 1.0 else unembed
+
+
+def _rms_norm_2d(x, w, eps):
+    """RMSNorm with the mean over the LAST TWO dims (whole-projection norm,
+    olmo2): x (..., n, h), w (n, h)."""
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=(-2, -1), keepdims=True)
+    return (xf * jax.lax.rsqrt(ms + eps)).astype(x.dtype) * w
 
 
 def _cache_write(cache, new, idx):
@@ -231,7 +283,12 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
         q = q + lp["bq"]
         k = k + lp["bk"]
         v = v + lp["bv"]
-    if cfg.qk_norm:
+    if cfg.qk_norm_whole:
+        # olmo2: RMSNorm over the flattened projection — mean over (heads,
+        # head_dim) jointly, weight (n, h) == the flat HF (n*h,) weight reshaped
+        q = _rms_norm_2d(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = _rms_norm_2d(k, lp["k_norm"], cfg.rms_norm_eps)
+    elif cfg.qk_norm:
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     rope = apply_rope_interleaved if cfg.rope_interleaved else apply_rope
@@ -255,6 +312,7 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
             positions_kv=cache_meta["positions"],
             sliding_window=sliding,
             sinks=lp.get("sinks"),
+            softmax_scale=cfg.attention_multiplier,
             backend="xla",  # q_len 1 / position-masked: the flash kernel doesn't apply
         )
         o = project(out, lp["wo"], 2, lin)
@@ -274,7 +332,8 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
     if use_ring:
         from automodel_tpu.parallel.ring_attention import make_ring_attention
 
-        ring = make_ring_attention(mesh, causal=cfg.causal)
+        ring = make_ring_attention(mesh, causal=cfg.causal,
+                                   softmax_scale=cfg.attention_multiplier)
         out = checkpoint_name(ring(q, k, v, positions, segment_ids), "attn_out")
     else:
         out = checkpoint_name(dot_product_attention(
@@ -287,6 +346,7 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
                            else None),
             sliding_window=sliding,
             sinks=lp.get("sinks"),
+            softmax_scale=cfg.attention_multiplier,
             backend=backend.attention,
         ), "attn_out")
     o = project(out, lp["wo"], 2, lin)
@@ -335,28 +395,44 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
         kv_len = h.shape[1] if kv is None else kv[0].shape[1]
         big_window = jnp.int32(cfg.max_position_embeddings + kv_len)
         # traced per-layer window (scan-compatible); None disables the mask entirely
-        eff_window = jnp.where(is_sliding > 0, window, big_window) if any_sliding else None
+        eff_window = jnp.where(is_sliding & 1, window, big_window) if any_sliding else None
+        # bit 1: NoPE layer (SmolLM3) — rope with zeroed frequencies is identity
+        inv_freq_l = inv_freq
+        if cfg.no_rope_layers is not None:
+            inv_freq_l = inv_freq * (1 - ((is_sliding >> 1) & 1)).astype(inv_freq.dtype)
         # named scopes label the profiler trace per block (the reference gets the
         # same from autonvtx module hooks, autonvtx/__init__.py:33)
+        post = cfg.norm_placement == "post"
         with jax.named_scope("attention"):
-            x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+            # post (olmo2): attention reads h RAW; attn_norm applies to the
+            # sublayer OUTPUT before the residual add (post_attention_layernorm)
+            x = h if post else rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
             if kv is None:
                 attn_out, kv_out = _attention_block(
                     cfg, backend, lp, x, state["positions"], state.get("segment_ids"),
-                    inv_freq, attn_scale, eff_window, rules), None
+                    inv_freq_l, attn_scale, eff_window, rules), None
             else:
                 cache_meta = {k_: state[k_] for k_ in ("write_idx", "valid")}
                 cache_meta["positions"] = state["kv_positions"]
                 attn_out, kv_out = _attention_block(
                     cfg, backend, lp, x, state["positions"], state.get("segment_ids"),
-                    inv_freq, attn_scale, eff_window, rules,
+                    inv_freq_l, attn_scale, eff_window, rules,
                     cache=kv, cache_meta=cache_meta,
                 )
+            if post:
+                attn_out = rms_norm(attn_out, lp["attn_norm"], cfg.rms_norm_eps)
+            if cfg.residual_multiplier != 1.0:  # granite
+                attn_out = attn_out * cfg.residual_multiplier
             h = h + attn_out
             h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
         with jax.named_scope("mlp"):
-            x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-            h = h + _mlp_block(backend, lp, x, rules)
+            x = h if post else rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+            mlp_out = _mlp_block(backend, lp, x, rules)
+            if post:  # post_feedforward_layernorm
+                mlp_out = rms_norm(mlp_out, lp["mlp_norm"], cfg.rms_norm_eps)
+            if cfg.residual_multiplier != 1.0:
+                mlp_out = mlp_out * cfg.residual_multiplier
+            h = h + mlp_out
             h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
         return dict(state, h=h), kv_out
 
@@ -422,8 +498,13 @@ def decoder_forward(
     if cache is not None and segment_ids is None:
         raise ValueError("cache decoding requires segment_ids (1 = real token)")
     dtype = backend.jnp_dtype
-    h = (inputs_embeds if inputs_embeds is not None
-         else embed_lookup(params["embed"], input_ids, dtype, rules))
+    if inputs_embeds is not None:
+        h = inputs_embeds
+        if cfg.embedding_multiplier != 1.0:  # HF scales provided embeds too
+            h = h * jnp.asarray(cfg.embedding_multiplier, h.dtype)
+    else:
+        h = embed_lookup(params["embed"], input_ids, dtype, rules,
+                         scale=cfg.embedding_multiplier)
     h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
 
     state = {"h": h, "positions": positions}
@@ -433,7 +514,7 @@ def decoder_forward(
         state["kv_positions"] = cache["positions"]
         state["valid"] = cache["valid"]
         state["write_idx"] = cache["write_idx"]
-    sliding_flags = jnp.asarray(cfg.sliding_flags, dtype=jnp.int32)
+    sliding_flags = jnp.asarray(cfg.layer_flags, dtype=jnp.int32)
     out = apply_layer_stack(cfg, backend, params["layers"], sliding_flags, state, rules,
                             cache=cache)
     state, cache = out if cache is not None else (out, None)
@@ -449,15 +530,9 @@ def decoder_forward(
         h = jnp.take_along_axis(h, last[:, None, None], axis=1)  # (B, 1, D)
         if return_hidden:
             return h, cache
-        unembed = params.get("lm_head")
-        if unembed is None:
-            unembed = params["embed"].T
-        logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
+        logits = jnp.einsum("bsd,dv->bsv", h, resolve_unembed(cfg, params, dtype))
         return logits, cache
     if return_hidden:
         return h
-    unembed = params.get("lm_head")
-    if unembed is None:
-        unembed = params["embed"].T
-    logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dtype))
+    logits = jnp.einsum("bsd,dv->bsv", h, resolve_unembed(cfg, params, dtype))
     return logits

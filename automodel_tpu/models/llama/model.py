@@ -24,6 +24,24 @@ from automodel_tpu.models.common.transformer import (
 __all__ = ["LlamaConfig", "LlamaForCausalLM"]
 
 
+def _is_olmo2(hf: dict) -> bool:
+    archs = "".join(hf.get("architectures", []))
+    return "Olmo2" in archs or "Olmo3" in archs
+
+
+def _no_rope_layers(hf: dict) -> list | None:
+    """SmolLM3 NoPE pattern: explicit per-layer list (1 = rope ON), or derived
+    from no_rope_layer_interval the way SmolLM3Config does (every interval-th
+    layer is NoPE). None when every layer uses rope."""
+    layers = hf.get("no_rope_layers")
+    if layers is None and hf.get("no_rope_layer_interval"):
+        k = int(hf["no_rope_layer_interval"])
+        layers = [int((i + 1) % k != 0) for i in range(hf["num_hidden_layers"])]
+    if layers is not None and all(layers):
+        return None
+    return layers
+
+
 @dataclasses.dataclass
 class LlamaConfig(DenseDecoderConfig):
     @classmethod
@@ -44,9 +62,18 @@ class LlamaConfig(DenseDecoderConfig):
             tie_word_embeddings=hf.get("tie_word_embeddings", False),
             attention_bias=hf.get("attention_bias", hf.get("qkv_bias", False)),
             qk_norm="Qwen3" in "".join(hf.get("architectures", [])),
+            # Olmo2/3: post-sublayer norms + whole-projection qk-RMSNorm
+            qk_norm_whole=_is_olmo2(hf),
+            norm_placement="post" if _is_olmo2(hf) else "pre",
             sliding_window=hf.get("sliding_window") if hf.get("use_sliding_window", True) else None,
             layer_types=hf.get("layer_types"),
+            no_rope_layers=_no_rope_layers(hf),
             initializer_range=hf.get("initializer_range", 0.02),
+            # granite mup-style scalars (identity for every other family)
+            embedding_multiplier=hf.get("embedding_multiplier", 1.0),
+            residual_multiplier=hf.get("residual_multiplier", 1.0),
+            attention_multiplier=hf.get("attention_multiplier"),
+            logits_scaling=hf.get("logits_scaling", 1.0),
         )
 
 

@@ -68,11 +68,18 @@ def _t(w: np.ndarray) -> np.ndarray:
 class LlamaStateDictAdapter(MappingAdapter):
     def __init__(self, cfg: DenseDecoderConfig, scan_layers: bool = True):
         n, k, h = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        post = getattr(cfg, "norm_placement", "pre") == "post"
         entries = [
             Entry("model.embed_tokens.weight", "embed"),
             Entry("model.norm.weight", "final_norm"),
-            Entry("model.layers.{i}.input_layernorm.weight", "layers.attn_norm"),
-            Entry("model.layers.{i}.post_attention_layernorm.weight", "layers.mlp_norm"),
+            # olmo2 post-norm blocks have no input_layernorm: attn_norm holds
+            # post_attention_layernorm and mlp_norm post_feedforward_layernorm
+            Entry("model.layers.{i}.post_attention_layernorm.weight"
+                  if post else "model.layers.{i}.input_layernorm.weight",
+                  "layers.attn_norm"),
+            Entry("model.layers.{i}.post_feedforward_layernorm.weight"
+                  if post else "model.layers.{i}.post_attention_layernorm.weight",
+                  "layers.mlp_norm"),
             Entry("model.layers.{i}.self_attn.q_proj.weight", "layers.wq", _proj_in(n, h), _proj_out(n, h)),
             Entry("model.layers.{i}.self_attn.k_proj.weight", "layers.wk", _proj_in(k, h), _proj_out(k, h)),
             Entry("model.layers.{i}.self_attn.v_proj.weight", "layers.wv", _proj_in(k, h), _proj_out(k, h)),
@@ -87,7 +94,17 @@ class LlamaStateDictAdapter(MappingAdapter):
                 Entry("model.layers.{i}.self_attn.k_proj.bias", "layers.bk", _bias_in(k, h), _bias_out(k, h)),
                 Entry("model.layers.{i}.self_attn.v_proj.bias", "layers.bv", _bias_in(k, h), _bias_out(k, h)),
             ]
-        if cfg.qk_norm:
+        if getattr(cfg, "qk_norm_whole", False):
+            # olmo2: flat (n*h,) HF weights <-> our (n, h) / (k, h) layout
+            entries += [
+                Entry("model.layers.{i}.self_attn.q_norm.weight", "layers.q_norm",
+                      lambda a, n=n, h=h: a.reshape(n, h),
+                      lambda a: np.ascontiguousarray(a.reshape(-1))),
+                Entry("model.layers.{i}.self_attn.k_norm.weight", "layers.k_norm",
+                      lambda a, k=k, h=h: a.reshape(k, h),
+                      lambda a: np.ascontiguousarray(a.reshape(-1))),
+            ]
+        elif cfg.qk_norm:
             entries += [
                 Entry("model.layers.{i}.self_attn.q_norm.weight", "layers.q_norm"),
                 Entry("model.layers.{i}.self_attn.k_norm.weight", "layers.k_norm"),

@@ -16,6 +16,16 @@ MODEL_REGISTRY: dict[str, str] = {
     "Qwen2ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
     "Qwen3ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
     "MistralForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
+    # Granite = llama + four mup-style static scalars, read straight from config
+    # (embedding/residual/attention multipliers + logits_scaling)
+    "GraniteForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
+    # SmolLM3 = llama + per-layer NoPE (no_rope_layers via layer_flags bit 1)
+    "SmolLM3ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
+    # Olmo2/3 = llama + post-sublayer norms + whole-projection qk-RMSNorm
+    # (norm_placement="post", qk_norm_whole; Olmo3 adds per-layer sliding via
+    # layer_types, which the lineage already carries)
+    "Olmo2ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
+    "Olmo3ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
     "MixtralForCausalLM": "automodel_tpu.models.mixtral.model:MixtralForCausalLM",
     # Phi-3 lineage is llama-shaped with fused checkpoint tensors + longrope
     "Phi3ForCausalLM": "automodel_tpu.models.phi3.model:Phi3ForCausalLM",

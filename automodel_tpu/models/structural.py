@@ -39,9 +39,8 @@ class StructuralDivergence(Exception):
 # differs in code — verified against the transformers implementations (logits
 # mismatch at identical weights). Field inspection cannot detect these.
 _DENYLIST = {
-    "Olmo2ForCausalLM": "norms apply AFTER attention/MLP (post-norm residual) and "
-                        "QK-norm spans the whole projection, not per head",
-    "Olmo3ForCausalLM": "post-norm residual placement (Olmo2 lineage)",
+    # Olmo2/Olmo3 graduated to registered families (llama/model.py: post-norm
+    # placement + whole-projection qk-RMSNorm via norm_placement/qk_norm_whole)
     "GlmForCausalLM": "partial-rotary GLM block interleaves rope pairs differently",
     "Glm4ForCausalLM": "extra post_self_attn/post_mlp layernorms in the block",
     "CohereForCausalLM": "parallel attention+MLP block with LayerNorm",
@@ -68,6 +67,8 @@ _CONSUMED = {
     "tie_word_embeddings", "attention_bias", "qkv_bias", "sliding_window",
     "use_sliding_window", "layer_types", "initializer_range",
     "partial_rotary_factor",
+    "embedding_multiplier", "residual_multiplier", "attention_multiplier",
+    "logits_scaling", "no_rope_layers", "no_rope_layer_interval",
 }
 
 # Fields that never change the computation (identity, tokenizer ids, runtime
@@ -124,15 +125,13 @@ _GATED = {
     "clip_qkv": (_NONE, "QKV clipping changes the attention math"),
     "pretraining_tp": (_ONE, "pretraining_tp slicing changes the matmul order"),
     "rope_interleaved": (_FALSY, "interleaved rope pairs differ from half-rotation rope"),
-    "logits_scaling": (_ONE, "output-logit scaling is not applied by the lineage"),
-    "logit_scale": (_ONE, "output-logit scaling is not applied by the lineage"),
-    "embedding_multiplier": (_ONE, "embedding scaling is not applied by the lineage"),
-    "residual_multiplier": (_ONE, "residual scaling is not applied by the lineage"),
-    "attention_multiplier": (_NONE, "attention-score scaling differs from 1/sqrt(head_dim)"),
+    # granite's four mup-style scalars are CONSUMED by DenseDecoderConfig
+    # (LlamaConfig.from_hf reads them; transformer.py applies them)
+    "logit_scale": (_ONE, "output-logit scaling (cohere convention) is not the granite field"),
     "final_logit_softcapping": (_NONE, "logit soft-capping is the gemma lineage"),
     "attn_logit_softcapping": (_NONE, "attention soft-capping is the gemma lineage"),
-    "no_rope_layers": (lambda v: v is None or all(v), "some layers disable rope (NoPE)"),
-    "no_rope_layer_interval": (_NONE, "some layers disable rope (NoPE)"),
+    # SmolLM3 NoPE layers are CONSUMED (llama/model.py _no_rope_layers ->
+    # DenseDecoderConfig.no_rope_layers, applied per layer via layer_flags)
     "num_experts": (_NONE, "mixture-of-experts MLP (use a registered MoE family)"),
     "num_local_experts": (_NONE, "mixture-of-experts MLP (use a registered MoE family)"),
     "n_routed_experts": (_NONE, "mixture-of-experts MLP (use a registered MoE family)"),

@@ -301,15 +301,13 @@ def _make_head_loss(cfg, dtype, loss_name: str = "masked_ce"):
 
 
 def _head_pre(cfg, dtype, other, h):
-    """Final-norm + unembed resolution (tied-embedding fallback) — the ONE copy
-    of the decoder head shared by every pp loss/composition."""
+    """Final-norm + unembed (transformer.resolve_unembed: tied fallback +
+    granite logits_scaling) — shared by every pp loss/composition."""
+    from automodel_tpu.models.common.transformer import resolve_unembed
     from automodel_tpu.ops.norms import rms_norm
 
     h = rms_norm(h, other["final_norm"].astype(dtype), cfg.rms_norm_eps)
-    unembed = other.get("lm_head")
-    if unembed is None:
-        unembed = other["embed"].T
-    return h, jnp.asarray(unembed).astype(dtype)
+    return h, resolve_unembed(cfg, other, dtype)
 
 
 def make_head_logits(cfg, dtype):
@@ -368,7 +366,7 @@ def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "
     head_loss = _make_head_loss(cfg, dtype, loss_name)
 
     def forward_loss(params, batch_stack, num_label_tokens):
-        sliding = jnp.asarray(cfg.sliding_flags, jnp.int32)
+        sliding = jnp.asarray(cfg.layer_flags, jnp.int32)
         layer_params = (params["layers"], sliding)
         if V > 1:
             layer_params = _circular_reshape(layer_params, V, pp)
@@ -377,7 +375,8 @@ def make_dense_decoder_pp_loss(model, mesh: Mesh, rules=None, loss_name: str = "
         # unshard the table's fsdp (hidden-dim) axes first — same
         # involuntary-full-remat dodge as transformer.decoder_forward
         x_stack = {
-            "h": embed_lookup(other["embed"], batch_stack["input_ids"], dtype, rules),
+            "h": embed_lookup(other["embed"], batch_stack["input_ids"], dtype, rules,
+                              scale=getattr(cfg, "embedding_multiplier", 1.0)),
             "positions": batch_stack["positions"],
             "segment_ids": batch_stack["segment_ids"],
         }
@@ -409,7 +408,7 @@ def make_dense_decoder_pp_hidden(cfg, backend, mesh: Mesh, *,
         return apply_layer_stack(cfg, backend, lp, sliding, x, None)
 
     def hidden_fn(layer_stack, x_stack):
-        sliding = jnp.asarray(cfg.sliding_flags, jnp.int32)
+        sliding = jnp.asarray(cfg.layer_flags, jnp.int32)
         layer_params = (layer_stack, sliding)
         if V > 1:
             layer_params = _circular_reshape(layer_params, V, pp)
@@ -461,7 +460,8 @@ def make_moe_pp_hidden(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
     )
 
     def embed_fn(other, mb):
-        h = embed_lookup(other["embed"], mb["input_ids"], dtype, rules)
+        h = embed_lookup(other["embed"], mb["input_ids"], dtype, rules,
+                         scale=getattr(cfg, "embedding_multiplier", 1.0))
         state = {
             "h": h,
             "positions": mb["positions"],

@@ -180,7 +180,8 @@ class KnowledgeDistillationRecipe(TrainFinetuneRecipeForNextTokenPrediction):
                 other = {k: v for k, v in params.items() if k != "layers"}
                 x_stack = {
                     "h": embed_lookup(other["embed"], batch_stack["input_ids"],
-                                      dtype, self.rules),
+                                      dtype, self.rules,
+                                      scale=getattr(cfg, "embedding_multiplier", 1.0)),
                     "positions": batch_stack["positions"],
                     "segment_ids": batch_stack["segment_ids"],
                 }

@@ -388,18 +388,17 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         out = self._model_forward(params, batch, training)
         out, stats = out if isinstance(out, tuple) else (out, None)
         if self.loss_name == "linear_ce":
-            unembed = params.get("lm_head")
+            from automodel_tpu.models.common.transformer import resolve_unembed
+
+            # cast to the activation dtype: matches the masked path's logits
+            # precision and halves the kernel's VMEM tile footprint; the helper
+            # folds tied-embedding fallback + granite logits_scaling in
+            mcfg = getattr(self.model.config, "text", self.model.config)
+            unembed = resolve_unembed(mcfg, params, out.dtype)
             if unembed is None:
-                # tied embeddings; gpt2 names its table wte
-                table = params.get("embed", params.get("wte"))
-                if table is None:
-                    raise ValueError("linear_ce: model has neither lm_head nor a tied embedding table")
-                unembed = table.T
-            # cast the (possibly fp32-master) unembed to the activation dtype:
-            # matches the masked path's logits precision and halves the kernel's
-            # VMEM tile footprint
+                raise ValueError("linear_ce: model has neither lm_head nor a tied embedding table")
             loss = linear_cross_entropy(
-                out, jnp.asarray(unembed).astype(out.dtype), batch["labels"],
+                out, unembed, batch["labels"],
                 num_label_tokens, impl=self.loss_impl, filter_eps=self.loss_filter_eps,
             )
         else:

@@ -114,6 +114,38 @@ class TestTrainRecipeE2E:
         assert np.isfinite(ref).all() and ref[-1] < ref[0]
         np.testing.assert_allclose(got, ref, rtol=1e-4)
 
+    def test_granite_pp_matches_unpipelined_trajectory(self, tmp_path, cpu_devices):
+        """Granite's mup scalars under pp: the pipeline embeds OUTSIDE
+        decoder_forward, so embedding_multiplier must ride embed_lookup itself
+        (a review-caught silent-wrong-math bug) — pp=2 must reproduce the
+        unpipelined trajectory exactly with non-trivial scalars."""
+
+        def run(tag, dist):
+            cfg_text = _write_cfg(tmp_path, n_layers=4).read_text()
+            cfg_text = cfg_text.replace("architectures: [LlamaForCausalLM]",
+                                        "architectures: [GraniteForCausalLM]")
+            cfg_text = cfg_text.replace(
+                "max_position_embeddings: 128",
+                "max_position_embeddings: 128\n    embedding_multiplier: 6.0\n"
+                "    residual_multiplier: 0.25\n"
+                "    attention_multiplier: 0.0883883\n"
+                "    logits_scaling: 4.0")
+            cfg_text = cfg_text.replace("dp_shard: 4\n  tp: 2\n  pp: 1", dist)
+            cfg_text = cfg_text.replace(f"output_dir: {tmp_path}/out",
+                                        f"output_dir: {tmp_path}/{tag}")
+            p = tmp_path / f"cfg_{tag}.yaml"
+            p.write_text(cfg_text)
+            r = TrainFinetuneRecipeForNextTokenPrediction(load_config(str(p)))
+            r.setup()
+            assert r.model.config.embedding_multiplier == 6.0
+            r.run_train_validation_loop()
+            return [row["loss"] for row in _read_jsonl(tmp_path / tag / "training.jsonl")]
+
+        ref = run("gr_pp1", "dp_shard: 4\n  tp: 2\n  pp: 1")
+        got = run("gr_pp2", "dp_shard: 2\n  tp: 2\n  pp: 2")
+        assert np.isfinite(ref).all() and ref[-1] < ref[0]
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
     def test_resume_exact(self, tmp_path, cpu_devices):
         # run 1: 6 steps with ckpt at 3 and final at 6
         cfg = load_config(_write_cfg(tmp_path, ckpt=True))

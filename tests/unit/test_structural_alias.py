@@ -91,9 +91,7 @@ class TestHonestDivergence:
     @pytest.mark.parametrize("arch,kw,expect", [
         ("ArceeForCausalLM", {}, "hidden_act"),             # relu^2 MLP
         ("Starcoder2ForCausalLM", {}, "hidden_act"),        # gelu + LayerNorm
-        ("GraniteForCausalLM", {}, "multiplier"),           # mup-style scalers
         ("StableLmForCausalLM", {}, "layer_norm_eps"),      # LayerNorm
-        ("SmolLM3ForCausalLM", {}, "no_rope"),              # NoPE layers
         ("ApertusForCausalLM", {}, "hidden_act"),           # xIELU
         ("OlmoForCausalLM", {}, "rms_norm_eps"),            # non-parametric LN
     ])
@@ -103,16 +101,14 @@ class TestHonestDivergence:
             AutoModelForCausalLM.from_config(hf)
 
     @pytest.mark.parametrize("arch", [
-        # configs field-identical to llama but with different BLOCK code —
-        # the curated denylist is load-bearing for these
-        "Olmo2ForCausalLM",
-        "Olmo3ForCausalLM",
+        # config field-identical to llama but with different BLOCK code —
+        # the curated denylist is load-bearing here
         "Glm4ForCausalLM",
     ])
     def test_code_divergent_arch_is_denylisted(self, arch):
         hf = _hf_config(arch, **TINY)
         # prove the denylist is what catches it: the field check alone passes
-        assert classify_config(hf) == [] or arch == "Olmo3ForCausalLM"
+        assert classify_config(hf) == []
         with pytest.raises(StructuralDivergence):
             resolve_llama_delta(arch, hf)
 
@@ -126,6 +122,48 @@ class TestHonestDivergence:
     def test_non_causal_arch_refused(self):
         with pytest.raises(StructuralDivergence, match="ForCausalLM"):
             resolve_llama_delta("SomeBertModel", dict(TINY, rms_norm_eps=1e-5))
+
+
+class TestGraduatedFamilies:
+    """Families that graduated from honest-fail to registered llama-lineage
+    deltas in round 4: Granite (mup scalars), SmolLM3 (NoPE layers), Olmo2/3
+    (post-norm blocks + whole-projection qk-RMSNorm, Olmo3 adds sliding).
+    Logits parity vs the real transformers implementations."""
+
+    def _parity(self, arch, **kw):
+        cls = getattr(transformers, arch)
+        tcfg = cls.config_class(**{**TINY, "pad_token_id": 0, **kw})
+        hf = tcfg.to_dict()
+        hf["architectures"] = [arch]
+        torch.manual_seed(0)
+        tm = cls(tcfg).eval()
+        sd = {k: v.float().numpy() for k, v in tm.state_dict().items()}
+        am = AutoModelForCausalLM.from_config(hf, backend=BackendConfig(dtype="float32"))
+        import jax
+
+        params = jax.tree.map(np.asarray,
+                              am.state_dict_adapter().from_hf(sd, dtype=np.float32))
+        ids = np.arange(1, 17)[None, :] % hf["vocab_size"]
+        with torch.no_grad():
+            tlog = tm(torch.tensor(ids)).logits.numpy()
+        jlog = np.asarray(am(params, ids))
+        err = float(np.abs(tlog - jlog).max() / np.abs(tlog).max())
+        assert err < 2e-5, f"{arch} rel logits err {err:.2e}"
+
+    def test_granite_mup_scalars(self):
+        # granite-3-class non-trivial values: every scalar must actually bite
+        self._parity("GraniteForCausalLM", embedding_multiplier=12.0,
+                     residual_multiplier=0.22, attention_multiplier=0.015625,
+                     logits_scaling=8.0, tie_word_embeddings=True)
+
+    def test_smollm3_nope_layers(self):
+        self._parity("SmolLM3ForCausalLM", num_hidden_layers=4)  # layer 4 = NoPE
+
+    def test_olmo2_post_norm_whole_qk(self):
+        self._parity("Olmo2ForCausalLM", num_hidden_layers=4)
+
+    def test_olmo3_adds_sliding(self):
+        self._parity("Olmo3ForCausalLM", num_hidden_layers=4, sliding_window=8)
 
 
 def test_registry_error_carries_alias_failure():
