@@ -290,7 +290,8 @@ def moe_decoder_forward(
         raise ValueError("cache decoding requires segment_ids (1 = real token)")
     dtype = backend.jnp_dtype
     h = (inputs_embeds.astype(dtype) if inputs_embeds is not None
-         else embed_lookup(params["embed"], input_ids, dtype, rules))
+         else embed_lookup(params["embed"], input_ids, dtype, rules,
+                           scale=getattr(cfg, "embedding_multiplier", 1.0)))
     h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
 
     sliding_flags = jnp.asarray(cfg.sliding_flags, dtype=jnp.int32)

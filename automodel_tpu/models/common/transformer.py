@@ -66,6 +66,8 @@ class DenseDecoderConfig:
     qk_norm: bool = False  # qwen3: RMSNorm on per-head q/k
     qk_norm_whole: bool = False  # olmo2: RMSNorm over the WHOLE q/k projection (n*h)
     norm_placement: str = "pre"  # "pre" (llama) | "post" (olmo2: norm the sublayer OUTPUT)
+    norm_type: str = "rms"  # "rms" | "layernorm" (mean-centered, no bias — cohere)
+    parallel_block: bool = False  # cohere: h + attn(norm(h)) + mlp(norm(h)), ONE norm
     sliding_window: int | None = None
     layer_types: list[str] | None = None  # "full_attention" | "sliding_attention"
     # SmolLM3-style NoPE: per-layer rope enable (HF semantics: 1 = rope ON);
@@ -131,7 +133,12 @@ def _layer_shapes(cfg: DenseDecoderConfig) -> dict[str, tuple[int, ...]]:
         shapes |= {"bo": (d,)}
     if cfg.attention_sinks:
         shapes |= {"sinks": (n,)}
+    if cfg.parallel_block:
+        del shapes["mlp_norm"]  # one shared input norm (cohere)
     if cfg.qk_norm_whole:
+        shapes |= {"q_norm": (n, h), "k_norm": (k, h)}
+    elif cfg.qk_norm and cfg.norm_type == "layernorm":
+        # cohere: per-head LN with per-head weights, stored (n, h)/(k, h) as HF does
         shapes |= {"q_norm": (n, h), "k_norm": (k, h)}
     elif cfg.qk_norm:
         shapes |= {"q_norm": (h,), "k_norm": (h,)}
@@ -197,7 +204,8 @@ def dense_decoder_logical_axes(cfg: DenseDecoderConfig, scan_layers: bool = True
     """Pytree of logical-axis tuples matching init_dense_decoder_params' layout."""
     del scan_layers  # layer params are always stacked (L, ...)
     layers = {name: ("layers",) + _LAYER_AXES[name] for name in _layer_shapes(cfg)}
-    if cfg.qk_norm_whole:  # (n, h)-shaped norm weights
+    if cfg.qk_norm_whole or (cfg.qk_norm and cfg.norm_type == "layernorm"):
+        # (n, h)-shaped norm weights
         layers["q_norm"] = ("layers", "heads", "head_dim")
         layers["k_norm"] = ("layers", "kv_heads", "head_dim")
     axes = {
@@ -229,6 +237,24 @@ def embed_lookup(table, input_ids, dtype, rules=None, scale: float = 1.0):
     if scale != 1.0:  # granite embedding_multiplier
         h = h * jnp.asarray(scale, h.dtype)
     return h
+
+
+def _centered_norm(x, w, eps):
+    """Mean-centered LayerNorm without bias (CohereLayerNorm): works for (d,)
+    block weights and per-head (n, h) qk weights alike (stats over last dim).
+    The weight multiply stays in fp32 before the downcast, matching HF."""
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _block_norm(cfg, x, w):
+    """The block-level norm the config selects (rms | mean-centered LN)."""
+    if cfg.norm_type == "layernorm":
+        return _centered_norm(x, w, cfg.rms_norm_eps)
+    return rms_norm(x, w, cfg.rms_norm_eps)
 
 
 def resolve_unembed(cfg, params, dtype):
@@ -288,6 +314,10 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
         # head_dim) jointly, weight (n, h) == the flat HF (n*h,) weight reshaped
         q = _rms_norm_2d(q, lp["q_norm"], cfg.rms_norm_eps)
         k = _rms_norm_2d(k, lp["k_norm"], cfg.rms_norm_eps)
+    elif cfg.qk_norm and cfg.norm_type == "layernorm":
+        # cohere: per-head mean-centered LN with per-head (n, h) weights
+        q = _centered_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = _centered_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     elif cfg.qk_norm:
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
@@ -402,34 +432,47 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
             inv_freq_l = inv_freq * (1 - ((is_sliding >> 1) & 1)).astype(inv_freq.dtype)
         # named scopes label the profiler trace per block (the reference gets the
         # same from autonvtx module hooks, autonvtx/__init__.py:33)
+        def attn_call(x):
+            """One copy of the cache/no-cache attention dispatch for every
+            block style (sequential pre/post-norm AND cohere parallel)."""
+            if kv is None:
+                return _attention_block(
+                    cfg, backend, lp, x, state["positions"], state.get("segment_ids"),
+                    inv_freq_l, attn_scale, eff_window, rules), None
+            cache_meta = {k_: state[k_] for k_ in ("write_idx", "valid")}
+            cache_meta["positions"] = state["kv_positions"]
+            return _attention_block(
+                cfg, backend, lp, x, state["positions"], state.get("segment_ids"),
+                inv_freq_l, attn_scale, eff_window, rules,
+                cache=kv, cache_meta=cache_meta,
+            )
+
+        if cfg.parallel_block:
+            # cohere: ONE input norm feeds attention AND the MLP; both outputs
+            # add to the residual together
+            with jax.named_scope("parallel_block"):
+                x = _block_norm(cfg, h, lp["attn_norm"])
+                attn_out, kv_out = attn_call(x)
+                h = h + attn_out + _mlp_block(backend, lp, x, rules)
+                h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+            return dict(state, h=h), kv_out
         post = cfg.norm_placement == "post"
         with jax.named_scope("attention"):
             # post (olmo2): attention reads h RAW; attn_norm applies to the
             # sublayer OUTPUT before the residual add (post_attention_layernorm)
-            x = h if post else rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-            if kv is None:
-                attn_out, kv_out = _attention_block(
-                    cfg, backend, lp, x, state["positions"], state.get("segment_ids"),
-                    inv_freq_l, attn_scale, eff_window, rules), None
-            else:
-                cache_meta = {k_: state[k_] for k_ in ("write_idx", "valid")}
-                cache_meta["positions"] = state["kv_positions"]
-                attn_out, kv_out = _attention_block(
-                    cfg, backend, lp, x, state["positions"], state.get("segment_ids"),
-                    inv_freq_l, attn_scale, eff_window, rules,
-                    cache=kv, cache_meta=cache_meta,
-                )
+            x = h if post else _block_norm(cfg, h, lp["attn_norm"])
+            attn_out, kv_out = attn_call(x)
             if post:
-                attn_out = rms_norm(attn_out, lp["attn_norm"], cfg.rms_norm_eps)
+                attn_out = _block_norm(cfg, attn_out, lp["attn_norm"])
             if cfg.residual_multiplier != 1.0:  # granite
                 attn_out = attn_out * cfg.residual_multiplier
             h = h + attn_out
             h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
         with jax.named_scope("mlp"):
-            x = h if post else rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+            x = h if post else _block_norm(cfg, h, lp["mlp_norm"])
             mlp_out = _mlp_block(backend, lp, x, rules)
             if post:  # post_feedforward_layernorm
-                mlp_out = rms_norm(mlp_out, lp["mlp_norm"], cfg.rms_norm_eps)
+                mlp_out = _block_norm(cfg, mlp_out, lp["mlp_norm"])
             if cfg.residual_multiplier != 1.0:
                 mlp_out = mlp_out * cfg.residual_multiplier
             h = h + mlp_out
@@ -520,7 +563,7 @@ def decoder_forward(
     state, cache = out if cache is not None else (out, None)
     h = state["h"]
 
-    h = rms_norm(h, params["final_norm"].astype(dtype), cfg.rms_norm_eps)
+    h = _block_norm(cfg, h, params["final_norm"].astype(dtype))
     if cache is not None:
         # next-token logits ONLY (B, 1, V): unembedding the whole prefill chunk
         # would materialize a (B, S_prompt, V) tensor — an HBM spike at exactly

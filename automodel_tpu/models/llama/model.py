@@ -47,6 +47,8 @@ class LlamaConfig(DenseDecoderConfig):
     @classmethod
     def from_hf(cls, hf: dict[str, Any]) -> "LlamaConfig":
         """Build from an HF config.json dict (llama/qwen2/qwen3/mistral compatible)."""
+        archs = "".join(hf.get("architectures", []))
+        is_cohere = "Cohere" in archs
         return cls(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -58,13 +60,18 @@ class LlamaConfig(DenseDecoderConfig):
             max_position_embeddings=hf.get("max_position_embeddings", 4096),
             rope_theta=hf.get("rope_theta", 10000.0),
             rope_scaling=hf.get("rope_scaling"),
-            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
-            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            rms_norm_eps=hf.get("rms_norm_eps", hf.get("layer_norm_eps", 1e-5)),
+            tie_word_embeddings=hf.get("tie_word_embeddings", is_cohere),
             attention_bias=hf.get("attention_bias", hf.get("qkv_bias", False)),
-            qk_norm="Qwen3" in "".join(hf.get("architectures", [])),
+            qk_norm="Qwen3" in archs or (is_cohere and hf.get("use_qk_norm", False)),
             # Olmo2/3: post-sublayer norms + whole-projection qk-RMSNorm
             qk_norm_whole=_is_olmo2(hf),
             norm_placement="post" if _is_olmo2(hf) else "pre",
+            # Cohere: mean-centered LN, parallel attn||mlp block, interleaved
+            # rope, and a MULTIPLicative logit_scale (== dividing by its inverse)
+            norm_type="layernorm" if is_cohere else "rms",
+            parallel_block=is_cohere,
+            rope_interleaved=is_cohere,
             sliding_window=hf.get("sliding_window") if hf.get("use_sliding_window", True) else None,
             layer_types=hf.get("layer_types"),
             no_rope_layers=_no_rope_layers(hf),
@@ -73,7 +80,9 @@ class LlamaConfig(DenseDecoderConfig):
             embedding_multiplier=hf.get("embedding_multiplier", 1.0),
             residual_multiplier=hf.get("residual_multiplier", 1.0),
             attention_multiplier=hf.get("attention_multiplier"),
-            logits_scaling=hf.get("logits_scaling", 1.0),
+            logits_scaling=(1.0 / hf["logit_scale"]
+                            if is_cohere and hf.get("logit_scale")
+                            else hf.get("logits_scaling", 1.0)),
         )
 
 

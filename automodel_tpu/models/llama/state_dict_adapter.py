@@ -77,9 +77,10 @@ class LlamaStateDictAdapter(MappingAdapter):
             Entry("model.layers.{i}.post_attention_layernorm.weight"
                   if post else "model.layers.{i}.input_layernorm.weight",
                   "layers.attn_norm"),
-            Entry("model.layers.{i}.post_feedforward_layernorm.weight"
-                  if post else "model.layers.{i}.post_attention_layernorm.weight",
-                  "layers.mlp_norm"),
+            *([] if getattr(cfg, "parallel_block", False) else [
+                Entry("model.layers.{i}.post_feedforward_layernorm.weight"
+                      if post else "model.layers.{i}.post_attention_layernorm.weight",
+                      "layers.mlp_norm")]),
             Entry("model.layers.{i}.self_attn.q_proj.weight", "layers.wq", _proj_in(n, h), _proj_out(n, h)),
             Entry("model.layers.{i}.self_attn.k_proj.weight", "layers.wk", _proj_in(k, h), _proj_out(k, h)),
             Entry("model.layers.{i}.self_attn.v_proj.weight", "layers.wv", _proj_in(k, h), _proj_out(k, h)),

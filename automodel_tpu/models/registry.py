@@ -26,6 +26,9 @@ MODEL_REGISTRY: dict[str, str] = {
     # layer_types, which the lineage already carries)
     "Olmo2ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
     "Olmo3ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
+    # Cohere (Command R) = llama + mean-centered LN + parallel attn||mlp block
+    # + interleaved rope + multiplicative logit_scale (+ per-head qk-LN on R+)
+    "CohereForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
     "MixtralForCausalLM": "automodel_tpu.models.mixtral.model:MixtralForCausalLM",
     # Phi-3 lineage is llama-shaped with fused checkpoint tensors + longrope
     "Phi3ForCausalLM": "automodel_tpu.models.phi3.model:Phi3ForCausalLM",

@@ -43,8 +43,10 @@ _DENYLIST = {
     # placement + whole-projection qk-RMSNorm via norm_placement/qk_norm_whole)
     "GlmForCausalLM": "partial-rotary GLM block interleaves rope pairs differently",
     "Glm4ForCausalLM": "extra post_self_attn/post_mlp layernorms in the block",
-    "CohereForCausalLM": "parallel attention+MLP block with LayerNorm",
-    "Cohere2ForCausalLM": "parallel attention+MLP block with LayerNorm",
+    # CohereForCausalLM graduated to a registered family; Cohere2 changes the
+    # block again (sliding/rope pattern) and stays pinned
+    "Cohere2ForCausalLM": "parallel attention+MLP block with per-layer rope/sliding "
+                          "pattern (Cohere2) not yet mapped",
 }
 
 # Code-level deltas that ARE expressible as dense-decoder config knobs but are

@@ -303,10 +303,9 @@ def _make_head_loss(cfg, dtype, loss_name: str = "masked_ce"):
 def _head_pre(cfg, dtype, other, h):
     """Final-norm + unembed (transformer.resolve_unembed: tied fallback +
     granite logits_scaling) — shared by every pp loss/composition."""
-    from automodel_tpu.models.common.transformer import resolve_unembed
-    from automodel_tpu.ops.norms import rms_norm
+    from automodel_tpu.models.common.transformer import _block_norm, resolve_unembed
 
-    h = rms_norm(h, other["final_norm"].astype(dtype), cfg.rms_norm_eps)
+    h = _block_norm(cfg, h, other["final_norm"].astype(dtype))
     return h, resolve_unembed(cfg, other, dtype)
 
 

@@ -165,6 +165,13 @@ class TestGraduatedFamilies:
     def test_olmo3_adds_sliding(self):
         self._parity("Olmo3ForCausalLM", num_hidden_layers=4, sliding_window=8)
 
+    def test_cohere_parallel_block_logit_scale(self):
+        # mean-centered LN + parallel attn||mlp + interleaved rope + logit_scale
+        self._parity("CohereForCausalLM", logit_scale=0.0625)
+
+    def test_cohere_plus_per_head_qk_layernorm(self):
+        self._parity("CohereForCausalLM", logit_scale=0.0625, use_qk_norm=True)
+
 
 def test_registry_error_carries_alias_failure():
     """The combined error names both the registry miss and the divergent field."""
