@@ -251,8 +251,10 @@ def _centered_norm(x, w, eps):
 
 
 def _block_norm(cfg, x, w):
-    """The block-level norm the config selects (rms | mean-centered LN)."""
-    if cfg.norm_type == "layernorm":
+    """The block-level norm the config selects (rms | mean-centered LN).
+    getattr: family configs outside the dense lineage (MLA) reach here via the
+    shared pipeline head and carry no norm_type — they are all RMSNorm."""
+    if getattr(cfg, "norm_type", "rms") == "layernorm":
         return _centered_norm(x, w, cfg.rms_norm_eps)
     return rms_norm(x, w, cfg.rms_norm_eps)
 
