@@ -250,3 +250,54 @@ class LazyHFTensor:
     def __array__(self, dtype=None, copy=None):
         arr = self.materialize()
         return arr.astype(dtype) if dtype is not None else arr
+
+
+class FusedTensorMixin:
+    """Split fused HF checkpoint tensors into the mapping table's virtual keys
+    on the way in and re-fuse on export (Phi-3 packs q|k|v and gate|up; GLM-4
+    packs gate|up). Mix in BEFORE the mapping adapter and set:
+
+    - ``_fused``:  [(fused HF suffix, [virtual part suffixes])]
+    - ``_fused_splits``: {fused suffix: np.split offsets along HF dim 0}
+    """
+
+    _fused: "list[tuple[str, list[str]]]" = []
+    _fused_splits: "dict[str, list[int]]" = {}
+
+    def _fused_keys(self, i: int, fused: str, parts: "list[str]"):
+        pre = f"model.layers.{i}."
+        return pre + fused, [pre + p for p in parts]
+
+    def from_hf(self, tensors, dtype=None) -> dict:
+        t = dict(tensors)
+        for i in range(self.num_layers):
+            for fused, parts in self._fused:
+                fk, pks = self._fused_keys(i, fused, parts)
+                if fk not in t:
+                    continue
+                for pk, arr in zip(
+                    pks, np.split(np.asarray(t.pop(fk)), self._fused_splits[fused], axis=0)
+                ):
+                    t[pk] = arr
+        return super().from_hf(t, dtype)
+
+    def to_hf(self, params, dtype=None) -> dict:
+        out = super().to_hf(params, dtype)
+        for i in range(self.num_layers):
+            for fused, parts in self._fused:
+                fk, pks = self._fused_keys(i, fused, parts)
+                out[fk] = np.concatenate([out.pop(pk) for pk in pks], axis=0)
+        return out
+
+    def to_hf_lazy(self, params, dtype=None, host_fn=None) -> dict:
+        out = super().to_hf_lazy(params, dtype, host_fn)
+        for i in range(self.num_layers):
+            for fused, parts in self._fused:
+                fk, pks = self._fused_keys(i, fused, parts)
+                lazies = [out.pop(pk) for pk in pks]
+                out[fk] = LazyHFTensor(
+                    (lambda ls=lazies: np.concatenate(
+                        [x.materialize() for x in ls], axis=0)),
+                    sum(x.nbytes for x in lazies),
+                )
+        return out

@@ -65,7 +65,9 @@ class DenseDecoderConfig:
     attention_sinks: bool = False  # gpt-oss: per-head sink logits absorbing mass
     qk_norm: bool = False  # qwen3: RMSNorm on per-head q/k
     qk_norm_whole: bool = False  # olmo2: RMSNorm over the WHOLE q/k projection (n*h)
-    norm_placement: str = "pre"  # "pre" (llama) | "post" (olmo2: norm the sublayer OUTPUT)
+    # "pre" (llama) | "post" (olmo2: norm the sublayer OUTPUT, no input norm)
+    # | "sandwich" (glm4/gemma2 style: input norm AND a second norm on the output)
+    norm_placement: str = "pre"
     norm_type: str = "rms"  # "rms" | "layernorm" (mean-centered, no bias — cohere)
     parallel_block: bool = False  # cohere: h + attn(norm(h)) + mlp(norm(h)), ONE norm
     sliding_window: int | None = None
@@ -135,6 +137,8 @@ def _layer_shapes(cfg: DenseDecoderConfig) -> dict[str, tuple[int, ...]]:
         shapes |= {"sinks": (n,)}
     if cfg.parallel_block:
         del shapes["mlp_norm"]  # one shared input norm (cohere)
+    if cfg.norm_placement == "sandwich":  # glm4: post_self_attn/post_mlp norms
+        shapes |= {"attn_post_norm": (d,), "mlp_post_norm": (d,)}
     if cfg.qk_norm_whole:
         shapes |= {"q_norm": (n, h), "k_norm": (k, h)}
     elif cfg.qk_norm and cfg.norm_type == "layernorm":
@@ -159,6 +163,8 @@ _LAYER_AXES = {
     "q_norm": ("norm",),
     "k_norm": ("norm",),
     "mlp_norm": ("norm",),
+    "attn_post_norm": ("norm",),
+    "mlp_post_norm": ("norm",),
     "w_gate": ("embed", "mlp"),
     "w_up": ("embed", "mlp"),
     "w_down": ("mlp", "embed"),
@@ -459,13 +465,17 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
                 h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
             return dict(state, h=h), kv_out
         post = cfg.norm_placement == "post"
+        sandwich = cfg.norm_placement == "sandwich"
         with jax.named_scope("attention"):
             # post (olmo2): attention reads h RAW; attn_norm applies to the
-            # sublayer OUTPUT before the residual add (post_attention_layernorm)
+            # sublayer OUTPUT before the residual add (post_attention_layernorm).
+            # sandwich (glm4): input norm AND a post norm on the output.
             x = h if post else _block_norm(cfg, h, lp["attn_norm"])
             attn_out, kv_out = attn_call(x)
             if post:
                 attn_out = _block_norm(cfg, attn_out, lp["attn_norm"])
+            elif sandwich:  # post_self_attn_layernorm
+                attn_out = _block_norm(cfg, attn_out, lp["attn_post_norm"])
             if cfg.residual_multiplier != 1.0:  # granite
                 attn_out = attn_out * cfg.residual_multiplier
             h = h + attn_out
@@ -475,6 +485,8 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
             mlp_out = _mlp_block(backend, lp, x, rules)
             if post:  # post_feedforward_layernorm
                 mlp_out = _block_norm(cfg, mlp_out, lp["mlp_norm"])
+            elif sandwich:  # post_mlp_layernorm
+                mlp_out = _block_norm(cfg, mlp_out, lp["mlp_post_norm"])
             if cfg.residual_multiplier != 1.0:
                 mlp_out = mlp_out * cfg.residual_multiplier
             h = h + mlp_out

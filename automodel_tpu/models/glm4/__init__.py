@@ -1,0 +1,3 @@
+from automodel_tpu.models.glm4.model import Glm4ForCausalLM
+
+__all__ = ["Glm4ForCausalLM"]

@@ -1,0 +1,25 @@
+"""GLM-4 dense (glm-4-9b lineage) — llama lineage + three config deltas
+(reference serves it through the HF wrapper; transformers modeling_glm4.py):
+
+- SANDWICH norms: input_layernorm + post_self_attn_layernorm around attention,
+  post_attention_layernorm + post_mlp_layernorm around the MLP
+  (norm_placement="sandwich" in the shared dense block)
+- interleaved rope over the FIRST HALF of head_dim (partial_rotary_factor 0.5)
+- fused gate_up_proj checkpoint tensors (split/merged by the adapter, the same
+  pattern Phi-3's fused qkv uses)
+"""
+
+from __future__ import annotations
+
+from automodel_tpu.models.llama.model import LlamaForCausalLM
+
+__all__ = ["Glm4ForCausalLM"]
+
+
+class Glm4ForCausalLM(LlamaForCausalLM):
+    hf_architectures = ("Glm4ForCausalLM",)
+
+    def state_dict_adapter(self):
+        from automodel_tpu.models.glm4.state_dict_adapter import Glm4StateDictAdapter
+
+        return Glm4StateDictAdapter(self.config, scan_layers=self.backend.scan_layers)

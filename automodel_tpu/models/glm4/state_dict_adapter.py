@@ -1,0 +1,20 @@
+"""GLM-4 HF key mapping: llama table (incl. sandwich norms) + fused gate_up
+split/merge (transformers Glm4MLP packs gate|up into mlp.gate_up_proj.weight;
+the shared FusedTensorMixin owns the machinery)."""
+
+from __future__ import annotations
+
+from automodel_tpu.models.common.state_dict import FusedTensorMixin
+from automodel_tpu.models.common.transformer import DenseDecoderConfig
+from automodel_tpu.models.llama.state_dict_adapter import LlamaStateDictAdapter
+
+__all__ = ["Glm4StateDictAdapter"]
+
+
+class Glm4StateDictAdapter(FusedTensorMixin, LlamaStateDictAdapter):
+    _fused = [("mlp.gate_up_proj.weight",
+               ["mlp.gate_proj.weight", "mlp.up_proj.weight"])]
+
+    def __init__(self, cfg: DenseDecoderConfig, scan_layers: bool = True):
+        super().__init__(cfg, scan_layers)
+        self._fused_splits = {"mlp.gate_up_proj.weight": [cfg.intermediate_size]}

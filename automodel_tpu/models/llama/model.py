@@ -49,6 +49,8 @@ class LlamaConfig(DenseDecoderConfig):
         """Build from an HF config.json dict (llama/qwen2/qwen3/mistral compatible)."""
         archs = "".join(hf.get("architectures", []))
         is_cohere = "Cohere" in archs
+        is_glm4 = "Glm4" in archs  # dense glm4 only (Glm4Moe routes to its own family)
+        is_glm = "Glm" in archs  # old GLM + Glm4: both use interleaved partial rope
         return cls(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -60,18 +62,20 @@ class LlamaConfig(DenseDecoderConfig):
             max_position_embeddings=hf.get("max_position_embeddings", 4096),
             rope_theta=hf.get("rope_theta", 10000.0),
             rope_scaling=hf.get("rope_scaling"),
+            partial_rotary_factor=hf.get("partial_rotary_factor", 1.0),
             rms_norm_eps=hf.get("rms_norm_eps", hf.get("layer_norm_eps", 1e-5)),
             tie_word_embeddings=hf.get("tie_word_embeddings", is_cohere),
             attention_bias=hf.get("attention_bias", hf.get("qkv_bias", False)),
             qk_norm="Qwen3" in archs or (is_cohere and hf.get("use_qk_norm", False)),
             # Olmo2/3: post-sublayer norms + whole-projection qk-RMSNorm
             qk_norm_whole=_is_olmo2(hf),
-            norm_placement="post" if _is_olmo2(hf) else "pre",
+            norm_placement=("post" if _is_olmo2(hf)
+                            else "sandwich" if is_glm4 else "pre"),
             # Cohere: mean-centered LN, parallel attn||mlp block, interleaved
             # rope, and a MULTIPLicative logit_scale (== dividing by its inverse)
             norm_type="layernorm" if is_cohere else "rms",
             parallel_block=is_cohere,
-            rope_interleaved=is_cohere,
+            rope_interleaved=is_cohere or is_glm,
             sliding_window=hf.get("sliding_window") if hf.get("use_sliding_window", True) else None,
             layer_types=hf.get("layer_types"),
             no_rope_layers=_no_rope_layers(hf),

@@ -89,6 +89,13 @@ class LlamaStateDictAdapter(MappingAdapter):
             Entry("model.layers.{i}.mlp.up_proj.weight", "layers.w_up", _t, _t),
             Entry("model.layers.{i}.mlp.down_proj.weight", "layers.w_down", _t, _t),
         ]
+        if getattr(cfg, "norm_placement", "pre") == "sandwich":
+            entries += [
+                Entry("model.layers.{i}.post_self_attn_layernorm.weight",
+                      "layers.attn_post_norm"),
+                Entry("model.layers.{i}.post_mlp_layernorm.weight",
+                      "layers.mlp_post_norm"),
+            ]
         if cfg.attention_bias:
             entries += [
                 Entry("model.layers.{i}.self_attn.q_proj.bias", "layers.bq", _bias_in(n, h), _bias_out(n, h)),

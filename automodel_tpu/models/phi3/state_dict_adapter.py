@@ -1,69 +1,32 @@
 """Phi-3 HF key/layout mapping: llama table + fused-tensor split/merge.
 
 HF Phi-3 packs q|k|v into ``self_attn.qkv_proj.weight`` and gate|up into
-``mlp.gate_up_proj.weight`` (transformers Phi3Attention/Phi3MLP). The adapter
-splits them into the llama-table's virtual q/k/v/gate/up keys on the way in and
-re-fuses on the way out, so the model tree stays identical to llama's.
+``mlp.gate_up_proj.weight`` (transformers Phi3Attention/Phi3MLP); the shared
+FusedTensorMixin splits them into the llama-table's virtual q/k/v/gate/up keys
+on the way in and re-fuses on the way out, so the model tree stays identical
+to llama's.
 """
 
 from __future__ import annotations
 
-import numpy as np
-
-from automodel_tpu.models.common.state_dict import LazyHFTensor
+from automodel_tpu.models.common.state_dict import FusedTensorMixin
 from automodel_tpu.models.common.transformer import DenseDecoderConfig
 from automodel_tpu.models.llama.state_dict_adapter import LlamaStateDictAdapter
 
 __all__ = ["Phi3StateDictAdapter"]
 
-_FUSED = (
-    # (fused HF suffix, [unfused llama-table suffixes])
-    ("self_attn.qkv_proj.weight",
-     ["self_attn.q_proj.weight", "self_attn.k_proj.weight", "self_attn.v_proj.weight"]),
-    ("mlp.gate_up_proj.weight", ["mlp.gate_proj.weight", "mlp.up_proj.weight"]),
-)
 
+class Phi3StateDictAdapter(FusedTensorMixin, LlamaStateDictAdapter):
+    _fused = [
+        ("self_attn.qkv_proj.weight",
+         ["self_attn.q_proj.weight", "self_attn.k_proj.weight", "self_attn.v_proj.weight"]),
+        ("mlp.gate_up_proj.weight", ["mlp.gate_proj.weight", "mlp.up_proj.weight"]),
+    ]
 
-class Phi3StateDictAdapter(LlamaStateDictAdapter):
     def __init__(self, cfg: DenseDecoderConfig, scan_layers: bool = True):
         super().__init__(cfg, scan_layers)
         q = cfg.num_attention_heads * cfg.head_dim
         kv = cfg.num_key_value_heads * cfg.head_dim
         # split offsets along HF's out_features dim 0
-        self._splits = {"self_attn.qkv_proj.weight": [q, q + kv],
-                        "mlp.gate_up_proj.weight": [cfg.intermediate_size]}
-
-    def _keys(self, i: int, fused: str, parts: "list[str]"):
-        pre = f"model.layers.{i}."
-        return pre + fused, [pre + p for p in parts]
-
-    def from_hf(self, tensors, dtype=None) -> dict:
-        t = dict(tensors)
-        for i in range(self.num_layers):
-            for fused, parts in _FUSED:
-                fk, pks = self._keys(i, fused, parts)
-                if fk not in t:
-                    continue
-                for pk, arr in zip(pks, np.split(np.asarray(t.pop(fk)), self._splits[fused], axis=0)):
-                    t[pk] = arr
-        return super().from_hf(t, dtype)
-
-    def to_hf(self, params, dtype=None) -> dict:
-        out = super().to_hf(params, dtype)
-        for i in range(self.num_layers):
-            for fused, parts in _FUSED:
-                fk, pks = self._keys(i, fused, parts)
-                out[fk] = np.concatenate([out.pop(pk) for pk in pks], axis=0)
-        return out
-
-    def to_hf_lazy(self, params, dtype=None, host_fn=None) -> dict:
-        out = super().to_hf_lazy(params, dtype, host_fn)
-        for i in range(self.num_layers):
-            for fused, parts in _FUSED:
-                fk, pks = self._keys(i, fused, parts)
-                lazies = [out.pop(pk) for pk in pks]
-                out[fk] = LazyHFTensor(
-                    (lambda ls=lazies: np.concatenate([x.materialize() for x in ls], axis=0)),
-                    sum(x.nbytes for x in lazies),
-                )
-        return out
+        self._fused_splits = {"self_attn.qkv_proj.weight": [q, q + kv],
+                              "mlp.gate_up_proj.weight": [cfg.intermediate_size]}

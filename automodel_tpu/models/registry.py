@@ -29,6 +29,11 @@ MODEL_REGISTRY: dict[str, str] = {
     # Cohere (Command R) = llama + mean-centered LN + parallel attn||mlp block
     # + interleaved rope + multiplicative logit_scale (+ per-head qk-LN on R+)
     "CohereForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
+    # GLM-4 dense = llama + sandwich norms + interleaved partial rope + fused
+    # gate_up checkpoints (split by its adapter); old GLM (glm-4-9b-chat-hf) is
+    # the same minus the sandwich norms and rides the same adapter
+    "Glm4ForCausalLM": "automodel_tpu.models.glm4.model:Glm4ForCausalLM",
+    "GlmForCausalLM": "automodel_tpu.models.glm4.model:Glm4ForCausalLM",
     "MixtralForCausalLM": "automodel_tpu.models.mixtral.model:MixtralForCausalLM",
     # Phi-3 lineage is llama-shaped with fused checkpoint tensors + longrope
     "Phi3ForCausalLM": "automodel_tpu.models.phi3.model:Phi3ForCausalLM",

@@ -41,8 +41,8 @@ class StructuralDivergence(Exception):
 _DENYLIST = {
     # Olmo2/Olmo3 graduated to registered families (llama/model.py: post-norm
     # placement + whole-projection qk-RMSNorm via norm_placement/qk_norm_whole)
-    "GlmForCausalLM": "partial-rotary GLM block interleaves rope pairs differently",
-    "Glm4ForCausalLM": "extra post_self_attn/post_mlp layernorms in the block",
+    # Glm4ForCausalLM (dense) graduated to a registered family (models/glm4);
+    # old GlmForCausalLM aliases via _ARCH_DELTAS (llama + interleaved rope)
     # CohereForCausalLM graduated to a registered family; Cohere2 changes the
     # block again (sliding/rope pattern) and stays pinned
     "Cohere2ForCausalLM": "parallel attention+MLP block with per-layer rope/sliding "
@@ -200,10 +200,8 @@ def resolve_llama_delta(architecture: str, hf: dict, backend=None):
         )
     from automodel_tpu.models.llama.model import LlamaConfig, LlamaForCausalLM
 
-    cfg = LlamaConfig.from_hf(hf)
+    cfg = LlamaConfig.from_hf(hf)  # consumes partial_rotary_factor directly
     overrides = dict(_ARCH_DELTAS.get(architecture, {}))
-    if hf.get("partial_rotary_factor") not in (None, 1, 1.0):
-        overrides["partial_rotary_factor"] = float(hf["partial_rotary_factor"])
     if hf.get("qk_norm") or hf.get("use_qk_norm"):
         overrides["qk_norm"] = True
     if overrides:

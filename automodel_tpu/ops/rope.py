@@ -141,13 +141,20 @@ def apply_rope_interleaved(
     pairs (x0,x1) rotate as x0*cos - x1*sin, x0*sin + x1*cos (DeepSeek MLA convention,
     reference deepseek_v3/rope_utils.py apply_rotary_emb view_as_complex layout)."""
     dtype = x.dtype
+    rotary_dim = 2 * inv_freq.shape[0]
+    x_pass = None
+    if rotary_dim < x.shape[-1]:  # glm4: interleaved rope over the first fraction
+        x, x_pass = x[..., :rotary_dim], x[..., rotary_dim:]
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # (b, s, rot/2)
     cos = (jnp.cos(angles) * attention_scaling)[:, :, None, :]  # (b, s, 1, rot/2)
     sin = (jnp.sin(angles) * attention_scaling)[:, :, None, :]
     xf = x.astype(jnp.float32)
     x0, x1 = xf[..., 0::2], xf[..., 1::2]
     out = jnp.stack([x0 * cos - x1 * sin, x0 * sin + x1 * cos], axis=-1)
-    return out.reshape(x.shape).astype(dtype)
+    out = out.reshape(x.shape).astype(dtype)
+    if x_pass is not None:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out
 
 
 def mrope_angles(

@@ -161,6 +161,27 @@ class TestMoEDecode:
                        cache_dtype=jnp.float32)
         np.testing.assert_array_equal(np.asarray(got["tokens"]), want)
 
+    def test_glm4_sandwich_cache_matches_full(self):
+        """GLM4's sandwich norms + interleaved partial rope through the decode
+        cache == full recompute."""
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+
+        hf_cfg = {
+            "architectures": ["Glm4ForCausalLM"],
+            "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "rms_norm_eps": 1e-5,
+            "partial_rotary_factor": 0.5, "max_position_embeddings": 64,
+        }
+        model = AutoModelForCausalLM.from_config(
+            hf_cfg, BackendConfig(dtype="float32", remat_policy="none"))
+        params = model.init(jax.random.key(21), jnp.float32)
+        prompts = np.random.RandomState(22).randint(0, 128, (2, 6)).astype(np.int32)
+        want = _full_greedy(model, params, prompts, 5)
+        got = generate(model, params, prompts, max_new_tokens=5,
+                       cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(got["tokens"]), want)
+
     def test_cohere_parallel_block_cache_matches_full(self):
         """Cohere's parallel attn||mlp block + centered LN + interleaved rope
         through the decode cache path == full recompute."""

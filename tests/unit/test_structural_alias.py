@@ -100,17 +100,16 @@ class TestHonestDivergence:
         with pytest.raises(KeyError, match=expect):
             AutoModelForCausalLM.from_config(hf)
 
-    @pytest.mark.parametrize("arch", [
-        # config field-identical to llama but with different BLOCK code —
-        # the curated denylist is load-bearing here
-        "Glm4ForCausalLM",
-    ])
-    def test_code_divergent_arch_is_denylisted(self, arch):
-        hf = _hf_config(arch, **TINY)
-        # prove the denylist is what catches it: the field check alone passes
-        assert classify_config(hf) == []
-        with pytest.raises(StructuralDivergence):
-            resolve_llama_delta(arch, hf)
+    def test_code_divergent_arch_is_denylisted(self):
+        # Cohere2's config field-level check fails on logit_scale anyway, so
+        # pin the denylist mechanism with a field-clean synthetic lookup
+        from automodel_tpu.models.structural import _DENYLIST
+
+        assert "Cohere2ForCausalLM" in _DENYLIST
+        hf = _hf_config("LlamaForCausalLM", **TINY)
+        hf["architectures"] = ["Cohere2ForCausalLM"]
+        with pytest.raises(StructuralDivergence, match="Cohere2"):
+            resolve_llama_delta("Cohere2ForCausalLM", hf)
 
     def test_unsupported_rope_scaling_variant_named(self):
         hf = _hf_config("LlamaForCausalLM", **TINY)
@@ -164,6 +163,34 @@ class TestGraduatedFamilies:
 
     def test_olmo3_adds_sliding(self):
         self._parity("Olmo3ForCausalLM", num_hidden_layers=4, sliding_window=8)
+
+    def test_glm4_sandwich_norms_partial_interleaved_rope(self):
+        self._parity("Glm4ForCausalLM")  # defaults: partial_rotary 0.5, sandwich
+
+    def test_old_glm_no_sandwich(self):
+        # glm-4-9b-chat-hf lineage: same family minus the sandwich norms
+        self._parity("GlmForCausalLM")
+
+    def test_glm4_fused_gate_up_roundtrip(self):
+        """to_hf re-fuses gate|up into mlp.gate_up_proj and from_hf splits it
+        back — bit-exact roundtrip (the export path HF loading depends on)."""
+        import jax
+
+        from automodel_tpu.models.glm4.model import Glm4ForCausalLM
+
+        hf = {**TINY, "architectures": ["Glm4ForCausalLM"],
+              "partial_rotary_factor": 0.5, "rms_norm_eps": 1e-5}
+        am = AutoModelForCausalLM.from_config(hf, backend=BackendConfig(dtype="float32"))
+        assert isinstance(am, Glm4ForCausalLM)
+        params = am.init(jax.random.key(0))
+        adapter = am.state_dict_adapter()
+        sd = adapter.to_hf(params)
+        assert "model.layers.0.mlp.gate_up_proj.weight" in sd
+        assert "model.layers.0.mlp.gate_proj.weight" not in sd
+        assert "model.layers.0.post_self_attn_layernorm.weight" in sd
+        back = adapter.from_hf(sd, dtype=np.float32)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     def test_cohere_parallel_block_logit_scale(self):
         # mean-centered LN + parallel attn||mlp + interleaved rope + logit_scale
