@@ -30,13 +30,19 @@ def _is_olmo2(hf: dict) -> bool:
 
 
 def _no_rope_layers(hf: dict) -> list | None:
-    """SmolLM3 NoPE pattern: explicit per-layer list (1 = rope ON), or derived
-    from no_rope_layer_interval the way SmolLM3Config does (every interval-th
-    layer is NoPE). None when every layer uses rope."""
+    """Per-layer rope enable (1 = rope ON); None when every layer uses rope.
+
+    - SmolLM3: explicit no_rope_layers list, or derived from
+      no_rope_layer_interval (every interval-th layer is NoPE)
+    - Cohere2: rope applies ONLY on sliding_attention layers (transformers
+      Cohere2Attention gates rotary on self.sliding_window)"""
     layers = hf.get("no_rope_layers")
     if layers is None and hf.get("no_rope_layer_interval"):
         k = int(hf["no_rope_layer_interval"])
         layers = [int((i + 1) % k != 0) for i in range(hf["num_hidden_layers"])]
+    if (layers is None and "Cohere2" in "".join(hf.get("architectures", []))
+            and hf.get("layer_types")):
+        layers = [int(t == "sliding_attention") for t in hf["layer_types"]]
     if layers is not None and all(layers):
         return None
     return layers

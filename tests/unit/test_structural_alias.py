@@ -100,16 +100,17 @@ class TestHonestDivergence:
         with pytest.raises(KeyError, match=expect):
             AutoModelForCausalLM.from_config(hf)
 
-    def test_code_divergent_arch_is_denylisted(self):
-        # Cohere2's config field-level check fails on logit_scale anyway, so
-        # pin the denylist mechanism with a field-clean synthetic lookup
-        from automodel_tpu.models.structural import _DENYLIST
+    def test_denylist_mechanism(self, monkeypatch):
+        # every real entry graduated to a family in round 4; pin the mechanism
+        # itself so the next config-invisible code divergence can use it
+        from automodel_tpu.models import structural
 
-        assert "Cohere2ForCausalLM" in _DENYLIST
+        monkeypatch.setitem(structural._DENYLIST, "WeirdBlockForCausalLM",
+                            "block code differs despite llama-shaped fields")
         hf = _hf_config("LlamaForCausalLM", **TINY)
-        hf["architectures"] = ["Cohere2ForCausalLM"]
-        with pytest.raises(StructuralDivergence, match="Cohere2"):
-            resolve_llama_delta("Cohere2ForCausalLM", hf)
+        hf["architectures"] = ["WeirdBlockForCausalLM"]
+        with pytest.raises(StructuralDivergence, match="WeirdBlock"):
+            resolve_llama_delta("WeirdBlockForCausalLM", hf)
 
     def test_unsupported_rope_scaling_variant_named(self):
         hf = _hf_config("LlamaForCausalLM", **TINY)
@@ -198,6 +199,12 @@ class TestGraduatedFamilies:
 
     def test_cohere_plus_per_head_qk_layernorm(self):
         self._parity("CohereForCausalLM", logit_scale=0.0625, use_qk_norm=True)
+
+    def test_cohere2_sliding_pattern_nope_full_layers(self):
+        # rope only on sliding layers; full-attention layers are NoPE
+        self._parity("Cohere2ForCausalLM", logit_scale=0.0625,
+                     num_hidden_layers=4, sliding_window=8,
+                     sliding_window_pattern=4)
 
 
 def test_registry_error_carries_alias_failure():
