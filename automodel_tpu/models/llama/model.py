@@ -29,6 +29,17 @@ def _is_olmo2(hf: dict) -> bool:
     return "Olmo2" in archs or "Olmo3" in archs
 
 
+def _cohere2_layer_types(hf: dict) -> list:
+    """Cohere2's per-layer pattern: explicit layer_types, or derived from the
+    original R7B config format's integer sliding_window_pattern the way
+    Cohere2Config's BC branch does (every pattern-th layer is full attention)."""
+    if hf.get("layer_types"):
+        return hf["layer_types"]
+    p = int(hf.get("sliding_window_pattern", 4))
+    return ["sliding_attention" if (i + 1) % p else "full_attention"
+            for i in range(hf["num_hidden_layers"])]
+
+
 def _no_rope_layers(hf: dict) -> list | None:
     """Per-layer rope enable (1 = rope ON); None when every layer uses rope.
 
@@ -40,9 +51,8 @@ def _no_rope_layers(hf: dict) -> list | None:
     if layers is None and hf.get("no_rope_layer_interval"):
         k = int(hf["no_rope_layer_interval"])
         layers = [int((i + 1) % k != 0) for i in range(hf["num_hidden_layers"])]
-    if (layers is None and "Cohere2" in "".join(hf.get("architectures", []))
-            and hf.get("layer_types")):
-        layers = [int(t == "sliding_attention") for t in hf["layer_types"]]
+    if layers is None and "Cohere2" in "".join(hf.get("architectures", [])):
+        layers = [int(t == "sliding_attention") for t in _cohere2_layer_types(hf)]
     if layers is not None and all(layers):
         return None
     return layers
@@ -83,7 +93,8 @@ class LlamaConfig(DenseDecoderConfig):
             parallel_block=is_cohere,
             rope_interleaved=is_cohere or is_glm,
             sliding_window=hf.get("sliding_window") if hf.get("use_sliding_window", True) else None,
-            layer_types=hf.get("layer_types"),
+            layer_types=(_cohere2_layer_types(hf) if "Cohere2" in archs
+                         else hf.get("layer_types")),
             no_rope_layers=_no_rope_layers(hf),
             initializer_range=hf.get("initializer_range", 0.02),
             # granite mup-style scalars (identity for every other family)

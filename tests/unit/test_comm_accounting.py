@@ -1,0 +1,63 @@
+"""The dryrun's collective-byte accounting (__graft_entry__._collective_bytes):
+the parser the MULTICHIP_r* comm tables and analytic floor/ceiling assertions
+stand on. Pin its conventions on synthetic HLO text."""
+
+import importlib.util
+import sys
+
+
+def _graft():
+    if "__graft_entry__" in sys.modules:
+        return sys.modules["__graft_entry__"]
+    spec = importlib.util.spec_from_file_location("__graft_entry__", "__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["__graft_entry__"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_sums_output_bytes_per_collective_kind():
+    g = _graft()
+    hlo = """
+  %ag = f32[16,64]{1,0} all-gather(f32[4,64]{1,0} %p0), dimensions={0}
+  %ar = bf16[8,128]{1,0} all-reduce(bf16[8,128]{1,0} %x), to_apply=%sum
+  %rs = f32[2,64]{1,0} reduce-scatter(f32[8,64]{1,0} %y), dimensions={0}
+  %cp = s32[5]{0} collective-permute(s32[5]{0} %z), source_target_pairs={{0,1}}
+  %a2a = f32[4,8,32]{2,1,0} all-to-all(f32[4,8,32]{2,1,0} %w), dimensions={0}
+"""
+    got = g._collective_bytes(hlo)
+    assert got["all-gather"] == 16 * 64 * 4
+    assert got["all-reduce"] == 8 * 128 * 2
+    assert got["reduce-scatter"] == 2 * 64 * 4
+    assert got["collective-permute"] == 5 * 4
+    assert got["all-to-all"] == 4 * 8 * 32 * 4
+
+
+def test_async_start_counts_result_not_operand_alias():
+    """-start ops carry (operand alias, ..., result) tuples; counting every
+    element would inflate all-gather ~1.5x (the review-caught double count)."""
+    g = _graft()
+    hlo = """
+  %ags = (f32[4,64]{1,0}, f32[16,64]{1,0}) all-gather-start(f32[4,64]{1,0} %p0), dimensions={0}
+  %agd = f32[16,64]{1,0} all-gather-done((f32[4,64]{1,0}, f32[16,64]{1,0}) %ags)
+"""
+    got = g._collective_bytes(hlo)
+    # only the -start result (the LAST tuple element); -done doesn't re-count
+    assert got["all-gather"] == 16 * 64 * 4
+
+
+def test_sync_tuple_output_sums_all_elements():
+    """A plain (non-start) variadic all-to-all's tuple output is all real data."""
+    g = _graft()
+    hlo = "  %t = (f32[2,8]{1,0}, f32[2,8]{1,0}) all-to-all(f32[2,8] %a, f32[2,8] %b), dimensions={0}"
+    got = g._collective_bytes(hlo)
+    assert got["all-to-all"] == 2 * (2 * 8 * 4)
+
+
+def test_non_collective_lines_ignored():
+    g = _graft()
+    hlo = """
+  %d = f32[128,128]{1,0} dot(f32[128,64] %a, f32[64,128] %b)
+  %f = f32[8]{0} fusion(f32[8] %x), kind=kLoop
+"""
+    assert g._collective_bytes(hlo) == {}

@@ -206,6 +206,34 @@ class TestGraduatedFamilies:
                      num_hidden_layers=4, sliding_window=8,
                      sliding_window_pattern=4)
 
+    def test_cohere2_raw_hub_config_format(self):
+        """Original R7B config.json carries an integer sliding_window_pattern
+        and NO layer_types — the derivation must mirror Cohere2Config's BC
+        branch or every layer silently ropes/slides wrong."""
+        import jax
+
+        cls = transformers.Cohere2ForCausalLM
+        tcfg = cls.config_class(**{**TINY, "pad_token_id": 0,
+                                   "num_hidden_layers": 4, "logit_scale": 0.0625,
+                                   "sliding_window": 8,
+                                   "sliding_window_pattern": 4})
+        hf = tcfg.to_dict()
+        hf["architectures"] = ["Cohere2ForCausalLM"]
+        hf.pop("layer_types", None)
+        hf["sliding_window_pattern"] = 4
+        torch.manual_seed(0)
+        tm = cls(tcfg).eval()
+        sd = {k: v.float().numpy() for k, v in tm.state_dict().items()}
+        am = AutoModelForCausalLM.from_config(hf, backend=BackendConfig(dtype="float32"))
+        params = jax.tree.map(np.asarray,
+                              am.state_dict_adapter().from_hf(sd, dtype=np.float32))
+        ids = np.arange(1, 17)[None, :] % 128
+        with torch.no_grad():
+            tlog = tm(torch.tensor(ids)).logits.numpy()
+        jlog = np.asarray(am(params, ids))
+        err = float(np.abs(tlog - jlog).max() / np.abs(tlog).max())
+        assert err < 2e-5, f"raw-format cohere2 rel err {err:.2e}"
+
 
 def test_registry_error_carries_alias_failure():
     """The combined error names both the registry miss and the divergent field."""
