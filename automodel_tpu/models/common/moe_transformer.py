@@ -241,7 +241,7 @@ def make_moe_layer_fns(
         lp = jax.tree.map(lambda a: a.astype(dtype), lp)
         h, kv_out = attn(state, lp, is_sliding, kv)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp_block(backend, lp, x, rules)
+        h = h + _mlp_block(cfg, backend, lp, x, rules)
         state = dict(state, h=_constrain(h, rules, ("batch", "act_seq", "act_embed")))
         return state, kv_out
 

@@ -70,6 +70,8 @@ class DenseDecoderConfig:
     norm_placement: str = "pre"
     norm_type: str = "rms"  # "rms" | "layernorm" (mean-centered, no bias — cohere)
     parallel_block: bool = False  # cohere: h + attn(norm(h)) + mlp(norm(h)), ONE norm
+    mlp_gated: bool = True  # False (arcee): down(act(up(x))), no gate matrix
+    mlp_act: str = "silu"  # "silu" | "gelu" | "relu2" (arcee)
     sliding_window: int | None = None
     layer_types: list[str] | None = None  # "full_attention" | "sliding_attention"
     # SmolLM3-style NoPE: per-layer rope enable (HF semantics: 1 = rope ON);
@@ -137,6 +139,8 @@ def _layer_shapes(cfg: DenseDecoderConfig) -> dict[str, tuple[int, ...]]:
         shapes |= {"sinks": (n,)}
     if cfg.parallel_block:
         del shapes["mlp_norm"]  # one shared input norm (cohere)
+    if not cfg.mlp_gated:
+        del shapes["w_gate"]  # arcee: two-matrix ungated MLP
     if cfg.norm_placement == "sandwich":  # glm4: post_self_attn/post_mlp norms
         shapes |= {"attn_post_norm": (d,), "mlp_post_norm": (d,)}
     if cfg.qk_norm_whole:
@@ -393,16 +397,30 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
     return o
 
 
-def _mlp_block(backend: BackendConfig, lp: dict, x, rules):
+_MLP_ACTS = {
+    "silu": jax.nn.silu,
+    "gelu": jax.nn.gelu,
+    "relu2": lambda x: jnp.square(jax.nn.relu(x)),  # arcee
+}
+
+
+def _mlp_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, x, rules):
     from jax.ad_checkpoint import checkpoint_name
 
     lin = backend.linear
-    # names feed the "dots_except_mlp" remat policy (backend.py): these two
-    # (tokens, intermediate) tensors are the activation-memory peak of the layer
-    gate = checkpoint_name(project(x, lp["w_gate"], 1, lin), "mlp_gate")
+    # getattr: family configs outside the dense lineage (MLA) reach this shared
+    # MLP through the MoE dense prefix and carry no mlp_* fields (all gated silu)
+    act_fn = _MLP_ACTS[getattr(cfg, "mlp_act", "silu")]
+    # names feed the "mlp_*" remat policies (backend.py): these (tokens,
+    # intermediate) tensors are the activation-memory peak of the layer
     up = checkpoint_name(project(x, lp["w_up"], 1, lin), "mlp_up")
-    act = _constrain(jax.nn.silu(gate) * up, rules, ("batch", "act_attn_seq", "act_mlp"))
-    return project(act, lp["w_down"], 1, lin)
+    if getattr(cfg, "mlp_gated", True):
+        gate = checkpoint_name(project(x, lp["w_gate"], 1, lin), "mlp_gate")
+        h = act_fn(gate) * up
+    else:  # arcee: down(act(up(x)))
+        h = act_fn(up)
+    h = _constrain(h, rules, ("batch", "act_attn_seq", "act_mlp"))
+    return project(h, lp["w_down"], 1, lin)
 
 
 def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None):
@@ -461,7 +479,7 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
             with jax.named_scope("parallel_block"):
                 x = _block_norm(cfg, h, lp["attn_norm"])
                 attn_out, kv_out = attn_call(x)
-                h = h + attn_out + _mlp_block(backend, lp, x, rules)
+                h = h + attn_out + _mlp_block(cfg, backend, lp, x, rules)
                 h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
             return dict(state, h=h), kv_out
         post = cfg.norm_placement == "post"
@@ -482,7 +500,7 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
             h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
         with jax.named_scope("mlp"):
             x = h if post else _block_norm(cfg, h, lp["mlp_norm"])
-            mlp_out = _mlp_block(backend, lp, x, rules)
+            mlp_out = _mlp_block(cfg, backend, lp, x, rules)
             if post:  # post_feedforward_layernorm
                 mlp_out = _block_norm(cfg, mlp_out, lp["mlp_norm"])
             elif sandwich:  # post_mlp_layernorm

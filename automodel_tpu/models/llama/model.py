@@ -67,6 +67,7 @@ class LlamaConfig(DenseDecoderConfig):
         is_cohere = "Cohere" in archs
         is_glm4 = "Glm4" in archs  # dense glm4 only (Glm4Moe routes to its own family)
         is_glm = "Glm" in archs  # old GLM + Glm4: both use interleaved partial rope
+        is_arcee = "Arcee" in archs  # ungated relu^2 MLP
         return cls(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -91,6 +92,8 @@ class LlamaConfig(DenseDecoderConfig):
             # rope, and a MULTIPLicative logit_scale (== dividing by its inverse)
             norm_type="layernorm" if is_cohere else "rms",
             parallel_block=is_cohere,
+            mlp_gated=not is_arcee,
+            mlp_act="relu2" if is_arcee else "silu",
             rope_interleaved=is_cohere or is_glm,
             sliding_window=hf.get("sliding_window") if hf.get("use_sliding_window", True) else None,
             layer_types=(_cohere2_layer_types(hf) if "Cohere2" in archs

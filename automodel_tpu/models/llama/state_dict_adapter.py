@@ -85,7 +85,8 @@ class LlamaStateDictAdapter(MappingAdapter):
             Entry("model.layers.{i}.self_attn.k_proj.weight", "layers.wk", _proj_in(k, h), _proj_out(k, h)),
             Entry("model.layers.{i}.self_attn.v_proj.weight", "layers.wv", _proj_in(k, h), _proj_out(k, h)),
             Entry("model.layers.{i}.self_attn.o_proj.weight", "layers.wo", _o_in(n, h), _o_out(n, h)),
-            Entry("model.layers.{i}.mlp.gate_proj.weight", "layers.w_gate", _t, _t),
+            *([] if not getattr(cfg, "mlp_gated", True) else [
+                Entry("model.layers.{i}.mlp.gate_proj.weight", "layers.w_gate", _t, _t)]),
             Entry("model.layers.{i}.mlp.up_proj.weight", "layers.w_up", _t, _t),
             Entry("model.layers.{i}.mlp.down_proj.weight", "layers.w_down", _t, _t),
         ]

@@ -32,6 +32,8 @@ MODEL_REGISTRY: dict[str, str] = {
     # Cohere2 (Command R7B) adds the 3:1 sliding pattern with rope ONLY on
     # sliding layers (NoPE full-attention layers via no_rope_layers)
     "Cohere2ForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
+    # Arcee (AFM) = llama + ungated relu^2 MLP
+    "ArceeForCausalLM": "automodel_tpu.models.llama.model:LlamaForCausalLM",
     # GLM-4 dense = llama + sandwich norms + interleaved partial rope + fused
     # gate_up checkpoints (split by its adapter); old GLM (glm-4-9b-chat-hf) is
     # the same minus the sandwich norms and rides the same adapter

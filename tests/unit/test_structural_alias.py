@@ -89,7 +89,6 @@ class TestHonestDivergence:
     """Divergent architectures fail NAMING the structural field, never silently."""
 
     @pytest.mark.parametrize("arch,kw,expect", [
-        ("ArceeForCausalLM", {}, "hidden_act"),             # relu^2 MLP
         ("Starcoder2ForCausalLM", {}, "hidden_act"),        # gelu + LayerNorm
         ("StableLmForCausalLM", {}, "layer_norm_eps"),      # LayerNorm
         ("ApertusForCausalLM", {}, "hidden_act"),           # xIELU
@@ -165,6 +164,9 @@ class TestGraduatedFamilies:
     def test_olmo3_adds_sliding(self):
         self._parity("Olmo3ForCausalLM", num_hidden_layers=4, sliding_window=8)
 
+    def test_arcee_ungated_relu2_mlp(self):
+        self._parity("ArceeForCausalLM")
+
     def test_glm4_sandwich_norms_partial_interleaved_rope(self):
         self._parity("Glm4ForCausalLM")  # defaults: partial_rotary 0.5, sandwich
 
@@ -237,7 +239,7 @@ class TestGraduatedFamilies:
 
 def test_registry_error_carries_alias_failure():
     """The combined error names both the registry miss and the divergent field."""
-    hf = _hf_config("ArceeForCausalLM", **TINY)
+    hf = _hf_config("ApertusForCausalLM", **TINY)
     with pytest.raises(KeyError) as ei:
         AutoModelForCausalLM.from_config(hf)
     msg = str(ei.value)
