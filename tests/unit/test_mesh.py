@@ -93,3 +93,22 @@ class TestMainProcessFirst:
             if should_work:
                 ran.append(1)
         assert ran == [1]
+
+
+class TestLayerFlags:
+    def test_bitfield_semantics(self):
+        """layer_flags packs sliding (bit 0) and NoPE (bit 1) into one int
+        stream so scan/pipeline tuple shapes never change as flags accrue."""
+        from automodel_tpu.models.common.transformer import DenseDecoderConfig
+
+        cfg = DenseDecoderConfig(
+            num_hidden_layers=4, sliding_window=8,
+            layer_types=["sliding_attention", "full_attention",
+                         "sliding_attention", "full_attention"],
+            no_rope_layers=[1, 1, 0, 0],  # HF semantics: 1 = rope ON
+        )
+        # bit0 = sliding, bit1 = NoPE
+        assert cfg.layer_flags == [1, 0, 1 | 2, 2]
+        # all-rope, no sliding degenerates to zeros (the llama fast path)
+        plain = DenseDecoderConfig(num_hidden_layers=2)
+        assert plain.layer_flags == [0, 0]
