@@ -189,6 +189,72 @@ def _dq_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, w_ref, do_ref, lse_ref, del
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
+def _dqdkv_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, w_ref, do_ref, lse_ref,
+                  delta_ref, dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc, *,
+                  scale, causal, block_q, block_k, num_q, num_kv, segmented,
+                  softcap, windowed):
+    """Fused backward: dq, dk, dv off ONE s/p recompute per (q, kv) block pair.
+
+    The split kernels each redo s = qk^T and the dq kernel redoes dp = do v^T,
+    so the split backward runs 7 block matmuls per pair; sharing the recompute
+    cuts that to 5 (s, dp, dq += ds k, dv += p^T do, dk += ds^T q) and halves
+    the q/k/v/do HBM streaming. The price: dk/dv accumulate across the whole
+    per-row grid, so they live as full-(Skv, d) f32 VMEM scratch — the wrapper
+    gates this path on that footprint and falls back to the split kernels.
+    """
+    window = w_ref[0] if windowed else None
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(jnp.logical_and(qi == 0, ki == 0))
+    def _init_kv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(ki == 0)
+    def _init_q():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start, kv_start = qi * block_q, ki * block_k
+
+    @pl.when(_run_block(q_start, kv_start, block_q, block_k, causal=causal, window=window))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = _soft_cap(s, softcap)
+        allowed = _block_mask(
+            q_start, kv_start, block_q, block_k, causal=causal, window=window,
+            seg_q=sq_ref[0, :, :1] if segmented else None,
+            seg_kv=skv_ref[0, :1, :] if segmented else None,
+        )
+        p = jnp.exp(s - lse_ref[0, :, :1])
+        if allowed is not None:
+            p = jnp.where(allowed, p, 0.0)
+        do = do_ref[0].astype(jnp.float32)
+        kv_rows = pl.ds(kv_start, block_k)
+        dv_acc[kv_rows, :] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, :, :1])
+        if softcap is not None:
+            ds = ds * _soft_cap_jac(s, softcap)
+        dq_acc[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32) * scale
+        dk_acc[kv_rows, :] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize_q():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+    @pl.when(jnp.logical_and(qi == num_q - 1, ki == num_kv - 1))
+    def _finalize_kv():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _dkv_kernel(q_ref, k_ref, v_ref, sq_ref, skv_ref, w_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
                 block_q, block_k, num_q, segmented, softcap, windowed):
@@ -273,6 +339,42 @@ def _filter_specs(specs, args):
     return [s for s, _ in keep], [a for _, a in keep]
 
 
+# trace counter for the fused dq+dkv path — lets tests assert the fused kernel
+# actually engaged (the VMEM gate silently falls back to the split kernels)
+_fused_bwd_traces = 0
+
+
+def _make_entry(kernel, segmented, windowed, has_sink=False, sink_slot=False):
+    """Adapter from pallas_call's flat ref list to a kernel's optional-arg
+    signature (q, k, v, seg_q, seg_kv, [sink], window, *rest). `has_sink` says a
+    sink ref is actually present in the flat list; `sink_slot` says the kernel's
+    signature has a sink parameter at all (the fwd kernel takes one even when no
+    sinks input was passed — it receives None)."""
+
+    def entry(*refs):
+        it = iter(refs)
+        q_r, k_r, v_r = next(it), next(it), next(it)
+        sq_r = next(it) if segmented else None
+        skv_r = next(it) if segmented else None
+        sink_r = next(it) if has_sink else None
+        w_r = next(it) if windowed else None
+        if sink_slot:
+            kernel(q_r, k_r, v_r, sq_r, skv_r, sink_r, w_r, *it)
+        else:
+            kernel(q_r, k_r, v_r, sq_r, skv_r, w_r, *it)
+
+    return entry
+
+
+def _gqa_group_sum(dk, dv, groups, k_dtype, v_dtype):
+    """Reduce per-q-head dk/dv (bn, skv, d) over the GQA group -> (bk, skv, d)."""
+    if groups == 1:
+        return dk, dv
+    dk = dk.reshape(-1, groups, *dk.shape[1:]).sum(1).astype(k_dtype)
+    dv = dv.reshape(-1, groups, *dv.shape[1:]).sum(1).astype(v_dtype)
+    return dk, dv
+
+
 def _flash_fwd_impl(q, k, v, seg_q, seg_kv, sinks, warr, scale, causal,
                     softcap, block_q, block_k, groups, interpret):
     """q: (BN, Sq, D); k/v: (BK, Skv, D) with BN = BK * groups.
@@ -292,14 +394,8 @@ def _flash_fwd_impl(q, k, v, seg_q, seg_kv, sinks, warr, scale, causal,
         softcap=softcap, has_sink=has_sink, windowed=windowed,
     )
 
-    def kernel_entry(*refs):
-        it = iter(refs)
-        q_r, k_r, v_r = next(it), next(it), next(it)
-        sq_r = next(it) if segmented else None
-        skv_r = next(it) if segmented else None
-        sink_r = next(it) if has_sink else None
-        w_r = next(it) if windowed else None
-        kernel(q_r, k_r, v_r, sq_r, skv_r, sink_r, w_r, *it)
+    kernel_entry = _make_entry(kernel, segmented, windowed,
+                               has_sink=has_sink, sink_slot=True)
 
     specs, args = _filter_specs(
         _specs(lambda b: b // groups, d, block_q, block_k, segmented, has_sink, windowed),
@@ -355,19 +451,72 @@ def _flash_bwd(scale, causal, softcap, block_q, block_k, groups, interpret,
             pl.BlockSpec((1, bq, LANES), index_q),
         ]
 
+    # Fused dq+dkv path: one kernel, one s/p recompute (5 block matmuls vs the
+    # split kernels' 7, and one q/k/v/do HBM stream instead of two). dk/dv ride
+    # full-(Skv, d) f32 VMEM scratch PLUS full-(Skv, d) output windows, so the
+    # path is gated on that whole resident footprint (f32 scratch pair + the
+    # dk/dv output windows at output dtype); long-context shapes fall back to
+    # the split kernels below. Block tiles / dq scratch / double-buffering are
+    # roughly shape-independent here and covered by the budget's headroom to
+    # the 16MB scoped-VMEM line.
+    fused_kv_bytes = 2 * skv * d * (4 + k.dtype.itemsize)
+    fused_budget = int(os.environ.get("AUTOMODEL_FLASH_FUSED_KV_BYTES", str(8 << 20)))
+    if os.environ.get("AUTOMODEL_FLASH_FUSED_BWD", "1") != "0" and fused_kv_bytes <= fused_budget:
+        block_q_f = min(block_q, int(os.environ.get("AUTOMODEL_FLASH_FUSED_Q_BLOCK", "512")))
+        if sq % block_q_f:
+            # the default (512, capped by block_q — itself a power of two
+            # dividing sq) always divides; only an explicit override can't
+            raise ValueError(
+                f"AUTOMODEL_FLASH_FUSED_Q_BLOCK={block_q_f} must divide seq {sq} "
+                "(a silent fallback here would benchmark the split kernels "
+                "while reporting a fused config)"
+            )
+        global _fused_bwd_traces
+        _fused_bwd_traces += 1
+        num_q_f = sq // block_q_f
+        fused_kernel = functools.partial(
+            _dqdkv_kernel, scale=scale, causal=causal,
+            block_q=block_q_f, block_k=block_k, num_q=num_q_f, num_kv=num_kv,
+            segmented=segmented, softcap=softcap, windowed=windowed,
+        )
+        specs, args = _filter_specs(
+            _specs(lambda b: b // groups, d, block_q_f, block_k, segmented, False, windowed)
+            + row_specs(lambda b, i, j: (b, i, 0), block_q_f),
+            [q, k, v, seg_q, seg_kv, None, warr, do, lse, delta],
+        )
+        dq, dk, dv = pl.pallas_call(
+            _make_entry(fused_kernel, segmented, windowed),
+            grid=(bn, num_q_f, num_kv),
+            in_specs=specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q_f, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, skv, d), lambda b, i, j: (b, 0, 0)),
+                pl.BlockSpec((1, skv, d), lambda b, i, j: (b, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(q.shape, q.dtype),
+                jax.ShapeDtypeStruct((bn, skv, d), k.dtype),
+                jax.ShapeDtypeStruct((bn, skv, d), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q_f, d), jnp.float32),
+                pltpu.VMEM((skv, d), jnp.float32),
+                pltpu.VMEM((skv, d), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+            ),
+            interpret=interpret,
+        )(*args)
+        dk, dv = _gqa_group_sum(dk, dv, groups, k.dtype, v.dtype)
+        return (dq, dk, dv, None, None,
+                _dsinks_from_residuals(sinks, lse, delta), None)
+
     dq_kernel = functools.partial(
         _dq_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, num_kv=num_kv, segmented=segmented,
         softcap=softcap, windowed=windowed,
     )
-
-    def dq_entry(*refs):
-        it = iter(refs)
-        q_r, k_r, v_r = next(it), next(it), next(it)
-        sq_r = next(it) if segmented else None
-        skv_r = next(it) if segmented else None
-        w_r = next(it) if windowed else None
-        dq_kernel(q_r, k_r, v_r, sq_r, skv_r, w_r, *it)
 
     specs, args = _filter_specs(
         _specs(lambda b: b // groups, d, block_q, block_k, segmented, False, windowed)
@@ -375,7 +524,7 @@ def _flash_bwd(scale, causal, softcap, block_q, block_k, groups, interpret,
         [q, k, v, seg_q, seg_kv, None, warr, do, lse, delta],  # None: no sink input in bwd
     )
     dq = pl.pallas_call(
-        dq_entry,
+        _make_entry(dq_kernel, segmented, windowed),
         grid=(bn, num_q, num_kv),
         in_specs=specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -413,14 +562,6 @@ def _flash_bwd(scale, causal, softcap, block_q, block_k, groups, interpret,
         softcap=softcap, windowed=windowed,
     )
 
-    def dkv_entry(*refs):
-        it = iter(refs)
-        q_r, k_r, v_r = next(it), next(it), next(it)
-        sq_r = next(it) if segmented else None
-        skv_r = next(it) if segmented else None
-        w_r = next(it) if windowed else None
-        dkv_kernel(q_r, k_r, v_r, sq_r, skv_r, w_r, *it)
-
     # grid order here is (bn, kv, q): q/do/lse/delta index with the LAST grid dim
     qkv_specs = [
         pl.BlockSpec((1, block_q_kv, d), lambda b, j, i: (b, i, 0)),
@@ -435,7 +576,7 @@ def _flash_bwd(scale, causal, softcap, block_q, block_k, groups, interpret,
         [q, kx, vx, seg_q, skx, warr, do, lse, delta],
     )
     dk, dv = pl.pallas_call(
-        dkv_entry,
+        _make_entry(dkv_kernel, segmented, windowed),
         grid=(bn, num_kv, num_q_kv),
         in_specs=specs,
         out_specs=[
@@ -455,21 +596,21 @@ def _flash_bwd(scale, causal, softcap, block_q, block_k, groups, interpret,
         ),
         interpret=interpret,
     )(*args)
-    if groups > 1:
-        dk = dk.reshape(bk_heads, groups, skv, d).sum(1).astype(k.dtype)
-        dv = dv.reshape(bk_heads, groups, skv, d).sum(1).astype(v.dtype)
-    dsinks = None
-    if sinks is not None:
-        # d loss / d sink_b = -sum_i exp(sink_b - lse_{b,i}) * Delta_{b,i}
-        # (the sink column's p * (dp - Delta) with dp = 0); cheap XLA reduction
-        # over the saved lse + delta. Gradient lands on lane 0, matching the
-        # kernel's sink_ref[0, 0, 0] read; the wrapper's broadcast transposes
-        # the rest away.
-        p_sink = jnp.exp(sinks[:, 0, 0][:, None] - lse[:, :, 0])  # (bn, sq)
-        dsink_rows = -(p_sink * delta[:, :, 0]).sum(-1)  # (bn,)
-        dsinks = jnp.zeros_like(sinks).at[:, 0, 0].set(dsink_rows)
+    dk, dv = _gqa_group_sum(dk, dv, groups, k.dtype, v.dtype)
     dwarr = None
-    return dq, dk, dv, None, None, dsinks, dwarr
+    return dq, dk, dv, None, None, _dsinks_from_residuals(sinks, lse, delta), dwarr
+
+
+def _dsinks_from_residuals(sinks, lse, delta):
+    """d loss / d sink_b = -sum_i exp(sink_b - lse_{b,i}) * Delta_{b,i}
+    (the sink column's p * (dp - Delta) with dp = 0); cheap XLA reduction over
+    the saved lse + delta. Gradient lands on lane 0, matching the kernel's
+    sink_ref[0, 0, 0] read; the wrapper's broadcast transposes the rest away."""
+    if sinks is None:
+        return None
+    p_sink = jnp.exp(sinks[:, 0, 0][:, None] - lse[:, :, 0])  # (bn, sq)
+    dsink_rows = -(p_sink * delta[:, :, 0]).sum(-1)  # (bn,)
+    return jnp.zeros_like(sinks).at[:, 0, 0].set(dsink_rows)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

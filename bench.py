@@ -55,7 +55,10 @@ def _measure(cfg, seq_len: int, micro_batch: int, n_steps: int):
     # (stable over repeats). The ladder: fp32-nu adamw -> remat "none" 11.7k;
     # bf16-nu -> "mlp_gate_dot" 12.0k; factored+bf16 trace -> "mlp_dots"
     # 12.87k; momentum-free -> "mlp_attn_dots" 13.14k; segment-free attention
-    # -> 13.68k. Round-4 dead ends at 4096
+    # -> 13.68k; round-5 fused dq+dkv backward (one s/p recompute feeding all
+    # three grads, 5 bwd block-matmuls instead of 7) -> 14.38k @2048 / 12.78k
+    # @4096 (60.0% / 58.5% MFU). Fused q-block sweep: 512 best (256: -2%,
+    # 1024: scoped-VMEM OOM at 19.6M/16M). Round-4 dead ends at 4096
     # (tools/bench_seq4096_sweep.py): saving q too in remat (-1.3pt, bandwidth),
     # dkv q-block 256 (-2.1pt) or 1024 (+-0), fwd blocks (2048,1024) and
     # micro_batch 3/4 (OOM even with linear-CE — the mlp saved tensors dominate).
