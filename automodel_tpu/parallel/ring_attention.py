@@ -10,19 +10,37 @@ current chunk's attention math.
 
 Causality is enforced by *global* positions (each shard's token positions travel with
 it), so any seq-dim layout works — including the load-balanced interleave the
-reference gets from THD round-robin sharding (cp_utils.py:296-321). Differentiable
-end-to-end (ppermute has a transpose rule), so no custom VJP is needed.
+reference gets from THD round-robin sharding (cp_utils.py:296-321).
+
+Two per-chunk implementations:
+
+- ``flash`` (default): Pallas chunk kernels (ops/pallas/ring_chunk.py) carrying the
+  online-softmax state (acc, m, l) across ring steps in VMEM — no per-chunk
+  (Sq_local x Skv_local) score matrix ever reaches HBM, which is the whole point of
+  CP at long context. The ring is a ``lax.fori_loop`` (O(1) HLO at any cp), wrapped
+  in a custom VJP whose backward runs a second ring: dk/dv accumulators travel WITH
+  their kv chunk and arrive home after cp rotations.
+- ``dense``: the plain-XLA partial-attention path (materializes per-chunk scores;
+  differentiable by plain AD through an unrolled ring). Kept as the fallback for
+  shapes the kernels can't tile and as the parity oracle in tests.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["ring_attention_local", "make_ring_attention"]
+from automodel_tpu.ops.pallas.flash_attention import (
+    LANES,
+    NEG_INF,
+    _kv_sublanes,
+    _q_lanes,
+)
 
-NEG_INF = -1e30
+__all__ = ["ring_attention_local", "make_ring_attention"]
 
 
 def _partial_attention(q, k, v, allowed, scale):
@@ -49,6 +67,123 @@ def _partial_attention(q, k, v, allowed, scale):
     return acc, m, l
 
 
+def _rotate(tree, axis, perm):
+    return jax.tree.map(
+        lambda x: jax.lax.ppermute(x, axis, perm) if x is not None else None,
+        tree, is_leaf=lambda x: x is None,
+    )
+
+
+def _gqa_sum(g, groups):
+    """(BN, S, d) per-q-head grads -> (BK, S, d) kv-row grads."""
+    if groups == 1:
+        return g
+    return g.reshape(-1, groups, *g.shape[1:]).sum(1)
+
+
+# cfg: (axis, causal, window, scale, block_q, block_k, groups, n_heads,
+#       interpret, kv_chunk) — hashable, so it rides nondiff_argnums.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
+def _ring_flash(q, k, v, pq, pkv, sq, skv, cfg):
+    out, _ = _ring_flash_fwd(q, k, v, pq, pkv, sq, skv, cfg)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, pq, pkv, sq, skv, cfg):
+    from automodel_tpu.ops.pallas.ring_chunk import chunk_attention_fwd
+
+    axis, causal, window, scale, bq, bk, groups, nh, interp, _ = cfg
+    cp = jax.lax.axis_size(axis)
+    bn, sqlen, _ = q.shape
+    dv = v.shape[-1]
+    perm = [(j, (j + 1) % cp) for j in range(cp)]
+
+    # pvary: the carry must be marked varying-over-cp like the pallas outputs
+    # that replace it each iteration, or shard_map's vma check rejects the loop
+    acc = jax.lax.pcast(jnp.zeros((bn, sqlen, dv), jnp.float32), axis, to='varying')
+    m = jax.lax.pcast(jnp.full((bn, sqlen, LANES), NEG_INF, jnp.float32), axis, to='varying')
+    l = jax.lax.pcast(jnp.zeros((bn, sqlen, LANES), jnp.float32), axis, to='varying')
+
+    def body(_, carry):
+        kv_bundle, acc, m, l = carry
+        k_i, v_i, pkv_i, skv_i = kv_bundle
+        acc, m, l = chunk_attention_fwd(
+            q, k_i, v_i, pq, pkv_i, sq, skv_i, acc, m, l,
+            scale=scale, causal=causal, window=window, groups=groups,
+            n_heads=nh, block_q=bq, block_k=bk, interpret=interp,
+            vma=frozenset({axis}),
+        )
+        # rotate every step: after cp rotations the bundle is home again, and
+        # an unconditional rotate keeps the loop body collective-uniform
+        return _rotate(kv_bundle, axis, perm), acc, m, l
+
+    _, acc, m, l = jax.lax.fori_loop(0, cp, body, ((k, v, pkv, skv), acc, m, l))
+
+    l0 = l[:, :, :1]
+    out = (acc / jnp.where(l0 == 0.0, 1.0, l0)).astype(q.dtype)
+    lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(jnp.where(l == 0.0, 1.0, l)))
+    return out, (q, k, v, pq, pkv, sq, skv, out, lse)
+
+
+def _ring_flash_bwd(cfg, res, do):
+    from automodel_tpu.ops.pallas.ring_chunk import chunk_attention_bwd
+
+    axis, causal, window, scale, bq, bk, groups, nh, interp, kv_chunk = cfg
+    q, k, v, pq, pkv, sq, skv, out, lse = res
+    cp = jax.lax.axis_size(axis)
+    perm = [(j, (j + 1) % cp) for j in range(cp)]
+    skv_len = k.shape[1]
+    delta = _q_lanes((out.astype(jnp.float32) * do.astype(jnp.float32)).sum(-1))
+
+    # bound the bwd kernel's full-(Skv, d) dk/dv scratch by sub-chunking kv;
+    # each sub-chunk is an independent kernel call (dq partials sum, dk/dv
+    # slices concatenate), so VMEM stays flat in sequence length. The chunk
+    # must hold whole kernel blocks AND tile the local kv length — otherwise
+    # fall back to one full-length chunk.
+    kvc = max(bk, (kv_chunk // bk) * bk) if kv_chunk else skv_len
+    if skv_len % kvc:
+        kvc = skv_len
+
+    def body(_, carry):
+        bundle, dq = carry
+        k_i, v_i, pkv_i, skv_i, dk_i, dv_i = bundle
+        for c in range(skv_len // kvc):
+            rows = slice(c * kvc, (c + 1) * kvc)
+            dq_p, dk_c, dv_c = chunk_attention_bwd(
+                q, k_i[:, rows], v_i[:, rows], pq, pkv_i[:, :, rows], sq,
+                None if skv_i is None else skv_i[:, :, rows], do, lse, delta,
+                scale=scale, causal=causal, window=window, groups=groups,
+                n_heads=nh, block_q=bq, block_k=bk, interpret=interp,
+                vma=frozenset({axis}),
+            )
+            dq = dq + dq_p
+            dk_i = dk_i.at[:, rows].add(_gqa_sum(dk_c, groups))
+            dv_i = dv_i.at[:, rows].add(_gqa_sum(dv_c, groups))
+        # dk/dv travel WITH their kv chunk; after cp rotations they are home
+        return _rotate((k_i, v_i, pkv_i, skv_i, dk_i, dv_i), axis, perm), dq
+
+    dq0 = jax.lax.pcast(jnp.zeros(q.shape, jnp.float32), axis, to='varying')
+    dk0 = jax.lax.pcast(jnp.zeros(k.shape, jnp.float32), axis, to='varying')
+    dv0 = jax.lax.pcast(jnp.zeros(v.shape, jnp.float32), axis, to='varying')
+    bundle, dq = jax.lax.fori_loop(
+        0, cp, body, ((k, v, pkv, skv, dk0, dv0), dq0)
+    )
+    _, _, _, _, dk, dv = bundle
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None, None, None, None)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def _pick_block(seq, target):
+    """Largest power-of-two block <= target dividing seq (>= 8); 0 if none."""
+    b = 1 << (max(min(target, seq), 8).bit_length() - 1)
+    while b > 8 and seq % b:
+        b //= 2
+    return b if seq % b == 0 else 0
+
+
 def ring_attention_local(
     q: jnp.ndarray,  # (B, Sq_local, N, D)
     k: jnp.ndarray,  # (B, Skv_local, K, D)
@@ -62,6 +197,11 @@ def ring_attention_local(
     causal: bool = True,
     sliding_window: int | None = None,
     softmax_scale: float | None = None,
+    impl: str | None = None,  # "flash" | "dense" | None = auto
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,  # None = auto (True off-TPU)
+    kv_chunk: int = 4096,
 ) -> jnp.ndarray:
     """The per-shard body — call inside shard_map manual over ``axis``."""
     cp = jax.lax.axis_size(axis)
@@ -70,8 +210,40 @@ def ring_attention_local(
     kh = k.shape[2]
     g = n // kh
     scale = softmax_scale if softmax_scale is not None else d**-0.5
-    perm = [(j, (j + 1) % cp) for j in range(cp)]
+    if impl not in (None, "flash", "dense"):
+        raise ValueError(f"unknown ring impl {impl!r} (flash | dense | None=auto)")
 
+    if impl is None or impl == "flash":
+        bq = _pick_block(sq, block_q or 1024)
+        bk = _pick_block(k.shape[1], block_k or 1024)
+        flash_ok = bq > 0 and bk > 0
+        if impl == "flash" and not flash_ok:
+            raise ValueError(
+                f"ring flash needs power-of-two-tileable local seqs, got "
+                f"sq={sq}, skv={k.shape[1]}"
+            )
+        if flash_ok:
+            if interpret is None:
+                interpret = jax.default_backend() != "tpu"
+            # rows: (B, S, H, D) -> (B*H, S, D); kv heads stay un-repeated
+            qf = q.transpose(0, 2, 1, 3).reshape(b * n, sq, d)
+            kf = k.transpose(0, 2, 1, 3).reshape(b * kh, k.shape[1], d)
+            vf = v.transpose(0, 2, 1, 3).reshape(b * kh, v.shape[1], dv)
+            pq = _q_lanes(positions_q.astype(jnp.int32))
+            pkv = _kv_sublanes(positions_kv.astype(jnp.int32))
+            sq_ids = skv_ids = None
+            if segment_ids_q is not None or segment_ids_kv is not None:
+                a = segment_ids_q if segment_ids_q is not None else segment_ids_kv
+                c = segment_ids_kv if segment_ids_kv is not None else segment_ids_q
+                sq_ids = _q_lanes(a.astype(jnp.int32))
+                skv_ids = _kv_sublanes(c.astype(jnp.int32))
+            cfg = (axis, causal, sliding_window, scale, bq, bk, g, n,
+                   interpret, kv_chunk)
+            o = _ring_flash(qf, kf, vf, pq, pkv, sq_ids, skv_ids, cfg)
+            return o.reshape(b, n, sq, dv).transpose(0, 2, 1, 3)
+
+    # dense fallback: plain-XLA partials, unrolled ring, plain AD
+    perm = [(j, (j + 1) % cp) for j in range(cp)]
     acc = jnp.zeros((b, kh, g, sq, dv), jnp.float32)
     m = jnp.full((b, kh, g, sq), NEG_INF, jnp.float32)
     l = jnp.zeros((b, kh, g, sq), jnp.float32)
@@ -104,10 +276,7 @@ def ring_attention_local(
         m = m_new
 
         if step < cp - 1:
-            kv = jax.tree.map(
-                lambda x: jax.lax.ppermute(x, axis, perm) if x is not None else None,
-                kv, is_leaf=lambda x: x is None,
-            )
+            kv = _rotate(kv, axis, perm)
 
     out = acc / jnp.where(l == 0.0, 1.0, l)[..., None]  # (b, kh, g, sq, dv)
     return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, n, dv).astype(q.dtype)
@@ -120,6 +289,9 @@ def make_ring_attention(
     causal: bool = True,
     sliding_window: int | None = None,
     softmax_scale: float | None = None,
+    impl: str | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ):
     """Wrap :func:`ring_attention_local` in a partial-manual shard_map over ``cp``.
 
@@ -127,6 +299,9 @@ def make_ring_attention(
     GSPMD-managed). Returns ``fn(q, k, v, positions, segment_ids=None) -> out``.
     """
 
+    # jit: eager shard_map dispatch rejects partial-manual + check_vma=False;
+    # the traced path (the only one models ever take) is fine
+    @jax.jit
     def fn(q, k, v, positions, segment_ids=None):
         seq_spec = P(None, cp_axis)
 
@@ -136,6 +311,7 @@ def make_ring_attention(
                 segment_ids, segment_ids,
                 axis=cp_axis, causal=causal,
                 sliding_window=sliding_window, softmax_scale=softmax_scale,
+                impl=impl, block_q=block_q, block_k=block_k,
             )
 
         return jax.shard_map(
@@ -150,6 +326,14 @@ def make_ring_attention(
             ),
             out_specs=P(None, cp_axis, None, None),
             axis_names={cp_axis},
+            # interpret-mode pallas lowering internally mixes varying and
+            # unvarying operands (dynamic_slice), which the vma checker
+            # rejects; JAX's own error message prescribes check_vma=False.
+            # Unconditional (not interpret-only) on purpose: flipping the
+            # check on for the real-TPU path would ship a configuration no
+            # test environment here can exercise (cp needs >1 chip) —
+            # revisit when a multi-chip TPU runner exists
+            check_vma=False,
         )(q, k, v, positions, segment_ids)
 
     return fn
