@@ -72,7 +72,14 @@ class AutoModelForCausalLM:
         on devices with its PartitionSpec — per-tensor host->device streaming, never a
         full replicated copy (reference load-before-shard rules,
         _transformers/infrastructure.py:397-403).
+
+        ``path`` may be a local HF directory or a hub repo id
+        (``meta-llama/Llama-3.2-1B``): ids resolve through a process-0-first
+        snapshot download (models/hub.py; reference model_init.py:194).
         """
+        from automodel_tpu.models.hub import resolve_pretrained_path
+
+        path = resolve_pretrained_path(path)
         config = load_hf_config(path)
         model = cls.from_config(config, backend)
         if not return_params:

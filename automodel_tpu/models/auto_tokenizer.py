@@ -30,6 +30,16 @@ class AutoTokenizer:
 
         import os
 
+        from automodel_tpu.models.hub import (
+            TOKENIZER_PATTERNS, looks_like_repo_id, resolve_pretrained_path,
+        )
+
+        if looks_like_repo_id(path):
+            # hub ids resolve process-0-first like model weights (models/hub.py)
+            # so the mistral-file sniffing below sees real local files;
+            # tokenizer-only patterns: don't pull the weight shards
+            path = resolve_pretrained_path(path, allow_patterns=TOKENIZER_PATTERNS)
+
         if find_mistral_tokenizer_file(path):
             has_hf = os.path.isfile(os.path.join(path, "tokenizer.json")) or os.path.isfile(
                 os.path.join(path, "tokenizer_config.json")

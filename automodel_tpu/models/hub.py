@@ -1,0 +1,95 @@
+"""HF Hub resolution: accept ``org/name`` repo ids anywhere a local HF
+directory is accepted (reference pre-downloads on rank 0,
+_transformers/model_init.py:194, so ``pretrained_model_name_or_path:
+meta-llama/Llama-3.2-1B`` just works day-0).
+
+Multi-host protocol: process 0 downloads first while every other process
+waits at a cross-host barrier, then the others resolve — a no-op cache hit
+when the HF cache is on a shared filesystem, an uncontended per-host download
+when it is not (TPU pods usually have per-host local disk; either topology
+works, and the barrier prevents N processes thundering the Hub for the same
+blobs)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["resolve_pretrained_path", "looks_like_repo_id"]
+
+# org/name or bare name: hub id segments are [\w.-]+, at most one slash, and a
+# path that exists on disk always wins over the hub interpretation
+_REPO_ID_RE = re.compile(r"^[A-Za-z0-9][\w.-]*(/[\w.-]+)?$")
+
+# config + weights + tokenizer assets; skips .bin/.pt duplicates, images, etc.
+_DEFAULT_PATTERNS = ("*.json", "*.safetensors", "*.model", "*.txt",
+                     "tokenizer*", "*.tiktoken")
+# tokenizer-only resolution must not pull the weight shards
+TOKENIZER_PATTERNS = ("*.json", "*.model", "*.txt", "tokenizer*", "*.tiktoken")
+
+
+def looks_like_repo_id(path_or_id: str) -> bool:
+    return bool(_REPO_ID_RE.match(path_or_id)) and not os.path.exists(path_or_id)
+
+
+def resolve_pretrained_path(path_or_id: str, *, revision: str | None = None,
+                            allow_patterns=_DEFAULT_PATTERNS) -> str:
+    """Local directory -> itself; HF repo id -> local snapshot directory."""
+    if os.path.isdir(path_or_id):
+        return path_or_id
+    if not looks_like_repo_id(path_or_id):
+        raise FileNotFoundError(
+            f"{path_or_id!r} is neither a local HF model directory nor a "
+            "hub repo id (expected 'org/name')"
+        )
+    return _download(path_or_id, revision=revision, allow_patterns=allow_patterns)
+
+
+def _snapshot_download(repo_id: str, revision=None, allow_patterns=None) -> str:
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as exc:  # pragma: no cover - hub ships with transformers
+        raise ImportError(
+            f"loading {repo_id!r} from the HF Hub needs huggingface_hub; "
+            "pass a local directory instead"
+        ) from exc
+    return snapshot_download(repo_id, revision=revision, allow_patterns=allow_patterns)
+
+
+def _download(repo_id: str, *, revision, allow_patterns) -> str:
+    idx, n_proc = _process_topology()
+    fetch = lambda: _snapshot_download(  # noqa: E731
+        repo_id, revision=revision, allow_patterns=allow_patterns
+    )
+    if n_proc == 1:
+        return fetch()
+    if idx == 0:
+        logger.info("process 0 downloading %s from the HF Hub", repo_id)
+        try:
+            return fetch()
+        finally:
+            # reach the barrier even when the download raises (404/auth/
+            # network): otherwise every other process hangs in
+            # sync_global_devices until the coordination timeout instead of
+            # the job surfacing process 0's clean exception
+            _barrier(f"hub_download:{repo_id}")
+    _barrier(f"hub_download:{repo_id}")
+    return fetch()  # cache hit on shared fs; per-host fetch otherwise
+
+
+def _process_topology() -> tuple[int, int]:
+    import jax
+
+    try:
+        return jax.process_index(), jax.process_count()
+    except RuntimeError:  # backend not initialized (e.g. pure-host tooling)
+        return 0, 1
+
+
+def _barrier(name: str) -> None:
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

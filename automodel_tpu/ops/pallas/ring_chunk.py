@@ -47,6 +47,47 @@ def _sds(shape, dtype, vma):
     return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
 
+def _make_entry(kernel, segmented):
+    """Flat pallas ref list -> kernel(q, k, v, pq, pkv, seg_q|None, seg_kv|None, *rest)."""
+
+    def entry(*refs):
+        it = iter(refs)
+        q_r, k_r, v_r, pq_r, pkv_r = (next(it) for _ in range(5))
+        sq_r = next(it) if segmented else None
+        skv_r = next(it) if segmented else None
+        kernel(q_r, k_r, v_r, pq_r, pkv_r, sq_r, skv_r, *it)
+
+    return entry
+
+
+def _qkv_pos_specs(q, k, v, pos_q, pos_kv, seg_q, seg_kv, *,
+                   block_q, block_k, groups, n_heads):
+    """Shared (in_specs, args) prefix for both chunk kernels: q/k/v blocks with
+    GQA via the b // groups index map, per-batch positions/segments via the
+    b // n_heads map (no HBM repeats)."""
+    d = q.shape[-1]
+    dv = v.shape[-1]
+
+    def batch_of(b):
+        return b // n_heads
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
+        pl.BlockSpec((1, block_k, dv), lambda b, i, j: (b // groups, j, 0)),
+        pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (batch_of(b), i, 0)),
+        pl.BlockSpec((1, SUBLANES, block_k), lambda b, i, j: (batch_of(b), 0, j)),
+    ]
+    args = [q, k, v, pos_q, pos_kv]
+    if seg_q is not None:
+        in_specs += [
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (batch_of(b), i, 0)),
+            pl.BlockSpec((1, SUBLANES, block_k), lambda b, i, j: (batch_of(b), 0, j)),
+        ]
+        args += [seg_q, seg_kv]
+    return in_specs, args
+
+
 def _pos_mask(pq, pkv, sq, skv, *, causal, window, segmented):
     """(bq, bk) allowed-mask from position/segment tiles; None when unmasked.
 
@@ -124,33 +165,10 @@ def chunk_attention_fwd(q, k, v, pos_q, pos_kv, seg_q, seg_kv, acc, m, l, *,
         _chunk_fwd_kernel, scale=scale, causal=causal, window=window,
         num_kv=num_kv, segmented=segmented,
     )
-
-    def entry(*refs):
-        it = iter(refs)
-        q_r, k_r, v_r, pq_r, pkv_r = (next(it) for _ in range(5))
-        sq_r = next(it) if segmented else None
-        skv_r = next(it) if segmented else None
-        kernel(q_r, k_r, v_r, pq_r, pkv_r, sq_r, skv_r, *it)
-
-    # positions/segments are per-batch (B, ...) and shared across heads: index
-    # maps divide the row id instead of materializing repeats in HBM
-    def batch_of(b):
-        return b // n_heads
-
-    in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
-        pl.BlockSpec((1, block_k, dv), lambda b, i, j: (b // groups, j, 0)),
-        pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (batch_of(b), i, 0)),
-        pl.BlockSpec((1, SUBLANES, block_k), lambda b, i, j: (batch_of(b), 0, j)),
-    ]
-    args = [q, k, v, pos_q, pos_kv]
-    if segmented:
-        in_specs += [
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (batch_of(b), i, 0)),
-            pl.BlockSpec((1, SUBLANES, block_k), lambda b, i, j: (batch_of(b), 0, j)),
-        ]
-        args += [seg_q, seg_kv]
+    in_specs, args = _qkv_pos_specs(
+        q, k, v, pos_q, pos_kv, seg_q, seg_kv,
+        block_q=block_q, block_k=block_k, groups=groups, n_heads=n_heads,
+    )
     carry_specs = [
         pl.BlockSpec((1, block_q, dv), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
@@ -158,7 +176,7 @@ def chunk_attention_fwd(q, k, v, pos_q, pos_kv, seg_q, seg_kv, acc, m, l, *,
     ]
     base = len(args)  # index of acc among the call operands
     return pl.pallas_call(
-        entry,
+        _make_entry(kernel, segmented),
         grid=(bn, num_q, num_kv),
         in_specs=in_specs + carry_specs,
         out_specs=carry_specs,
@@ -255,38 +273,17 @@ def chunk_attention_bwd(q, k, v, pos_q, pos_kv, seg_q, seg_kv, do, lse, delta, *
         _chunk_bwd_kernel, scale=scale, causal=causal, window=window,
         num_q=num_q, num_kv=num_kv, segmented=segmented,
     )
-
-    def entry(*refs):
-        it = iter(refs)
-        q_r, k_r, v_r, pq_r, pkv_r = (next(it) for _ in range(5))
-        sq_r = next(it) if segmented else None
-        skv_r = next(it) if segmented else None
-        kernel(q_r, k_r, v_r, pq_r, pkv_r, sq_r, skv_r, *it)
-
-    def batch_of(b):
-        return b // n_heads
-
-    in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
-        pl.BlockSpec((1, block_k, dv), lambda b, i, j: (b // groups, j, 0)),
-        pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (batch_of(b), i, 0)),
-        pl.BlockSpec((1, SUBLANES, block_k), lambda b, i, j: (batch_of(b), 0, j)),
-    ]
-    args = [q, k, v, pos_q, pos_kv]
-    if segmented:
-        in_specs += [
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (batch_of(b), i, 0)),
-            pl.BlockSpec((1, SUBLANES, block_k), lambda b, i, j: (batch_of(b), 0, j)),
-        ]
-        args += [seg_q, seg_kv]
+    in_specs, args = _qkv_pos_specs(
+        q, k, v, pos_q, pos_kv, seg_q, seg_kv,
+        block_q=block_q, block_k=block_k, groups=groups, n_heads=n_heads,
+    )
     in_specs += [
         pl.BlockSpec((1, block_q, dv), lambda b, i, j: (b, i, 0)),   # do
         pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),  # lse
         pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),  # delta
     ]
     dq, dk, dv_out = pl.pallas_call(
-        entry,
+        _make_entry(kernel, segmented),
         grid=(bn, num_q, num_kv),
         in_specs=in_specs,
         out_specs=[

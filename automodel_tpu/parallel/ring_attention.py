@@ -121,7 +121,12 @@ def _ring_flash_fwd(q, k, v, pq, pkv, sq, skv, cfg):
 
     l0 = l[:, :, :1]
     out = (acc / jnp.where(l0 == 0.0, 1.0, l0)).astype(q.dtype)
-    lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(jnp.where(l == 0.0, 1.0, l)))
+    # save lse COMPACT (bn, sq, 1): every lane is identical by construction,
+    # and the residual lives from fwd to bwd — a LANES-broadcast copy here
+    # would 128x the per-layer activation memory at exactly the long-context
+    # sizes CP exists for; the bwd re-broadcasts transiently
+    lse = jnp.where(l0 == 0.0, NEG_INF,
+                    m[:, :, :1] + jnp.log(jnp.where(l0 == 0.0, 1.0, l0)))
     return out, (q, k, v, pq, pkv, sq, skv, out, lse)
 
 
@@ -133,6 +138,7 @@ def _ring_flash_bwd(cfg, res, do):
     cp = jax.lax.axis_size(axis)
     perm = [(j, (j + 1) % cp) for j in range(cp)]
     skv_len = k.shape[1]
+    lse = jnp.broadcast_to(lse, (*lse.shape[:2], LANES))  # compact -> lanes
     delta = _q_lanes((out.astype(jnp.float32) * do.astype(jnp.float32)).sum(-1))
 
     # bound the bwd kernel's full-(Skv, d) dk/dv scratch by sub-chunking kv;

@@ -1,0 +1,124 @@
+"""HF Hub id resolution (models/hub.py) — fully offline: the download itself is
+monkeypatched; what's under test is id-vs-path routing and the process-0-first
+multi-host protocol (reference pre-downloads on rank 0, model_init.py:194)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import automodel_tpu.models.hub as hub
+from automodel_tpu.models.hub import looks_like_repo_id, resolve_pretrained_path
+
+
+class TestRepoIdDetection:
+    def test_org_name_is_repo_id(self):
+        assert looks_like_repo_id("meta-llama/Llama-3.2-1B")
+        assert looks_like_repo_id("gpt2")
+
+    def test_paths_are_not(self, tmp_path):
+        assert not looks_like_repo_id(str(tmp_path))  # exists
+        assert not looks_like_repo_id("/abs/missing/dir")
+        assert not looks_like_repo_id("a/b/c")
+        assert not looks_like_repo_id("./rel")
+
+    def test_existing_dir_wins_over_id_shape(self, tmp_path):
+        # a directory literally named like a repo id resolves as the directory
+        d = tmp_path / "org" / "name"
+        d.mkdir(parents=True)
+        old = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            assert not looks_like_repo_id("org/name")
+            assert resolve_pretrained_path("org/name") == "org/name"
+        finally:
+            os.chdir(old)
+
+
+class TestResolution:
+    def test_local_dir_passthrough_no_download(self, tmp_path, monkeypatch):
+        def boom(*a, **k):
+            raise AssertionError("must not download for a local dir")
+
+        monkeypatch.setattr(hub, "_download", boom)
+        assert resolve_pretrained_path(str(tmp_path)) == str(tmp_path)
+
+    def test_repo_id_downloads(self, tmp_path, monkeypatch):
+        calls = []
+
+        def fake_snapshot(repo_id, revision=None, allow_patterns=None):
+            calls.append((repo_id, revision, tuple(allow_patterns)))
+            return str(tmp_path / "snap")
+
+        monkeypatch.setattr(hub, "_snapshot_download", fake_snapshot)
+        got = resolve_pretrained_path("org/model-x", revision="abc123")
+        assert got == str(tmp_path / "snap")
+        assert calls == [("org/model-x", "abc123", hub._DEFAULT_PATTERNS)]
+
+    def test_garbage_raises(self):
+        with pytest.raises(FileNotFoundError, match="neither a local"):
+            resolve_pretrained_path("/no/such/dir")
+        with pytest.raises(FileNotFoundError):
+            resolve_pretrained_path("too/many/segments")
+
+
+class TestProcessZeroGating:
+    def _run(self, monkeypatch, idx, n):
+        events = []
+        monkeypatch.setattr(hub, "_process_topology", lambda: (idx, n))
+        monkeypatch.setattr(hub, "_barrier", lambda name: events.append("barrier"))
+        monkeypatch.setattr(
+            hub, "_snapshot_download",
+            lambda *a, **k: (events.append("download"), "/cache/snap")[1],
+        )
+        out = resolve_pretrained_path("org/m")
+        assert out == "/cache/snap"
+        return events
+
+    def test_single_process_no_barrier(self, monkeypatch):
+        assert self._run(monkeypatch, 0, 1) == ["download"]
+
+    def test_process_zero_downloads_then_barriers(self, monkeypatch):
+        assert self._run(monkeypatch, 0, 4) == ["download", "barrier"]
+
+    def test_other_processes_barrier_then_resolve(self, monkeypatch):
+        assert self._run(monkeypatch, 3, 4) == ["barrier", "download"]
+
+
+class TestFromPretrainedWithHubId(object):
+    def test_auto_model_loads_via_fake_cache(self, tmp_path, monkeypatch):
+        """End-to-end: a hub id resolves to a (fake) snapshot dir and the
+        normal local from_pretrained path loads it."""
+        import jax.numpy as jnp
+        import ml_dtypes
+        import safetensors.numpy
+
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+        from automodel_tpu.models.common.backend import BackendConfig
+
+        cfg = {
+            "architectures": ["LlamaForCausalLM"],
+            "vocab_size": 64, "hidden_size": 16, "intermediate_size": 32,
+            "num_hidden_layers": 1, "num_attention_heads": 2,
+            "num_key_value_heads": 2, "max_position_embeddings": 32,
+            "tie_word_embeddings": False,
+        }
+        snap = tmp_path / "models--org--tiny" / "snapshots" / "rev"
+        snap.mkdir(parents=True)
+        (snap / "config.json").write_text(json.dumps(cfg))
+        model = AutoModelForCausalLM.from_config(cfg, BackendConfig(dtype="float32"))
+        params = model.init(__import__("jax").random.key(0), jnp.float32)
+        tensors = model.state_dict_adapter().to_hf(params)
+        safetensors.numpy.save_file(
+            {k: np.asarray(v) for k, v in tensors.items()},
+            str(snap / "model.safetensors"),
+        )
+        monkeypatch.setattr(hub, "_snapshot_download", lambda *a, **k: str(snap))
+
+        model2, params2 = AutoModelForCausalLM.from_pretrained(
+            "org/tiny", BackendConfig(dtype="float32"), dtype=jnp.float32
+        )
+        np.testing.assert_array_equal(
+            np.asarray(params2["embed"]), np.asarray(params["embed"])
+        )
