@@ -60,36 +60,20 @@ def _snapshot_download(repo_id: str, revision=None, allow_patterns=None) -> str:
 
 
 def _download(repo_id: str, *, revision, allow_patterns) -> str:
-    idx, n_proc = _process_topology()
-    fetch = lambda: _snapshot_download(  # noqa: E731
-        repo_id, revision=revision, allow_patterns=allow_patterns
-    )
-    if n_proc == 1:
-        return fetch()
-    if idx == 0:
-        logger.info("process 0 downloading %s from the HF Hub", repo_id)
-        try:
-            return fetch()
-        finally:
-            # reach the barrier even when the download raises (404/auth/
-            # network): otherwise every other process hangs in
-            # sync_global_devices until the coordination timeout instead of
-            # the job surfacing process 0's clean exception
-            _barrier(f"hub_download:{repo_id}")
-    _barrier(f"hub_download:{repo_id}")
-    return fetch()  # cache hit on shared fs; per-host fetch otherwise
+    """main_process_first (parallel/init.py) is the whole protocol: process 0
+    fetches before the rest proceed, its barrier is reached even when the
+    download raises (so an error can't strand peers in sync_global_devices),
+    and the others then hit the shared-fs cache or fetch per-host uncontended.
 
+    Caveat: the topology comes from ``jax.process_count()``, so on multi-host
+    this must run AFTER ``jax.distributed.initialize`` (the recipes do) — a
+    bare script calling from_pretrained pre-init sees one process per host and
+    every host downloads concurrently (correct, just uncoordinated)."""
+    from automodel_tpu.parallel.init import main_process_first
 
-def _process_topology() -> tuple[int, int]:
-    import jax
-
-    try:
-        return jax.process_index(), jax.process_count()
-    except RuntimeError:  # backend not initialized (e.g. pure-host tooling)
-        return 0, 1
-
-
-def _barrier(name: str) -> None:
-    from jax.experimental import multihost_utils
-
-    multihost_utils.sync_global_devices(name)
+    with main_process_first(f"hub_download:{repo_id}") as is_main:
+        if is_main:
+            logger.info("downloading %s from the HF Hub", repo_id)
+        return _snapshot_download(
+            repo_id, revision=revision, allow_patterns=allow_patterns
+        )

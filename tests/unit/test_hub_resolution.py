@@ -64,10 +64,19 @@ class TestResolution:
 
 
 class TestProcessZeroGating:
+    """The download rides parallel.init.main_process_first; fake the process
+    topology at that layer and record the barrier/download interleaving."""
+
     def _run(self, monkeypatch, idx, n):
+        import jax
+
+        import automodel_tpu.parallel.init as dist_init
+
         events = []
-        monkeypatch.setattr(hub, "_process_topology", lambda: (idx, n))
-        monkeypatch.setattr(hub, "_barrier", lambda name: events.append("barrier"))
+        monkeypatch.setattr(jax, "process_index", lambda: idx)
+        monkeypatch.setattr(jax, "process_count", lambda: n)
+        monkeypatch.setattr(dist_init, "barrier",
+                            lambda name="barrier": events.append("barrier"))
         monkeypatch.setattr(
             hub, "_snapshot_download",
             lambda *a, **k: (events.append("download"), "/cache/snap")[1],
