@@ -69,9 +69,16 @@ class DenseDecoderConfig:
     # | "sandwich" (glm4/gemma2 style: input norm AND a second norm on the output)
     norm_placement: str = "pre"
     norm_type: str = "rms"  # "rms" | "layernorm" (mean-centered, no bias — cohere)
+    norm_bias: bool = False  # starcoder2/stablelm: LayerNorm with learnable bias
+    norm_param: bool = True  # False (olmo-v1): non-parametric LayerNorm (no weight)
     parallel_block: bool = False  # cohere: h + attn(norm(h)) + mlp(norm(h)), ONE norm
     mlp_gated: bool = True  # False (arcee): down(act(up(x))), no gate matrix
     mlp_act: str = "silu"  # "silu" | "gelu" | "relu2" (arcee)
+    mlp_bias: bool = False  # starcoder2: bias terms on the MLP projections
+    clip_qkv: float | None = None  # olmo: clamp q/k/v projection outputs
+    # ungated-MLP HF tensor names when they differ from up_proj/down_proj
+    # (starcoder2: c_fc/c_proj); None = llama names
+    hf_mlp_names: tuple[str, str] | None = None
     sliding_window: int | None = None
     layer_types: list[str] | None = None  # "full_attention" | "sliding_attention"
     # SmolLM3-style NoPE: per-layer rope enable (HF semantics: 1 = rope ON);
@@ -141,6 +148,17 @@ def _layer_shapes(cfg: DenseDecoderConfig) -> dict[str, tuple[int, ...]]:
         del shapes["mlp_norm"]  # one shared input norm (cohere)
     if not cfg.mlp_gated:
         del shapes["w_gate"]  # arcee: two-matrix ungated MLP
+    if cfg.mlp_bias:
+        shapes |= {"b_up": (i,), "b_down": (d,)}
+        if cfg.mlp_gated:
+            shapes |= {"b_gate": (i,)}
+    if cfg.norm_bias:
+        shapes |= {k + "_b": (d,) for k in ("attn_norm", "mlp_norm") if k in shapes}
+    if not cfg.norm_param:
+        # olmo-v1: LayerNorm with NO learnable weight — the params simply
+        # don't exist (a trainable ones-init would drift from HF semantics)
+        for k in ("attn_norm", "mlp_norm"):
+            shapes.pop(k, None)
     if cfg.norm_placement == "sandwich":  # glm4: post_self_attn/post_mlp norms
         shapes |= {"attn_post_norm": (d,), "mlp_post_norm": (d,)}
     if cfg.qk_norm_whole:
@@ -172,6 +190,11 @@ _LAYER_AXES = {
     "w_gate": ("embed", "mlp"),
     "w_up": ("embed", "mlp"),
     "w_down": ("mlp", "embed"),
+    "b_gate": ("mlp",),
+    "b_up": ("mlp",),
+    "b_down": ("embed",),
+    "attn_norm_b": ("norm",),
+    "mlp_norm_b": ("norm",),
 }
 
 
@@ -191,7 +214,9 @@ def init_dense_decoder_params(
 
     layers = {}
     for idx, (name, shape) in enumerate(shapes.items()):
-        if name.endswith("norm"):
+        if name.endswith("_b"):  # norm biases init to zero like linear biases
+            layers[name] = jnp.zeros((L, *shape), dtype)
+        elif name.endswith("norm"):
             layers[name] = jnp.ones((L, *shape), dtype)
         elif name.startswith("b"):
             layers[name] = jnp.zeros((L, *shape), dtype)
@@ -201,8 +226,11 @@ def init_dense_decoder_params(
     params = {
         "embed": (jax.random.normal(keys[-2], (cfg.vocab_size, cfg.hidden_size), jnp.float32) * std).astype(dtype),
         "layers": layers,
-        "final_norm": jnp.ones((cfg.hidden_size,), dtype),
     }
+    if cfg.norm_param:
+        params["final_norm"] = jnp.ones((cfg.hidden_size,), dtype)
+    if cfg.norm_bias:
+        params["final_norm_b"] = jnp.zeros((cfg.hidden_size,), dtype)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = (
             jax.random.normal(keys[-1], (cfg.hidden_size, cfg.vocab_size), jnp.float32) * std
@@ -221,8 +249,11 @@ def dense_decoder_logical_axes(cfg: DenseDecoderConfig, scan_layers: bool = True
     axes = {
         "embed": ("vocab", "embed"),
         "layers": layers,
-        "final_norm": ("norm",),
     }
+    if cfg.norm_param:
+        axes["final_norm"] = ("norm",)
+    if cfg.norm_bias:
+        axes["final_norm_b"] = ("norm",)
     if not cfg.tie_word_embeddings:
         axes["lm_head"] = ("embed", "vocab")
     return axes
@@ -249,23 +280,38 @@ def embed_lookup(table, input_ids, dtype, rules=None, scale: float = 1.0):
     return h
 
 
-def _centered_norm(x, w, eps):
-    """Mean-centered LayerNorm without bias (CohereLayerNorm): works for (d,)
+def _centered_norm(x, w, eps, b=None):
+    """Mean-centered LayerNorm (CohereLayerNorm and friends): works for (d,)
     block weights and per-head (n, h) qk weights alike (stats over last dim).
-    The weight multiply stays in fp32 before the downcast, matching HF."""
+    ``w=None`` is the non-parametric form (olmo-v1); ``b`` the optional bias
+    (starcoder2/stablelm). Affine math stays in fp32 before the downcast,
+    matching HF."""
     xf = x.astype(jnp.float32)
     mean = xf.mean(-1, keepdims=True)
     var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
-    out = (xf - mean) * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if w is not None:
+        out = out * w.astype(jnp.float32)
+    if b is not None:
+        out = out + b.astype(jnp.float32)
     return out.astype(x.dtype)
 
 
-def _block_norm(cfg, x, w):
+def apply_final_norm(cfg, params, h, dtype):
+    """Top-level final norm shared by decoder_forward and the pipeline head —
+    weight/bias may be absent (olmo-v1 non-parametric LN / no-bias families)."""
+    w = params.get("final_norm")
+    b = params.get("final_norm_b")
+    return _block_norm(cfg, h, None if w is None else w.astype(dtype),
+                       None if b is None else b.astype(dtype))
+
+
+def _block_norm(cfg, x, w, b=None):
     """The block-level norm the config selects (rms | mean-centered LN).
     getattr: family configs outside the dense lineage (MLA) reach here via the
     shared pipeline head and carry no norm_type — they are all RMSNorm."""
     if getattr(cfg, "norm_type", "rms") == "layernorm":
-        return _centered_norm(x, w, cfg.rms_norm_eps)
+        return _centered_norm(x, w, cfg.rms_norm_eps, b)
     return rms_norm(x, w, cfg.rms_norm_eps)
 
 
@@ -321,6 +367,9 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
         q = q + lp["bq"]
         k = k + lp["bk"]
         v = v + lp["bv"]
+    if getattr(cfg, "clip_qkv", None) is not None:
+        c = cfg.clip_qkv  # olmo: clamp projection outputs (post-bias, like HF)
+        q, k, v = (jnp.clip(t, -c, c) for t in (q, k, v))
     if cfg.qk_norm_whole:
         # olmo2: RMSNorm over the flattened projection — mean over (heads,
         # head_dim) jointly, weight (n, h) == the flat HF (n*h,) weight reshaped
@@ -399,7 +448,8 @@ def _attention_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, 
 
 _MLP_ACTS = {
     "silu": jax.nn.silu,
-    "gelu": jax.nn.gelu,
+    "gelu": jax.nn.gelu,  # tanh approximation (HF "gelu_pytorch_tanh")
+    "gelu_exact": lambda x: jax.nn.gelu(x, approximate=False),  # HF bare "gelu"
     "relu2": lambda x: jnp.square(jax.nn.relu(x)),  # arcee
 }
 
@@ -414,13 +464,20 @@ def _mlp_block(cfg: DenseDecoderConfig, backend: BackendConfig, lp: dict, x, rul
     # names feed the "mlp_*" remat policies (backend.py): these (tokens,
     # intermediate) tensors are the activation-memory peak of the layer
     up = checkpoint_name(project(x, lp["w_up"], 1, lin), "mlp_up")
+    if getattr(cfg, "mlp_bias", False):
+        up = up + lp["b_up"]
     if getattr(cfg, "mlp_gated", True):
         gate = checkpoint_name(project(x, lp["w_gate"], 1, lin), "mlp_gate")
+        if getattr(cfg, "mlp_bias", False):
+            gate = gate + lp["b_gate"]
         h = act_fn(gate) * up
-    else:  # arcee: down(act(up(x)))
+    else:  # arcee/starcoder2: down(act(up(x)))
         h = act_fn(up)
     h = _constrain(h, rules, ("batch", "act_attn_seq", "act_mlp"))
-    return project(h, lp["w_down"], 1, lin)
+    out = project(h, lp["w_down"], 1, lin)
+    if getattr(cfg, "mlp_bias", False):
+        out = out + lp["b_down"]
+    return out
 
 
 def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None):
@@ -477,7 +534,7 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
             # cohere: ONE input norm feeds attention AND the MLP; both outputs
             # add to the residual together
             with jax.named_scope("parallel_block"):
-                x = _block_norm(cfg, h, lp["attn_norm"])
+                x = _block_norm(cfg, h, lp.get("attn_norm"), lp.get("attn_norm_b"))
                 attn_out, kv_out = attn_call(x)
                 h = h + attn_out + _mlp_block(cfg, backend, lp, x, rules)
                 h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
@@ -488,10 +545,10 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
             # post (olmo2): attention reads h RAW; attn_norm applies to the
             # sublayer OUTPUT before the residual add (post_attention_layernorm).
             # sandwich (glm4): input norm AND a post norm on the output.
-            x = h if post else _block_norm(cfg, h, lp["attn_norm"])
+            x = h if post else _block_norm(cfg, h, lp.get("attn_norm"), lp.get("attn_norm_b"))
             attn_out, kv_out = attn_call(x)
             if post:
-                attn_out = _block_norm(cfg, attn_out, lp["attn_norm"])
+                attn_out = _block_norm(cfg, attn_out, lp.get("attn_norm"), lp.get("attn_norm_b"))
             elif sandwich:  # post_self_attn_layernorm
                 attn_out = _block_norm(cfg, attn_out, lp["attn_post_norm"])
             if cfg.residual_multiplier != 1.0:  # granite
@@ -499,10 +556,10 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
             h = h + attn_out
             h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
         with jax.named_scope("mlp"):
-            x = h if post else _block_norm(cfg, h, lp["mlp_norm"])
+            x = h if post else _block_norm(cfg, h, lp.get("mlp_norm"), lp.get("mlp_norm_b"))
             mlp_out = _mlp_block(cfg, backend, lp, x, rules)
             if post:  # post_feedforward_layernorm
-                mlp_out = _block_norm(cfg, mlp_out, lp["mlp_norm"])
+                mlp_out = _block_norm(cfg, mlp_out, lp.get("mlp_norm"), lp.get("mlp_norm_b"))
             elif sandwich:  # post_mlp_layernorm
                 mlp_out = _block_norm(cfg, mlp_out, lp["mlp_post_norm"])
             if cfg.residual_multiplier != 1.0:
@@ -595,7 +652,7 @@ def decoder_forward(
     state, cache = out if cache is not None else (out, None)
     h = state["h"]
 
-    h = _block_norm(cfg, h, params["final_norm"].astype(dtype))
+    h = apply_final_norm(cfg, params, h, dtype)
     if cache is not None:
         # next-token logits ONLY (B, 1, V): unembedding the whole prefill chunk
         # would materialize a (B, S_prompt, V) tensor — an HBM spike at exactly

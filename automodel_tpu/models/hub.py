@@ -69,8 +69,18 @@ def _download(repo_id: str, *, revision, allow_patterns) -> str:
     this must run AFTER ``jax.distributed.initialize`` (the recipes do) — a
     bare script calling from_pretrained pre-init sees one process per host and
     every host downloads concurrently (correct, just uncoordinated)."""
+    import jax
+
     from automodel_tpu.parallel.init import main_process_first
 
+    try:
+        jax.process_count()  # probe: raises when no backend can initialize
+    except RuntimeError:
+        # pure-host tooling, or a TPU already locked by a running job —
+        # degrade to a plain single-process download
+        return _snapshot_download(
+            repo_id, revision=revision, allow_patterns=allow_patterns
+        )
     with main_process_first(f"hub_download:{repo_id}") as is_main:
         if is_main:
             logger.info("downloading %s from the HF Hub", repo_id)

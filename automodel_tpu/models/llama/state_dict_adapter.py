@@ -69,27 +69,47 @@ class LlamaStateDictAdapter(MappingAdapter):
     def __init__(self, cfg: DenseDecoderConfig, scan_layers: bool = True):
         n, k, h = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
         post = getattr(cfg, "norm_placement", "pre") == "post"
+        gated = getattr(cfg, "mlp_gated", True)
+        # ungated families may rename the two MLP projections (starcoder2: c_fc/c_proj)
+        up_name, down_name = getattr(cfg, "hf_mlp_names", None) or ("up_proj", "down_proj")
+        attn_norm_key = ("model.layers.{i}.post_attention_layernorm"
+                         if post else "model.layers.{i}.input_layernorm")
+        mlp_norm_key = ("model.layers.{i}.post_feedforward_layernorm"
+                        if post else "model.layers.{i}.post_attention_layernorm")
+        has_mlp_norm = not getattr(cfg, "parallel_block", False)
         entries = [
             Entry("model.embed_tokens.weight", "embed"),
-            Entry("model.norm.weight", "final_norm"),
-            # olmo2 post-norm blocks have no input_layernorm: attn_norm holds
-            # post_attention_layernorm and mlp_norm post_feedforward_layernorm
-            Entry("model.layers.{i}.post_attention_layernorm.weight"
-                  if post else "model.layers.{i}.input_layernorm.weight",
-                  "layers.attn_norm"),
-            *([] if getattr(cfg, "parallel_block", False) else [
-                Entry("model.layers.{i}.post_feedforward_layernorm.weight"
-                      if post else "model.layers.{i}.post_attention_layernorm.weight",
-                      "layers.mlp_norm")]),
+            # olmo-v1 (norm_param=False): LayerNorms carry NO weights at all
+            *([Entry("model.norm.weight", "final_norm"),
+               Entry(attn_norm_key + ".weight", "layers.attn_norm"),
+               *([Entry(mlp_norm_key + ".weight", "layers.mlp_norm")]
+                 if has_mlp_norm else [])]
+              if getattr(cfg, "norm_param", True) else []),
             Entry("model.layers.{i}.self_attn.q_proj.weight", "layers.wq", _proj_in(n, h), _proj_out(n, h)),
             Entry("model.layers.{i}.self_attn.k_proj.weight", "layers.wk", _proj_in(k, h), _proj_out(k, h)),
             Entry("model.layers.{i}.self_attn.v_proj.weight", "layers.wv", _proj_in(k, h), _proj_out(k, h)),
             Entry("model.layers.{i}.self_attn.o_proj.weight", "layers.wo", _o_in(n, h), _o_out(n, h)),
-            *([] if not getattr(cfg, "mlp_gated", True) else [
+            *([] if not gated else [
                 Entry("model.layers.{i}.mlp.gate_proj.weight", "layers.w_gate", _t, _t)]),
-            Entry("model.layers.{i}.mlp.up_proj.weight", "layers.w_up", _t, _t),
-            Entry("model.layers.{i}.mlp.down_proj.weight", "layers.w_down", _t, _t),
+            Entry(f"model.layers.{{i}}.mlp.{up_name}.weight", "layers.w_up", _t, _t),
+            Entry(f"model.layers.{{i}}.mlp.{down_name}.weight", "layers.w_down", _t, _t),
         ]
+        if getattr(cfg, "norm_bias", False):
+            entries += [
+                Entry("model.norm.bias", "final_norm_b"),
+                Entry(attn_norm_key + ".bias", "layers.attn_norm_b"),
+                *([Entry(mlp_norm_key + ".bias", "layers.mlp_norm_b")]
+                  if has_mlp_norm else []),
+            ]
+        if getattr(cfg, "mlp_bias", False):
+            entries += [
+                *([] if not gated else [
+                    Entry("model.layers.{i}.mlp.gate_proj.bias", "layers.b_gate")]),
+                Entry(f"model.layers.{{i}}.mlp.{up_name}.bias", "layers.b_up"),
+                Entry(f"model.layers.{{i}}.mlp.{down_name}.bias", "layers.b_down"),
+            ]
+        if getattr(cfg, "attention_out_bias", False):
+            entries.append(Entry("model.layers.{i}.self_attn.o_proj.bias", "layers.bo"))
         if getattr(cfg, "norm_placement", "pre") == "sandwich":
             entries += [
                 Entry("model.layers.{i}.post_self_attn_layernorm.weight",

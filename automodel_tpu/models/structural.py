@@ -52,10 +52,62 @@ _DENYLIST = {
 # Code-level deltas that ARE expressible as dense-decoder config knobs but are
 # invisible in the arch's config.json — verified by the logits-parity suite.
 # (Helium/Ernie rotate consecutive element pairs where llama rotates the
-# half-split; both implementations exist in ops/rope.py.)
+# half-split; both implementations exist in ops/rope.py.) Values are either a
+# static dict or a callable(hf_config) -> dict for deltas that read config
+# values the llama from_hf doesn't consume.
 _ARCH_DELTAS = {
     "HeliumForCausalLM": {"rope_interleaved": True},
     "Ernie4_5ForCausalLM": {"rope_interleaved": True},
+    # OLMo v1: NON-PARAMETRIC LayerNorm (no weight, no bias, eps pinned 1e-5
+    # in code — transformers OlmoLayerNorm) + optional qkv clamping
+    "OlmoForCausalLM": lambda hf: {
+        "norm_type": "layernorm", "norm_param": False, "rms_norm_eps": 1e-5,
+        "clip_qkv": hf.get("clip_qkv"),
+    },
+    # Starcoder2: affine LayerNorm (weight+bias), ungated c_fc/c_proj MLP with
+    # tanh-gelu, biases on every linear (use_bias)
+    "Starcoder2ForCausalLM": lambda hf: {
+        "norm_type": "layernorm", "norm_bias": True,
+        "rms_norm_eps": hf.get("norm_epsilon", 1e-5),
+        "mlp_gated": False,
+        # HF "gelu_pytorch_tanh" == our tanh-approx "gelu"; bare HF "gelu" is
+        # the EXACT erf form — mapping it to the tanh approximation would
+        # diverge ~1e-3, far past the parity bar
+        "mlp_act": ("gelu_exact" if hf.get("hidden_act") == "gelu" else "gelu"),
+        "hf_mlp_names": ("c_fc", "c_proj"),
+        "mlp_bias": bool(hf.get("use_bias", True)),
+        "attention_bias": bool(hf.get("use_bias", True)),
+        "attention_out_bias": bool(hf.get("use_bias", True)),
+    },
+    # StableLM: affine LayerNorm + partial rope (partial_rotary_factor is
+    # consumed by from_hf) + optional parallel residual / qkv bias
+    "StableLmForCausalLM": lambda hf: {
+        "norm_type": "layernorm", "norm_bias": True,
+        "rms_norm_eps": hf.get("layer_norm_eps", 1e-5),
+        "attention_bias": bool(hf.get("use_qkv_bias", False)),
+        "parallel_block": bool(hf.get("use_parallel_residual", False)),
+    },
+}
+
+# Per-arch extra config fields the delta itself consumes (bypassing the
+# generic gates); each maps to a predicate over the value so a checkpoint
+# with an UNEXPECTED value still fails loudly instead of silently mis-mapping.
+_ARCH_FIELDS = {
+    "OlmoForCausalLM": {"clip_qkv": lambda v: True},
+    "Starcoder2ForCausalLM": {
+        "use_bias": lambda v: True,
+        "norm_epsilon": lambda v: True,
+        "hidden_act": lambda v: v in ("gelu_pytorch_tanh", "gelu"),
+        "residual_dropout": lambda v: not v,
+        "embedding_dropout": lambda v: not v,
+    },
+    "StableLmForCausalLM": {
+        "use_qkv_bias": lambda v: True,
+        "use_parallel_residual": lambda v: True,
+        "layer_norm_eps": lambda v: True,
+        # per-head qk LayerNorm (stablelm-2-12b) is NOT mapped; default False
+        # checkpoints pass, qk_layernorm=True fails via the generic gate
+    },
 }
 
 # rope_scaling variants ops/rope.py:26 implements bit-for-bit
@@ -153,11 +205,19 @@ _GATED = {
 }
 
 
-def classify_config(hf: dict) -> list[str]:
+def classify_config(hf: dict, architecture: str | None = None) -> list[str]:
     """Return a list of human-readable divergences (empty == llama delta)."""
+    arch_fields = _ARCH_FIELDS.get(architecture, {})
     problems = []
     for key, value in hf.items():
         if key in _CONSUMED or key in _COSMETIC or key.startswith("_"):
+            continue
+        arch_gate = arch_fields.get(key)
+        if arch_gate is not None:
+            if not arch_gate(value):
+                problems.append(
+                    f"{key}={value!r} (outside the {architecture} delta's "
+                    "supported range)")
             continue
         gate = _GATED.get(key)
         if gate is None:
@@ -185,10 +245,12 @@ def resolve_llama_delta(architecture: str, hf: dict, backend=None):
             f"{architecture} is not a causal-LM architecture; structural "
             "auto-aliasing covers *ForCausalLM configs only."
         )
-    problems = classify_config(hf)
-    if "rms_norm_eps" not in hf:
-        # OLMo-v1-style configs omit it because the model is NOT RMSNorm; an
-        # absent field is as structural as a wrong one
+    raw_delta = _ARCH_DELTAS.get(architecture, {})
+    overrides = dict(raw_delta(hf) if callable(raw_delta) else raw_delta)
+    problems = classify_config(hf, architecture)
+    if "rms_norm_eps" not in hf and "norm_type" not in overrides:
+        # configs that omit it are usually NOT RMSNorm; an absent field is as
+        # structural as a wrong one — unless the arch delta pins the norm type
         problems.insert(0, "rms_norm_eps missing (norm type unknown — the "
                            "llama lineage is parametric RMSNorm)")
     if problems:
@@ -201,7 +263,6 @@ def resolve_llama_delta(architecture: str, hf: dict, backend=None):
     from automodel_tpu.models.llama.model import LlamaConfig, LlamaForCausalLM
 
     cfg = LlamaConfig.from_hf(hf)  # consumes partial_rotary_factor directly
-    overrides = dict(_ARCH_DELTAS.get(architecture, {}))
     if hf.get("qk_norm") or hf.get("use_qk_norm"):
         overrides["qk_norm"] = True
     if overrides:

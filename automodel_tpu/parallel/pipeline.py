@@ -303,9 +303,9 @@ def _make_head_loss(cfg, dtype, loss_name: str = "masked_ce"):
 def _head_pre(cfg, dtype, other, h):
     """Final-norm + unembed (transformer.resolve_unembed: tied fallback +
     granite logits_scaling) — shared by every pp loss/composition."""
-    from automodel_tpu.models.common.transformer import _block_norm, resolve_unembed
+    from automodel_tpu.models.common.transformer import apply_final_norm, resolve_unembed
 
-    h = _block_norm(cfg, h, other["final_norm"].astype(dtype))
+    h = apply_final_norm(cfg, other, h, dtype)
     return h, resolve_unembed(cfg, other, dtype)
 
 

@@ -89,10 +89,10 @@ class TestHonestDivergence:
     """Divergent architectures fail NAMING the structural field, never silently."""
 
     @pytest.mark.parametrize("arch,kw,expect", [
-        ("Starcoder2ForCausalLM", {}, "hidden_act"),        # gelu + LayerNorm
-        ("StableLmForCausalLM", {}, "layer_norm_eps"),      # LayerNorm
-        ("ApertusForCausalLM", {}, "hidden_act"),           # xIELU
-        ("OlmoForCausalLM", {}, "rms_norm_eps"),            # non-parametric LN
+        # starcoder2/stablelm/olmo-v1 graduated in round 5; these still diverge
+        ("ApertusForCausalLM", {}, "hidden_act"),            # xIELU
+        ("StableLmForCausalLM", {"qk_layernorm": True}, "qk_layernorm"),
+        ("Starcoder2ForCausalLM", {"hidden_act": "relu"}, "hidden_act"),
     ])
     def test_divergent_arch_fails_naming_field(self, arch, kw, expect):
         hf = _hf_config(arch, **TINY, **kw)
@@ -173,6 +173,32 @@ class TestGraduatedFamilies:
     def test_old_glm_no_sandwich(self):
         # glm-4-9b-chat-hf lineage: same family minus the sandwich norms
         self._parity("GlmForCausalLM")
+
+    # -- round-5 graduations (previously named-fail archs) -------------------
+
+    def test_olmo_v1_nonparam_layernorm(self):
+        # the whole point: LayerNorm with NO learnable weight/bias, eps pinned
+        # in code; clip_qkv exercises the clamp branch with a biting value
+        self._parity("OlmoForCausalLM", clip_qkv=0.08)
+
+    def test_olmo_v1_without_clip(self):
+        self._parity("OlmoForCausalLM")
+
+    def test_starcoder2_ln_bias_gelu_mqa(self):
+        # affine LN (weight+bias), ungated c_fc/c_proj tanh-gelu MLP, biases on
+        # every linear, tied embeddings — all defaults of the real config
+        self._parity("Starcoder2ForCausalLM")
+
+    def test_starcoder2_no_bias_variant(self):
+        self._parity("Starcoder2ForCausalLM", use_bias=False)
+
+    def test_stablelm_partial_rope_ln(self):
+        # partial_rotary_factor 0.25 default + affine LN + qkv bias
+        self._parity("StableLmForCausalLM", use_qkv_bias=True)
+
+    def test_stablelm_parallel_residual(self):
+        # stablelm-alpha style: x + attn(ln(x)) + mlp(ln(x)) with ONE norm
+        self._parity("StableLmForCausalLM", use_parallel_residual=True)
 
     def test_glm4_fused_gate_up_roundtrip(self):
         """to_hf re-fuses gate|up into mlp.gate_up_proj and from_hf splits it
