@@ -16,10 +16,13 @@ forms are what the functional suite (and any air-gapped machine) uses.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import random
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "json2token",
@@ -49,7 +52,15 @@ def _load(path_or_dataset: str, split: str):
     if os.path.isdir(path_or_dataset):
         loaded = datasets.load_from_disk(path_or_dataset)
         if isinstance(loaded, datasets.DatasetDict):
-            loaded = loaded[split]
+            return loaded[split]
+        if split != "train":
+            # a bare save_to_disk dir carries no split structure: the caller
+            # asked for a specific split we cannot select — say so instead of
+            # silently serving whatever rows were saved
+            logger.warning(
+                "%s is a single-split on-disk dataset; requested split %r "
+                "cannot be selected and ALL saved rows are used",
+                path_or_dataset, split)
         return loaded
     return datasets.load_dataset(path_or_dataset, split=split)
 
@@ -65,7 +76,7 @@ def _image_array(img) -> np.ndarray:
 
 
 def make_rdr_dataset(path_or_dataset: str = "quintend/rdr-items",
-                     split: str = "train", limit: int | None = None, **kwargs):
+                     split: str = "train", limit: int | None = None):
     """Image-captioning rows (reference make_rdr_dataset, datasets.py:24):
     image + "Describe this image." -> caption text."""
     rows = []
@@ -82,7 +93,7 @@ def make_rdr_dataset(path_or_dataset: str = "quintend/rdr-items",
 
 def make_cord_v2_dataset(path_or_dataset: str = "naver-clova-ix/cord-v2",
                          split: str = "train", limit: int | None = None,
-                         seed: int = 0, **kwargs):
+                         seed: int = 0):
     """CORD-v2 receipt parsing (reference make_cord_v2_dataset,
     datasets.py:58): the ground-truth JSON parse flattens to the Donut token
     string; multiple gt_parses pick one at random (seeded — the reference uses
@@ -118,7 +129,7 @@ def _resample_to_16k(wave: np.ndarray, sr: int) -> np.ndarray:
 
 
 def make_cv17_dataset(path_or_dataset: str = "ysdede/commonvoice_17_tr_fixed",
-                      split: str = "train", limit: int | None = None, **kwargs):
+                      split: str = "train", limit: int | None = None):
     """CommonVoice-17 speech transcription (reference make_cv17_dataset,
     datasets.py:120): audio clip -> transcription; waveforms land as raw
     16kHz float arrays (the omni collate's "audio" contract)."""

@@ -53,7 +53,10 @@ class TrainBiencoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             )
         )
 
-    def _forward_loss(self, params, batch, num_label_tokens, training=True):
+    def _scores_and_labels(self, params, batch):
+        """(scores (B, B*G) fp32 already temperature-scaled, labels (B,)) —
+        the contrastive core shared by the train loss and the retrieval-metric
+        validation (reference contrastive_scores_and_labels)."""
         bc = self.cfg.get("biencoder") or ConfigNode()
         temperature = float(bc.get("temperature", 0.02))
         normalize = bool(bc.get("normalize", True))
@@ -79,10 +82,60 @@ class TrainBiencoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             cols = jnp.arange(b * group)[None, :]
             own = (cols // group) == jnp.arange(b)[:, None]
             scores = jnp.where(own, scores, -jnp.inf)
+        return scores, labels
+
+    def _forward_loss(self, params, batch, num_label_tokens, training=True):
+        scores, labels = self._scores_and_labels(params, batch)
         logp = jax.nn.log_softmax(scores, axis=-1)
         nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
         # num_label_tokens = global query count (labels are all valid)
         return nll.sum() / jnp.maximum(num_label_tokens, 1).astype(jnp.float32)
+
+    def _run_validation(self, step: int):
+        """Validation with retrieval metrics (reference _run_validation epoch,
+        train_biencoder.py:408: val_loss + acc@1 + MRR; recall@k added on top):
+        the positive's rank within each query's score row yields acc@1
+        (recall@1), recall@k, and reciprocal rank, summed per batch in-jit and
+        aggregated across hosts by the shared val logger."""
+        bc = self.cfg.get("biencoder") or ConfigNode()
+        recall_k = int(bc.get("recall_k", 5))
+        if getattr(self, "_bi_eval_step", None) is None:
+
+            def eval_fn(params, batch, frozen=None):
+                if self.peft is not None:
+                    # PEFT shape: params is the LoRA tree, frozen the base —
+                    # merge exactly like the train/eval steps do
+                    from automodel_tpu.peft.lora import merge_lora_params
+
+                    params = merge_lora_params(frozen, params, self.peft)
+                scores, labels = self._scores_and_labels(params, batch)
+                logp = jax.nn.log_softmax(scores, axis=-1)
+                pos = jnp.take_along_axis(
+                    scores, labels[:, None].astype(jnp.int32), axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+                # rank = 1 + number of strictly-better columns (ties favor us,
+                # matching torch.topk's first-occurrence convention closely
+                # enough for distinct fp32 scores)
+                rank = 1 + (scores > pos).sum(-1)
+                return (nll.sum(), (rank == 1).sum(), (rank <= recall_k).sum(),
+                        (1.0 / rank.astype(jnp.float32)).sum())
+
+            self._bi_eval_step = jax.jit(eval_fn)
+        loss_t = acc1_t = hitk_t = rr_t = 0.0
+        nq = 0
+        extra = (self.params,) if self.peft is not None else ()
+        for batch in self._iter_val_batches():
+            l, a1, hk, rr = self._bi_eval_step(self.train_params, batch, *extra)
+            loss_t += float(l)
+            acc1_t += float(a1)
+            hitk_t += float(hk)
+            rr_t += float(rr)
+            nq += int(batch["q_ids"].shape[0])
+        self._log_val_loss(step, loss_t, nq, extra_sums={
+            "val_acc1": acc1_t, f"val_recall_at_{recall_k}": hitk_t,
+            "val_mrr": rr_t,
+        })
 
     def encode(self, texts: list[str], batch_size: int = 32, seq_len: int | None = None):
         """Embed texts with the current tower (mine_hard_negatives uses this)."""

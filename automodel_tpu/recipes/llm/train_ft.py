@@ -762,24 +762,32 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             if state is not None and hasattr(dl, "load_state_dict"):
                 dl.load_state_dict(state)
 
-    def _log_val_loss(self, step: int, total: float, count: float):
+    def _log_val_loss(self, step: int, total: float, count: float,
+                      extra_sums: dict[str, float] | None = None):
         """Token-weighted mean aggregated across the pod: each process sees a
         different dataloader shard, so a host-local mean would log a different
         val_loss per host (reference allreduces val loss the same way,
-        train_ft.py:1456)."""
+        train_ft.py:1456). ``extra_sums``: additional per-example metric SUMS
+        sharing ``count`` as denominator (biencoder acc@1/recall@k/MRR) —
+        summed across hosts like the loss."""
+        extra_sums = extra_sums or {}
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
             agg = multihost_utils.process_allgather(
-                jnp.asarray([total, float(count)], jnp.float64)
+                jnp.asarray([total, float(count), *extra_sums.values()], jnp.float64)
             )
             total, count = float(agg[:, 0].sum()), float(agg[:, 1].sum())
+            extra_sums = {k: float(agg[:, 2 + i].sum())
+                          for i, k in enumerate(extra_sums)}
         if count:
             val_loss = total / count
-            self.val_metric_logger.log(step, val_loss=val_loss)
+            extras = {k: v / count for k, v in extra_sums.items()}
+            self.val_metric_logger.log(step, val_loss=val_loss, **extras)
             for lg in self.experiment_loggers:
-                lg.log(step, val_loss=val_loss)
-            logger.info("validation @ step %d: loss %.4f", step, val_loss)
+                lg.log(step, val_loss=val_loss, **extras)
+            logger.info("validation @ step %d: loss %.4f%s", step, val_loss,
+                        "".join(f" | {k} {v:.4f}" for k, v in extras.items()))
             # best-checkpoint tracking (reference base_recipe.py:383-425): save
             # the improving step and point the `best` symlink at it. The
             # improvement decision is made on process 0 and broadcast — per-host
