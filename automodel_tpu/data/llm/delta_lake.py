@@ -35,15 +35,7 @@ def _is_unity_catalog_name(path: str) -> bool:
 
 def _read_rows(path: str, version: int | None, limit: int | None) -> list[dict]:
     if _is_unity_catalog_name(path):
-        if not _has("databricks.sql"):
-            raise ImportError(
-                f"{path!r} looks like a Unity-Catalog table; "
-                "pip install databricks-sql-connector to read it"
-            )
-        raise NotImplementedError(
-            "Unity-Catalog access needs workspace credentials; pass a table URI "
-            "(file/s3/gs path) instead, or read it to JSONL first"
-        )
+        return _read_unity_catalog(path, version, limit)
     if _has("deltalake"):
         from deltalake import DeltaTable
 
@@ -55,7 +47,10 @@ def _read_rows(path: str, version: int | None, limit: int | None) -> list[dict]:
         from pyspark.sql import SparkSession
 
         spark = SparkSession.builder.getOrCreate()
-        df = spark.read.format("delta").load(path)
+        reader = spark.read.format("delta")
+        if version is not None:  # honor the pin like the other two readers
+            reader = reader.option("versionAsOf", int(version))
+        df = reader.load(path)
         if limit:
             df = df.limit(limit)
         return [r.asDict() for r in df.collect()]
@@ -63,6 +58,54 @@ def _read_rows(path: str, version: int | None, limit: int | None) -> list[dict]:
         "reading Delta tables needs a reader: pip install deltalake "
         "(or pyspark / databricks-sql-connector)"
     )
+
+
+def _read_unity_catalog(name: str, version: int | None, limit: int | None,
+                        connect=None) -> list[dict]:
+    """catalog.schema.table via databricks-sql (reference delta_lake_dataset's
+    UC branch). Credentials ride the standard Databricks env vars —
+    DATABRICKS_SERVER_HOSTNAME, DATABRICKS_HTTP_PATH, DATABRICKS_TOKEN — the
+    same contract databricks-sql-connector documents. ``connect`` is a test
+    seam defaulting to databricks.sql.connect."""
+    import os
+
+    if connect is None:
+        if not _has("databricks.sql"):
+            raise ImportError(
+                f"{name!r} looks like a Unity-Catalog table; "
+                "pip install databricks-sql-connector to read it"
+            )
+        from databricks import sql as dbsql
+
+        connect = dbsql.connect
+    missing = [v for v in ("DATABRICKS_SERVER_HOSTNAME", "DATABRICKS_HTTP_PATH",
+                           "DATABRICKS_TOKEN") if not os.environ.get(v)]
+    if missing:
+        raise EnvironmentError(
+            f"Unity-Catalog table {name!r} needs workspace credentials: "
+            f"set {', '.join(missing)} (or pass a file/s3/gs table URI instead)"
+        )
+    # backtick-quote each identifier part: hyphenated names parse, and a
+    # config value can't smuggle SQL past the three-part gate into a query
+    # that runs with the user's workspace token
+    parts = name.split(".")
+    if any("`" in p or not p for p in parts):
+        raise ValueError(f"invalid Unity-Catalog table name {name!r}")
+    quoted = ".".join(f"`{p}`" for p in parts)
+    query = f"SELECT * FROM {quoted}"
+    if version is not None:
+        query += f" VERSION AS OF {int(version)}"
+    if limit:
+        query += f" LIMIT {int(limit)}"
+    with connect(
+        server_hostname=os.environ["DATABRICKS_SERVER_HOSTNAME"],
+        http_path=os.environ["DATABRICKS_HTTP_PATH"],
+        access_token=os.environ["DATABRICKS_TOKEN"],
+    ) as conn:
+        with conn.cursor() as cur:
+            cur.execute(query)
+            cols = [d[0] for d in cur.description]
+            return [dict(zip(cols, row)) for row in cur.fetchall()]
 
 
 class DeltaLakeDataset:

@@ -21,6 +21,20 @@ import time
 import numpy as np
 
 
+def device_peak_tflops(device: str) -> float:
+    """bf16 peak for MFU math; warns and assumes v5e on unknown devices
+    (shared by bench.py and the tools/ bench scripts)."""
+    peaks = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0, "v4": 275.0, "v6": 918.0}
+    peak = next((v for k, v in peaks.items() if k in device.lower()), None)
+    if peak is None:
+        import sys
+
+        print(f"WARNING: unknown device {device!r}; assuming v5e 197 TFLOP peak "
+              "(mfu/vs_baseline unreliable)", file=sys.stderr)
+        peak = 197.0
+    return peak
+
+
 def llama_flops_per_token(cfg, seq_len: int) -> float:
     """Training FLOPs/token (fwd+bwd = 3x fwd) incl. attention quadratic term."""
     d, i, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
@@ -125,14 +139,7 @@ def main():
     tps_4k = _measure(cfg, seq_len=4096, micro_batch=2, n_steps=10)
 
     device = str(jax.devices()[0])
-    peaks = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0, "v4": 275.0, "v6": 918.0}
-    peak = next((v for k, v in peaks.items() if k in device.lower()), None)
-    if peak is None:
-        import sys
-
-        print(f"WARNING: unknown device {device!r}; assuming v5e 197 TFLOP peak "
-              "(mfu/vs_baseline unreliable)", file=sys.stderr)
-        peak = 197.0
+    peak = device_peak_tflops(device)
 
     f_2k = llama_flops_per_token(cfg, 2048)
     f_4k = llama_flops_per_token(cfg, 4096)

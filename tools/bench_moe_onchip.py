@@ -104,9 +104,10 @@ def main():
 
     cfg = Qwen3MoeConfig.from_hf(dict(PROXY_CFG, max_position_embeddings=seq_len))
     f_tok = flops_per_token(cfg, seq_len)
+    from bench import device_peak_tflops
+
     device = str(jax.devices()[0])
-    peaks = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0, "v4": 275.0, "v6": 918.0}
-    peak = next((v for k, v in peaks.items() if k in device.lower()), 197.0)
+    peak = device_peak_tflops(device)
     mfu = tps_dense * f_tok / 1e12 / peak
     ref_mfu = 277.0 / 989.0  # reference Qwen3-MoE-30B on 8xH100
 
