@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 import time
 from typing import Any, IO
@@ -26,19 +27,33 @@ class MetricsSample:
     def to_json(self) -> str:
         rec = {"step": self.step, "ts": round(self.timestamp, 3)}
         for k, v in self.metrics.items():
-            rec[k] = _jsonable(v)
-        return json.dumps(rec)
+            v, nonfinite = _jsonable(v)
+            rec[k] = v
+            if nonfinite:
+                # a NaN loss row must stay machine-readable: the value itself
+                # becomes null (bare NaN/Infinity is invalid JSON and breaks
+                # every json.loads consumer) and the flag records what happened
+                rec[f"{k}_nonfinite"] = True
+        # allow_nan=False: any non-finite float that slips past _jsonable fails
+        # loudly here instead of corrupting the stream
+        return json.dumps(rec, allow_nan=False)
 
 
-def _jsonable(v: Any) -> Any:
+def _jsonable(v: Any) -> tuple[Any, bool]:
+    """(json-safe value, had-nonfinite-floats) — non-finite floats become None."""
     ndim = getattr(v, "ndim", None)
     if ndim == 0:
         v = v.item()
     elif ndim is not None and hasattr(v, "tolist"):
-        return v.tolist()
+        v = v.tolist()
     if isinstance(v, float):
-        return round(v, 6)
-    return v
+        if not math.isfinite(v):
+            return None, True
+        return round(v, 6), False
+    if isinstance(v, (list, tuple)):
+        items = [_jsonable(x) for x in v]
+        return [x for x, _ in items], any(nf for _, nf in items)
+    return v, False
 
 
 class MetricLogger:

@@ -35,6 +35,7 @@ from automodel_tpu.moe.layers import (
 )
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rope import apply_rope, rope_attention_scaling, rope_frequencies
+from automodel_tpu.utils.tracing import scope_blocks
 
 __all__ = [
     "MoEDecoderConfig",
@@ -236,25 +237,34 @@ def make_moe_layer_fns(
             return layer_inputs
         return (*layer_inputs, None)
 
+    moe_block = make_moe_block_forward(cfg.moe, backend, rules, training=training)
+
+    def mlp_sublayer(lp, h):
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        return h + _mlp_block(cfg, backend, lp, x, rules)
+
+    # profiler scopes on the shared MoE decoder path (autonvtx parity,
+    # utils/tracing.py): attention / dense-mlp / moe regions are legible in
+    # every family's trace, matching the stacks that annotate per-family
+    # (nemotron_v3, qwen3_next, step3p5)
+    blocks = scope_blocks({"attention": attn, "mlp": mlp_sublayer, "moe": moe_block})
+
     def dense_layer_fn(state, layer_inputs):
         lp, is_sliding, kv = _split(layer_inputs)
         lp = jax.tree.map(lambda a: a.astype(dtype), lp)
-        h, kv_out = attn(state, lp, is_sliding, kv)
-        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp_block(cfg, backend, lp, x, rules)
+        h, kv_out = blocks["attention"](state, lp, is_sliding, kv)
+        h = blocks["mlp"](lp, h)
         state = dict(state, h=_constrain(h, rules, ("batch", "act_seq", "act_embed")))
         return state, kv_out
-
-    moe_block = make_moe_block_forward(cfg.moe, backend, rules, training=training)
 
     def moe_layer_fn(state, layer_inputs):
         lp, is_sliding, kv = _split(layer_inputs)
         moe_params = lp["moe"]
         lp = jax.tree.map(lambda a: a.astype(dtype), {k: v for k, v in lp.items() if k != "moe"})
-        h, kv_out = attn(state, lp, is_sliding, kv)
+        h, kv_out = blocks["attention"](state, lp, is_sliding, kv)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
         moe_params = cast_moe_compute_params(moe_params, dtype)
-        y, aux, load, dropped = moe_block(moe_params, x, state.get("token_mask"))
+        y, aux, load, dropped = blocks["moe"](moe_params, x, state.get("token_mask"))
         h = h + y
         h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
         # decode (kv given) swaps the aux/load ys for the updated kv cache —

@@ -31,6 +31,7 @@ from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rope import (
     apply_rope, apply_rope_interleaved, rope_attention_scaling, rope_frequencies,
 )
+from automodel_tpu.utils.tracing import scope_blocks
 
 __all__ = [
     "DenseDecoderConfig",
@@ -513,8 +514,6 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
         inv_freq_l = inv_freq
         if cfg.no_rope_layers is not None:
             inv_freq_l = inv_freq * (1 - ((is_sliding >> 1) & 1)).astype(inv_freq.dtype)
-        # named scopes label the profiler trace per block (the reference gets the
-        # same from autonvtx module hooks, autonvtx/__init__.py:33)
         def attn_call(x):
             """One copy of the cache/no-cache attention dispatch for every
             block style (sequential pre/post-norm AND cohere parallel)."""
@@ -530,18 +529,18 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
                 cache=kv, cache_meta=cache_meta,
             )
 
-        if cfg.parallel_block:
+        def parallel_sublayer(h):
             # cohere: ONE input norm feeds attention AND the MLP; both outputs
             # add to the residual together
-            with jax.named_scope("parallel_block"):
-                x = _block_norm(cfg, h, lp.get("attn_norm"), lp.get("attn_norm_b"))
-                attn_out, kv_out = attn_call(x)
-                h = h + attn_out + _mlp_block(cfg, backend, lp, x, rules)
-                h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
-            return dict(state, h=h), kv_out
+            x = _block_norm(cfg, h, lp.get("attn_norm"), lp.get("attn_norm_b"))
+            attn_out, kv_out = attn_call(x)
+            h = h + attn_out + _mlp_block(cfg, backend, lp, x, rules)
+            return _constrain(h, rules, ("batch", "act_seq", "act_embed")), kv_out
+
         post = cfg.norm_placement == "post"
         sandwich = cfg.norm_placement == "sandwich"
-        with jax.named_scope("attention"):
+
+        def attention_sublayer(h):
             # post (olmo2): attention reads h RAW; attn_norm applies to the
             # sublayer OUTPUT before the residual add (post_attention_layernorm).
             # sandwich (glm4): input norm AND a post norm on the output.
@@ -554,8 +553,9 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
             if cfg.residual_multiplier != 1.0:  # granite
                 attn_out = attn_out * cfg.residual_multiplier
             h = h + attn_out
-            h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
-        with jax.named_scope("mlp"):
+            return _constrain(h, rules, ("batch", "act_seq", "act_embed")), kv_out
+
+        def mlp_sublayer(h):
             x = h if post else _block_norm(cfg, h, lp.get("mlp_norm"), lp.get("mlp_norm_b"))
             mlp_out = _mlp_block(cfg, backend, lp, x, rules)
             if post:  # post_feedforward_layernorm
@@ -565,7 +565,20 @@ def make_layer_body(cfg: DenseDecoderConfig, backend: BackendConfig, rules=None)
             if cfg.residual_multiplier != 1.0:
                 mlp_out = mlp_out * cfg.residual_multiplier
             h = h + mlp_out
-            h = _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+            return _constrain(h, rules, ("batch", "act_seq", "act_embed"))
+
+        # named scopes label the profiler trace per block (the reference gets the
+        # same from autonvtx module hooks, autonvtx/__init__.py:33)
+        blocks = scope_blocks({
+            "parallel_block": parallel_sublayer,
+            "attention": attention_sublayer,
+            "mlp": mlp_sublayer,
+        })
+        if cfg.parallel_block:
+            h, kv_out = blocks["parallel_block"](h)
+            return dict(state, h=h), kv_out
+        h, kv_out = blocks["attention"](h)
+        h = blocks["mlp"](h)
         return dict(state, h=h), kv_out
 
     return layer_fn

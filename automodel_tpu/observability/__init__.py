@@ -1,0 +1,18 @@
+"""Unified training observability: goodput accounting, HBM + compile telemetry,
+a stall watchdog, and on-demand profiling (docs/observability.md)."""
+
+from automodel_tpu.observability.goodput import BUCKETS, GoodputTracker
+from automodel_tpu.observability.manager import Observability, ObservabilityConfig
+from automodel_tpu.observability.memory import device_memory_stats
+from automodel_tpu.observability.profiling import OnDemandProfiler
+from automodel_tpu.observability.watchdog import StallWatchdog
+
+__all__ = [
+    "BUCKETS",
+    "GoodputTracker",
+    "Observability",
+    "ObservabilityConfig",
+    "OnDemandProfiler",
+    "StallWatchdog",
+    "device_memory_stats",
+]

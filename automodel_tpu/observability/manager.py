@@ -1,0 +1,177 @@
+"""Config-driven observability manager wired into the training recipes.
+
+One object owns the four pillars — goodput accounting, HBM/compile telemetry,
+the stall watchdog, and on-demand profiling — so a recipe integrates with five
+hooks: ``start()``, ``track(bucket)``, ``heartbeat(step)``,
+``on_step_start/end(step)``, and ``step_metrics()`` merged into each log row.
+Everything flows through the existing MetricLogger/experiment-logger fan-out;
+this module adds no new output channels.
+
+YAML (all keys optional; the subsystem is on by default and every pillar
+no-ops cleanly where its backing API is unavailable):
+
+.. code-block:: yaml
+
+    observability:
+      enabled: true
+      goodput: true
+      memory: true
+      watchdog: {enabled: true, threshold_s: 600}
+      profiling: {server_port: 0, trace_steps: 5, signal: SIGUSR1}
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import signal as _signal
+from typing import Any, Callable
+
+from automodel_tpu.observability.goodput import GoodputTracker
+from automodel_tpu.observability.memory import device_memory_stats
+from automodel_tpu.observability.profiling import OnDemandProfiler
+from automodel_tpu.observability.watchdog import StallWatchdog
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ObservabilityConfig", "Observability"]
+
+
+@dataclasses.dataclass
+class ObservabilityConfig:
+    enabled: bool = True
+    goodput: bool = True
+    memory: bool = True
+    watchdog: bool = True
+    watchdog_threshold_s: float = 600.0
+    watchdog_poll_interval_s: float | None = None
+    profiler_port: int = 0  # 0 = no profiler server
+    trace_steps: int = 5
+    trace_signal: str | None = "SIGUSR1"  # None/"none" = no signal handler
+
+    @classmethod
+    def from_dict(cls, raw: Any) -> "ObservabilityConfig":
+        """Build from the ``observability:`` YAML section (ConfigNode or dict)."""
+        if raw is None:
+            return cls()
+        if hasattr(raw, "to_dict"):
+            raw = raw.to_dict()
+        raw = dict(raw)
+        kw: dict[str, Any] = {k: raw[k] for k in ("enabled", "goodput", "memory") if k in raw}
+        wd = raw.get("watchdog")
+        if isinstance(wd, bool):
+            kw["watchdog"] = wd
+        elif isinstance(wd, dict):
+            kw["watchdog"] = bool(wd.get("enabled", True))
+            if wd.get("threshold_s") is not None:
+                kw["watchdog_threshold_s"] = float(wd["threshold_s"])
+            if wd.get("poll_interval_s") is not None:
+                kw["watchdog_poll_interval_s"] = float(wd["poll_interval_s"])
+        prof = raw.get("profiling")
+        if isinstance(prof, dict):
+            kw["profiler_port"] = int(prof.get("server_port", 0))
+            kw["trace_steps"] = int(prof.get("trace_steps", 5))
+            kw["trace_signal"] = prof.get("signal", "SIGUSR1")
+        return cls(**kw)
+
+    def resolve_signal(self) -> int | None:
+        name = self.trace_signal
+        if not name or str(name).lower() == "none":
+            return None
+        return getattr(_signal, str(name).upper())
+
+
+class Observability:
+    """The manager a recipe holds; disabled pillars degrade to no-ops."""
+
+    def __init__(
+        self,
+        config: ObservabilityConfig,
+        out_dir: str,
+        metric_sink: Callable[..., None] | None = None,
+    ):
+        self.config = config
+        self.out_dir = str(out_dir)
+        self.compile_time_s: float | None = None
+        on = config.enabled
+        self.goodput: GoodputTracker | None = GoodputTracker() if on and config.goodput else None
+        self._memory = on and config.memory
+        self.watchdog: StallWatchdog | None = None
+        if on and config.watchdog:
+            on_stall = None
+            if metric_sink is not None:
+                def on_stall(event: dict, _sink=metric_sink):
+                    _sink(int(event.get("step") or 0),
+                          **{k: v for k, v in event.items() if k != "step"})
+            self.watchdog = StallWatchdog(
+                threshold_s=config.watchdog_threshold_s,
+                dump_dir=self.out_dir,
+                on_stall=on_stall,
+                poll_interval_s=config.watchdog_poll_interval_s,
+            )
+        self.profiler: OnDemandProfiler | None = None
+        if on:
+            self.profiler = OnDemandProfiler(
+                self.out_dir,
+                trace_steps=config.trace_steps,
+                server_port=config.profiler_port,
+                signum=config.resolve_signal(),
+            )
+
+    @classmethod
+    def from_config(cls, cfg: Any, out_dir: str,
+                    metric_sink: Callable[..., None] | None = None) -> "Observability":
+        return cls(ObservabilityConfig.from_dict(cfg), out_dir, metric_sink)
+
+    # ------------------------------------------------------------------ lifecycle
+    def start(self) -> "Observability":
+        if self.watchdog is not None:
+            self.watchdog.start()
+        if self.profiler is not None:
+            self.profiler.start()
+        return self
+
+    def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.profiler is not None:
+            self.profiler.close()
+
+    # ------------------------------------------------------------------ hooks
+    def track(self, bucket: str):
+        """Goodput context manager; nullcontext when accounting is off."""
+        if self.goodput is None:
+            return contextlib.nullcontext()
+        return self.goodput.track(bucket)
+
+    def record_compile(self, seconds: float) -> None:
+        """Cumulative: a delayed-QAT switch compiles a second step mid-run."""
+        self.compile_time_s = round((self.compile_time_s or 0.0) + float(seconds), 3)
+        if self.goodput is not None:
+            self.goodput.add("compile", seconds)
+        logger.info("jit compile + first execute: %.1fs (cumulative %.1fs)",
+                    seconds, self.compile_time_s)
+
+    def heartbeat(self, step: int | None = None) -> None:
+        if self.watchdog is not None:
+            self.watchdog.heartbeat(step)
+
+    def on_step_start(self, step: int) -> None:
+        if self.profiler is not None:
+            self.profiler.on_step_start(step)
+
+    def on_step_end(self, step: int, sync: Any = None) -> None:
+        if self.profiler is not None:
+            self.profiler.on_step_end(step, sync)
+
+    def step_metrics(self) -> dict[str, Any]:
+        """The per-log-row contribution: compile time, goodput fractions, HBM."""
+        out: dict[str, Any] = {}
+        if self.compile_time_s is not None:
+            out["compile_time_s"] = self.compile_time_s
+        if self.goodput is not None:
+            out.update(self.goodput.snapshot())
+        if self._memory:
+            out.update(device_memory_stats())
+        return out

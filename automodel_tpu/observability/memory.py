@@ -1,0 +1,39 @@
+"""Per-device HBM telemetry via ``Device.memory_stats()``.
+
+TPU/GPU runtimes expose allocator counters (bytes_in_use, peak_bytes_in_use);
+the CPU backend returns ``None`` — there this degrades to an empty dict, so
+log rows simply carry no hbm_* keys instead of nulls or crashes. The max over
+local devices is reported: the first chip to OOM is the one that matters, and
+per-chip skew (pp stages, uneven ep) shows up as a high peak long before it
+kills the run.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["device_memory_stats"]
+
+
+def device_memory_stats(devices=None) -> dict[str, float]:
+    """Max in-use/peak HBM over ``devices`` (default: local), {} when unavailable."""
+    devs = list(devices) if devices is not None else jax.local_devices()
+    in_use: list[int] = []
+    peak: list[int] = []
+    for d in devs:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # backends without the API raise instead of returning None
+            stats = None
+        if not stats:
+            continue
+        if stats.get("bytes_in_use") is not None:
+            in_use.append(int(stats["bytes_in_use"]))
+        if stats.get("peak_bytes_in_use") is not None:
+            peak.append(int(stats["peak_bytes_in_use"]))
+    out: dict[str, float] = {}
+    if in_use:
+        out["hbm_gib_in_use"] = round(max(in_use) / 2**30, 3)
+    if peak:
+        out["hbm_gib_peak"] = round(max(peak) / 2**30, 3)
+    return out

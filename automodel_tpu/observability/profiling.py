@@ -1,0 +1,122 @@
+"""On-demand profiling for live runs.
+
+The benchmark recipe can capture a trace, but steady-state production runs are
+where the interesting regressions live. Two entry points, both zero-cost until
+used:
+
+- ``jax.profiler.start_server(port)`` at init: attach TensorBoard's profile
+  plugin (or ``xprof``) to a live run at any time.
+- a ``SIGUSR1`` handler that arms a one-shot N-step trace window: the next
+  ``on_step_start`` opens ``out_dir/profiles/step_NNNNNN``, and the window
+  closes after ``trace_steps`` steps with a device sync so the trace carries
+  complete steps. ``kill -USR1 <pid>`` is the whole UX.
+
+The signal handler only sets a flag (async-signal-safe); all profiler calls
+happen on the train-loop thread at step boundaries.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+from typing import Any
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["OnDemandProfiler"]
+
+
+class OnDemandProfiler:
+    def __init__(
+        self,
+        out_dir: str,
+        trace_steps: int = 5,
+        server_port: int = 0,
+        signum: int | None = signal.SIGUSR1,
+    ):
+        self.profile_dir = os.path.join(str(out_dir), "profiles")
+        self.trace_steps = max(int(trace_steps), 1)
+        self.server_port = int(server_port or 0)
+        self.signum = signum
+        self._requested = False
+        self._tracing = False
+        self._stop_after = -1
+        self._server: Any = None
+        self._prev_handler: Any = None
+        self._handler_installed = False
+
+    @property
+    def armed(self) -> bool:
+        """A trace request is pending (set by SIGUSR1 or request_trace)."""
+        return self._requested
+
+    @property
+    def tracing(self) -> bool:
+        return self._tracing
+
+    def start(self) -> "OnDemandProfiler":
+        if self.server_port > 0 and self._server is None:
+            try:
+                self._server = jax.profiler.start_server(self.server_port)
+                logger.info("jax profiler server listening on port %d", self.server_port)
+            except Exception:
+                logger.exception("could not start jax profiler server on port %d",
+                                 self.server_port)
+        if self.signum is not None and not self._handler_installed:
+            if threading.current_thread() is not threading.main_thread():
+                logger.warning("profiler signal handler not installed (non-main thread)")
+            else:
+                self._prev_handler = signal.signal(self.signum, self._handle_signal)
+                self._handler_installed = True
+        return self
+
+    def _handle_signal(self, signum, frame) -> None:
+        self._requested = True  # flag only: profiler calls are not signal-safe
+
+    def request_trace(self) -> None:
+        """Programmatic equivalent of SIGUSR1."""
+        self._requested = True
+
+    def on_step_start(self, step: int) -> None:
+        if not self._requested or self._tracing:
+            return
+        self._requested = False
+        path = os.path.join(self.profile_dir, f"step_{step:06d}")
+        try:
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+        except Exception:
+            logger.exception("on-demand trace failed to start at step %d", step)
+            return
+        self._tracing = True
+        self._stop_after = step + self.trace_steps - 1
+        logger.info("on-demand trace: steps %d..%d -> %s", step, self._stop_after, path)
+
+    def on_step_end(self, step: int, sync: Any = None) -> None:
+        if not self._tracing or step < self._stop_after:
+            return
+        if sync is not None:
+            jax.block_until_ready(sync)  # the trace must contain COMPLETE steps
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            logger.exception("on-demand trace failed to stop cleanly")
+        self._tracing = False
+        logger.info("on-demand trace written under %s", self.profile_dir)
+
+    def close(self) -> None:
+        if self._tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                logger.exception("trace still open at close; stop failed")
+            self._tracing = False
+        if self._handler_installed:
+            signal.signal(self.signum, self._prev_handler or signal.SIG_DFL)
+            self._handler_installed = False
+        self._requested = False
+        # no public stop for the profiler server; it lives for the process

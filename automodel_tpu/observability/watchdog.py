@@ -1,0 +1,134 @@
+"""Stall watchdog: turn a silent multi-host hang into a diagnosable event.
+
+A wedged collective (one host lost, a deadlocked checkpoint barrier, a stuck
+data worker) freezes the train loop with no output at all — the worst failure
+mode a long run has. The watchdog is a daemon thread fed heartbeats from the
+loop; after ``threshold_s`` of silence it dumps every thread's stack to the
+run dir and reports a structured stall event, then re-arms on the next
+heartbeat (so a recovered stall and a second stall are both visible).
+
+The dump is pure-Python (``sys._current_frames``) rather than ``faulthandler``
+so it lands in a named file with thread names attached, and so a custom
+``on_stall`` sink can route the event into the metric stream.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["StallWatchdog"]
+
+
+class StallWatchdog:
+    """Daemon thread that fires when heartbeats stop arriving.
+
+    ``on_stall`` (optional) receives ``{"event": "stall", "stall_s": float,
+    "step": int | None, "stack_dump": path}``; exceptions in the sink are
+    swallowed — diagnostics must never take the run down themselves.
+    """
+
+    def __init__(
+        self,
+        threshold_s: float,
+        dump_dir: str,
+        on_stall: Callable[[dict[str, Any]], None] | None = None,
+        poll_interval_s: float | None = None,
+    ):
+        if threshold_s <= 0:
+            raise ValueError(f"threshold_s must be > 0, got {threshold_s}")
+        self.threshold_s = float(threshold_s)
+        self.dump_dir = str(dump_dir)
+        self.on_stall = on_stall
+        self._poll = poll_interval_s if poll_interval_s else min(max(threshold_s / 4, 0.01), 60.0)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._last_beat: float | None = None
+        self._last_step: int | None = None
+        self._fired = False
+        self._thread: threading.Thread | None = None
+        self.stall_count = 0
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "StallWatchdog":
+        if self.running:
+            return self
+        with self._lock:
+            self._last_beat = time.monotonic()
+            self._fired = False
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name="stall-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def heartbeat(self, step: int | None = None) -> None:
+        with self._lock:
+            self._last_beat = time.monotonic()
+            self._last_step = step
+            self._fired = False  # re-arm: a later second stall fires again
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # ------------------------------------------------------------------ internals
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            with self._lock:
+                if self._last_beat is None or self._fired:
+                    continue
+                silence = time.monotonic() - self._last_beat
+                if silence < self.threshold_s:
+                    continue
+                self._fired = True  # once per silence window
+                step = self._last_step
+            self._fire(silence, step)
+
+    def _fire(self, silence: float, step: int | None) -> None:
+        self.stall_count += 1
+        try:
+            path = self.dump_stacks(silence, step)
+        except Exception:
+            logger.exception("stall watchdog failed to write stack dump")
+            path = None
+        logger.error(
+            "STALL: no train-loop heartbeat for %.1fs (threshold %.1fs, last step %s); "
+            "all-thread stacks -> %s", silence, self.threshold_s, step, path,
+        )
+        if self.on_stall is not None:
+            try:
+                self.on_stall({
+                    "event": "stall",
+                    "stall_s": round(silence, 1),
+                    "step": step,
+                    "stack_dump": path,
+                })
+            except Exception:
+                logger.exception("stall watchdog on_stall sink raised")
+
+    def dump_stacks(self, silence: float, step: int | None = None) -> str:
+        """Write every thread's stack to ``dump_dir``; returns the file path."""
+        os.makedirs(self.dump_dir, exist_ok=True)
+        path = os.path.join(self.dump_dir, f"stall_{self.stall_count:03d}_{int(time.time())}.txt")
+        names = {t.ident: t.name for t in threading.enumerate()}
+        with open(path, "w") as f:
+            f.write(
+                f"stall after {silence:.1f}s of silence (threshold {self.threshold_s}s, "
+                f"last step {step})\n"
+            )
+            for tid, frame in sys._current_frames().items():
+                f.write(f"\n--- thread {names.get(tid, '?')} (ident {tid}) ---\n")
+                f.write("".join(traceback.format_stack(frame)))
+        return path

@@ -52,6 +52,7 @@ from automodel_tpu.loggers.log_utils import setup_logging
 from automodel_tpu.loggers.metric_logger import MetricLogger
 from automodel_tpu.models.auto import AutoModelForCausalLM, load_hf_config
 from automodel_tpu.models.common.backend import BackendConfig
+from automodel_tpu.observability import Observability
 from automodel_tpu.optim import build_lr_schedule, build_optimizer
 from automodel_tpu.ops.losses import linear_cross_entropy, masked_cross_entropy
 from automodel_tpu.parallel.init import initialize_distributed
@@ -220,6 +221,22 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         from automodel_tpu.loggers.experiment_loggers import build_experiment_loggers
 
         self.experiment_loggers = build_experiment_loggers(cfg)
+
+        # observability (docs/observability.md): goodput accounting, HBM +
+        # compile telemetry, stall watchdog, on-demand profiling. Stall events
+        # fan out through the same JSONL/wandb/mlflow sinks as step metrics.
+        self.observability = Observability.from_config(
+            cfg.get("observability"), out_dir, metric_sink=self._log_event
+        )
+        # per-log-row MFU needs the analytic FLOPs formula; families outside
+        # the formula table (VLM towers, audio) skip gracefully
+        try:
+            from automodel_tpu.utils.flops import flops_per_token
+
+            self._flops_per_token = float(flops_per_token(self.hf_config, self.seq_len))
+        except Exception:
+            self._flops_per_token = None
+        self._device_kind = jax.devices()[0].device_kind
 
         # the jitted step
         self._train_step = self._build_train_step()
@@ -598,117 +615,198 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         }
 
     # ------------------------------------------------------------------ train
+    def _log_event(self, step: int, **fields):
+        """Async structured events (watchdog stalls) into the metric fan-out."""
+        self.metric_logger.log(step, **fields)
+        for lg in self.experiment_loggers:
+            lg.log(step, **fields)
+
     def run_train_validation_loop(self):
         mesh = self.mesh
+        obs = self.observability
+        obs.start()
         t_last = time.perf_counter()
         steps_since_log = 0
+        window_overhead = 0.0  # eval/ckpt seconds to exclude from step_time_s
         checked_vocab = False
-        with mesh:
-            for batches in self.step_scheduler:
-                stack = stack_batches(batches)
-                if not checked_vocab:
-                    # tokenizer/model vocab mismatch shows up as NaN loss deep in
-                    # training; fail loudly on the first batch instead
-                    vocab = getattr(getattr(self.model.config, "text", self.model.config),
-                                    "vocab_size", None)
-                    if vocab is not None:
-                        for key in ("input_ids", "q_ids", "p_ids"):
-                            if key in stack and int(stack[key].max()) >= vocab:
-                                raise ValueError(
-                                    f"batch {key} contains token id {int(stack[key].max())} "
-                                    f">= model vocab_size {vocab}: tokenizer/model mismatch"
-                                )
-                    checked_vocab = True
-                stack = self._device_put_stack(stack)
-                extra = (self.params,) if self.peft is not None else ()
-                if self._step_needs_rng:
-                    extra = (*extra, self.rng.key("lora_dropout"))
-                step_fn = self._train_step
-                if self._pre_qat_step is not None and self.step_scheduler.step < self._qat_start_step:
-                    step_fn = self._pre_qat_step
-                self.train_params, self.opt_state, metrics = step_fn(
-                    self.train_params, self.opt_state, stack, *extra
-                )
-                if self.peft is None:
-                    self.params = self.train_params
-                step = self.step_scheduler.step
-                steps_since_log += 1
-                # reference check_for_nan_in_grad (distributed/config.py:129): a
-                # non-finite gradient is a training bug. The jitted step already
-                # SKIPPED the corrupt update (guard_nonfinite), so params and
-                # optimizer state stay clean; raise loudly here every step.
-                # Costs one scalar device->host pull per step.
-                if self._check_nan_grads and bool(metrics["nonfinite"]):
-                    raise RuntimeError(
-                        f"non-finite training signal at step {step}: "
-                        f"loss={float(metrics['loss'])} "
-                        f"grad_norm={float(metrics['grad_norm'])} "
-                        "(the offending update was skipped; params remain clean)"
-                    )
-                if self.step_scheduler.is_log_step:
-                    loss = float(metrics["loss"])
-                    gnorm = float(metrics["grad_norm"])
-                    ntok = int(metrics["num_label_tokens"])
-                    now = time.perf_counter()
-                    dt = (now - t_last) / steps_since_log  # per-step time
-                    t_last = now
-                    steps_since_log = 0
-                    # global tokens per optimizer step (local slice x process count);
-                    # biencoder batches carry q_ids/p_ids instead of input_ids
-                    step_tokens = sum(
-                        int(np.prod(stack[k].shape))
-                        for k in ("input_ids", "q_ids", "p_ids") if k in stack
-                    ) * jax.process_count()
-                    extra = {}
-                    if "expert_load" in metrics and self.moe_metrics_mode:
-                        from automodel_tpu.moe.metrics import compute_load_balance_metrics
-
-                        extra = compute_load_balance_metrics(
-                            np.asarray(metrics["expert_load"]), mode=self.moe_metrics_mode
+        compiled_fns: set[int] = set()
+        try:
+            with mesh:
+                it = iter(self.step_scheduler)
+                while True:
+                    with obs.track("data_wait"):
+                        batches = next(it, None)
+                    if batches is None:
+                        break
+                    stack = stack_batches(batches)
+                    if not checked_vocab:
+                        # tokenizer/model vocab mismatch shows up as NaN loss deep in
+                        # training; fail loudly on the first batch instead
+                        vocab = getattr(getattr(self.model.config, "text", self.model.config),
+                                        "vocab_size", None)
+                        if vocab is not None:
+                            for key in ("input_ids", "q_ids", "p_ids"):
+                                if key in stack and int(stack[key].max()) >= vocab:
+                                    raise ValueError(
+                                        f"batch {key} contains token id {int(stack[key].max())} "
+                                        f">= model vocab_size {vocab}: tokenizer/model mismatch"
+                                    )
+                        checked_vocab = True
+                    step = self.step_scheduler.step
+                    obs.on_step_start(step)
+                    with obs.track("data_wait"):
+                        # host->device staging is data movement, not device compute
+                        stack = self._device_put_stack(stack)
+                    extra = (self.params,) if self.peft is not None else ()
+                    if self._step_needs_rng:
+                        extra = (*extra, self.rng.key("lora_dropout"))
+                    step_fn = self._train_step
+                    if self._pre_qat_step is not None and step < self._qat_start_step:
+                        step_fn = self._pre_qat_step
+                    if id(step_fn) not in compiled_fns:
+                        # first call of a jitted step pays tracing + XLA compile
+                        # (step 0, and again at a delayed-QAT switch): bill it to
+                        # the compile bucket and keep it OUT of the throughput
+                        # window — the first step_time_s/tps row would otherwise
+                        # absorb minutes of compile. float() pulls a scalar to
+                        # host: a real sync even through remote-execution tunnels
+                        # where block_until_ready is a no-op.
+                        t0 = time.perf_counter()
+                        self.train_params, self.opt_state, metrics = step_fn(
+                            self.train_params, self.opt_state, stack, *extra
                         )
-                    if "dropped_token_frac" in metrics:
-                        # summed over the step's microbatches in the train-step carry
-                        extra["moe_load/dropped_token_frac"] = float(
-                            np.asarray(metrics["dropped_token_frac"])
-                        ) / max(1, self.step_scheduler.grad_acc_steps)
-                    row = dict(
-                        loss=loss,
-                        grad_norm=gnorm,
-                        lr=float(self.lr_schedule(step)),
-                        num_label_tokens=ntok,
-                        step_time_s=round(dt, 4),
-                        tps=round(step_tokens / dt, 1),
-                        tps_per_chip=round(step_tokens / dt / jax.device_count(), 1),
-                        **extra,
-                        **self._static_log_fields,
-                    )
-                    self.metric_logger.log(step, **row)
-                    for lg in self.experiment_loggers:
-                        lg.log(step, **row)
-                    logger.info(
-                        "step %d | loss %.4f | gnorm %.3f | %.0f tok/s", step, loss, gnorm, step_tokens / dt
-                    )
-                if self.val_dataloader is not None and self.step_scheduler.is_val_step:
-                    self._run_validation(step)
-                if (
-                    self.checkpointer.config.enabled
-                    and self.step_scheduler.is_ckpt_step
-                    and getattr(self, "_last_saved_step", None) != step
-                ):
-                    # the best-tracking path may have just saved this very step
-                    self._save(step)
-                if self.step_scheduler.sigterm_received:
-                    logger.warning("SIGTERM received; checkpointing and exiting")
-                    self._save(step)
-                    break
-        # final checkpoint; wait() commits any async save's latest symlink
-        if self.checkpointer.config.enabled:
-            self._save(self.step_scheduler.step)
-            self.checkpointer.wait()
-        self.metric_logger.close()
-        self.val_metric_logger.close()
-        for lg in self.experiment_loggers:
-            lg.close()
+                        float(metrics["loss"])
+                        obs.record_compile(time.perf_counter() - t0)
+                        compiled_fns.add(id(step_fn))
+                        t_last = time.perf_counter()
+                        steps_since_log = 0  # compile step excluded from the window
+                        window_overhead = 0.0
+                    else:
+                        with obs.track("device_step"):
+                            self.train_params, self.opt_state, metrics = step_fn(
+                                self.train_params, self.opt_state, stack, *extra
+                            )
+                        steps_since_log += 1
+                    if self.peft is None:
+                        self.params = self.train_params
+                    obs.heartbeat(step)
+                    # reference check_for_nan_in_grad (distributed/config.py:129): a
+                    # non-finite gradient is a training bug. The jitted step already
+                    # SKIPPED the corrupt update (guard_nonfinite), so params and
+                    # optimizer state stay clean; raise loudly here every step.
+                    # Costs one scalar device->host pull per step.
+                    if self._check_nan_grads and bool(metrics["nonfinite"]):
+                        raise RuntimeError(
+                            f"non-finite training signal at step {step}: "
+                            f"loss={float(metrics['loss'])} "
+                            f"grad_norm={float(metrics['grad_norm'])} "
+                            "(the offending update was skipped; params remain clean)"
+                        )
+                    if self.step_scheduler.is_log_step:
+                        with obs.track("device_step"):
+                            # the scalar pulls block on the step's device work, so
+                            # this wait is device time, not idle
+                            loss = float(metrics["loss"])
+                            gnorm = float(metrics["grad_norm"])
+                            ntok = int(metrics["num_label_tokens"])
+                        now = time.perf_counter()
+                        # per-step time, with eval/ckpt pauses subtracted;
+                        # steps_since_log == 0 <=> the window held only a compile
+                        # step, whose device time already lives in compile_time_s
+                        # — no throughput to report yet
+                        dt = (max(now - t_last - window_overhead, 0.0) / steps_since_log
+                              if steps_since_log else None)
+                        t_last = now
+                        steps_since_log = 0
+                        window_overhead = 0.0
+                        # global tokens per optimizer step (local slice x process count);
+                        # biencoder batches carry q_ids/p_ids instead of input_ids
+                        step_tokens = sum(
+                            int(np.prod(stack[k].shape))
+                            for k in ("input_ids", "q_ids", "p_ids") if k in stack
+                        ) * jax.process_count()
+                        extra = {}
+                        if "expert_load" in metrics and self.moe_metrics_mode:
+                            from automodel_tpu.moe.metrics import compute_load_balance_metrics
+
+                            extra = compute_load_balance_metrics(
+                                np.asarray(metrics["expert_load"]), mode=self.moe_metrics_mode
+                            )
+                        if "dropped_token_frac" in metrics:
+                            # summed over the step's microbatches in the train-step carry
+                            extra["moe_load/dropped_token_frac"] = float(
+                                np.asarray(metrics["dropped_token_frac"])
+                            ) / max(1, self.step_scheduler.grad_acc_steps)
+                        row = dict(
+                            loss=loss,
+                            grad_norm=gnorm,
+                            lr=float(self.lr_schedule(step)),
+                            num_label_tokens=ntok,
+                            step_time_s=round(dt, 4) if dt else None,
+                            tps=round(step_tokens / dt, 1) if dt else None,
+                            tps_per_chip=(round(step_tokens / dt / jax.device_count(), 1)
+                                          if dt else None),
+                            **extra,
+                            **self._static_log_fields,
+                        )
+                        if self._flops_per_token is not None:
+                            from automodel_tpu.utils.flops import mfu
+
+                            fpt = self._flops_per_token
+                            if dt:
+                                tps_now = step_tokens / dt
+                                row["tflops_per_chip"] = round(
+                                    tps_now * fpt / 1e12 / jax.device_count(), 2
+                                )
+                                # 0.0 on device kinds without a peak-TFLOPs entry (CPU)
+                                row["mfu"] = round(
+                                    mfu(tps_now, fpt, self._device_kind, jax.device_count()), 4
+                                )
+                            else:  # compile-only window: keys present, no rate yet
+                                row["tflops_per_chip"] = None
+                                row["mfu"] = None
+                        row.update(obs.step_metrics())
+                        self.metric_logger.log(step, **row)
+                        for lg in self.experiment_loggers:
+                            lg.log(step, **row)
+                        logger.info(
+                            "step %d | loss %.4f | gnorm %.3f | %s", step, loss, gnorm,
+                            f"{step_tokens / dt:.0f} tok/s" if dt else "compile step",
+                        )
+                    if self.val_dataloader is not None and self.step_scheduler.is_val_step:
+                        t_pause = time.perf_counter()
+                        with obs.track("eval"):
+                            self._run_validation(step)
+                        obs.heartbeat(step)
+                        window_overhead += time.perf_counter() - t_pause
+                    if (
+                        self.checkpointer.config.enabled
+                        and self.step_scheduler.is_ckpt_step
+                        and getattr(self, "_last_saved_step", None) != step
+                    ):
+                        # the best-tracking path may have just saved this very step
+                        t_pause = time.perf_counter()
+                        with obs.track("checkpoint"):
+                            self._save(step)
+                        obs.heartbeat(step)
+                        window_overhead += time.perf_counter() - t_pause
+                    obs.on_step_end(step, sync=metrics.get("loss"))
+                    if self.step_scheduler.sigterm_received:
+                        logger.warning("SIGTERM received; checkpointing and exiting")
+                        with obs.track("checkpoint"):
+                            self._save(step)
+                        break
+            # final checkpoint; wait() commits any async save's latest symlink
+            if self.checkpointer.config.enabled:
+                with obs.track("checkpoint"):
+                    self._save(self.step_scheduler.step)
+                    self.checkpointer.wait()
+        finally:
+            obs.close()
+            self.metric_logger.close()
+            self.val_metric_logger.close()
+            for lg in self.experiment_loggers:
+                lg.close()
 
     def _run_validation(self, step: int):
         # validate on the SAME weights training currently sees: before a delayed

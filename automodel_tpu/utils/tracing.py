@@ -17,7 +17,7 @@ from typing import Callable, Mapping
 
 import jax
 
-__all__ = ["scoped", "scope_blocks"]
+__all__ = ["scoped", "scope_blocks", "lowered_text_with_scopes"]
 
 
 def scoped(name: str, fn: Callable | None = None):
@@ -41,3 +41,16 @@ def scope_blocks(block_fns: Mapping[str, Callable], prefix: str = "") -> dict:
     separately (the autonvtx per-module labels, at block granularity).
     """
     return {k: scoped(f"{prefix}{k}", fn) for k, fn in block_fns.items()}
+
+
+def lowered_text_with_scopes(lowered) -> str:
+    """StableHLO text for a ``jax.jit(...).lower(...)`` result WITH location
+    metadata, so named-scope labels are visible. ``Lowered.as_text`` grew a
+    ``debug_info`` kwarg only after jax 0.4.38; older releases need the mlir
+    module printed with debug info explicitly."""
+    try:
+        return lowered.as_text(debug_info=True)
+    except TypeError:
+        from jax._src.interpreters import mlir
+
+        return mlir.module_to_string(lowered.compiler_ir(), enable_debug_info=True)

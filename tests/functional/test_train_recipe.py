@@ -80,6 +80,21 @@ class TestTrainRecipeE2E:
         assert losses[0] > 4.0
         assert losses[-1] < losses[0] - 0.3
         assert all(np.isfinite(r["grad_norm"]) for r in rows)
+        # observability: every row carries compile time, goodput fractions, and
+        # mfu (0.0 on CPU — the device kind has no peak-TFLOPs entry)
+        for r in rows:
+            assert r["compile_time_s"] > 0.0
+            assert 0.0 <= r["goodput"] <= 1.0
+            for bucket in ("compile", "data_wait", "device_step", "idle"):
+                assert 0.0 <= r[f"goodput/{bucket}"] <= 1.0
+        # mfu is null on the compile-only first window, 0.0 on CPU afterwards
+        # (the device kind has no peak-TFLOPs entry)
+        assert rows[0]["mfu"] is None
+        assert all(r["mfu"] == 0.0 for r in rows[1:])
+        # the first log window holds only the compile step: throughput is null,
+        # never inf/0-division garbage
+        assert rows[0]["tps"] is None
+        assert all(r["tps"] > 0 for r in rows[1:])
 
     def test_hsdp_matches_fsdp_trajectory(self, tmp_path, cpu_devices):
         """HSDP (dp_replicate=2 x dp_shard=2 x tp=2 — reference

@@ -1,6 +1,10 @@
-"""Timers + experiment-logger tests (reference tests for training/timers.py and
-loggers/)."""
+"""Observability subsystem tests: goodput accounting, stall watchdog, HBM
+telemetry, on-demand profiling — plus the timers + experiment-logger tests
+(reference tests for training/timers.py and loggers/)."""
 
+import json
+import os
+import signal
 import time
 
 import jax.numpy as jnp
@@ -80,13 +84,14 @@ class TestNamedScopes:
 
         from automodel_tpu.moe.config import MoEConfig
         from automodel_tpu.moe.layers import init_moe_params, moe_forward
+        from automodel_tpu.utils.tracing import lowered_text_with_scopes
 
         cfg = MoEConfig(n_routed_experts=4, n_activated_experts=2, dim=16,
                         moe_inter_dim=32, n_shared_experts=1)
         p = init_moe_params(cfg, jax.random.key(0))
         x = jnp.ones((4, 16))
-        txt = jax.jit(lambda p, x: moe_forward(cfg, p, x)[0]).lower(p, x).as_text(
-            debug_info=True
+        txt = lowered_text_with_scopes(
+            jax.jit(lambda p, x: moe_forward(cfg, p, x)[0]).lower(p, x)
         )
         for scope in ("moe_gate", "moe_experts", "moe_shared_experts"):
             assert scope in txt, scope
@@ -98,6 +103,7 @@ class TestNamedScopes:
         from automodel_tpu.models.common.backend import BackendConfig
         from automodel_tpu.models.nemotron_v3.model import NemotronHForCausalLM, NemotronV3Config
         from automodel_tpu.moe.config import MoEConfig
+        from automodel_tpu.utils.tracing import lowered_text_with_scopes
 
         cfg = NemotronV3Config(
             vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=4,
@@ -113,8 +119,8 @@ class TestNamedScopes:
         model = NemotronHForCausalLM(cfg, BackendConfig(dtype="float32", remat_policy="full"))
         params = model.init(jax.random.key(0), jnp.float32)
         ids = jnp.asarray(np.zeros((1, 8), np.int32))
-        txt = jax.jit(lambda p, i: model(p, i)[0]).lower(params, ids).as_text(
-            debug_info=True
+        txt = lowered_text_with_scopes(
+            jax.jit(lambda p, i: model(p, i)[0]).lower(params, ids)
         )
         for scope in ("mamba", "attention", "mlp"):
             assert scope in txt, scope
@@ -126,3 +132,314 @@ class TestNamedScopes:
         assert f(1, 2) == 3
         table = scope_blocks({"x": lambda v: v * 2})
         assert table["x"](4) == 8
+
+    def test_shared_dense_path_scopes_in_lowered_text(self):
+        """The common transformer path carries attention/mlp scope labels so
+        EVERY dense family's trace is legible, not just the 3 that annotate
+        per-family."""
+        import jax
+
+        from automodel_tpu.models.common.backend import BackendConfig
+        from automodel_tpu.models.common.transformer import (
+            DenseDecoderConfig, decoder_forward, init_dense_decoder_params,
+        )
+        from automodel_tpu.utils.tracing import lowered_text_with_scopes
+
+        cfg = DenseDecoderConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        )
+        backend = BackendConfig(dtype="float32")
+        params = init_dense_decoder_params(cfg, jax.random.key(0))
+        ids = jnp.zeros((1, 8), jnp.int32)
+        txt = lowered_text_with_scopes(
+            jax.jit(lambda p, i: decoder_forward(cfg, backend, p, i)).lower(params, ids)
+        )
+        for scope in ("attention", "mlp"):
+            assert scope in txt, scope
+
+    def test_shared_moe_path_scopes_in_lowered_text(self):
+        import jax
+
+        from automodel_tpu.models.common.backend import BackendConfig
+        from automodel_tpu.models.common.moe_transformer import (
+            MoEDecoderConfig, init_moe_decoder_params, moe_decoder_forward,
+        )
+        from automodel_tpu.moe.config import MoEConfig
+        from automodel_tpu.utils.tracing import lowered_text_with_scopes
+
+        cfg = MoEDecoderConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+            first_k_dense_replace=1,
+            moe=MoEConfig(n_routed_experts=4, n_activated_experts=2, dim=32,
+                          moe_inter_dim=32),
+        )
+        backend = BackendConfig(dtype="float32")
+        params = init_moe_decoder_params(cfg, jax.random.key(0))
+        ids = jnp.zeros((1, 8), jnp.int32)
+        txt = lowered_text_with_scopes(
+            jax.jit(lambda p, i: moe_decoder_forward(cfg, backend, p, i)[0]).lower(params, ids)
+        )
+        for scope in ("attention", "mlp", "moe"):
+            assert scope in txt, scope
+
+
+class TestNonFiniteJson:
+    """MetricLogger must emit VALID json for NaN/Inf metrics: bare NaN/Infinity
+    from json.dumps breaks every json.loads consumer of training.jsonl."""
+
+    def test_nonfinite_roundtrips_through_json_loads(self):
+        from automodel_tpu.loggers.metric_logger import MetricsSample
+
+        line = MetricsSample(
+            step=3, metrics={"loss": float("nan"), "grad_norm": float("inf"), "ok": 1.5}
+        ).to_json()
+        rec = json.loads(line)  # bare NaN/Infinity would raise here
+        assert rec["loss"] is None
+        assert rec["loss_nonfinite"] is True
+        assert rec["grad_norm"] is None
+        assert rec["grad_norm_nonfinite"] is True
+        assert rec["ok"] == 1.5
+        assert "ok_nonfinite" not in rec
+
+    def test_nonfinite_inside_arrays_and_lists(self):
+        import numpy as np
+
+        from automodel_tpu.loggers.metric_logger import MetricsSample
+
+        line = MetricsSample(
+            step=1,
+            metrics={"load": np.asarray([1.0, float("nan")]),
+                     "scalar": jnp.float32(2.0)},
+        ).to_json()
+        rec = json.loads(line)
+        assert rec["load"] == [1.0, None]
+        assert rec["load_nonfinite"] is True
+        assert rec["scalar"] == 2.0
+
+    def test_logger_writes_parseable_lines(self, tmp_path):
+        from automodel_tpu.loggers.metric_logger import MetricLogger
+
+        path = tmp_path / "training.jsonl"
+        with MetricLogger(path) as ml:
+            ml.log(1, loss=float("nan"), tps=None, mfu=0.31)
+        rows = [json.loads(line) for line in open(path)]
+        assert rows[0]["loss"] is None and rows[0]["loss_nonfinite"] is True
+        assert rows[0]["tps"] is None
+        assert rows[0]["mfu"] == 0.31
+
+
+class TestGoodputTracker:
+    def test_buckets_sum_to_wall_time(self):
+        from automodel_tpu.observability import GoodputTracker
+
+        now = [0.0]
+        tracker = GoodputTracker(clock=lambda: now[0])
+
+        def spend(bucket, s):
+            with tracker.track(bucket):
+                now[0] += s
+
+        spend("compile", 30.0)
+        spend("data_wait", 5.0)
+        for _ in range(4):
+            spend("device_step", 10.0)
+        spend("eval", 15.0)
+        spend("checkpoint", 5.0)
+        now[0] += 5.0  # unaccounted -> idle
+
+        totals = tracker.totals()
+        assert sum(totals.values()) == pytest.approx(tracker.wall_s)
+        assert totals["idle"] == pytest.approx(5.0)
+
+        snap = tracker.snapshot()
+        fracs = [v for k, v in snap.items() if k.startswith("goodput/")]
+        assert sum(fracs) == pytest.approx(1.0, abs=1e-3)
+        assert snap["goodput"] == pytest.approx(40.0 / 100.0, abs=1e-3)
+        assert snap["goodput/compile"] == pytest.approx(0.3, abs=1e-3)
+
+    def test_add_and_unknown_bucket(self):
+        from automodel_tpu.observability import GoodputTracker
+
+        tracker = GoodputTracker()
+        tracker.add("device_step", 1.0)
+        tracker.add("custom", 2.0)  # ad-hoc buckets allowed
+        assert tracker.totals()["custom"] == 2.0
+        assert "goodput/custom" in tracker.snapshot()
+
+
+class TestStallWatchdog:
+    def test_fires_on_simulated_stall_and_dumps_stacks(self, tmp_path):
+        from automodel_tpu.observability import StallWatchdog
+
+        events = []
+        wd = StallWatchdog(threshold_s=0.05, dump_dir=str(tmp_path),
+                           on_stall=events.append, poll_interval_s=0.01)
+        wd.start()
+        wd.heartbeat(step=7)
+        deadline = time.monotonic() + 5.0
+        while not events and time.monotonic() < deadline:
+            time.sleep(0.01)  # the loop is "hung": no heartbeats arrive
+        wd.stop()
+        assert len(events) == 1, "stall must fire exactly once per silence window"
+        ev = events[0]
+        assert ev["event"] == "stall"
+        assert ev["step"] == 7
+        assert ev["stall_s"] >= 0.0
+        assert os.path.exists(ev["stack_dump"])
+        dump = open(ev["stack_dump"]).read()
+        # the dump must contain THIS (stalled) thread's stack
+        assert "test_fires_on_simulated_stall_and_dumps_stacks" in dump
+        assert "last step 7" in dump
+
+    def test_heartbeats_rearm_and_suppress(self, tmp_path):
+        from automodel_tpu.observability import StallWatchdog
+
+        events = []
+        wd = StallWatchdog(threshold_s=0.2, dump_dir=str(tmp_path),
+                           on_stall=events.append, poll_interval_s=0.01)
+        wd.start()
+        for _ in range(10):  # steady heartbeats: never fires
+            wd.heartbeat(step=1)
+            time.sleep(0.01)
+        assert events == []
+        time.sleep(0.4)  # silence: fires once
+        assert len(events) == 1
+        wd.heartbeat(step=2)  # recovery re-arms
+        time.sleep(0.4)  # second stall fires again
+        wd.stop()
+        assert len(events) == 2
+        assert not wd.running
+
+    def test_bad_threshold_raises(self, tmp_path):
+        from automodel_tpu.observability import StallWatchdog
+
+        with pytest.raises(ValueError, match="threshold_s"):
+            StallWatchdog(threshold_s=0.0, dump_dir=str(tmp_path))
+
+
+class TestMemoryTelemetry:
+    def test_cpu_noops_cleanly(self):
+        """CPU devices return None from memory_stats(): telemetry degrades to
+        an empty dict, never a crash (JAX_PLATFORMS=cpu in the suite)."""
+        from automodel_tpu.observability import device_memory_stats
+
+        out = device_memory_stats()
+        assert isinstance(out, dict)
+        for v in out.values():  # if a backend DOES report, values are numeric GiB
+            assert isinstance(v, float)
+
+    def test_fake_device_stats(self):
+        from automodel_tpu.observability import device_memory_stats
+
+        class Dev:
+            def __init__(self, in_use, peak):
+                self._s = {"bytes_in_use": in_use, "peak_bytes_in_use": peak}
+
+            def memory_stats(self):
+                return self._s
+
+        class NoneDev:
+            def memory_stats(self):
+                return None
+
+        out = device_memory_stats([Dev(2**30, 2 * 2**30), Dev(2**29, 3 * 2**30), NoneDev()])
+        assert out["hbm_gib_in_use"] == 1.0  # max over devices
+        assert out["hbm_gib_peak"] == 3.0
+
+
+class TestOnDemandProfiler:
+    def test_sigusr1_arms_and_close_disarms_without_server(self, tmp_path):
+        """The signal handler must arm a trace request (and restore the prior
+        handler on close) with NO profiler server running."""
+        from automodel_tpu.observability import OnDemandProfiler
+
+        prev = signal.getsignal(signal.SIGUSR1)
+        p = OnDemandProfiler(str(tmp_path), trace_steps=2, server_port=0)
+        p.start()
+        assert not p.armed
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert p.armed
+        assert not p.tracing  # arming alone must not touch the profiler
+        p.close()
+        assert not p.armed
+        assert signal.getsignal(signal.SIGUSR1) == prev
+        # after close, SIGUSR1 no longer arms this profiler
+        assert not p.armed
+
+    def test_request_trace_programmatic(self, tmp_path):
+        from automodel_tpu.observability import OnDemandProfiler
+
+        p = OnDemandProfiler(str(tmp_path), trace_steps=3, server_port=0, signum=None)
+        p.start()  # signum=None: no handler installed, no server started
+        p.request_trace()
+        assert p.armed
+        p.close()
+
+
+class TestObservabilityManager:
+    def test_from_config_nested_sections(self):
+        from automodel_tpu.observability import Observability, ObservabilityConfig
+
+        cfg = ObservabilityConfig.from_dict({
+            "goodput": True,
+            "watchdog": {"enabled": True, "threshold_s": 120},
+            "profiling": {"server_port": 0, "trace_steps": 7, "signal": "SIGUSR1"},
+        })
+        assert cfg.watchdog and cfg.watchdog_threshold_s == 120.0
+        assert cfg.trace_steps == 7
+        assert cfg.resolve_signal() == signal.SIGUSR1
+        assert ObservabilityConfig.from_dict(None) == ObservabilityConfig()
+        assert ObservabilityConfig.from_dict({"watchdog": False}).watchdog is False
+
+        obs = Observability(cfg, out_dir="/tmp/obs-test")
+        assert obs.watchdog is not None and obs.profiler is not None
+
+    def test_disabled_manager_noops(self, tmp_path):
+        from automodel_tpu.observability import Observability
+
+        obs = Observability.from_config({"enabled": False}, str(tmp_path))
+        obs.start()
+        with obs.track("device_step"):
+            pass
+        obs.heartbeat(1)
+        obs.on_step_start(1)
+        obs.on_step_end(1)
+        assert obs.step_metrics() == {}
+        obs.close()
+
+    def test_step_metrics_carries_compile_and_goodput(self, tmp_path):
+        from automodel_tpu.observability import Observability
+
+        obs = Observability.from_config({"watchdog": False, "memory": False},
+                                        str(tmp_path))
+        obs.record_compile(12.5)
+        obs.record_compile(0.5)  # delayed-QAT second compile accumulates
+        with obs.track("device_step"):
+            pass
+        m = obs.step_metrics()
+        assert m["compile_time_s"] == 13.0
+        assert "goodput" in m and "goodput/idle" in m
+        obs.close()
+
+    def test_stall_event_reaches_metric_sink(self, tmp_path):
+        from automodel_tpu.observability import Observability
+
+        rows = []
+        obs = Observability.from_config(
+            {"watchdog": {"threshold_s": 0.05, "poll_interval_s": 0.01},
+             "goodput": False, "memory": False},
+            str(tmp_path),
+            metric_sink=lambda step, **kw: rows.append((step, kw)),
+        )
+        obs.start()
+        obs.heartbeat(4)
+        deadline = time.monotonic() + 5.0
+        while not rows and time.monotonic() < deadline:
+            time.sleep(0.01)
+        obs.close()
+        assert rows, "stall event must flow through the metric sink"
+        step, fields = rows[0]
+        assert step == 4 and fields["event"] == "stall"
+        assert os.path.exists(fields["stack_dump"])
