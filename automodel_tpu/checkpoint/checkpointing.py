@@ -28,6 +28,11 @@ from typing import Any, Callable, Mapping
 import jax
 import numpy as np
 
+from automodel_tpu.checkpoint.manifest import (
+    has_manifest, verify_manifest, write_manifest,
+)
+from automodel_tpu.utils.retry import RetryConfig, with_retry
+
 logger = logging.getLogger(__name__)
 
 __all__ = ["CheckpointingConfig", "Checkpointer"]
@@ -40,6 +45,9 @@ class CheckpointingConfig:
     save_consolidated: bool = False  # also write HF safetensors per save
     keep_last_k: int | None = None  # prune old step dirs
     async_save: bool = False
+    write_manifest: bool = True  # integrity manifest per save (docs/resilience.md)
+    verify_on_load: bool = True  # manifest-verify a step before restoring it
+    retry: dict | None = None  # transient-I/O retry tuning (utils/retry.py)
 
 
 class Checkpointer:
@@ -53,6 +61,7 @@ class Checkpointer:
         self.hf_config = hf_config
         self._ckptr = None
         self._pending = None
+        self._retry = RetryConfig.from_dict(config.retry)
 
     # lazily create so importing this module never touches orbax/devices
     @property
@@ -70,22 +79,41 @@ class Checkpointer:
     def step_dir(self, step: int) -> str:
         return os.path.join(self.config.checkpoint_dir, f"step_{step}")
 
+    @staticmethod
+    def _parse_step(name: str) -> int | None:
+        """``step_{N}`` -> N, or None for anything unparseable (a stray
+        ``step_final/`` or ``step_3.bak`` must not take down resume)."""
+        if not name.startswith("step_"):
+            return None
+        try:
+            return int(name.split("_", 1)[1])
+        except ValueError:
+            logger.warning("ignoring non-numeric step entry %r in checkpoint dir", name)
+            return None
+
+    def _step_dirs(self) -> list[int]:
+        """Completed step numbers on this host's filesystem view, sorted ascending."""
+        root = self.config.checkpoint_dir
+        if not os.path.isdir(root):
+            return []
+        steps = []
+        for d in os.listdir(root):
+            s = self._parse_step(d)
+            if s is None or not os.path.isdir(os.path.join(root, d)):
+                continue
+            if self._step_complete(os.path.join(root, d)):
+                steps.append(s)
+        return sorted(steps)
+
     def latest_step(self) -> int | None:
         root = self.config.checkpoint_dir
         link = os.path.join(root, "latest")
         if os.path.islink(link):
-            target = os.readlink(link)
-            if target.startswith("step_"):
-                return int(target.split("_")[1])
-        if not os.path.isdir(root):
-            return None
-        steps = [
-            int(d.split("_")[1])
-            for d in os.listdir(root)
-            if d.startswith("step_") and os.path.isdir(os.path.join(root, d))
-            and self._step_complete(os.path.join(root, d))
-        ]
-        return max(steps) if steps else None
+            s = self._parse_step(os.readlink(link))
+            if s is not None:
+                return s
+        steps = self._step_dirs()
+        return steps[-1] if steps else None
 
     @staticmethod
     def _step_complete(d: str) -> bool:
@@ -106,26 +134,38 @@ class Checkpointer:
         opt_state: Any = None,
         client_states: Mapping[str, Any] | None = None,
         hf_params: Any = None,
+        consolidated: bool | None = None,
     ) -> str:
         """``hf_params`` overrides what the consolidated HF export writes — used by
         PEFT to export merged base+adapter weights while ``params`` stays
-        adapter-only (reference checkpoint/addons.py)."""
+        adapter-only (reference checkpoint/addons.py). ``consolidated`` overrides
+        ``config.save_consolidated`` for this save only: the preemption path
+        drops the (slow, collective) HF export when the grace window is short
+        (resilience/manager.py skip_consolidated_export). Must be uniform across
+        hosts — the export's gathers are collectives."""
         if not self.config.enabled:
             return ""
         self.wait()  # finalize any in-flight async save (writes its latest symlink)
         d = self.step_dir(step)
         os.makedirs(d, exist_ok=True)
-        self.ckptr.save(os.path.join(d, "model"), params, force=True)
+        with_retry(self.ckptr.save, os.path.join(d, "model"), params, force=True,
+                   config=self._retry, description="orbax model save")
         if opt_state is not None:
-            self.ckptr.save(os.path.join(d, "optim"), opt_state, force=True)
+            with_retry(self.ckptr.save, os.path.join(d, "optim"), opt_state, force=True,
+                       config=self._retry, description="orbax optim save")
         if jax.process_index() == 0 and client_states:
-            with open(os.path.join(d, "client.json"), "w") as f:
-                json.dump({k: _jsonify(v.state_dict() if hasattr(v, "state_dict") else v)
-                           for k, v in client_states.items()}, f)
+            # tmp + os.replace: a crash mid-write must never leave a truncated
+            # client.json that poisons the next resume
+            _write_json_atomic(
+                os.path.join(d, "client.json"),
+                {k: _jsonify(v.state_dict() if hasattr(v, "state_dict") else v)
+                 for k, v in client_states.items()},
+            )
         if jax.process_index() == 0:
-            with open(os.path.join(d, "signature.json"), "w") as f:
-                json.dump(_model_signature(params), f)
-        if self.config.save_consolidated and self.state_dict_adapter is not None:
+            _write_json_atomic(os.path.join(d, "signature.json"), _model_signature(params))
+        do_consolidated = (self.config.save_consolidated
+                           if consolidated is None else consolidated)
+        if do_consolidated and self.state_dict_adapter is not None:
             self.save_hf(os.path.join(d, "hf"), params if hf_params is None else hf_params)
         # async: the array write may still be in flight — defer the latest symlink
         # to wait() so a crash mid-write can't leave latest -> incomplete step
@@ -164,6 +204,10 @@ class Checkpointer:
             self._ckptr.wait_until_finished()
         if self._pending is not None:
             if jax.process_index() == 0:
+                # manifest AFTER the arrays finalize and BEFORE latest commits:
+                # its presence implies a committed step (checkpoint/manifest.py)
+                if self.config.write_manifest:
+                    write_manifest(self.step_dir(self._pending), step=self._pending)
                 self._update_latest(self._pending)
             self._pending = None
 
@@ -173,8 +217,15 @@ class Checkpointer:
         params_template: Any,
         opt_state_template: Any = None,
         step: int | None = None,
+        verify: bool | None = None,
     ) -> tuple[Any, Any, dict[str, Any]]:
-        """Restore into the shardings/dtypes of the provided templates."""
+        """Restore into the shardings/dtypes of the provided templates.
+
+        ``verify`` (default: ``config.verify_on_load``) checks the step's
+        integrity manifest host-side BEFORE the collective Orbax restore, so a
+        truncated/corrupt file fails with a named problem instead of an opaque
+        mid-collective error. Legacy steps without a manifest load unverified
+        with a warning."""
         if step is None:
             step = self.latest_step()
         if step is None:
@@ -182,6 +233,18 @@ class Checkpointer:
         import orbax.checkpoint as ocp
 
         d = self.step_dir(step)
+        if verify is None:
+            verify = self.config.verify_on_load
+        if verify:
+            if has_manifest(d):
+                problems = verify_manifest(d)
+                if problems:
+                    raise ValueError(
+                        f"checkpoint at {d!r} failed integrity verification: "
+                        f"{problems[:5]}{' ...' if len(problems) > 5 else ''}"
+                    )
+            else:
+                logger.warning("checkpoint at %s has no integrity manifest; loading unverified", d)
         # model-signature compat check (reference base_recipe.py:768-846): fail
         # with a diff instead of orbax's opaque tree-mismatch errors when the
         # config changed between save and resume
@@ -213,21 +276,99 @@ class Checkpointer:
             return jax.tree.map(put, restored, template)
 
         params = _resharded(
-            self.ckptr.restore(os.path.join(d, "model"), args=ocp.args.StandardRestore(params_template)),
+            with_retry(self.ckptr.restore, os.path.join(d, "model"),
+                       args=ocp.args.StandardRestore(params_template),
+                       config=self._retry, description="orbax model restore"),
             params_template,
         )
         opt_state = None
         if opt_state_template is not None and os.path.isdir(os.path.join(d, "optim")):
             opt_state = _resharded(
-                self.ckptr.restore(os.path.join(d, "optim"), args=ocp.args.StandardRestore(opt_state_template)),
+                with_retry(self.ckptr.restore, os.path.join(d, "optim"),
+                           args=ocp.args.StandardRestore(opt_state_template),
+                           config=self._retry, description="orbax optim restore"),
                 opt_state_template,
             )
         client: dict[str, Any] = {}
         cj = os.path.join(d, "client.json")
         if os.path.exists(cj):
-            with open(cj) as f:
-                client = json.load(f)
+            try:
+                with open(cj) as f:
+                    client = json.load(f)
+            except (ValueError, OSError) as e:
+                # a legacy (pre-atomic-write) crash left a truncated client.json;
+                # params/optimizer are intact, so resume with fresh host state
+                # instead of refusing the whole checkpoint
+                logger.warning(
+                    "unreadable client.json at %s (%s: %s); resuming without "
+                    "rng/scheduler/dataloader state", cj, type(e).__name__, e,
+                )
+                client = {}
         return params, opt_state, client
+
+    # -- verified / fallback restore (docs/resilience.md) --------------------
+    def verify_step(self, step: int) -> list[str]:
+        """Integrity problems for a step (empty = verified or legacy-unverifiable)."""
+        d = self.step_dir(step)
+        if not self._step_complete(d):
+            return [f"incomplete step dir {d!r}"]
+        if not has_manifest(d):
+            return []  # legacy pre-manifest save: complete dir is the best signal
+        return verify_manifest(d)
+
+    def newest_verifiable_step(self, exclude: set[int] | None = None) -> int | None:
+        """Walk back from the newest complete step to the newest one that passes
+        integrity verification on THIS host (local filesystem view only)."""
+        exclude = exclude or set()
+        for s in reversed(self._step_dirs()):
+            if s in exclude:
+                continue
+            problems = self.verify_step(s)
+            if not problems:
+                return s
+            logger.warning(
+                "checkpoint step %d fails verification (%s); walking back",
+                s, problems[:3],
+            )
+        return None
+
+    def agreed_restore_step(self, exclude: set[int] | None = None) -> int | None:
+        """The step every host agrees to restore: each host's newest verifiable
+        step, all-gathered, minimum taken — so a host whose filesystem view lags
+        (checkpoint/checkpointing.py filesystem-skew hazard) can never be asked
+        to restore a step it cannot see. Collective on multi-host: every host
+        must call this at the same point."""
+        from automodel_tpu.parallel.init import agreed_min_int
+
+        local = self.newest_verifiable_step(exclude)
+        agreed = agreed_min_int(-1 if local is None else local)
+        return None if agreed < 0 else agreed
+
+    def load_latest_verified(
+        self,
+        params_template: Any,
+        opt_state_template: Any = None,
+    ) -> tuple[Any, Any, dict[str, Any], int] | None:
+        """Restore the newest checkpoint that verifies, walking back through
+        older steps on corruption instead of crashing. Returns
+        ``(params, opt_state, client, step)`` or None when nothing is restorable.
+        Each candidate is re-agreed across hosts so the walk-back cannot split
+        the collective restore."""
+        exclude: set[int] = set()
+        while True:
+            step = self.agreed_restore_step(exclude)
+            if step is None:
+                return None
+            try:
+                params, opt_state, client = self.load(
+                    params_template, opt_state_template, step=step
+                )
+                return params, opt_state, client, step
+            except ValueError as e:
+                # verification failure (or signature mismatch) on this candidate:
+                # exclude it and walk back to the next verifiable step
+                logger.warning("restore of step %d failed (%s); trying an older step", step, e)
+                exclude.add(step)
 
     # -- best tracking -------------------------------------------------------
     def _read_best(self) -> dict | None:
@@ -243,11 +384,22 @@ class Checkpointer:
             return None
 
     def is_best(self, val_loss: float) -> bool:
-        """Would this validation loss improve on the recorded best? (read-only.
-        On multi-host runs decide on process 0 and broadcast — filesystem
-        visibility can skew across hosts.)"""
-        best = self._read_best()
-        return best is None or float(val_loss) < best["val_loss"]
+        """Would this validation loss improve on the recorded best? (read-only.)
+
+        On multi-host runs process 0 reads best.json and DECIDES, then
+        broadcasts the verdict: per-host filesystem reads can skew (a host may
+        see a stale or missing best.json), and since mark_best gates a
+        collective save, a split decision would deadlock the pod."""
+        decision = False
+        if jax.process_index() == 0:
+            best = self._read_best()
+            decision = best is None or float(val_loss) < best["val_loss"]
+        if jax.process_count() > 1:
+            import jax.numpy as jnp
+            from jax.experimental import multihost_utils
+
+            decision = bool(multihost_utils.broadcast_one_to_all(jnp.asarray(decision)))
+        return decision
 
     def mark_best(self, step: int, val_loss: float) -> bool:
         """Record a validation result; when it improves on the best so far,
@@ -292,15 +444,24 @@ class Checkpointer:
             return
         root = self.config.checkpoint_dir
         steps = sorted(
-            int(d.split("_")[1])
-            for d in os.listdir(root)
-            if d.startswith("step_") and os.path.isdir(os.path.join(root, d))
+            s for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+            and (s := self._parse_step(d)) is not None
         )
         best = self.best_step()
         for s in steps[:-k]:
             if s == best:
                 continue  # the best checkpoint survives pruning (reference contract)
             shutil.rmtree(self.step_dir(s), ignore_errors=True)
+
+
+def _write_json_atomic(path: str, obj: Any) -> None:
+    """tmp + os.replace: readers see the old file or the new one, never a
+    truncated half-write (the crash-mid-write hazard that poisons resume)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
 
 
 def _model_signature(params: Any) -> dict[str, str]:

@@ -40,10 +40,18 @@ class LazySafetensors(Mapping):
         self._files = files
 
     def __getitem__(self, key: str) -> np.ndarray:
+        from automodel_tpu.utils.retry import with_retry
+
         path = self._files[key]
-        with _open_file(path) as f:
-            t = f.get_tensor(key)
-        return np.asarray(t)
+
+        def read():
+            with _open_file(path) as f:
+                return f.get_tensor(key)
+
+        # network/remote filesystems (GCS FUSE, NFS) surface transient EIOs;
+        # a truncated file raises a safetensors format error (not transient)
+        # and fails immediately (utils/retry.py allowlist)
+        return np.asarray(with_retry(read, description=f"safetensors read {key!r}"))
 
     def __iter__(self) -> Iterator[str]:
         return iter(self._files)

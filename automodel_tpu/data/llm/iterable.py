@@ -19,6 +19,20 @@ import numpy as np
 __all__ = ["ColumnMappedTextInstructionIterableDataset", "MockIterableDataset"]
 
 
+def _retrying_rows(ds, source: str) -> Iterator[dict]:
+    """Pull rows off a (possibly HTTP-backed) stream, retrying transient
+    failures per row so a mid-epoch network blip doesn't kill the run."""
+    from automodel_tpu.utils.retry import with_retry
+
+    it = iter(ds)
+    sentinel = object()
+    while True:
+        row = with_retry(next, it, sentinel, description=f"stream row from {source!r}")
+        if row is sentinel:
+            return
+        yield row
+
+
 class ColumnMappedTextInstructionIterableDataset:
     """Streaming version of ColumnMappedTextInstructionDataset.
 
@@ -70,8 +84,15 @@ class ColumnMappedTextInstructionIterableDataset:
             return
         import datasets as hf_datasets
 
-        ds = hf_datasets.load_dataset(self.source, split=self.split or "train", streaming=True)
-        yield from ds
+        from automodel_tpu.utils.retry import with_retry
+
+        # opening the stream touches the hub; transient failures retry with
+        # backoff (utils/retry.py) instead of killing a long run at step 0
+        ds = with_retry(
+            hf_datasets.load_dataset, self.source, split=self.split or "train",
+            streaming=True, description=f"load_dataset({self.source!r})",
+        )
+        yield from _retrying_rows(ds, self.source)
 
     def _format(self, row: Mapping[str, Any]) -> dict:
         from automodel_tpu.data.llm.column_mapped import format_and_tokenize

@@ -119,6 +119,25 @@ class DataLoader:
         self.epoch += 1
         self._cursor = 0
 
+    def fast_forward(self, n_batches: int) -> None:
+        """Advance the resume cursor by ``n_batches`` global batches without
+        materializing them — how anomaly rollback skips the data window that
+        produced a loss spike (resilience/manager.py): restore the cursor from
+        the last good checkpoint, then fast-forward past the offending batches.
+        Epoch boundaries wrap exactly as iteration would cross them."""
+        n = int(n_batches)
+        if n < 0:
+            raise ValueError(f"fast_forward needs n_batches >= 0, got {n}")
+        if not self._sized:
+            # streams resume by skip-draining; a larger cursor skips more rows
+            self._cursor += n
+            return
+        nb = len(self)
+        self._cursor += n
+        while self._cursor >= nb and nb > 0:
+            self._cursor -= nb
+            self.epoch += 1
+
     # -- resumable state ----------------------------------------------------
     def state_dict(self) -> dict:
         return {"epoch": self.epoch, "cursor": self._cursor, "seed": self.seed}

@@ -56,7 +56,14 @@ def _snapshot_download(repo_id: str, revision=None, allow_patterns=None) -> str:
             f"loading {repo_id!r} from the HF Hub needs huggingface_hub; "
             "pass a local directory instead"
         ) from exc
-    return snapshot_download(repo_id, revision=revision, allow_patterns=allow_patterns)
+    from automodel_tpu.utils.retry import with_retry
+
+    # transient hub/network blips retry with backoff (utils/retry.py); a 401/404
+    # or corrupt blob is not transient and raises immediately
+    return with_retry(
+        snapshot_download, repo_id, revision=revision, allow_patterns=allow_patterns,
+        description=f"snapshot_download({repo_id!r})",
+    )
 
 
 def _download(repo_id: str, *, revision, allow_patterns) -> str:

@@ -22,7 +22,7 @@ from typing import Callable
 __all__ = ["BUCKETS", "GoodputTracker"]
 
 # buckets the train loop bills explicitly; the remainder is idle
-BUCKETS = ("compile", "data_wait", "device_step", "eval", "checkpoint")
+BUCKETS = ("compile", "data_wait", "device_step", "eval", "checkpoint", "rollback")
 
 
 class GoodputTracker:

@@ -17,7 +17,7 @@ import jax
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["DistInfo", "initialize_distributed", "barrier", "is_main_process", "main_process_first", "any_process_flag"]
+__all__ = ["DistInfo", "initialize_distributed", "barrier", "is_main_process", "main_process_first", "any_process_flag", "agreed_min_int"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,3 +142,24 @@ def any_process_flag(flag: bool) -> bool:
 
     flags = multihost_utils.process_allgather(np.asarray([flag], dtype=np.bool_))
     return bool(np.any(flags))
+
+
+def agreed_min_int(value: int) -> int:
+    """All-gather an int and return the pod-wide MINIMUM — how hosts agree on a
+    restore step when filesystem visibility skews (checkpoint/checkpointing.py):
+    the minimum is the newest state EVERY host can see, so no host is asked to
+    restore a step its filesystem hasn't caught up to. Every host must call this
+    at the same point (it is a collective on multi-host)."""
+    if jax.process_count() == 1:
+        return int(value)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    vals = multihost_utils.process_allgather(np.asarray([int(value)], dtype=np.int64))
+    agreed = int(np.min(vals))
+    if agreed != int(np.max(vals)):
+        logger.warning(
+            "cross-host skew while agreeing on an int (min=%d max=%d); using min",
+            agreed, int(np.max(vals)),
+        )
+    return agreed

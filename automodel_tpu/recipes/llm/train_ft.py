@@ -205,6 +205,18 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             state_dict_adapter=self.model.state_dict_adapter(),
             hf_config=getattr(self, "hf_config", None),
         )
+        # resilience (docs/resilience.md): anomaly rollback, verified fallback
+        # restore, coordinated preemption, chaos injection. Built before resume
+        # (resume goes through the verified-restore path) with a late-bound
+        # metric sink — the loggers come up a few lines below, before any event
+        # can fire.
+        from automodel_tpu.resilience import ResilienceManager
+
+        self.resilience = ResilienceManager.from_config(
+            cfg.get("resilience"), checkpointer=self.checkpointer,
+            metric_sink=lambda step, **f: self._log_event(step, **f),
+        )
+        self.chaos = self.resilience.chaos
         self._maybe_resume()
 
         # metrics: JSONL always on; wandb/mlflow when configured (reference
@@ -451,6 +463,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self._pre_qat_step = None
         self._qat_start_step = 0
         self._step_needs_rng = False
+        # resilience keeps params restorable THROUGH an anomaly: the jitted step
+        # must zero non-finite updates so the tree the host later rolls back
+        # from (or keeps, on skip_update) is never poisoned
+        self._guard_nonfinite = self._check_nan_grads or self.resilience.guards_updates
         qfn = self._qat_param_fn()
         qat_cfg = self.cfg.get("qat")
         qat_start = int(qat_cfg.get("fake_quant_after_n_steps") or 0) if qat_cfg else 0
@@ -501,7 +517,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     )
                     self._step_needs_rng = use_dropout
                     return make_pp_train_step(pp_peft_loss, self.optimizer,
-                                              guard_nonfinite=self._check_nan_grads,
+                                              guard_nonfinite=self._guard_nonfinite,
                                               with_frozen=True,
                                               pass_rng=use_dropout)
                 # qat x pp: quantize the stacked layer params (and head/embed)
@@ -510,7 +526,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 return make_pp_train_step(lambda p, bs, n: pp_loss(q(p), bs, n),
                                           self.optimizer,
                                           post_update=pp_post_update,
-                                          guard_nonfinite=self._check_nan_grads)
+                                          guard_nonfinite=self._guard_nonfinite)
             if self.peft is not None:
                 from automodel_tpu.peft.lora import lora_merged_loss
 
@@ -524,12 +540,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 )
                 self._step_needs_rng = use_dropout
                 return make_train_step(peft_loss, self.optimizer, with_frozen=True,
-                                       guard_nonfinite=self._check_nan_grads,
+                                       guard_nonfinite=self._guard_nonfinite,
                                        pass_rng=use_dropout)
             return make_train_step(
                 lambda p, b, n: self._forward_loss(q(p), b, n),
                 self.optimizer, post_update=self._post_update(),
-                guard_nonfinite=self._check_nan_grads,
+                guard_nonfinite=self._guard_nonfinite,
             )
 
         step = build(with_qat=True)
@@ -590,13 +606,20 @@ class TrainFinetuneRecipeForNextTokenPrediction:
     def _maybe_resume(self):
         if not self.checkpointer.config.enabled:
             return
-        latest = self.checkpointer.latest_step()
-        if latest is None:
+        # verified restore with walk-back: a truncated/corrupt latest step falls
+        # back to the newest step that passes its integrity manifest, agreed
+        # across hosts (docs/resilience.md). load_latest_verified returns None
+        # only when NO restorable checkpoint exists — a fresh run.
+        restored = self.checkpointer.load_latest_verified(self.train_params, self.opt_state)
+        if restored is None:
             return
-        logger.info("resuming from step %d", latest)
-        self.train_params, self.opt_state, client = self.checkpointer.load(
-            self.train_params, self.opt_state, step=latest
-        )
+        self.train_params, self.opt_state, client, step = restored
+        logger.info("resuming from step %d", step)
+        self._apply_client_state(client)
+
+    def _apply_client_state(self, client: dict):
+        """Restore the host-side training services a checkpoint carries; shared
+        by process-restart resume and in-process anomaly rollback."""
         if self.peft is None:
             self.params = self.train_params
         if "rng" in client:
@@ -605,6 +628,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             self.step_scheduler.load_state_dict(client["step_scheduler"])
         if "dataloader" in client:
             self.dataloader.load_state_dict(client["dataloader"])
+        if "resilience" in client:
+            self.resilience.load_state_dict(client["resilience"])
 
     def _device_put_stack(self, stack):
         """Shard the stacked (n_micro, B, S) token streams over the batch axes;
@@ -622,184 +647,30 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             lg.log(step, **fields)
 
     def run_train_validation_loop(self):
-        mesh = self.mesh
         obs = self.observability
         obs.start()
-        t_last = time.perf_counter()
-        steps_since_log = 0
-        window_overhead = 0.0  # eval/ckpt seconds to exclude from step_time_s
-        checked_vocab = False
-        compiled_fns: set[int] = set()
+        # compile billing survives rollback re-entries: a restored pass reuses
+        # the already-jitted step, so it must not re-charge the compile bucket
+        self._compiled_fns: set[int] = set()
+        self._checked_vocab = False
+        outcome = "done"
         try:
-            with mesh:
-                it = iter(self.step_scheduler)
+            with self.mesh:
+                # each pass runs until done/preempted or an anomaly rolls state
+                # back to the last verifiable checkpoint, in-process; the pass
+                # then restarts with a fresh scheduler iterator (same mechanics
+                # as a process-restart resume, without losing the jit cache)
                 while True:
-                    with obs.track("data_wait"):
-                        batches = next(it, None)
-                    if batches is None:
+                    outcome = self._train_pass(obs)
+                    if outcome != "rollback":
                         break
-                    stack = stack_batches(batches)
-                    if not checked_vocab:
-                        # tokenizer/model vocab mismatch shows up as NaN loss deep in
-                        # training; fail loudly on the first batch instead
-                        vocab = getattr(getattr(self.model.config, "text", self.model.config),
-                                        "vocab_size", None)
-                        if vocab is not None:
-                            for key in ("input_ids", "q_ids", "p_ids"):
-                                if key in stack and int(stack[key].max()) >= vocab:
-                                    raise ValueError(
-                                        f"batch {key} contains token id {int(stack[key].max())} "
-                                        f">= model vocab_size {vocab}: tokenizer/model mismatch"
-                                    )
-                        checked_vocab = True
-                    step = self.step_scheduler.step
-                    obs.on_step_start(step)
-                    with obs.track("data_wait"):
-                        # host->device staging is data movement, not device compute
-                        stack = self._device_put_stack(stack)
-                    extra = (self.params,) if self.peft is not None else ()
-                    if self._step_needs_rng:
-                        extra = (*extra, self.rng.key("lora_dropout"))
-                    step_fn = self._train_step
-                    if self._pre_qat_step is not None and step < self._qat_start_step:
-                        step_fn = self._pre_qat_step
-                    if id(step_fn) not in compiled_fns:
-                        # first call of a jitted step pays tracing + XLA compile
-                        # (step 0, and again at a delayed-QAT switch): bill it to
-                        # the compile bucket and keep it OUT of the throughput
-                        # window — the first step_time_s/tps row would otherwise
-                        # absorb minutes of compile. float() pulls a scalar to
-                        # host: a real sync even through remote-execution tunnels
-                        # where block_until_ready is a no-op.
-                        t0 = time.perf_counter()
-                        self.train_params, self.opt_state, metrics = step_fn(
-                            self.train_params, self.opt_state, stack, *extra
-                        )
-                        float(metrics["loss"])
-                        obs.record_compile(time.perf_counter() - t0)
-                        compiled_fns.add(id(step_fn))
-                        t_last = time.perf_counter()
-                        steps_since_log = 0  # compile step excluded from the window
-                        window_overhead = 0.0
-                    else:
-                        with obs.track("device_step"):
-                            self.train_params, self.opt_state, metrics = step_fn(
-                                self.train_params, self.opt_state, stack, *extra
-                            )
-                        steps_since_log += 1
-                    if self.peft is None:
-                        self.params = self.train_params
-                    obs.heartbeat(step)
-                    # reference check_for_nan_in_grad (distributed/config.py:129): a
-                    # non-finite gradient is a training bug. The jitted step already
-                    # SKIPPED the corrupt update (guard_nonfinite), so params and
-                    # optimizer state stay clean; raise loudly here every step.
-                    # Costs one scalar device->host pull per step.
-                    if self._check_nan_grads and bool(metrics["nonfinite"]):
-                        raise RuntimeError(
-                            f"non-finite training signal at step {step}: "
-                            f"loss={float(metrics['loss'])} "
-                            f"grad_norm={float(metrics['grad_norm'])} "
-                            "(the offending update was skipped; params remain clean)"
-                        )
-                    if self.step_scheduler.is_log_step:
-                        with obs.track("device_step"):
-                            # the scalar pulls block on the step's device work, so
-                            # this wait is device time, not idle
-                            loss = float(metrics["loss"])
-                            gnorm = float(metrics["grad_norm"])
-                            ntok = int(metrics["num_label_tokens"])
-                        now = time.perf_counter()
-                        # per-step time, with eval/ckpt pauses subtracted;
-                        # steps_since_log == 0 <=> the window held only a compile
-                        # step, whose device time already lives in compile_time_s
-                        # — no throughput to report yet
-                        dt = (max(now - t_last - window_overhead, 0.0) / steps_since_log
-                              if steps_since_log else None)
-                        t_last = now
-                        steps_since_log = 0
-                        window_overhead = 0.0
-                        # global tokens per optimizer step (local slice x process count);
-                        # biencoder batches carry q_ids/p_ids instead of input_ids
-                        step_tokens = sum(
-                            int(np.prod(stack[k].shape))
-                            for k in ("input_ids", "q_ids", "p_ids") if k in stack
-                        ) * jax.process_count()
-                        extra = {}
-                        if "expert_load" in metrics and self.moe_metrics_mode:
-                            from automodel_tpu.moe.metrics import compute_load_balance_metrics
-
-                            extra = compute_load_balance_metrics(
-                                np.asarray(metrics["expert_load"]), mode=self.moe_metrics_mode
-                            )
-                        if "dropped_token_frac" in metrics:
-                            # summed over the step's microbatches in the train-step carry
-                            extra["moe_load/dropped_token_frac"] = float(
-                                np.asarray(metrics["dropped_token_frac"])
-                            ) / max(1, self.step_scheduler.grad_acc_steps)
-                        row = dict(
-                            loss=loss,
-                            grad_norm=gnorm,
-                            lr=float(self.lr_schedule(step)),
-                            num_label_tokens=ntok,
-                            step_time_s=round(dt, 4) if dt else None,
-                            tps=round(step_tokens / dt, 1) if dt else None,
-                            tps_per_chip=(round(step_tokens / dt / jax.device_count(), 1)
-                                          if dt else None),
-                            **extra,
-                            **self._static_log_fields,
-                        )
-                        if self._flops_per_token is not None:
-                            from automodel_tpu.utils.flops import mfu
-
-                            fpt = self._flops_per_token
-                            if dt:
-                                tps_now = step_tokens / dt
-                                row["tflops_per_chip"] = round(
-                                    tps_now * fpt / 1e12 / jax.device_count(), 2
-                                )
-                                # 0.0 on device kinds without a peak-TFLOPs entry (CPU)
-                                row["mfu"] = round(
-                                    mfu(tps_now, fpt, self._device_kind, jax.device_count()), 4
-                                )
-                            else:  # compile-only window: keys present, no rate yet
-                                row["tflops_per_chip"] = None
-                                row["mfu"] = None
-                        row.update(obs.step_metrics())
-                        self.metric_logger.log(step, **row)
-                        for lg in self.experiment_loggers:
-                            lg.log(step, **row)
-                        logger.info(
-                            "step %d | loss %.4f | gnorm %.3f | %s", step, loss, gnorm,
-                            f"{step_tokens / dt:.0f} tok/s" if dt else "compile step",
-                        )
-                    if self.val_dataloader is not None and self.step_scheduler.is_val_step:
-                        t_pause = time.perf_counter()
-                        with obs.track("eval"):
-                            self._run_validation(step)
-                        obs.heartbeat(step)
-                        window_overhead += time.perf_counter() - t_pause
-                    if (
-                        self.checkpointer.config.enabled
-                        and self.step_scheduler.is_ckpt_step
-                        and getattr(self, "_last_saved_step", None) != step
-                    ):
-                        # the best-tracking path may have just saved this very step
-                        t_pause = time.perf_counter()
-                        with obs.track("checkpoint"):
-                            self._save(step)
-                        obs.heartbeat(step)
-                        window_overhead += time.perf_counter() - t_pause
-                    obs.on_step_end(step, sync=metrics.get("loss"))
-                    if self.step_scheduler.sigterm_received:
-                        logger.warning("SIGTERM received; checkpointing and exiting")
-                        with obs.track("checkpoint"):
-                            self._save(step)
-                        break
-            # final checkpoint; wait() commits any async save's latest symlink
+            # final checkpoint; wait() commits any async save's latest symlink.
+            # A preempted pass already saved under its grace deadline — a second
+            # save here would re-run the consolidated export it chose to skip.
             if self.checkpointer.config.enabled:
                 with obs.track("checkpoint"):
-                    self._save(self.step_scheduler.step)
+                    if outcome != "preempted":
+                        self._save(self.step_scheduler.step)
                     self.checkpointer.wait()
         finally:
             obs.close()
@@ -807,6 +678,254 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             self.val_metric_logger.close()
             for lg in self.experiment_loggers:
                 lg.close()
+
+    def _train_pass(self, obs) -> str:
+        """One pass over the step loop inside the mesh context. Returns
+        ``"done"`` (data exhausted / max_steps), ``"preempted"`` (SIGTERM saved
+        and exited), or ``"rollback"`` (state restored to the last good
+        checkpoint — the caller re-enters)."""
+        t_last = time.perf_counter()
+        steps_since_log = 0
+        window_overhead = 0.0  # eval/ckpt seconds to exclude from step_time_s
+        compiled_fns = self._compiled_fns
+        it = iter(self.step_scheduler)
+        while True:
+            with obs.track("data_wait"):
+                batches = next(it, None)
+            if batches is None:
+                return "done"
+            stack = stack_batches(batches)
+            if not self._checked_vocab:
+                # tokenizer/model vocab mismatch shows up as NaN loss deep in
+                # training; fail loudly on the first batch instead
+                vocab = getattr(getattr(self.model.config, "text", self.model.config),
+                                "vocab_size", None)
+                if vocab is not None:
+                    for key in ("input_ids", "q_ids", "p_ids"):
+                        if key in stack and int(stack[key].max()) >= vocab:
+                            raise ValueError(
+                                f"batch {key} contains token id {int(stack[key].max())} "
+                                f">= model vocab_size {vocab}: tokenizer/model mismatch"
+                            )
+                self._checked_vocab = True
+            step = self.step_scheduler.step
+            obs.on_step_start(step)
+            with obs.track("data_wait"):
+                # host->device staging is data movement, not device compute
+                stack = self._device_put_stack(stack)
+            extra = (self.params,) if self.peft is not None else ()
+            if self._step_needs_rng:
+                extra = (*extra, self.rng.key("lora_dropout"))
+            step_fn = self._train_step
+            if self._pre_qat_step is not None and step < self._qat_start_step:
+                step_fn = self._pre_qat_step
+            if id(step_fn) not in compiled_fns:
+                # first call of a jitted step pays tracing + XLA compile
+                # (step 0, and again at a delayed-QAT switch): bill it to
+                # the compile bucket and keep it OUT of the throughput
+                # window — the first step_time_s/tps row would otherwise
+                # absorb minutes of compile. float() pulls a scalar to
+                # host: a real sync even through remote-execution tunnels
+                # where block_until_ready is a no-op.
+                t0 = time.perf_counter()
+                self.train_params, self.opt_state, metrics = step_fn(
+                    self.train_params, self.opt_state, stack, *extra
+                )
+                float(metrics["loss"])
+                obs.record_compile(time.perf_counter() - t0)
+                compiled_fns.add(id(step_fn))
+                t_last = time.perf_counter()
+                steps_since_log = 0  # compile step excluded from the window
+                window_overhead = 0.0
+            else:
+                with obs.track("device_step"):
+                    self.train_params, self.opt_state, metrics = step_fn(
+                        self.train_params, self.opt_state, stack, *extra
+                    )
+                steps_since_log += 1
+            if self.chaos is not None and self.chaos.should_poison(step):
+                # fault injection (resilience/chaos.py): simulate corruption
+                # the jit guard missed — params AND metrics go non-finite,
+                # so recovery genuinely requires a checkpoint rollback
+                self.train_params, metrics = self.chaos.poison(
+                    step, self.train_params, metrics
+                )
+            if self.peft is None:
+                self.params = self.train_params
+            obs.heartbeat(step)
+            if self.resilience.active:
+                # same-step anomaly handling (docs/resilience.md): one
+                # scalar device->host sync per step buys detection before
+                # the bad trajectory reaches the next checkpoint
+                action = self.resilience.on_step(
+                    step,
+                    float(metrics["loss"]),
+                    float(metrics["grad_norm"]),
+                    bool(metrics.get("nonfinite", False)),
+                )
+                if action == "rollback":
+                    if self._perform_rollback(step, obs):
+                        return "rollback"
+                    action = "abort"  # nothing verifiable to roll back to
+                if action == "abort":
+                    raise RuntimeError(
+                        f"resilience: unrecoverable training anomaly at step {step} "
+                        f"(loss={float(metrics['loss'])}, "
+                        f"grad_norm={float(metrics['grad_norm'])}); "
+                        "rollback budget exhausted or no verifiable checkpoint"
+                    )
+                # skip_update: the jitted guard already zeroed the bad
+                # update — params/optimizer state are the pre-step values
+            elif self._check_nan_grads and bool(metrics["nonfinite"]):
+                # reference check_for_nan_in_grad (distributed/config.py:129):
+                # without resilience a non-finite gradient is a training
+                # bug. The jitted step already SKIPPED the corrupt update
+                # (guard_nonfinite), so params and optimizer state stay
+                # clean; raise loudly here every step.
+                raise RuntimeError(
+                    f"non-finite training signal at step {step}: "
+                    f"loss={float(metrics['loss'])} "
+                    f"grad_norm={float(metrics['grad_norm'])} "
+                    "(the offending update was skipped; params remain clean)"
+                )
+            if self.step_scheduler.is_log_step:
+                with obs.track("device_step"):
+                    # the scalar pulls block on the step's device work, so
+                    # this wait is device time, not idle
+                    loss = float(metrics["loss"])
+                    gnorm = float(metrics["grad_norm"])
+                    ntok = int(metrics["num_label_tokens"])
+                now = time.perf_counter()
+                # per-step time, with eval/ckpt pauses subtracted;
+                # steps_since_log == 0 <=> the window held only a compile
+                # step, whose device time already lives in compile_time_s
+                # — no throughput to report yet
+                dt = (max(now - t_last - window_overhead, 0.0) / steps_since_log
+                      if steps_since_log else None)
+                t_last = now
+                steps_since_log = 0
+                window_overhead = 0.0
+                # global tokens per optimizer step (local slice x process count);
+                # biencoder batches carry q_ids/p_ids instead of input_ids
+                step_tokens = sum(
+                    int(np.prod(stack[k].shape))
+                    for k in ("input_ids", "q_ids", "p_ids") if k in stack
+                ) * jax.process_count()
+                extra = {}
+                if "expert_load" in metrics and self.moe_metrics_mode:
+                    from automodel_tpu.moe.metrics import compute_load_balance_metrics
+
+                    extra = compute_load_balance_metrics(
+                        np.asarray(metrics["expert_load"]), mode=self.moe_metrics_mode
+                    )
+                if "dropped_token_frac" in metrics:
+                    # summed over the step's microbatches in the train-step carry
+                    extra["moe_load/dropped_token_frac"] = float(
+                        np.asarray(metrics["dropped_token_frac"])
+                    ) / max(1, self.step_scheduler.grad_acc_steps)
+                row = dict(
+                    loss=loss,
+                    grad_norm=gnorm,
+                    lr=float(self.lr_schedule(step)),
+                    num_label_tokens=ntok,
+                    step_time_s=round(dt, 4) if dt else None,
+                    tps=round(step_tokens / dt, 1) if dt else None,
+                    tps_per_chip=(round(step_tokens / dt / jax.device_count(), 1)
+                                  if dt else None),
+                    **extra,
+                    **self._static_log_fields,
+                )
+                if self._flops_per_token is not None:
+                    from automodel_tpu.utils.flops import mfu
+
+                    fpt = self._flops_per_token
+                    if dt:
+                        tps_now = step_tokens / dt
+                        row["tflops_per_chip"] = round(
+                            tps_now * fpt / 1e12 / jax.device_count(), 2
+                        )
+                        # 0.0 on device kinds without a peak-TFLOPs entry (CPU)
+                        row["mfu"] = round(
+                            mfu(tps_now, fpt, self._device_kind, jax.device_count()), 4
+                        )
+                    else:  # compile-only window: keys present, no rate yet
+                        row["tflops_per_chip"] = None
+                        row["mfu"] = None
+                row.update(obs.step_metrics())
+                self.metric_logger.log(step, **row)
+                for lg in self.experiment_loggers:
+                    lg.log(step, **row)
+                logger.info(
+                    "step %d | loss %.4f | gnorm %.3f | %s", step, loss, gnorm,
+                    f"{step_tokens / dt:.0f} tok/s" if dt else "compile step",
+                )
+            if self.val_dataloader is not None and self.step_scheduler.is_val_step:
+                t_pause = time.perf_counter()
+                with obs.track("eval"):
+                    self._run_validation(step)
+                obs.heartbeat(step)
+                window_overhead += time.perf_counter() - t_pause
+            if (
+                self.checkpointer.config.enabled
+                and self.step_scheduler.is_ckpt_step
+                and getattr(self, "_last_saved_step", None) != step
+            ):
+                # the best-tracking path may have just saved this very step
+                t_pause = time.perf_counter()
+                with obs.track("checkpoint"):
+                    self._save(step)
+                obs.heartbeat(step)
+                window_overhead += time.perf_counter() - t_pause
+            obs.on_step_end(step, sync=metrics.get("loss"))
+            if self.step_scheduler.sigterm_received:
+                # coordinated preemption (docs/resilience.md): the flag is
+                # pod-agreed, so every host reaches this save together.
+                # When the remaining grace window is short, the pod agrees
+                # to drop the consolidated HF export — the sharded arrays
+                # + client state (all that resume needs) still land.
+                logger.warning("SIGTERM received; checkpointing and exiting")
+                consolidated = None
+                if (self.resilience.config.enabled
+                        and self.checkpointer.config.save_consolidated
+                        and self.resilience.skip_consolidated_export(
+                            self.step_scheduler.sigterm_elapsed_s)):
+                    consolidated = False
+                with obs.track("checkpoint"):
+                    self._save(step, consolidated=consolidated)
+                return "preempted"
+
+    def _perform_rollback(self, bad_step: int, obs) -> bool:
+        """In-process restore from the newest pod-agreed verifiable checkpoint
+        (PaLM-style spike recovery: restore, then skip the offending data
+        window). Returns False when no restorable checkpoint exists."""
+        self.checkpointer.wait()  # commit any in-flight save before choosing
+        with obs.track("rollback"):
+            restored = self.checkpointer.load_latest_verified(
+                self.train_params, self.opt_state
+            )
+            if restored is None:
+                return False
+            self.train_params, self.opt_state, client, to_step = restored
+            # the live anomaly counters (rollback budget, skip streak) must
+            # survive the restore — reloading them from the checkpoint would
+            # reset the budget and let a persistent fault loop forever
+            client.pop("resilience", None)
+            self._apply_client_state(client)
+            # the step counter jumps back to bad_step (monotone logs, LR
+            # schedule continues) while the data cursor fast-forwards past the
+            # offending window [to_step+1, bad_step] plus skip_steps fresh
+            # batches — the PaLM recipe: do not re-feed the data that spiked
+            skip = int(self.resilience.config.rollback.skip_steps)
+            n_bad = bad_step - self.step_scheduler.step
+            self.dataloader.fast_forward(
+                max(n_bad + skip, 0) * self.step_scheduler.grad_acc_steps
+            )
+            self.step_scheduler.step = bad_step
+            # fast-forward may have crossed an epoch boundary; the scheduler
+            # counts epochs by completed dataloader passes, so re-sync
+            self.step_scheduler.epoch = self.dataloader.epoch
+            self.resilience.note_rollback(bad_step, to_step, n_bad + skip)
+        return True
 
     def _run_validation(self, step: int):
         # validate on the SAME weights training currently sees: before a delayed
@@ -887,41 +1006,45 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             logger.info("validation @ step %d: loss %.4f%s", step, val_loss,
                         "".join(f" | {k} {v:.4f}" for k, v in extras.items()))
             # best-checkpoint tracking (reference base_recipe.py:383-425): save
-            # the improving step and point the `best` symlink at it. The
-            # improvement decision is made on process 0 and broadcast — per-host
+            # the improving step and point the `best` symlink at it. is_best()
+            # decides on process 0 and broadcasts internally — per-host
             # filesystem reads can skew, and orbax save is a collective, so a
             # split decision would deadlock the pod.
             if self.checkpointer.config.enabled and bool(self.cfg.get("checkpoint.save_best", True)):
-                improved = self.checkpointer.is_best(val_loss)
-                if jax.process_count() > 1:
-                    from jax.experimental import multihost_utils
-
-                    improved = bool(
-                        multihost_utils.broadcast_one_to_all(jnp.asarray(improved))
-                    )
-                if improved:
+                if self.checkpointer.is_best(val_loss):
                     self._save(step)
                     self.checkpointer.mark_best(step, val_loss)
 
-    def _save(self, step: int):
+    def _save(self, step: int, consolidated: bool | None = None):
         """PEFT saves are adapter-only (reference PEFT checkpoint addon,
         checkpoint/addons.py); consolidated HF export merges the adapter so the
-        output is a plain HF model either way."""
+        output is a plain HF model either way. ``consolidated=False`` drops the
+        HF export for this save (preemption under a short grace window)."""
         self._last_saved_step = step
         client = {
             "rng": self.rng,
             "step_scheduler": self.step_scheduler,
             "dataloader": self.dataloader,
+            "resilience": self.resilience,
         }
+        do_consolidated = (self.checkpointer.config.save_consolidated
+                           if consolidated is None else consolidated)
         hf_params = None
         if self.peft is not None:
             client["peft_config"] = self.peft.to_dict()
-            if self.checkpointer.config.save_consolidated:
+            if do_consolidated:
                 hf_params = self._merge_lora(self.params, self.train_params)
         d = self.checkpointer.save(
-            step, self.train_params, self.opt_state, client_states=client, hf_params=hf_params
+            step, self.train_params, self.opt_state, client_states=client,
+            hf_params=hf_params, consolidated=consolidated,
         )
-        if d and self.peft is not None and self.checkpointer.config.save_consolidated:
+        self.resilience.record_checkpoint(step)
+        if d and self.chaos is not None and self.chaos.should_corrupt(step):
+            # fault injection: finalize first (manifest written, latest committed)
+            # so the truncation exercises verify-and-walk-back, not a half save
+            self.checkpointer.wait()
+            self.chaos.corrupt_checkpoint(step, d)
+        if d and self.peft is not None and do_consolidated:
             # adapter-only HF PEFT export alongside the merged model: deployable
             # via peft.PeftModel without shipping base weights
             from automodel_tpu.checkpoint.checkpointing import _full_host_array

@@ -1,0 +1,24 @@
+"""Fault-tolerant training: anomaly rollback, checkpoint integrity + fallback
+restore, coordinated preemption, transient-fault retry, and a deterministic
+fault-injection harness (docs/resilience.md)."""
+
+from automodel_tpu.resilience.anomaly import AnomalyDetector, RecoveryPolicy, Verdict
+from automodel_tpu.resilience.chaos import ChaosConfig, ChaosInjector, FlakyIO
+from automodel_tpu.resilience.config import (
+    AnomalyConfig, PreemptionConfig, ResilienceConfig, RollbackConfig,
+)
+from automodel_tpu.resilience.manager import ResilienceManager
+
+__all__ = [
+    "AnomalyConfig",
+    "AnomalyDetector",
+    "ChaosConfig",
+    "ChaosInjector",
+    "FlakyIO",
+    "PreemptionConfig",
+    "RecoveryPolicy",
+    "ResilienceConfig",
+    "ResilienceManager",
+    "RollbackConfig",
+    "Verdict",
+]

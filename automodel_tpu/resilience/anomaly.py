@@ -1,0 +1,146 @@
+"""Anomaly detection + the skip→rollback→abort policy engine.
+
+The PaLM-style recovery loop needs two host-side pieces: a detector that turns
+per-step training signals into verdicts, and a policy that turns verdicts into
+actions under a budget. Both are pure-python and device-free so they are
+testable without a model (tests/unit/test_resilience.py).
+
+Detector: a rolling window of recent finite losses gives mean/std; a step whose
+loss z-score exceeds ``zscore_threshold`` (or whose grad norm exceeds the
+optional absolute ceiling, or that is non-finite) is anomalous. Anomalous
+observations never enter the window — a spike must not inflate the std it is
+judged against.
+
+Policy escalation:
+
+- ``nonfinite`` verdicts: the jitted step's guard already dropped the update
+  (training/train_step.py ``_guard_nonfinite_update``), so params are clean —
+  the cheapest response is to skip and continue. After
+  ``max_skipped_updates`` CONSECUTIVE skips the signal is persistent, not a
+  blip: escalate to rollback.
+- ``loss_spike``/``grad_spike`` verdicts: the update already landed in params,
+  so rollback is the only real remedy.
+- Rollback draws from a budget: ``max_rollbacks`` within ``budget_steps`` of
+  the last anomaly; a budget-exhausted rollback request becomes ``abort``.
+  Clean progress past ``budget_steps`` refills the budget.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+
+from automodel_tpu.resilience.config import AnomalyConfig, RollbackConfig
+
+__all__ = ["Verdict", "AnomalyDetector", "RecoveryPolicy"]
+
+# policy actions, in escalation order
+OK = "ok"
+SKIP_UPDATE = "skip_update"
+ROLLBACK = "rollback"
+ABORT = "abort"
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    kind: str  # "ok" | "nonfinite" | "loss_spike" | "grad_spike"
+    step: int
+    loss: float
+    grad_norm: float
+    zscore: float | None = None
+
+    @property
+    def anomalous(self) -> bool:
+        return self.kind != "ok"
+
+
+class AnomalyDetector:
+    """Rolling-statistics anomaly detection over the per-step training signal."""
+
+    def __init__(self, config: AnomalyConfig | None = None):
+        self.config = config or AnomalyConfig()
+        self._window: collections.deque[float] = collections.deque(
+            maxlen=max(int(self.config.window), 2)
+        )
+
+    def _loss_zscore(self, loss: float) -> float | None:
+        if len(self._window) < max(int(self.config.min_history), 2):
+            return None
+        n = len(self._window)
+        mean = sum(self._window) / n
+        var = sum((x - mean) ** 2 for x in self._window) / n
+        # floor the std: late in training losses flatline and a tiny jitter
+        # would otherwise produce astronomical z-scores
+        std = max(math.sqrt(var), 1e-3, 1e-3 * abs(mean))
+        return (loss - mean) / std
+
+    def observe(self, step: int, loss: float, grad_norm: float,
+                nonfinite: bool = False) -> Verdict:
+        """Classify one step; clean observations extend the rolling window."""
+        if nonfinite or not (math.isfinite(loss) and math.isfinite(grad_norm)):
+            return Verdict("nonfinite", step, loss, grad_norm)
+        gt = self.config.grad_norm_threshold
+        if gt is not None and grad_norm > float(gt):
+            return Verdict("grad_spike", step, loss, grad_norm)
+        z = self._loss_zscore(loss)
+        if z is not None and z > float(self.config.zscore_threshold):
+            return Verdict("loss_spike", step, loss, grad_norm, zscore=z)
+        self._window.append(loss)
+        return Verdict("ok", step, loss, grad_norm, zscore=z)
+
+    def reset(self) -> None:
+        """Drop history (after a rollback the restored trajectory re-seeds it)."""
+        self._window.clear()
+
+    # -- checkpointable (rides client.json so resume keeps the stats) -------
+    def state_dict(self) -> dict:
+        return {"window": list(self._window)}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._window.clear()
+        self._window.extend(float(x) for x in state.get("window", ()))
+
+
+class RecoveryPolicy:
+    """Turns verdicts into actions under the rollback budget."""
+
+    def __init__(self, rollback: RollbackConfig | None = None,
+                 max_skipped_updates: int = 3):
+        self.rollback = rollback or RollbackConfig()
+        self.max_skipped_updates = int(max_skipped_updates)
+        self.consecutive_skips = 0
+        self.rollbacks_used = 0
+        self.last_anomaly_step: int | None = None
+
+    def decide(self, verdict: Verdict) -> str:
+        """One of ``ok`` / ``skip_update`` / ``rollback`` / ``abort``."""
+        step = verdict.step
+        if not verdict.anomalous:
+            self.consecutive_skips = 0
+            if (
+                self.last_anomaly_step is not None
+                and step - self.last_anomaly_step >= int(self.rollback.budget_steps)
+            ):
+                # budget refill: sustained clean progress forgives old rollbacks
+                self.rollbacks_used = 0
+                self.last_anomaly_step = None
+            return OK
+        self.last_anomaly_step = step
+        if verdict.kind == "nonfinite":
+            self.consecutive_skips += 1
+            if self.consecutive_skips <= self.max_skipped_updates:
+                return SKIP_UPDATE
+        # persistent nonfinite, or a finite spike that already landed in params
+        return self._request_rollback()
+
+    def _request_rollback(self) -> str:
+        if not self.rollback.enabled:
+            return ABORT
+        if self.rollbacks_used >= int(self.rollback.max_rollbacks):
+            return ABORT
+        return ROLLBACK
+
+    def on_rollback(self) -> None:
+        self.rollbacks_used += 1
+        self.consecutive_skips = 0

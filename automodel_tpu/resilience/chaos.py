@@ -1,0 +1,164 @@
+"""Deterministic fault injection for the recovery path (docs/resilience.md).
+
+The whole point of a fault-tolerance subsystem is that it runs correctly on
+the worst day of the run — which never happens in CI unless faults are
+manufactured. ``ChaosInjector`` deterministically injects the three fault
+classes the resilience layer must survive, all CPU-runnable:
+
+- **NaN training signal** (``nan_grad_steps``): at the named optimizer steps
+  the step's params are poisoned with a NaN leaf and its metrics report a
+  non-finite loss/grad-norm — the worst case where a corrupt update already
+  landed, so ONLY a checkpoint rollback recovers.
+- **Truncated checkpoint** (``corrupt_ckpt_steps``): right after the save of a
+  named step commits, one of its files is truncated in place — the next
+  restore must detect it via the integrity manifest and walk back.
+- **Transient I/O errors** (:class:`FlakyIO`): a callable that raises
+  ``ConnectionError`` N times before succeeding, for exercising
+  ``utils/retry.py`` wiring end-to-end.
+
+Injection is step-keyed and config-driven, so a chaos run is exactly
+reproducible (tools/chaos_smoke.py asserts recovery on a mock recipe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Callable
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ChaosConfig", "ChaosInjector", "FlakyIO"]
+
+
+@dataclasses.dataclass
+class ChaosConfig:
+    enabled: bool = False
+    nan_grad_steps: tuple[int, ...] = ()
+    corrupt_ckpt_steps: tuple[int, ...] = ()
+    # which file of the step dir to truncate; the first match wins
+    corrupt_target: str = "largest"  # "largest" | "client.json" | "manifest.json"
+
+    @classmethod
+    def from_dict(cls, raw: Any) -> "ChaosConfig":
+        if raw is None:
+            return cls()
+        if hasattr(raw, "to_dict"):
+            raw = raw.to_dict()
+        d = dict(raw)
+        return cls(
+            enabled=bool(d.get("enabled", False)),
+            nan_grad_steps=tuple(int(s) for s in (d.get("nan_grad_steps") or ())),
+            corrupt_ckpt_steps=tuple(int(s) for s in (d.get("corrupt_ckpt_steps") or ())),
+            corrupt_target=str(d.get("corrupt_target", "largest")),
+        )
+
+
+class ChaosInjector:
+    """Holds the injection schedule; each fault fires at most once per step."""
+
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+        self._fired_nan: set[int] = set()
+        self._fired_corrupt: set[int] = set()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.config.enabled)
+
+    # -- NaN training signal -------------------------------------------------
+    def should_poison(self, step: int) -> bool:
+        return (
+            self.enabled
+            and step in self.config.nan_grad_steps
+            and step not in self._fired_nan
+        )
+
+    def poison(self, step: int, params: Any, metrics: dict) -> tuple[Any, dict]:
+        """Corrupt ``params`` (NaN into the first float leaf) and report a
+        non-finite signal — simulating a fault the jitted guard did NOT catch,
+        so recovery requires a genuine rollback."""
+        import jax
+        import jax.numpy as jnp
+
+        self._fired_nan.add(step)
+        logger.warning("chaos: injecting NaN training signal at step %d", step)
+        leaves, treedef = jax.tree.flatten(params)
+        poisoned = False
+        out = []
+        for leaf in leaves:
+            if not poisoned and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+                out.append(jnp.full_like(leaf, jnp.nan))
+                poisoned = True
+            else:
+                out.append(leaf)
+        metrics = dict(metrics)
+        metrics["loss"] = jnp.float32(np.nan)
+        metrics["grad_norm"] = jnp.float32(np.nan)
+        if "nonfinite" in metrics:
+            metrics["nonfinite"] = jnp.asarray(True)
+        return jax.tree.unflatten(treedef, out), metrics
+
+    # -- checkpoint corruption -----------------------------------------------
+    def should_corrupt(self, step: int) -> bool:
+        return (
+            self.enabled
+            and step in self.config.corrupt_ckpt_steps
+            and step not in self._fired_corrupt
+        )
+
+    def corrupt_checkpoint(self, step: int, step_dir: str) -> str | None:
+        """Truncate one file of a just-committed step dir in place; returns the
+        path truncated (None when the dir has nothing to corrupt)."""
+        self._fired_corrupt.add(step)
+        target = self._pick_target(step_dir)
+        if target is None:
+            return None
+        size = os.path.getsize(target)
+        with open(target, "rb+") as f:
+            f.truncate(max(size // 2, 1))
+        logger.warning(
+            "chaos: truncated %s (%d -> %d bytes) in checkpoint step %d",
+            target, size, max(size // 2, 1), step,
+        )
+        return target
+
+    def _pick_target(self, step_dir: str) -> str | None:
+        name = self.config.corrupt_target
+        if name != "largest":
+            fp = os.path.join(step_dir, name)
+            return fp if os.path.exists(fp) else None
+        best, best_size = None, -1
+        for root, dirs, files in os.walk(step_dir):
+            for f in files:
+                if f == "manifest.json":
+                    continue  # truncating the manifest tests a different path
+                fp = os.path.join(root, f)
+                s = os.path.getsize(fp)
+                if s > best_size:
+                    best, best_size = fp, s
+        return best
+
+
+class FlakyIO:
+    """Callable wrapper failing transiently N times before delegating.
+
+    >>> flaky = FlakyIO(fetch, failures=2)
+    >>> with_retry(flaky)   # two ConnectionErrors, then the real result
+    """
+
+    def __init__(self, fn: Callable[..., Any], failures: int = 1,
+                 exc: type[BaseException] = ConnectionError):
+        self.fn = fn
+        self.failures = int(failures)
+        self.exc = exc
+        self.calls = 0
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc(f"chaos: injected transient failure {self.calls}/{self.failures}")
+        return self.fn(*args, **kwargs)

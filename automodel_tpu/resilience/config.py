@@ -1,0 +1,100 @@
+"""Fault-tolerance configuration (docs/resilience.md).
+
+One ``resilience:`` YAML section drives the whole subsystem — anomaly
+detection thresholds, the skip→rollback→abort escalation budget, preemption
+grace deadlines, transient-I/O retry tuning, and the fault-injection harness.
+Absent section = subsystem off (the seed's crash-on-first-NaN behavior is
+preserved for configs that never opt in).
+
+.. code-block:: yaml
+
+    resilience:
+      enabled: true
+      anomaly:
+        window: 50              # rolling loss/grad-norm window
+        min_history: 12         # observations before z-scores fire
+        zscore_threshold: 6.0   # loss z-score that triggers recovery
+        grad_norm_threshold: null   # optional absolute grad-norm ceiling
+      rollback:
+        max_rollbacks: 3        # within budget_steps; then abort
+        budget_steps: 200       # clean steps that reset the rollback count
+        skip_steps: 1           # extra optimizer steps of data skipped past the anomaly
+      max_skipped_updates: 3    # consecutive guarded skips before rollback
+      preemption:
+        grace_period_s: 300     # what the platform grants after SIGTERM
+        export_min_grace_s: 60  # skip consolidated HF export when remaining < this
+      retry: {max_attempts: 3, base_delay_s: 0.5}
+      chaos: {enabled: false}   # fault injection (resilience/chaos.py)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from automodel_tpu.utils.retry import RetryConfig
+
+__all__ = ["AnomalyConfig", "RollbackConfig", "PreemptionConfig", "ResilienceConfig"]
+
+
+def _sub(raw: Any) -> dict:
+    if raw is None:
+        return {}
+    if hasattr(raw, "to_dict"):
+        raw = raw.to_dict()
+    return dict(raw)
+
+
+def _known(cls, d: dict) -> dict:
+    names = {f.name for f in dataclasses.fields(cls)}
+    return {k: v for k, v in d.items() if k in names}
+
+
+@dataclasses.dataclass
+class AnomalyConfig:
+    enabled: bool = True
+    window: int = 50
+    min_history: int = 12
+    zscore_threshold: float = 6.0
+    grad_norm_threshold: float | None = None
+
+
+@dataclasses.dataclass
+class RollbackConfig:
+    enabled: bool = True
+    max_rollbacks: int = 3
+    budget_steps: int = 200
+    skip_steps: int = 1  # extra optimizer steps of data skipped past the anomaly
+
+
+@dataclasses.dataclass
+class PreemptionConfig:
+    grace_period_s: float = 300.0
+    export_min_grace_s: float = 60.0
+
+
+@dataclasses.dataclass
+class ResilienceConfig:
+    enabled: bool = True
+    anomaly: AnomalyConfig = dataclasses.field(default_factory=AnomalyConfig)
+    rollback: RollbackConfig = dataclasses.field(default_factory=RollbackConfig)
+    preemption: PreemptionConfig = dataclasses.field(default_factory=PreemptionConfig)
+    retry: RetryConfig = dataclasses.field(default_factory=RetryConfig)
+    max_skipped_updates: int = 3
+    chaos: dict = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, raw: Any) -> "ResilienceConfig":
+        """``resilience:`` YAML section -> config; ``None`` -> disabled."""
+        if raw is None:
+            return cls(enabled=False)
+        d = _sub(raw)
+        return cls(
+            enabled=bool(d.get("enabled", True)),
+            anomaly=AnomalyConfig(**_known(AnomalyConfig, _sub(d.get("anomaly")))),
+            rollback=RollbackConfig(**_known(RollbackConfig, _sub(d.get("rollback")))),
+            preemption=PreemptionConfig(**_known(PreemptionConfig, _sub(d.get("preemption")))),
+            retry=RetryConfig.from_dict(d.get("retry")),
+            max_skipped_updates=int(d.get("max_skipped_updates", 3)),
+            chaos=_sub(d.get("chaos")),
+        )

@@ -1,0 +1,153 @@
+"""The resilience manager a recipe holds (docs/resilience.md).
+
+Glues the anomaly detector, the recovery policy, the checkpoint integrity
+layer, coordinated preemption, and the chaos harness behind a handful of
+hooks, mirroring how ``Observability`` wraps its pillars:
+
+- ``on_step(step, loss, grad_norm, nonfinite)`` -> action
+  (``ok``/``skip_update``/``rollback``/``abort``), emitting a structured
+  ``resilience/*`` event for every non-ok verdict;
+- ``rollback_target()`` -> the pod-agreed newest verifiable checkpoint step;
+- ``record_checkpoint(step)`` marks saves that happened on a clean trajectory;
+- ``skip_consolidated_export(elapsed_s)`` -> the pod-agreed preemption
+  decision to drop the HF export when the grace window is short.
+
+The manager never touches params itself — the recipe owns the restore
+(train_ft.py ``_perform_rollback``) because params/optimizer/rng/dataloader
+live there; the manager owns *deciding* and *accounting*.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable
+
+from automodel_tpu.resilience.anomaly import (
+    ABORT, OK, ROLLBACK, SKIP_UPDATE, AnomalyDetector, RecoveryPolicy,
+)
+from automodel_tpu.resilience.chaos import ChaosConfig, ChaosInjector
+from automodel_tpu.resilience.config import ResilienceConfig
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ResilienceManager"]
+
+
+class ResilienceManager:
+    def __init__(
+        self,
+        config: ResilienceConfig,
+        checkpointer: Any = None,
+        metric_sink: Callable[..., None] | None = None,
+    ):
+        self.config = config
+        self.checkpointer = checkpointer
+        self._sink = metric_sink
+        self.detector = AnomalyDetector(config.anomaly)
+        self.policy = RecoveryPolicy(config.rollback, config.max_skipped_updates)
+        chaos_cfg = ChaosConfig.from_dict(config.chaos)
+        self.chaos: ChaosInjector | None = (
+            ChaosInjector(chaos_cfg) if config.enabled and chaos_cfg.enabled else None
+        )
+        self.last_good_step: int | None = None
+        self.events = 0
+
+    @classmethod
+    def from_config(cls, raw: Any, checkpointer: Any = None,
+                    metric_sink: Callable[..., None] | None = None) -> "ResilienceManager":
+        return cls(ResilienceConfig.from_dict(raw), checkpointer, metric_sink)
+
+    # ------------------------------------------------------------------ state
+    @property
+    def active(self) -> bool:
+        """Anomaly handling on: the loop pulls loss/grad-norm every step (one
+        scalar device->host sync — the price of same-step detection) and the
+        jitted step must guard non-finite updates."""
+        return bool(self.config.enabled and self.config.anomaly.enabled)
+
+    @property
+    def guards_updates(self) -> bool:
+        return self.active
+
+    def emit(self, step: int, event: str, **fields: Any) -> None:
+        """Structured ``resilience/*`` event into the metric fan-out."""
+        self.events += 1
+        logger.warning("resilience: %s at step %d %s", event, step, fields or "")
+        if self._sink is not None:
+            self._sink(step, **{"resilience/event": event,
+                                **{f"resilience/{k}": v for k, v in fields.items()}})
+
+    # ------------------------------------------------------------------ steps
+    def on_step(self, step: int, loss: float, grad_norm: float,
+                nonfinite: bool = False) -> str:
+        """Classify the step's training signal and decide the action."""
+        if not self.active:
+            return OK
+        verdict = self.detector.observe(step, float(loss), float(grad_norm), bool(nonfinite))
+        action = self.policy.decide(verdict)
+        if action != OK:
+            self.emit(
+                step, action,
+                reason=verdict.kind,
+                loss=verdict.loss,
+                grad_norm=verdict.grad_norm,
+                zscore=verdict.zscore,
+                consecutive_skips=self.policy.consecutive_skips,
+                rollbacks_used=self.policy.rollbacks_used,
+            )
+        return action
+
+    def record_checkpoint(self, step: int) -> None:
+        """A save on a clean trajectory: the preferred rollback destination."""
+        self.last_good_step = step
+
+    # ------------------------------------------------------------------ rollback
+    def rollback_target(self) -> int | None:
+        """Pod-agreed newest verifiable checkpoint step (collective on
+        multi-host — every host must reach this call together)."""
+        if self.checkpointer is None or not self.checkpointer.config.enabled:
+            return None
+        return self.checkpointer.agreed_restore_step()
+
+    def note_rollback(self, from_step: int, to_step: int, skipped_steps: int) -> None:
+        self.policy.on_rollback()
+        self.detector.reset()
+        self.emit(
+            from_step, "rollback_done",
+            from_step=from_step, to_step=to_step, skipped_steps=skipped_steps,
+            rollbacks_used=self.policy.rollbacks_used,
+        )
+
+    # ------------------------------------------------------------------ preemption
+    def skip_consolidated_export(self, elapsed_since_sigterm_s: float) -> bool:
+        """Pod-agreed: drop the consolidated HF export from the preemption save
+        when the remaining grace window is short. Any host being short makes
+        EVERY host skip — the export's per-tensor gathers are collectives, so
+        the decision must be uniform or the pod deadlocks mid-export."""
+        from automodel_tpu.parallel.init import any_process_flag
+
+        p = self.config.preemption
+        remaining = float(p.grace_period_s) - float(elapsed_since_sigterm_s)
+        short = remaining < float(p.export_min_grace_s)
+        agreed = any_process_flag(short)
+        if agreed:
+            self.emit(
+                0, "preemption_skip_export",
+                remaining_grace_s=round(max(remaining, 0.0), 1),
+                export_min_grace_s=p.export_min_grace_s,
+            )
+        return agreed
+
+    # ------------------------------------------------------------------ client state
+    def state_dict(self) -> dict:
+        return {
+            "detector": self.detector.state_dict(),
+            "rollbacks_used": self.policy.rollbacks_used,
+            "last_anomaly_step": self.policy.last_anomaly_step,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.detector.load_state_dict(state.get("detector", {}))
+        self.policy.rollbacks_used = int(state.get("rollbacks_used", 0))
+        las = state.get("last_anomaly_step")
+        self.policy.last_anomaly_step = None if las is None else int(las)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import signal
 import threading
+import time
 from typing import Any, Iterable, Iterator
 
 __all__ = ["StepScheduler"]
@@ -39,6 +40,9 @@ class StepScheduler:
         self.step = 0  # completed optimizer steps
         self.epoch = 0
         self._sigterm = threading.Event()
+        self.sigterm_time: float | None = None  # monotonic stamp of first signal
+        self._sigterm_agreed = False
+        self._sigterm_poll: tuple[int, bool] | None = None  # (step, agreed result)
         if handle_sigterm:
             self._install_sigterm_handler()
 
@@ -48,6 +52,11 @@ class StepScheduler:
             prev = signal.getsignal(signal.SIGTERM)
 
             def handler(signum, frame):
+                if not self._sigterm.is_set():
+                    # the grace clock starts at the FIRST signal: the preemption
+                    # deadline (resilience/manager.py skip_consolidated_export)
+                    # is measured from here
+                    self.sigterm_time = time.monotonic()
                 self._sigterm.set()
                 if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
                     prev(signum, frame)
@@ -60,12 +69,30 @@ class StepScheduler:
     def sigterm_received(self) -> bool:
         """Cross-host-agreed SIGTERM: any host's local flag triggers ALL hosts, so
         everyone exits the step loop together and checkpoints (reference
-        step_scheduler.py:217 all-gathers the flag). The 1-byte allgather runs once
-        per optimizer step — negligible next to the step itself — and every host
-        calls it at the same loop point, so it cannot hang."""
+        step_scheduler.py:217 all-gathers the flag) — one preempted host can never
+        strand the others inside a collective. The 1-byte allgather runs at most
+        once per optimizer step (the result is cached per step, and sticky once
+        True) and every host calls it at the same loop point, so it cannot hang."""
+        if self._sigterm_agreed:
+            return True
+        if self._sigterm_poll is not None and self._sigterm_poll[0] == self.step:
+            return self._sigterm_poll[1]
         from automodel_tpu.parallel.init import any_process_flag
 
-        return any_process_flag(self._sigterm.is_set())
+        agreed = any_process_flag(self._sigterm.is_set())
+        self._sigterm_poll = (self.step, agreed)
+        if agreed:
+            self._sigterm_agreed = True
+            if self.sigterm_time is None:
+                # this host wasn't the one signalled; start its grace clock at
+                # agreement time (the first moment it can know)
+                self.sigterm_time = time.monotonic()
+        return agreed
+
+    @property
+    def sigterm_elapsed_s(self) -> float:
+        """Seconds since the preemption signal (0 when none arrived)."""
+        return 0.0 if self.sigterm_time is None else time.monotonic() - self.sigterm_time
 
     # -- iteration ----------------------------------------------------------
     def __iter__(self) -> Iterator[list[Any]]:
@@ -73,6 +100,10 @@ class StepScheduler:
         if self.dataloader is None:
             raise ValueError("StepScheduler has no dataloader")
         while self.epoch < self.num_epochs:
+            # a re-entered iterator (in-process rollback restarts the pass,
+            # train_ft.py _train_pass) must not overshoot a finished run
+            if self.max_steps is not None and self.step >= self.max_steps:
+                return
             batches: list[Any] = []
             for batch in self.dataloader:
                 batches.append(batch)

@@ -1,0 +1,21 @@
+"""Pytest entry for the chaos smoke (tools/chaos_smoke.py, docs/resilience.md).
+
+Marked ``chaos`` + ``slow`` so it stays out of the tier-1 ``-m 'not slow'``
+suite; run explicitly with ``pytest -m chaos``. The fast-path coverage of the
+same machinery lives in tests/functional/test_train_recipe.py::TestResilience.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "tools"))
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_smoke(tmp_path, cpu_devices):
+    import chaos_smoke
+
+    assert chaos_smoke.main(str(tmp_path)) == 0

@@ -233,6 +233,86 @@ class TestTrainRecipeE2E:
         assert rows[0]["loss"] > 4.0  # sane CE for random data
 
 
+class TestResilience:
+    """Chaos-driven recovery end-to-end on the mock recipe (docs/resilience.md):
+    an injected NaN step must roll back to the last checkpoint and finish with
+    a loss matching the uninterrupted baseline to within the skipped window,
+    and a truncated latest checkpoint must fall back to an older verifiable one
+    at resume."""
+
+    _resilience = textwrap.dedent("""\
+    resilience:
+      enabled: true
+      anomaly: {window: 20, min_history: 5}
+      max_skipped_updates: 0
+      rollback: {max_rollbacks: 2, skip_steps: 0}
+      chaos:
+        enabled: true
+        nan_grad_steps: [6]
+        corrupt_ckpt_steps: [8]
+    """).replace("\n", "\n    ")
+
+    def test_chaos_rollback_recovers_and_falls_back_on_resume(self, tmp_path, cpu_devices):
+        # uninterrupted baseline: same seed/data, no faults
+        base_dir = tmp_path / "base"
+        base_dir.mkdir()
+        cfg = load_config(_write_cfg(base_dir, ckpt=False, max_steps=10, grad_acc=1))
+        TrainFinetuneRecipeForNextTokenPrediction(cfg).setup().run_train_validation_loop()
+        base_rows = _read_jsonl(base_dir / "out" / "training.jsonl")
+
+        # chaos run: NaN-poisoned params at step 6, checkpoint truncated at 8
+        cfg = load_config(_write_cfg(tmp_path, extra=self._resilience, ckpt=True,
+                                     max_steps=10, grad_acc=1))
+        cfg["step_scheduler"]["ckpt_every_steps"] = 4
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        recipe.run_train_validation_loop()
+        rows = _read_jsonl(tmp_path / "out" / "training.jsonl")
+
+        events = [r["resilience/event"] for r in rows if "resilience/event" in r]
+        assert "rollback" in events and "rollback_done" in events
+        done = next(r for r in rows if r.get("resilience/event") == "rollback_done")
+        assert done["resilience/from_step"] == 6
+        assert done["resilience/to_step"] == 4
+
+        losses = {r["step"]: r["loss"] for r in rows if "loss" in r}
+        assert 6 not in losses  # the poisoned step never logs a metric row
+        assert all(np.isfinite(v) for v in losses.values())
+        base_losses = {r["step"]: r["loss"] for r in base_rows}
+        # rollback dropped the step-5..6 updates, so trajectories differ by the
+        # skipped window only — the final loss must land close to the baseline
+        assert losses[10] == pytest.approx(base_losses[10], abs=0.35)
+
+        # resume leg: drop the clean tail checkpoints so the truncated step_8
+        # is newest — setup must reject it and walk back to step_4
+        import shutil
+
+        for d in ("step_10", "step_12"):
+            if (tmp_path / "ckpt" / d).exists():
+                shutil.rmtree(tmp_path / "ckpt" / d)
+        (tmp_path / "ckpt" / "latest").unlink()
+        cfg2 = load_config(_write_cfg(tmp_path, extra=self._resilience, ckpt=True,
+                                      max_steps=10, grad_acc=1))
+        r2 = TrainFinetuneRecipeForNextTokenPrediction(cfg2).setup()
+        assert r2.step_scheduler.step == 4
+
+    def test_resilience_abort_when_budget_exhausted(self, tmp_path, cpu_devices):
+        # no checkpoints at all: a rollback request has nothing to restore and
+        # must abort loudly rather than loop on poisoned params
+        extra = textwrap.dedent("""\
+        resilience:
+          enabled: true
+          anomaly: {min_history: 5}
+          max_skipped_updates: 0
+          chaos:
+            enabled: true
+            nan_grad_steps: [3]
+        """).replace("\n", "\n    ")
+        cfg = load_config(_write_cfg(tmp_path, extra=extra, ckpt=False, max_steps=6))
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        with pytest.raises(RuntimeError, match="unrecoverable"):
+            recipe.run_train_validation_loop()
+
+
 class TestNanGuard:
     def test_nonfinite_grad_raises(self, tmp_path, cpu_devices):
         """distributed.check_for_nan_in_grad stops loudly on a non-finite signal

@@ -1,0 +1,473 @@
+"""Fault-tolerance subsystem units (docs/resilience.md): anomaly detection +
+escalation policy, transient-fault retry, checkpoint integrity manifests with
+walk-back restore, chaos injection, preemption deadline decisions, and the
+data-cursor fast-forward that rollback rides on."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from automodel_tpu.checkpoint.checkpointing import Checkpointer, CheckpointingConfig
+from automodel_tpu.checkpoint.manifest import (
+    MANIFEST_NAME, build_manifest, has_manifest, verify_manifest, write_manifest,
+)
+from automodel_tpu.data.loader import DataLoader
+from automodel_tpu.resilience import (
+    AnomalyDetector, ChaosConfig, ChaosInjector, FlakyIO, RecoveryPolicy,
+    ResilienceConfig, ResilienceManager,
+)
+from automodel_tpu.resilience.config import AnomalyConfig, RollbackConfig
+from automodel_tpu.utils.retry import RetryConfig, is_transient, retry, with_retry
+
+
+# ---------------------------------------------------------------- detector
+class TestAnomalyDetector:
+    def _warm(self, det, n=20, loss=2.0):
+        for i in range(n):
+            det.observe(i, loss + 0.01 * (i % 3), 1.0)
+
+    def test_nonfinite_is_always_anomalous(self):
+        det = AnomalyDetector(AnomalyConfig())
+        v = det.observe(0, float("nan"), 1.0)
+        assert v.kind == "nonfinite" and v.anomalous
+        assert det.observe(1, 2.0, float("inf")).kind == "nonfinite"
+        assert det.observe(2, 2.0, 1.0, nonfinite=True).kind == "nonfinite"
+
+    def test_spike_fires_only_after_min_history(self):
+        det = AnomalyDetector(AnomalyConfig(min_history=12, zscore_threshold=6.0))
+        # huge value while history is thin: no stats yet, must pass as ok
+        assert det.observe(0, 500.0, 1.0).kind == "ok"
+        det.reset()
+        self._warm(det, n=15)
+        v = det.observe(99, 500.0, 1.0)
+        assert v.kind == "loss_spike" and v.zscore > 6.0
+
+    def test_spike_excluded_from_window(self):
+        det = AnomalyDetector(AnomalyConfig(min_history=5, zscore_threshold=6.0))
+        self._warm(det, n=10)
+        assert det.observe(50, 500.0, 1.0).kind == "loss_spike"
+        # the spike must not inflate the std it is judged against: a second
+        # identical spike still flags
+        assert det.observe(51, 500.0, 1.0).kind == "loss_spike"
+
+    def test_grad_norm_ceiling(self):
+        det = AnomalyDetector(AnomalyConfig(grad_norm_threshold=10.0))
+        assert det.observe(0, 2.0, 50.0).kind == "grad_spike"
+        assert det.observe(1, 2.0, 9.0).kind == "ok"
+
+    def test_flatlined_loss_does_not_zscore_explode(self):
+        det = AnomalyDetector(AnomalyConfig(min_history=5, zscore_threshold=6.0))
+        for i in range(20):
+            det.observe(i, 1.5, 1.0)  # zero variance window
+        # tiny jitter over a flatline must stay ok (std floor)
+        assert det.observe(99, 1.503, 1.0).kind == "ok"
+
+    def test_state_roundtrip(self):
+        det = AnomalyDetector(AnomalyConfig(min_history=5))
+        self._warm(det, n=8)
+        fresh = AnomalyDetector(AnomalyConfig(min_history=5))
+        fresh.load_state_dict(json.loads(json.dumps(det.state_dict())))
+        assert list(fresh._window) == list(det._window)
+
+
+class TestRecoveryPolicy:
+    def _verdict(self, det_kind, step=10):
+        from automodel_tpu.resilience.anomaly import Verdict
+
+        return Verdict(det_kind, step, 2.0, 1.0)
+
+    def test_nonfinite_skips_then_escalates(self):
+        pol = RecoveryPolicy(RollbackConfig(max_rollbacks=3), max_skipped_updates=2)
+        assert pol.decide(self._verdict("nonfinite", 1)) == "skip_update"
+        assert pol.decide(self._verdict("nonfinite", 2)) == "skip_update"
+        assert pol.decide(self._verdict("nonfinite", 3)) == "rollback"
+
+    def test_clean_step_resets_skip_streak(self):
+        pol = RecoveryPolicy(RollbackConfig(), max_skipped_updates=1)
+        assert pol.decide(self._verdict("nonfinite", 1)) == "skip_update"
+        assert pol.decide(self._verdict("ok", 2)) == "ok"
+        assert pol.decide(self._verdict("nonfinite", 3)) == "skip_update"
+
+    def test_spike_goes_straight_to_rollback(self):
+        pol = RecoveryPolicy(RollbackConfig())
+        assert pol.decide(self._verdict("loss_spike")) == "rollback"
+        assert pol.decide(self._verdict("grad_spike")) == "rollback"
+
+    def test_budget_exhaustion_aborts(self):
+        pol = RecoveryPolicy(RollbackConfig(max_rollbacks=1))
+        assert pol.decide(self._verdict("loss_spike", 5)) == "rollback"
+        pol.on_rollback()
+        assert pol.decide(self._verdict("loss_spike", 6)) == "abort"
+
+    def test_clean_progress_refills_budget(self):
+        pol = RecoveryPolicy(RollbackConfig(max_rollbacks=1, budget_steps=10))
+        assert pol.decide(self._verdict("loss_spike", 5)) == "rollback"
+        pol.on_rollback()
+        assert pol.decide(self._verdict("ok", 20)) == "ok"  # >= budget_steps later
+        assert pol.rollbacks_used == 0
+        assert pol.decide(self._verdict("loss_spike", 21)) == "rollback"
+
+    def test_rollback_disabled_aborts(self):
+        pol = RecoveryPolicy(RollbackConfig(enabled=False))
+        assert pol.decide(self._verdict("loss_spike")) == "abort"
+
+
+# ---------------------------------------------------------------- retry
+class TestRetry:
+    def test_transient_retries_then_succeeds(self):
+        flaky = FlakyIO(lambda: "payload", failures=2)
+        out = with_retry(flaky, config=RetryConfig(max_attempts=3, base_delay_s=0),
+                         sleep=lambda s: None)
+        assert out == "payload" and flaky.calls == 3
+
+    def test_exhausted_attempts_reraise_last(self):
+        flaky = FlakyIO(lambda: "x", failures=10)
+        with pytest.raises(ConnectionError):
+            with_retry(flaky, config=RetryConfig(max_attempts=3, base_delay_s=0),
+                       sleep=lambda s: None)
+        assert flaky.calls == 3
+
+    def test_non_transient_raises_immediately(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("corrupt file")
+
+        with pytest.raises(ValueError):
+            with_retry(bad, config=RetryConfig(max_attempts=5), sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_retry_on_extends_allowlist(self):
+        class Weird(Exception):
+            pass
+
+        flaky = FlakyIO(lambda: 7, failures=1, exc=Weird)
+        assert with_retry(flaky, config=RetryConfig(max_attempts=2, base_delay_s=0),
+                          retry_on=(Weird,), sleep=lambda s: None) == 7
+
+    def test_is_transient_classification(self):
+        assert is_transient(ConnectionError())
+        assert is_transient(TimeoutError())
+        assert is_transient(OSError("i/o blip"))
+        assert not is_transient(FileNotFoundError())
+        assert not is_transient(PermissionError())
+        assert not is_transient(ValueError())
+
+        # by-MRO-name matching covers hub/requests errors without importing them
+        class HfHubHTTPError(Exception):
+            pass
+
+        class SubOfHub(HfHubHTTPError):
+            pass
+
+        assert is_transient(HfHubHTTPError())
+        assert is_transient(SubOfHub())
+
+    def test_backoff_curve_capped(self):
+        cfg = RetryConfig(base_delay_s=1.0, multiplier=2.0, max_delay_s=3.0, jitter=0.0)
+        assert [cfg.delay(a) for a in range(4)] == [1.0, 2.0, 3.0, 3.0]
+
+    def test_decorator_form(self):
+        state = {"n": 0}
+
+        @retry(RetryConfig(max_attempts=3, base_delay_s=0), sleep=lambda s: None)
+        def fetch():
+            state["n"] += 1
+            if state["n"] < 2:
+                raise ConnectionError("blip")
+            return state["n"]
+
+        assert fetch() == 2
+
+
+# ---------------------------------------------------------------- manifest
+class TestManifest:
+    def _step_dir(self, tmp_path):
+        d = tmp_path / "step_3"
+        (d / "model").mkdir(parents=True)
+        (d / "model" / "arrays.bin").write_bytes(b"x" * 1000)
+        (d / "client.json").write_text('{"step": 3}')
+        return str(d)
+
+    def test_roundtrip_verifies_clean(self, tmp_path):
+        d = self._step_dir(tmp_path)
+        write_manifest(d, step=3)
+        assert has_manifest(d)
+        assert verify_manifest(d) == []
+        m = json.load(open(os.path.join(d, MANIFEST_NAME)))
+        assert m["step"] == 3 and m["file_count"] == 2
+
+    def test_truncation_detected(self, tmp_path):
+        d = self._step_dir(tmp_path)
+        write_manifest(d, step=3)
+        with open(os.path.join(d, "model", "arrays.bin"), "rb+") as f:
+            f.truncate(500)
+        problems = verify_manifest(d)
+        assert problems and "arrays.bin" in problems[0]
+
+    def test_bitflip_detected_by_checksum(self, tmp_path):
+        d = self._step_dir(tmp_path)
+        write_manifest(d, step=3)
+        fp = os.path.join(d, "model", "arrays.bin")
+        data = bytearray(open(fp, "rb").read())
+        data[10] ^= 0xFF  # same size, different bytes
+        open(fp, "wb").write(bytes(data))
+        assert any("checksum" in p for p in verify_manifest(d))
+        assert verify_manifest(d, check_checksums=False) == []  # size-only mode
+
+    def test_missing_inventoried_file_detected(self, tmp_path):
+        d = self._step_dir(tmp_path)
+        write_manifest(d, step=3)
+        os.remove(os.path.join(d, "client.json"))
+        assert any("missing" in p for p in verify_manifest(d))
+
+    def test_extra_files_are_fine(self, tmp_path):
+        # the PEFT adapter export lands AFTER the manifest: extras must pass
+        d = self._step_dir(tmp_path)
+        write_manifest(d, step=3)
+        (tmp_path / "step_3" / "hf_adapter.json").write_text("{}")
+        assert verify_manifest(d) == []
+
+    def test_no_manifest_is_a_problem(self, tmp_path):
+        d = self._step_dir(tmp_path)
+        assert any("manifest" in p for p in verify_manifest(d))
+
+
+# ---------------------------------------------------------------- checkpointer integration
+def _params(seed=0, d=8):
+    rng = np.random.RandomState(seed)
+    return {"w": jnp.asarray(rng.randn(4, d), jnp.float32)}
+
+
+class TestCheckpointIntegrity:
+    def test_save_writes_manifest_and_load_verifies(self, tmp_path):
+        ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")))
+        p = _params()
+        ck.save(1, p)
+        assert has_manifest(ck.step_dir(1))
+        ck.load(p, step=1)  # verifying load passes on a clean step
+
+    def test_corrupt_step_load_raises_with_problem(self, tmp_path):
+        ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")))
+        p = _params()
+        ck.save(1, p)
+        chaos = ChaosInjector(ChaosConfig(enabled=True, corrupt_ckpt_steps=(1,)))
+        assert chaos.corrupt_checkpoint(1, ck.step_dir(1)) is not None
+        with pytest.raises(ValueError, match="integrity"):
+            ck.load(p, step=1)
+        ck.load(p, step=1, verify=False)  # explicit opt-out skips the check
+
+    def test_walk_back_to_newest_verifiable(self, tmp_path):
+        ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")))
+        p = _params()
+        for s in (1, 2, 3):
+            ck.save(s, p)
+        ChaosInjector(ChaosConfig(enabled=True, corrupt_ckpt_steps=(3,))).corrupt_checkpoint(
+            3, ck.step_dir(3)
+        )
+        assert ck.newest_verifiable_step() == 2
+        assert ck.agreed_restore_step() == 2
+        restored = ck.load_latest_verified(_params(seed=9))
+        assert restored is not None and restored[3] == 2
+
+    def test_all_corrupt_returns_none(self, tmp_path):
+        ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")))
+        p = _params()
+        ck.save(1, p)
+        chaos = ChaosInjector(ChaosConfig(enabled=True, corrupt_ckpt_steps=(1,)))
+        chaos.corrupt_checkpoint(1, ck.step_dir(1))
+        assert ck.newest_verifiable_step() is None
+        assert ck.load_latest_verified(p) is None
+
+    def test_legacy_step_without_manifest_still_loads(self, tmp_path):
+        # pre-manifest checkpoints (seed repos) must stay restorable
+        ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck"),
+                                              write_manifest=False))
+        p = _params()
+        ck.save(1, p)
+        assert not has_manifest(ck.step_dir(1))
+        verifying = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")))
+        verifying.load(p, step=1)
+        assert verifying.newest_verifiable_step() == 1  # legacy counts as usable
+
+    def test_non_numeric_step_dirs_ignored(self, tmp_path):
+        ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")))
+        p = _params()
+        ck.save(2, p)
+        os.makedirs(tmp_path / "ck" / "step_backup")  # stray human-made dir
+        os.makedirs(tmp_path / "ck" / "step_old.bak")
+        os.remove(tmp_path / "ck" / "latest")
+        fresh = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")))
+        assert fresh.latest_step() == 2
+        fresh.save(3, p)  # _prune must also survive the stray dirs
+        assert fresh.latest_step() == 3
+
+    def test_corrupt_client_json_tolerated(self, tmp_path):
+        ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck"),
+                                              write_manifest=False))
+        p = _params()
+        ck.save(1, p, client_states={"step": 1})
+        with open(os.path.join(ck.step_dir(1), "client.json"), "w") as f:
+            f.write("{truncated")
+        _, _, client = ck.load(p, step=1)
+        assert client == {}  # unreadable client state degrades, not crashes
+
+
+# ---------------------------------------------------------------- chaos
+class TestChaos:
+    def test_poison_fires_once_and_nans_params(self):
+        chaos = ChaosInjector(ChaosConfig(enabled=True, nan_grad_steps=(4,)))
+        params = {"w": jnp.ones((2, 2)), "ids": jnp.zeros((2,), jnp.int32)}
+        metrics = {"loss": jnp.float32(2.0), "grad_norm": jnp.float32(1.0),
+                   "nonfinite": jnp.asarray(False)}
+        assert not chaos.should_poison(3)
+        assert chaos.should_poison(4)
+        poisoned, m = chaos.poison(4, params, metrics)
+        assert np.isnan(np.asarray(poisoned["w"])).all()
+        assert np.array_equal(np.asarray(poisoned["ids"]), np.zeros(2))  # int leaf spared
+        assert math.isnan(float(m["loss"])) and bool(m["nonfinite"])
+        assert not chaos.should_poison(4)  # fires once
+
+    def test_disabled_injector_never_fires(self):
+        chaos = ChaosInjector(ChaosConfig(enabled=False, nan_grad_steps=(1,),
+                                          corrupt_ckpt_steps=(1,)))
+        assert not chaos.should_poison(1) and not chaos.should_corrupt(1)
+
+    def test_corrupt_picks_largest_not_manifest(self, tmp_path):
+        d = tmp_path / "step_1"
+        d.mkdir()
+        (d / "small.bin").write_bytes(b"x" * 10)
+        (d / "big.bin").write_bytes(b"y" * 1000)
+        (d / MANIFEST_NAME).write_bytes(b"z" * 5000)
+        chaos = ChaosInjector(ChaosConfig(enabled=True, corrupt_ckpt_steps=(1,)))
+        target = chaos.corrupt_checkpoint(1, str(d))
+        assert target.endswith("big.bin")
+        assert os.path.getsize(d / "big.bin") == 500
+
+
+# ---------------------------------------------------------------- manager
+class TestResilienceManager:
+    def _mgr(self, sink=None, **over):
+        raw = {"enabled": True,
+               "anomaly": {"min_history": 3, "window": 10, "zscore_threshold": 6.0},
+               "max_skipped_updates": 1, **over}
+        return ResilienceManager.from_config(raw, metric_sink=sink)
+
+    def test_absent_config_is_inert(self):
+        mgr = ResilienceManager.from_config(None)
+        assert not mgr.active and not mgr.guards_updates and mgr.chaos is None
+        assert mgr.on_step(1, float("nan"), float("nan"), True) == "ok"
+
+    def test_events_reach_sink_with_structured_fields(self):
+        rows = []
+        mgr = self._mgr(sink=lambda step, **f: rows.append((step, f)))
+        for i in range(6):
+            mgr.on_step(i, 2.0, 1.0)
+        assert mgr.on_step(6, 2.0, 1.0, nonfinite=True) == "skip_update"
+        step, fields = rows[-1]
+        assert step == 6
+        assert fields["resilience/event"] == "skip_update"
+        assert fields["resilience/reason"] == "nonfinite"
+
+    def test_skip_escalates_to_rollback_action(self):
+        mgr = self._mgr()
+        assert mgr.on_step(1, 2.0, 1.0, nonfinite=True) == "skip_update"
+        assert mgr.on_step(2, 2.0, 1.0, nonfinite=True) == "rollback"
+
+    def test_rollback_without_checkpointer_has_no_target(self):
+        mgr = self._mgr()
+        assert mgr.rollback_target() is None
+
+    def test_rollback_target_is_newest_verifiable(self, tmp_path):
+        ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "ck")))
+        ck.save(1, _params())
+        ck.save(2, _params())
+        mgr = ResilienceManager.from_config({"enabled": True}, checkpointer=ck)
+        assert mgr.rollback_target() == 2
+        ChaosInjector(ChaosConfig(enabled=True, corrupt_ckpt_steps=(2,))).corrupt_checkpoint(
+            2, ck.step_dir(2)
+        )
+        assert mgr.rollback_target() == 1
+
+    def test_preemption_export_skip_thresholds(self):
+        mgr = ResilienceManager.from_config(
+            {"enabled": True,
+             "preemption": {"grace_period_s": 100, "export_min_grace_s": 30}}
+        )
+        assert not mgr.skip_consolidated_export(elapsed_since_sigterm_s=10.0)
+        assert mgr.skip_consolidated_export(elapsed_since_sigterm_s=80.0)
+
+    def test_state_dict_roundtrip_preserves_budget(self):
+        mgr = self._mgr()
+        mgr.on_step(1, 2.0, 1.0, nonfinite=True)
+        mgr.on_step(2, 2.0, 1.0, nonfinite=True)
+        mgr.note_rollback(2, 0, 2)
+        state = json.loads(json.dumps(mgr.state_dict()))
+        fresh = self._mgr()
+        fresh.load_state_dict(state)
+        assert fresh.policy.rollbacks_used == 1
+        assert fresh.policy.last_anomaly_step == 2
+
+    def test_config_yaml_shapes(self):
+        cfg = ResilienceConfig.from_dict(
+            {"anomaly": {"zscore_threshold": 4.0}, "rollback": {"max_rollbacks": 7},
+             "retry": {"max_attempts": 9}, "chaos": {"enabled": True}}
+        )
+        assert cfg.enabled and cfg.anomaly.zscore_threshold == 4.0
+        assert cfg.rollback.max_rollbacks == 7 and cfg.retry.max_attempts == 9
+        assert ResilienceConfig.from_dict(None).enabled is False
+
+
+# ---------------------------------------------------------------- fast-forward
+class TestFastForward:
+    def _loader(self, n=20, bs=4):
+        return DataLoader(list(range(n)), batch_size=bs, shuffle=False)
+
+    def test_skips_batches_in_place(self):
+        dl = self._loader()
+        dl.fast_forward(2)
+        first = next(iter(dl))
+        assert first == [8, 9, 10, 11]  # two 4-wide batches skipped
+
+    def test_wraps_epoch_boundary(self):
+        dl = self._loader(n=20, bs=4)  # 5 batches/epoch
+        dl.fast_forward(12)
+        assert dl.epoch == 2 and dl._cursor == 2
+
+    def test_negative_raises(self):
+        with pytest.raises(ValueError):
+            self._loader().fast_forward(-1)
+
+    def test_matches_iteration(self):
+        # fast_forward(n) must land exactly where consuming n batches would
+        a, b = self._loader(), self._loader()
+        it = iter(a)
+        for _ in range(3):
+            next(it)
+        b.fast_forward(3)
+        assert next(iter(a.__class__(list(range(20)), batch_size=4, shuffle=False)
+                         .__iter__())) is not None  # loader sanity
+        assert a._cursor == b._cursor and a.epoch == b.epoch
+
+
+class TestSchedulerReentry:
+    def test_finished_scheduler_yields_nothing_on_reentry(self):
+        from automodel_tpu.training.step_scheduler import StepScheduler
+
+        dl = [1, 2, 3, 4]
+        ss = StepScheduler(dataloader=dl, max_steps=2, num_epochs=10,
+                           handle_sigterm=False)
+        assert len(list(ss)) == 2
+        assert list(ss) == []  # re-entered iterator must not overshoot
+
+    def test_sigterm_elapsed_defaults_zero(self):
+        from automodel_tpu.training.step_scheduler import StepScheduler
+
+        ss = StepScheduler(dataloader=[1], handle_sigterm=False)
+        assert ss.sigterm_elapsed_s == 0.0

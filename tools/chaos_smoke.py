@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Self-checking CPU chaos smoke for the resilience subsystem (docs/resilience.md).
+
+Trains a tiny mock llama on 8 virtual CPU devices with two injected faults —
+NaN-poisoned params after step 6 and a truncated checkpoint at step 8 — and
+asserts the run survives both:
+
+1. the NaN step triggers an in-process rollback to the step-4 checkpoint and
+   training finishes with finite losses, the final one matching an
+   uninterrupted baseline to within the skipped window;
+2. with the clean tail checkpoints removed, a fresh resume rejects the
+   truncated step-8 checkpoint on manifest verification and walks back to
+   step 4.
+
+Usage:  JAX_PLATFORMS=cpu python tools/chaos_smoke.py [--workdir DIR]
+
+The same scenario runs under pytest as ``pytest -m chaos``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import textwrap
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+MAX_STEPS = 14
+NAN_STEP = 6
+CORRUPT_STEP = 8
+CKPT_EVERY = 4
+
+_RESILIENCE = """\
+resilience:
+  enabled: true
+  anomaly: {window: 20, min_history: 5}
+  max_skipped_updates: 0
+  rollback: {max_rollbacks: 2, skip_steps: 0}
+  chaos:
+    enabled: true
+    nan_grad_steps: [%d]
+    corrupt_ckpt_steps: [%d]
+""" % (NAN_STEP, CORRUPT_STEP)
+
+
+def _write_cfg(root: str, name: str, *, ckpt: bool, chaos: bool) -> str:
+    text = textwrap.dedent(f"""\
+    seed: 7
+    output_dir: {root}/{name}/out
+    model:
+      config:
+        architectures: [LlamaForCausalLM]
+        vocab_size: 128
+        hidden_size: 64
+        intermediate_size: 128
+        num_hidden_layers: 2
+        num_attention_heads: 4
+        num_key_value_heads: 2
+        max_position_embeddings: 128
+    distributed:
+      dp_shard: 4
+      tp: 2
+    backend:
+      dtype: float32
+    dataset:
+      _target_: automodel_tpu.data.llm.mock.MockSFTDataset
+      vocab_size: 128
+      seq_len: 32
+      num_samples: 256
+      seed: 0
+      pattern: arith
+    micro_batch_size: 8
+    seq_len: 32
+    step_scheduler:
+      grad_acc_steps: 1
+      max_steps: {MAX_STEPS}
+      num_epochs: 10
+      handle_sigterm: false
+      ckpt_every_steps: {CKPT_EVERY if ckpt else 0}
+    optimizer:
+      lr: 1.0e-2
+      weight_decay: 0.0
+      max_grad_norm: 1.0
+    lr_scheduler:
+      lr_warmup_steps: 2
+    checkpoint:
+      enabled: {str(ckpt).lower()}
+      checkpoint_dir: {root}/{name}/ckpt
+    """)
+    if chaos:
+        text += _RESILIENCE
+    path = os.path.join(root, f"{name}.yaml")
+    os.makedirs(os.path.join(root, name), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text)
+    return path
+
+
+def _run(cfg_path: str):
+    from automodel_tpu.config.loader import load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    cfg = load_config(cfg_path)
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+    recipe.run_train_validation_loop()
+    return recipe
+
+
+def _rows(root: str, name: str) -> list[dict]:
+    with open(os.path.join(root, name, "out", "training.jsonl")) as f:
+        return [json.loads(line) for line in f]
+
+
+def main(workdir: str | None = None) -> int:
+    owns_workdir = workdir is None
+    root = workdir or tempfile.mkdtemp(prefix="chaos_smoke_")
+    try:
+        print(f"[chaos_smoke] workdir {root}")
+
+        print("[chaos_smoke] 1/3 uninterrupted baseline ...")
+        _run(_write_cfg(root, "base", ckpt=False, chaos=False))
+        base_losses = {r["step"]: r["loss"] for r in _rows(root, "base") if "loss" in r}
+
+        print(f"[chaos_smoke] 2/3 chaos run: NaN at step {NAN_STEP}, "
+              f"checkpoint truncated at step {CORRUPT_STEP} ...")
+        _run(_write_cfg(root, "chaos", ckpt=True, chaos=True))
+        rows = _rows(root, "chaos")
+
+        events = [r for r in rows if "resilience/event" in r]
+        names = [r["resilience/event"] for r in events]
+        assert "rollback" in names and "rollback_done" in names, f"events: {names}"
+        done = next(r for r in events if r["resilience/event"] == "rollback_done")
+        assert done["resilience/from_step"] == NAN_STEP, done
+        assert done["resilience/to_step"] == CKPT_EVERY, done
+
+        losses = {r["step"]: r["loss"] for r in rows if "loss" in r}
+        assert NAN_STEP not in losses, "poisoned step must not log a metric row"
+        bad = {s: v for s, v in losses.items() if v != v}
+        assert not bad, f"non-finite losses survived recovery: {bad}"
+        drift = abs(losses[MAX_STEPS] - base_losses[MAX_STEPS])
+        assert drift < 0.5, (
+            f"final loss {losses[MAX_STEPS]:.3f} drifted {drift:.3f} from "
+            f"baseline {base_losses[MAX_STEPS]:.3f}"
+        )
+        print(f"[chaos_smoke]     rollback {done['resilience/from_step']} -> "
+              f"{done['resilience/to_step']}, final loss {losses[MAX_STEPS]:.3f} "
+              f"(baseline {base_losses[MAX_STEPS]:.3f})")
+
+        print("[chaos_smoke] 3/3 fallback restore past the truncated checkpoint ...")
+        ckpt_dir = os.path.join(root, "chaos", "ckpt")
+        for d in sorted(os.listdir(ckpt_dir)):
+            step_dir = os.path.join(ckpt_dir, d)
+            if d.startswith("step_") and int(d.split("_")[1]) > CORRUPT_STEP:
+                shutil.rmtree(step_dir)
+        latest = os.path.join(ckpt_dir, "latest")
+        if os.path.lexists(latest):
+            os.unlink(latest)
+
+        from automodel_tpu.config.loader import load_config
+        from automodel_tpu.recipes.llm.train_ft import (
+            TrainFinetuneRecipeForNextTokenPrediction,
+        )
+
+        cfg = load_config(os.path.join(root, "chaos.yaml"))
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        assert recipe.step_scheduler.step == CKPT_EVERY, (
+            f"resumed at step {recipe.step_scheduler.step}, expected {CKPT_EVERY} "
+            f"(truncated step_{CORRUPT_STEP} should fail verification)"
+        )
+        print(f"[chaos_smoke]     resumed at step {recipe.step_scheduler.step}, "
+              f"skipping unverifiable step_{CORRUPT_STEP}")
+
+        print("[chaos_smoke] PASS")
+        return 0
+    finally:
+        if owns_workdir:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--workdir", default=None,
+                        help="keep artifacts here instead of a temp dir")
+    sys.exit(main(parser.parse_args().workdir))
