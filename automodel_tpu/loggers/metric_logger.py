@@ -15,7 +15,46 @@ from typing import Any, IO
 
 import jax
 
-__all__ = ["MetricsSample", "MetricLogger"]
+__all__ = ["MetricsSample", "MetricLogger", "build_run_header"]
+
+
+def build_run_header(cfg: Any = None, mesh: Any = None, model_id: str | None = None,
+                     **extra: Any) -> dict[str, Any]:
+    """The one-time run-header row: everything needed to join a training.jsonl
+    to a bench baseline or another run — git sha, jax/jaxlib versions, mesh
+    axis sizes, model id, and a digest of the full config. Every field is
+    best-effort; a missing git checkout must not block training."""
+    import hashlib
+    import subprocess
+
+    import jaxlib
+
+    header: dict[str, Any] = {
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib.__version__,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": jax.device_count(),
+        "process_count": jax.process_count(),
+    }
+    try:
+        header["git_sha"] = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        ).stdout.strip() or None
+    except Exception:
+        header["git_sha"] = None
+    if mesh is not None:
+        header["mesh"] = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    if model_id is not None:
+        header["model_id"] = str(model_id)
+    if cfg is not None:
+        raw = cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg)
+        digest = hashlib.sha256(
+            json.dumps(raw, sort_keys=True, default=str).encode()
+        ).hexdigest()
+        header["config_digest"] = digest[:16]
+    header.update(extra)
+    return header
 
 
 @dataclasses.dataclass
@@ -71,6 +110,18 @@ class MetricLogger:
         if not self.enabled or self._fh is None:
             return
         self._fh.write(MetricsSample(step=step, metrics=metrics).to_json() + "\n")
+        self._fh.flush()
+
+    def log_header(self, **fields: Any) -> None:
+        """One-time run-header row (``{"run_header": true, ...}``) making the
+        stream self-describing; consumers filter metric rows by the presence
+        of their metric keys (or absence of ``run_header``)."""
+        if not self.enabled or self._fh is None:
+            return
+        rec: dict[str, Any] = {"run_header": True, "ts": round(time.time(), 3)}
+        for k, v in fields.items():
+            rec[k] = _jsonable(v)[0] if not isinstance(v, dict) else v
+        self._fh.write(json.dumps(rec, allow_nan=False, default=str) + "\n")
         self._fh.flush()
 
     def close(self) -> None:

@@ -1,7 +1,18 @@
 """Unified training observability: goodput accounting, HBM + compile telemetry,
-a stall watchdog, and on-demand profiling (docs/observability.md)."""
+a stall watchdog, on-demand profiling, HLO cost/roofline accounting, cross-host
+metric aggregation, a unified trace timeline, and a perf-regression gate
+(docs/observability.md)."""
 
+from automodel_tpu.observability.aggregate import CrossHostAggregator
+from automodel_tpu.observability.events import TraceTimeline
 from automodel_tpu.observability.goodput import BUCKETS, GoodputTracker
+from automodel_tpu.observability.hlo_costs import (
+    collective_bytes,
+    compiled_cost_metrics,
+    device_specs,
+    diagnose_bound,
+    roofline_metrics,
+)
 from automodel_tpu.observability.manager import Observability, ObservabilityConfig
 from automodel_tpu.observability.memory import device_memory_stats
 from automodel_tpu.observability.profiling import OnDemandProfiler
@@ -9,10 +20,17 @@ from automodel_tpu.observability.watchdog import StallWatchdog
 
 __all__ = [
     "BUCKETS",
+    "CrossHostAggregator",
     "GoodputTracker",
     "Observability",
     "ObservabilityConfig",
     "OnDemandProfiler",
     "StallWatchdog",
+    "TraceTimeline",
+    "collective_bytes",
+    "compiled_cost_metrics",
     "device_memory_stats",
+    "device_specs",
+    "diagnose_bound",
+    "roofline_metrics",
 ]

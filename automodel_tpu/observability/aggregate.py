@@ -1,0 +1,106 @@
+"""Cross-host metric aggregation: make a lagging host visible from proc 0.
+
+Per-step metrics are proc-0-only, so a pod where one host's input pipeline (or
+one chip) runs 2x slower looks healthy in ``training.jsonl`` — every step just
+takes longer, because collectives wait for the slowest participant. The
+aggregator all-gathers each host's sample (step wall time, cumulative data
+wait, HBM high-water) at every log step; proc 0 then logs min/median/max per
+key and flags a ``straggler_host`` when one host's step time exceeds the
+median by a configurable factor.
+
+Collective discipline: ``aggregate()`` must be called by EVERY process at the
+same point (the train loop's log step, which is deterministic across hosts).
+Single-host runs return ``{}`` — nothing to compare.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Callable, Sequence
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CrossHostAggregator", "HOST_KEYS"]
+
+# the per-host sample, in wire order
+HOST_KEYS = ("step_time_s", "data_wait_s", "hbm_gib_peak")
+
+
+def _median(vals: Sequence[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+class CrossHostAggregator:
+    """All-gather per-host samples and reduce them to min/median/max + straggler.
+
+    ``allgather_fn(values) -> list[list[float]]`` is injectable so the 8-host
+    straggler logic is unit-testable on a single process; the default is
+    :func:`automodel_tpu.parallel.init.allgather_host_rows`.
+    """
+
+    def __init__(self, straggler_factor: float = 2.0,
+                 keys: Sequence[str] = HOST_KEYS,
+                 allgather_fn: Callable[[Sequence[float]], list] | None = None,
+                 process_count: int | None = None):
+        if straggler_factor <= 1.0:
+            raise ValueError(f"straggler_factor must be > 1, got {straggler_factor}")
+        self.straggler_factor = float(straggler_factor)
+        self.keys = tuple(keys)
+        if allgather_fn is None:
+            import jax
+
+            from automodel_tpu.parallel.init import allgather_host_rows
+
+            allgather_fn = allgather_host_rows
+            if process_count is None:
+                process_count = jax.process_count()
+        self._allgather = allgather_fn
+        self.process_count = process_count  # None = trust the gathered table
+
+    @property
+    def active(self) -> bool:
+        """False on single-host runs: no gather, no overhead, no output."""
+        return self.process_count is None or self.process_count > 1
+
+    def aggregate(self, sample: dict[str, Any]) -> dict[str, Any]:
+        """One log-step reduction; collective on multi-host (see module doc).
+
+        Missing/None values travel as NaN and are excluded per-key, so a host
+        without HBM counters (CPU) doesn't poison the pod-wide stats.
+        """
+        if not self.active:
+            return {}
+        vec = [float(sample[k]) if sample.get(k) is not None else math.nan
+               for k in self.keys]
+        try:
+            rows = self._allgather(vec)
+        except Exception:
+            logger.exception("cross-host metric allgather failed (run continues)")
+            return {}
+        out: dict[str, Any] = {"host/n": len(rows)}
+        for i, key in enumerate(self.keys):
+            vals = [r[i] for r in rows if not math.isnan(r[i])]
+            if not vals:
+                continue
+            out[f"host/{key}_min"] = round(min(vals), 4)
+            out[f"host/{key}_median"] = round(_median(vals), 4)
+            out[f"host/{key}_max"] = round(max(vals), 4)
+        self._flag_straggler(rows, out)
+        return out
+
+    def _flag_straggler(self, rows: list, out: dict[str, Any]) -> None:
+        idx = self.keys.index("step_time_s") if "step_time_s" in self.keys else None
+        if idx is None:
+            return
+        times = [(r[idx], host) for host, r in enumerate(rows)
+                 if not math.isnan(r[idx])]
+        if len(times) < 2:
+            return
+        med = _median([t for t, _ in times])
+        worst, host = max(times)
+        if med > 0 and worst / med >= self.straggler_factor:
+            out["straggler_host"] = host
+            out["straggler_ratio"] = round(worst / med, 3)

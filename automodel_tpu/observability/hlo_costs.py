@@ -1,0 +1,200 @@
+"""Analytic cost extraction from a compiled step + roofline accounting.
+
+One XLA compile already knows almost everything a performance investigation
+needs: the model FLOPs per step, the bytes the program touches, and — after
+GSPMD partitioning — the exact collective instructions and their shapes. This
+module pulls those numbers out of a ``jax.stages.Compiled`` once per compile
+and turns them, together with the attached chip's peak specs, into a
+roofline-expected step time and a per-row ``bound`` diagnosis
+(compute/memory/comms/input-bound).
+
+The per-collective byte accounting here is the single source of truth: the
+driver's MULTICHIP dryrun (``__graft_entry__.py``) imports
+:func:`collective_bytes` rather than carrying its own copy.
+
+Convention: "bytes" = sum of each collective instruction's OUTPUT shape in the
+per-device program (all-gather counts the gathered tensor, reduce-scatter the
+scattered shard). Costs are per-device-program numbers — under SPMD every
+device runs the same module, so per-chip rates compare directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import re
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "COLLECTIVE_OPS",
+    "DTYPE_BYTES",
+    "DeviceSpec",
+    "collective_bytes",
+    "device_specs",
+    "device_peak_tflops",
+    "compiled_cost_metrics",
+    "roofline_metrics",
+    "diagnose_bound",
+]
+
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+                  "collective-permute")
+DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1, "c64": 8, "c128": 16,
+}
+
+_OP_RE = re.compile(
+    r"=\s+((?:\([^)]*\))|(?:\S+))\s+(" + "|".join(COLLECTIVE_OPS) + r")(-start)?\("
+)
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def collective_bytes(hlo: str) -> dict:
+    """Sum output bytes per collective op kind in an optimized HLO module."""
+    out = {}
+    for line in hlo.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        shapes, op, is_start = m.group(1), m.group(2), m.group(3)
+        found = _SHAPE_RE.findall(shapes)
+        if is_start and len(found) > 1:
+            # async form: the -start tuple is (operand alias, ..., result) —
+            # count only the result or the operand would double the volume
+            found = found[-1:]
+        total = 0
+        for dt, dims in found:
+            nbytes = DTYPE_BYTES.get(dt)
+            if nbytes is None:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * nbytes
+        out[op] = out.get(op, 0) + total
+    return out
+
+
+# ---------------------------------------------------------------------- specs
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    """Peak numbers for roofline math (per chip, public datasheet figures)."""
+
+    name: str
+    peak_bf16_tflops: float
+    hbm_gbps: float  # HBM bandwidth, GB/s
+    ici_gbps: float  # aggregate interchip-interconnect bandwidth, GB/s
+    known: bool = True
+
+
+# matched by substring against the lowercased device kind, first hit wins;
+# "v5 lite" before "v5p" keeps the v5e tunnel string from matching v5p
+_DEVICE_SPECS = (
+    ("v5 lite", DeviceSpec("v5e", 197.0, 819.0, 200.0)),
+    ("v5e", DeviceSpec("v5e", 197.0, 819.0, 200.0)),
+    ("v5p", DeviceSpec("v5p", 459.0, 2765.0, 600.0)),
+    ("v4", DeviceSpec("v4", 275.0, 1228.0, 300.0)),
+    ("v6", DeviceSpec("v6e", 918.0, 1640.0, 448.0)),
+)
+_FALLBACK = DeviceSpec("v5e (assumed)", 197.0, 819.0, 200.0, known=False)
+
+
+def device_specs(device_kind: str) -> DeviceSpec:
+    """Spec table lookup; unknown kinds assume v5e with ``known=False``."""
+    kind = str(device_kind).lower()
+    for key, spec in _DEVICE_SPECS:
+        if key in kind:
+            return spec
+    return _FALLBACK
+
+
+def device_peak_tflops(device: str) -> float:
+    """bf16 peak for MFU math; warns and assumes v5e on unknown devices
+    (shared by bench.py and the tools/ bench scripts)."""
+    spec = device_specs(device)
+    if not spec.known:
+        import sys
+
+        print(f"WARNING: unknown device {device!r}; assuming v5e 197 TFLOP peak "
+              "(mfu/vs_baseline unreliable)", file=sys.stderr)
+    return spec.peak_bf16_tflops
+
+
+# ------------------------------------------------------------------ extraction
+def compiled_cost_metrics(compiled: Any) -> dict[str, int]:
+    """Analytic costs of one compiled step, as flat log-row-ready ints.
+
+    Returns ``hlo_flops`` / ``hlo_bytes_accessed`` (XLA's own cost analysis of
+    the optimized module) plus ``comm_bytes_<kind>`` per collective kind and
+    ``comm_bytes_total`` (regex accounting over the optimized HLO text). Any
+    unavailable source contributes nothing rather than raising — diagnostics
+    must never take the run down.
+    """
+    out: dict[str, int] = {}
+    try:
+        cost = compiled.cost_analysis()
+        # list-of-dicts on some backends (one per computation), dict on others
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if cost:
+            if cost.get("flops") is not None:
+                out["hlo_flops"] = int(cost["flops"])
+            if cost.get("bytes accessed") is not None:
+                out["hlo_bytes_accessed"] = int(cost["bytes accessed"])
+    except Exception:
+        logger.debug("cost_analysis unavailable on this backend", exc_info=True)
+    try:
+        comm = collective_bytes(compiled.as_text())
+        for op, nbytes in sorted(comm.items()):
+            out[f"comm_bytes_{op.replace('-', '_')}"] = int(nbytes)
+        out["comm_bytes_total"] = int(sum(comm.values()))
+    except Exception:
+        logger.debug("optimized HLO text unavailable", exc_info=True)
+    return out
+
+
+# -------------------------------------------------------------------- roofline
+def roofline_metrics(costs: dict[str, int], spec: DeviceSpec) -> dict[str, float]:
+    """Roofline-expected step time from analytic costs + chip peaks.
+
+    Each resource is an independent floor: the step can go no faster than its
+    FLOPs at peak compute, its bytes at peak HBM bandwidth, or its collective
+    bytes at peak ICI bandwidth. The expected time is the max of the three and
+    ``roofline_bound`` names the binding resource.
+    """
+    t_compute = costs.get("hlo_flops", 0) / (spec.peak_bf16_tflops * 1e12)
+    t_memory = costs.get("hlo_bytes_accessed", 0) / (spec.hbm_gbps * 1e9)
+    t_comm = costs.get("comm_bytes_total", 0) / (spec.ici_gbps * 1e9)
+    components = {"compute": t_compute, "memory": t_memory, "comms": t_comm}
+    if max(components.values()) <= 0:
+        return {}  # no analytic costs -> no roofline (an all-zero one misleads)
+    bound = max(components, key=components.get)
+    return {
+        "roofline_t_compute_s": t_compute,
+        "roofline_t_memory_s": t_memory,
+        "roofline_t_comm_s": t_comm,
+        "roofline_step_time_s": max(components.values()),
+        "roofline_bound": bound,
+        "roofline_spec": spec.name,
+    }
+
+
+def diagnose_bound(step_time_s: float | None, roofline: dict[str, Any],
+                   data_wait_frac: float = 0.0,
+                   input_bound_frac: float = 0.25) -> str | None:
+    """Per-row bound diagnosis: achieved step time vs the roofline expectation.
+
+    When the host spends more than ``input_bound_frac`` of wall time waiting on
+    data, the step is input-bound regardless of what the device program looks
+    like; otherwise the binding roofline resource is the diagnosis.
+    """
+    if not roofline or step_time_s is None:
+        return None
+    if data_wait_frac > input_bound_frac:
+        return "input"
+    return roofline.get("roofline_bound")

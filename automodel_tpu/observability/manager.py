@@ -1,11 +1,14 @@
 """Config-driven observability manager wired into the training recipes.
 
-One object owns the four pillars — goodput accounting, HBM/compile telemetry,
-the stall watchdog, and on-demand profiling — so a recipe integrates with five
-hooks: ``start()``, ``track(bucket)``, ``heartbeat(step)``,
-``on_step_start/end(step)``, and ``step_metrics()`` merged into each log row.
-Everything flows through the existing MetricLogger/experiment-logger fan-out;
-this module adds no new output channels.
+One object owns the pillars — goodput accounting, HBM/compile telemetry, the
+stall watchdog, on-demand profiling, per-compile HLO cost/roofline
+accounting, the unified trace timeline, and cross-host metric aggregation —
+so a recipe integrates with a handful of hooks: ``start()``,
+``track(bucket)``, ``heartbeat(step)``, ``on_step_start/end(step)``,
+``compile_step(fn, args)`` at the first call of a jitted step, and
+``step_metrics()`` / ``roofline_row()`` / ``host_metrics()`` merged into each
+log row. Everything flows through the existing MetricLogger/experiment-logger
+fan-out plus one new artifact, ``out_dir/timeline.json``.
 
 YAML (all keys optional; the subsystem is on by default and every pillar
 no-ops cleanly where its backing API is unavailable):
@@ -16,6 +19,9 @@ no-ops cleanly where its backing API is unavailable):
       enabled: true
       goodput: true
       memory: true
+      hlo_costs: true
+      timeline: {enabled: true, max_events: 20000}
+      aggregate: {enabled: true, straggler_factor: 2.0}
       watchdog: {enabled: true, threshold_s: 600}
       profiling: {server_port: 0, trace_steps: 5, signal: SIGUSR1}
 """
@@ -25,10 +31,20 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import logging
+import os
 import signal as _signal
+import time
 from typing import Any, Callable
 
+from automodel_tpu.observability.aggregate import CrossHostAggregator
+from automodel_tpu.observability.events import TraceTimeline
 from automodel_tpu.observability.goodput import GoodputTracker
+from automodel_tpu.observability.hlo_costs import (
+    compiled_cost_metrics,
+    device_specs,
+    diagnose_bound,
+    roofline_metrics,
+)
 from automodel_tpu.observability.memory import device_memory_stats
 from automodel_tpu.observability.profiling import OnDemandProfiler
 from automodel_tpu.observability.watchdog import StallWatchdog
@@ -37,12 +53,21 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["ObservabilityConfig", "Observability"]
 
+# phases long enough to deserve their own timeline span; steps and compiles
+# are spanned by their dedicated hooks
+_TIMELINE_BUCKETS = ("eval", "checkpoint", "rollback")
+
 
 @dataclasses.dataclass
 class ObservabilityConfig:
     enabled: bool = True
     goodput: bool = True
     memory: bool = True
+    hlo_costs: bool = True
+    timeline: bool = True
+    timeline_max_events: int = 20000
+    aggregate: bool = True
+    straggler_factor: float = 2.0
     watchdog: bool = True
     watchdog_threshold_s: float = 600.0
     watchdog_poll_interval_s: float | None = None
@@ -58,7 +83,23 @@ class ObservabilityConfig:
         if hasattr(raw, "to_dict"):
             raw = raw.to_dict()
         raw = dict(raw)
-        kw: dict[str, Any] = {k: raw[k] for k in ("enabled", "goodput", "memory") if k in raw}
+        kw: dict[str, Any] = {
+            k: raw[k] for k in ("enabled", "goodput", "memory", "hlo_costs") if k in raw
+        }
+        tl = raw.get("timeline")
+        if isinstance(tl, bool):
+            kw["timeline"] = tl
+        elif isinstance(tl, dict):
+            kw["timeline"] = bool(tl.get("enabled", True))
+            if tl.get("max_events") is not None:
+                kw["timeline_max_events"] = int(tl["max_events"])
+        agg = raw.get("aggregate")
+        if isinstance(agg, bool):
+            kw["aggregate"] = agg
+        elif isinstance(agg, dict):
+            kw["aggregate"] = bool(agg.get("enabled", True))
+            if agg.get("straggler_factor") is not None:
+                kw["straggler_factor"] = float(agg["straggler_factor"])
         wd = raw.get("watchdog")
         if isinstance(wd, bool):
             kw["watchdog"] = wd
@@ -82,6 +123,52 @@ class ObservabilityConfig:
         return getattr(_signal, str(name).upper())
 
 
+def _tree_avals(args: Any) -> Any:
+    """Shape/dtype fingerprint of an argument tree — the executor dispatch key."""
+    import jax
+
+    return jax.tree.map(
+        lambda x: (getattr(x, "shape", None), str(getattr(x, "dtype", type(x).__name__))),
+        args,
+    )
+
+
+class _GuardedCompiled:
+    """Run the AOT-compiled step; fall back to the jit fn on a shape change.
+
+    The jit dispatch cache does NOT share entries with an AOT compile of the
+    same function, so after extracting costs from ``lowered.compile()`` the
+    loop must execute through that same compiled object or it would pay the
+    full compile twice. The guard exists because the step scheduler can emit a
+    trailing partial accumulation (fewer microbatches in the stack): that
+    shape goes through the jit path, which compiles it as before.
+
+    A sharding change demotes to the jit path permanently: the AOT object
+    bakes in the input shardings seen at lowering, but a step whose outputs
+    carry different shardings than its inputs (e.g. adapter params re-sharded
+    by constraints inside the step) feeds those back as step-2 inputs. Plain
+    jit handles that with a silent recompile; the Compiled object raises.
+    """
+
+    def __init__(self, compiled: Any, fallback: Callable, args: Any):
+        self._compiled: Any | None = compiled
+        self._fallback = fallback
+        self._avals = _tree_avals(args)
+
+    def __call__(self, *args: Any) -> Any:
+        if self._compiled is not None and _tree_avals(args) == self._avals:
+            try:
+                return self._compiled(*args)
+            except ValueError as e:
+                if "Compiled object called with input" not in str(e):
+                    raise
+                logger.warning(
+                    "AOT-compiled step rejected re-sharded inputs; "
+                    "falling back to jit for the rest of the run")
+                self._compiled = None
+        return self._fallback(*args)
+
+
 class Observability:
     """The manager a recipe holds; disabled pillars degrade to no-ops."""
 
@@ -94,9 +181,23 @@ class Observability:
         self.config = config
         self.out_dir = str(out_dir)
         self.compile_time_s: float | None = None
+        self.roofline: dict[str, Any] | None = None
+        self._metric_sink = metric_sink
+        self._step_t0: float | None = None
         on = config.enabled
         self.goodput: GoodputTracker | None = GoodputTracker() if on and config.goodput else None
         self._memory = on and config.memory
+        self.timeline: TraceTimeline | None = None
+        if on and config.timeline:
+            import jax
+
+            proc = jax.process_index()
+            path = os.path.join(self.out_dir, "timeline.json") if proc == 0 else None
+            self.timeline = TraceTimeline(path, pid=proc,
+                                          max_events=config.timeline_max_events)
+        self.aggregator: CrossHostAggregator | None = None
+        if on and config.aggregate:
+            self.aggregator = CrossHostAggregator(config.straggler_factor)
         self.watchdog: StallWatchdog | None = None
         if on and config.watchdog:
             on_stall = None
@@ -109,6 +210,9 @@ class Observability:
                 dump_dir=self.out_dir,
                 on_stall=on_stall,
                 poll_interval_s=config.watchdog_poll_interval_s,
+                # a stack dump alone says where the run is stuck; the goodput
+                # snapshot says what it was doing with its time until then
+                context_fn=lambda: self.goodput.snapshot() if self.goodput else {},
             )
         self.profiler: OnDemandProfiler | None = None
         if on:
@@ -137,19 +241,72 @@ class Observability:
             self.watchdog.stop()
         if self.profiler is not None:
             self.profiler.close()
+        if self.timeline is not None:
+            self.timeline.close()
 
     # ------------------------------------------------------------------ hooks
     def track(self, bucket: str):
-        """Goodput context manager; nullcontext when accounting is off."""
-        if self.goodput is None:
-            return contextlib.nullcontext()
-        return self.goodput.track(bucket)
+        """Goodput context manager; long phases also land on the timeline."""
+        stack = contextlib.ExitStack()
+        if self.goodput is not None:
+            stack.enter_context(self.goodput.track(bucket))
+        if self.timeline is not None and bucket in _TIMELINE_BUCKETS:
+            stack.enter_context(self.timeline.span(bucket, cat="phase"))
+        return stack
+
+    def compile_step(self, step_fn: Callable, args: tuple, step: int = 0) -> Callable:
+        """First call of a jitted step: AOT-compile, log analytic costs +
+        roofline once, and return the executor the loop should run from now on.
+
+        Must run BEFORE the first execution — the step donates its params, so
+        lowering afterwards would trace over deleted buffers. On any failure
+        (backend without cost analysis, non-jit callable) the jit fn comes
+        back unchanged and the run proceeds with one log line of warning.
+        """
+        if not (self.config.enabled and self.config.hlo_costs):
+            return step_fn
+        if not hasattr(step_fn, "lower"):  # plain-function executor (e.g. pp wrapper)
+            logger.info("step executor is not a jit callable; no HLO cost row")
+            return step_fn
+        try:
+            import jax
+
+            t0 = time.perf_counter()
+            compiled = step_fn.lower(*args).compile()
+            costs = compiled_cost_metrics(compiled)
+            spec = device_specs(jax.devices()[0].device_kind)
+            roof = roofline_metrics(costs, spec)
+            self.roofline = roof or None
+            row: dict[str, Any] = {"event": "compile_costs", **costs}
+            if roof:
+                for key in ("roofline_t_compute_s", "roofline_t_memory_s",
+                            "roofline_t_comm_s", "roofline_step_time_s"):
+                    row[key] = round(roof[key], 6)
+                row["roofline_bound"] = roof["roofline_bound"]
+                row["roofline_spec"] = roof["roofline_spec"]
+            row["cost_extract_s"] = round(time.perf_counter() - t0, 3)
+            if self._metric_sink is not None:
+                self._metric_sink(step, **row)
+            if self.timeline is not None:
+                self.timeline.instant(
+                    "compile_costs", cat="compile", step=step,
+                    hlo_flops=costs.get("hlo_flops"),
+                    comm_bytes_total=costs.get("comm_bytes_total"),
+                )
+            return _GuardedCompiled(compiled, step_fn, args)
+        except Exception:
+            logger.warning("HLO cost extraction failed; step runs through jit",
+                           exc_info=True)
+            return step_fn
 
     def record_compile(self, seconds: float) -> None:
         """Cumulative: a delayed-QAT switch compiles a second step mid-run."""
         self.compile_time_s = round((self.compile_time_s or 0.0) + float(seconds), 3)
         if self.goodput is not None:
             self.goodput.add("compile", seconds)
+        if self.timeline is not None:
+            self.timeline.complete("compile", "compile",
+                                   self.timeline.now() - seconds, seconds)
         logger.info("jit compile + first execute: %.1fs (cumulative %.1fs)",
                     seconds, self.compile_time_s)
 
@@ -160,11 +317,33 @@ class Observability:
     def on_step_start(self, step: int) -> None:
         if self.profiler is not None:
             self.profiler.on_step_start(step)
+        if self.timeline is not None:
+            self._step_t0 = self.timeline.now()
 
     def on_step_end(self, step: int, sync: Any = None) -> None:
         if self.profiler is not None:
             self.profiler.on_step_end(step, sync)
+        if self.timeline is not None and self._step_t0 is not None:
+            self.timeline.complete("step", "step", self._step_t0,
+                                   self.timeline.now() - self._step_t0, step=step)
+            self._step_t0 = None
 
+    def note_event(self, step: int, fields: dict[str, Any]) -> None:
+        """Route structured events (stalls, resilience rollbacks/preemptions)
+        onto the timeline; the metric fan-out already carries them as rows."""
+        if self.timeline is None:
+            return
+        name = fields.get("event") or fields.get("resilience/event")
+        if not name or name == "compile_costs":
+            return
+        args = {
+            k.split("/")[-1]: v for k, v in fields.items()
+            if isinstance(v, (int, float, str, bool)) and k.split("/")[-1]
+            not in ("event", "step")
+        }
+        self.timeline.instant(str(name), cat="event", step=step, **args)
+
+    # ------------------------------------------------------------------ log rows
     def step_metrics(self) -> dict[str, Any]:
         """The per-log-row contribution: compile time, goodput fractions, HBM."""
         out: dict[str, Any] = {}
@@ -174,4 +353,41 @@ class Observability:
             out.update(self.goodput.snapshot())
         if self._memory:
             out.update(device_memory_stats())
+        return out
+
+    def roofline_row(self, step_time_s: float | None) -> dict[str, Any]:
+        """Per-row bound diagnosis + achieved fraction of the roofline."""
+        if self.roofline is None:
+            return {}
+        data_wait_frac = 0.0
+        if self.goodput is not None:
+            data_wait_frac = self.goodput.snapshot().get("goodput/data_wait", 0.0)
+        out: dict[str, Any] = {}
+        bound = diagnose_bound(step_time_s, self.roofline, data_wait_frac)
+        if bound is not None:
+            out["bound"] = bound
+        if step_time_s:
+            out["roofline_frac"] = round(
+                self.roofline["roofline_step_time_s"] / step_time_s, 4
+            )
+        return out
+
+    def host_metrics(self, step_time_s: float | None) -> dict[str, Any]:
+        """Cross-host min/median/max + straggler flag for one log step.
+
+        Collective on multi-host: every process must reach this call (the log
+        step is deterministic across hosts); only proc 0 uses the result.
+        """
+        if self.aggregator is None or not self.aggregator.active:
+            return {}
+        sample: dict[str, Any] = {"step_time_s": step_time_s}
+        if self.goodput is not None:
+            sample["data_wait_s"] = round(self.goodput.totals().get("data_wait", 0.0), 4)
+        if self._memory:
+            sample["hbm_gib_peak"] = device_memory_stats().get("hbm_gib_peak")
+        out = self.aggregator.aggregate(sample)
+        if self.timeline is not None and "straggler_host" in out:
+            self.timeline.instant("straggler", cat="event",
+                                  host=out["straggler_host"],
+                                  ratio=out.get("straggler_ratio"))
         return out

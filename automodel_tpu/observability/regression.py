@@ -1,0 +1,230 @@
+"""Perf-regression gate: compare a run against a committed baseline.
+
+A perf regression that lands silently costs every future run; this module
+turns "did this PR make training slower?" into an exit code. A run artifact —
+a recipe ``training.jsonl``, a ``benchmark.json`` from the benchmark recipe,
+or the single JSON line ``bench.py`` prints — is reduced to a few headline
+metrics (tps, mfu, step_time_s, goodput) and compared per-metric against a
+committed baseline with direction-aware tolerances: throughput-like metrics
+regress by dropping, step time by rising.
+
+CLI (also exposed as ``tools/bench_gate.py``)::
+
+    python tools/bench_gate.py --run out/training.jsonl --baseline baselines/v5e.json
+    python tools/bench_gate.py --run bench_line.json --baseline b.json --tolerance tps=0.08
+    python tools/bench_gate.py --run out/training.jsonl --baseline b.json --write-baseline
+
+Exit codes: 0 = within tolerance, 1 = regression, 2 = usage/artifact error.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Iterable
+
+__all__ = [
+    "DEFAULT_TOLERANCES",
+    "HIGHER_IS_BETTER",
+    "Comparison",
+    "summarize_rows",
+    "load_run_metrics",
+    "load_baseline",
+    "write_baseline",
+    "compare",
+    "main",
+]
+
+DEFAULT_TOLERANCES = {"tps": 0.05, "mfu": 0.05, "step_time_s": 0.05, "goodput": 0.05}
+# regression direction: True = lower is a regression, False = higher is
+HIGHER_IS_BETTER = {"tps": True, "mfu": True, "goodput": True, "step_time_s": False}
+
+
+def _median(vals: list[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def summarize_rows(rows: Iterable[dict[str, Any]]) -> dict[str, float]:
+    """Reduce training.jsonl rows to gate metrics.
+
+    Rate metrics take the median over steady-state rows (rows with a real
+    ``tps`` — the compile window logs null) so one GC hiccup or the warmup row
+    can't decide the gate; ``goodput`` takes the last row (it is cumulative).
+    """
+    metric_rows = [r for r in rows if "loss" in r]
+    out: dict[str, float] = {}
+    for key in ("tps", "mfu", "step_time_s"):
+        vals = [float(r[key]) for r in metric_rows if r.get(key) is not None]
+        if vals:
+            out[key] = _median(vals)
+    goodputs = [r["goodput"] for r in metric_rows if r.get("goodput") is not None]
+    if goodputs:
+        out["goodput"] = float(goodputs[-1])
+    return out
+
+
+def _from_bench_line(doc: dict[str, Any]) -> dict[str, float]:
+    """bench.py's one-line JSON: value is tokens/s/chip, mfu rides in extra."""
+    out: dict[str, float] = {}
+    if doc.get("value") is not None:
+        out["tps"] = float(doc["value"])
+    extra = doc.get("extra") or {}
+    if extra.get("mfu") is not None:
+        out["mfu"] = float(extra["mfu"])
+    return out
+
+
+def _from_benchmark_json(doc: dict[str, Any]) -> dict[str, float]:
+    """The benchmark recipe's benchmark.json (recipes/llm/benchmark.py)."""
+    out: dict[str, float] = {}
+    mapping = {"tokens_per_sec": "tps", "mfu": "mfu", "step_time_s": "step_time_s"}
+    for src, dst in mapping.items():
+        if doc.get(src) is not None:
+            out[dst] = float(doc[src])
+    return out
+
+
+def load_run_metrics(path: str) -> dict[str, float]:
+    """Dispatch on content, not extension: JSONL rows, a bench line, or
+    benchmark.json all reduce to the same gate-metric dict."""
+    with open(path) as f:
+        text = f.read()
+    if not text.strip():
+        raise ValueError(f"{path}: empty run artifact")
+    try:  # one JSON document (possibly pretty-printed benchmark.json)
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict):
+        if "metric" in doc and "value" in doc:
+            return _from_bench_line(doc)
+        if "tokens_per_sec" in doc:
+            return _from_benchmark_json(doc)
+        if "metrics" in doc:  # a baseline file doubles as a synthetic run
+            return {k: float(v) for k, v in doc["metrics"].items()}
+        return summarize_rows([doc])
+    rows = [json.loads(ln) for ln in text.splitlines() if ln.strip()]
+    return summarize_rows(rows)
+
+
+def load_baseline(path: str) -> dict[str, float]:
+    with open(path) as f:
+        doc = json.load(f)
+    metrics = doc.get("metrics", doc)
+    return {k: float(v) for k, v in metrics.items() if isinstance(v, (int, float))}
+
+
+def write_baseline(path: str, metrics: dict[str, float],
+                   meta: dict[str, Any] | None = None) -> None:
+    doc = {"metrics": {k: round(float(v), 6) for k, v in metrics.items()}}
+    if meta:
+        doc["meta"] = meta
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+@dataclasses.dataclass
+class Comparison:
+    metric: str
+    run: float | None
+    base: float | None
+    change: float | None  # relative move in the regression direction
+    tolerance: float
+    ok: bool
+
+    def line(self) -> str:
+        status = "OK" if self.ok else "FAIL"
+        if self.run is None or self.base is None:
+            return f"[gate] {self.metric:<12} missing from run artifact: {status}"
+        if self.change is None:  # base == 0: no relative move to compute
+            return (f"[gate] {self.metric:<12} run={self.run:.6g} "
+                    f"base={self.base:.6g} not comparable: {status}")
+        return (f"[gate] {self.metric:<12} run={self.run:.6g} base={self.base:.6g} "
+                f"change={self.change * 100:+.1f}% tol={self.tolerance * 100:.1f}%: {status}")
+
+
+def compare(run: dict[str, float], baseline: dict[str, float],
+            tolerances: dict[str, float] | None = None,
+            require: Iterable[str] = ()) -> list[Comparison]:
+    """Per-metric direction-aware comparison over the baseline's metrics.
+
+    Only metrics present in the baseline gate; a metric the run artifact lacks
+    passes unless listed in ``require`` (a CPU run has no meaningful mfu, but
+    a gate explicitly about tps must not pass on an empty artifact).
+    """
+    tols = dict(DEFAULT_TOLERANCES)
+    tols.update(tolerances or {})
+    required = set(require)
+    out: list[Comparison] = []
+    for metric, base in sorted(baseline.items()):
+        tol = tols.get(metric, 0.05)
+        got = run.get(metric)
+        if got is None or base == 0:
+            out.append(Comparison(metric, got, base, None, tol,
+                                  ok=metric not in required))
+            continue
+        if HIGHER_IS_BETTER.get(metric, True):
+            change = (base - got) / abs(base)  # positive = slower/worse
+        else:
+            change = (got - base) / abs(base)
+        out.append(Comparison(metric, got, base, change, tol, ok=change <= tol))
+    return out
+
+
+def _parse_tolerances(pairs: Iterable[str]) -> dict[str, float]:
+    out = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise ValueError(f"--tolerance wants metric=fraction, got {pair!r}")
+        k, v = pair.split("=", 1)
+        out[k.strip()] = float(v)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="bench_gate", description=__doc__.splitlines()[0],
+    )
+    parser.add_argument("--run", required=True,
+                        help="run artifact: training.jsonl, benchmark.json, or a bench JSON line")
+    parser.add_argument("--baseline", required=True,
+                        help="committed baseline JSON ({'metrics': {...}})")
+    parser.add_argument("--tolerance", action="append", default=[], metavar="METRIC=FRAC",
+                        help="override a tolerance, e.g. tps=0.08 (default 0.05)")
+    parser.add_argument("--require", action="append", default=[], metavar="METRIC",
+                        help="fail when METRIC is missing from the run artifact")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write the run's metrics to --baseline and exit 0")
+    args = parser.parse_args(argv)
+
+    try:
+        tolerances = _parse_tolerances(args.tolerance)
+        run = load_run_metrics(args.run)
+        if args.write_baseline:
+            write_baseline(args.baseline, run, meta={"source": os.path.abspath(args.run)})
+            print(f"[gate] baseline written: {args.baseline} <- {sorted(run)}")
+            return 0
+        baseline = load_baseline(args.baseline)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"[gate] ERROR: {exc}")
+        return 2
+    if not baseline:
+        print(f"[gate] ERROR: no gate metrics in baseline {args.baseline}")
+        return 2
+    results = compare(run, baseline, tolerances, require=args.require)
+    for comparison in results:
+        print(comparison.line())
+    failed = [c.metric for c in results if not c.ok]
+    if failed:
+        print(f"[gate] REGRESSION: {', '.join(failed)} outside tolerance")
+        return 1
+    print("[gate] PASS")
+    return 0

@@ -33,6 +33,11 @@ class StallWatchdog:
     ``on_stall`` (optional) receives ``{"event": "stall", "stall_s": float,
     "step": int | None, "stack_dump": path}``; exceptions in the sink are
     swallowed — diagnostics must never take the run down themselves.
+
+    ``context_fn`` (optional) is called at fire time and its dict merged into
+    the event — the manager passes the goodput snapshot so a stack dump can be
+    correlated with what the run was doing (last-completed step rides in
+    ``step`` already).
     """
 
     def __init__(
@@ -41,12 +46,14 @@ class StallWatchdog:
         dump_dir: str,
         on_stall: Callable[[dict[str, Any]], None] | None = None,
         poll_interval_s: float | None = None,
+        context_fn: Callable[[], dict[str, Any]] | None = None,
     ):
         if threshold_s <= 0:
             raise ValueError(f"threshold_s must be > 0, got {threshold_s}")
         self.threshold_s = float(threshold_s)
         self.dump_dir = str(dump_dir)
         self.on_stall = on_stall
+        self.context_fn = context_fn
         self._poll = poll_interval_s if poll_interval_s else min(max(threshold_s / 4, 0.01), 60.0)
         self._stop = threading.Event()
         self._lock = threading.Lock()
@@ -108,13 +115,19 @@ class StallWatchdog:
             "all-thread stacks -> %s", silence, self.threshold_s, step, path,
         )
         if self.on_stall is not None:
+            event: dict[str, Any] = {
+                "event": "stall",
+                "stall_s": round(silence, 1),
+                "step": step,
+                "stack_dump": path,
+            }
+            if self.context_fn is not None:
+                try:
+                    event.update(self.context_fn() or {})
+                except Exception:
+                    logger.exception("stall watchdog context_fn raised")
             try:
-                self.on_stall({
-                    "event": "stall",
-                    "stall_s": round(silence, 1),
-                    "step": step,
-                    "stack_dump": path,
-                })
+                self.on_stall(event)
             except Exception:
                 logger.exception("stall watchdog on_stall sink raised")
 

@@ -17,7 +17,7 @@ import jax
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["DistInfo", "initialize_distributed", "barrier", "is_main_process", "main_process_first", "any_process_flag", "agreed_min_int"]
+__all__ = ["DistInfo", "initialize_distributed", "barrier", "is_main_process", "main_process_first", "any_process_flag", "agreed_min_int", "host_metadata", "allgather_host_rows"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,6 +142,37 @@ def any_process_flag(flag: bool) -> bool:
 
     flags = multihost_utils.process_allgather(np.asarray([flag], dtype=np.bool_))
     return bool(np.any(flags))
+
+
+def host_metadata() -> dict:
+    """This host's identity for metric rows and run headers: which process in
+    the pod wrote a sample, and where it ran. Pure host-side — safe before the
+    mesh exists and on any backend."""
+    import socket
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "hostname": socket.gethostname(),
+        "pid": os.getpid(),
+    }
+
+
+def allgather_host_rows(values) -> "list[list[float]]":
+    """All-gather one float vector per host; returns the (process_count, k)
+    table as nested lists, ordered by process index. The cross-host metric
+    aggregation rides this: every host contributes its step timings, and each
+    host sees the full table to compute min/median/max and spot stragglers.
+    Collective on multi-host — every process must call it at the same point."""
+    import numpy as np
+
+    vec = np.asarray(values, dtype=np.float64).reshape(-1)
+    if jax.process_count() == 1:
+        return [vec.tolist()]
+    from jax.experimental import multihost_utils
+
+    rows = multihost_utils.process_allgather(vec)
+    return np.asarray(rows, dtype=np.float64).reshape(jax.process_count(), -1).tolist()
 
 
 def agreed_min_int(value: int) -> int:

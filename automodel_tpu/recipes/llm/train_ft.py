@@ -250,6 +250,19 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             self._flops_per_token = None
         self._device_kind = jax.devices()[0].device_kind
 
+        # self-describing stream: one header row up front (git sha, versions,
+        # mesh axis sizes, model id, config digest) so any training.jsonl can
+        # be joined to a bench baseline without its YAML
+        from automodel_tpu.loggers.metric_logger import build_run_header
+
+        arch = None
+        if isinstance(getattr(self, "hf_config", None), dict):
+            arch = (self.hf_config.get("architectures") or [None])[0]
+        model_id = cfg.get("model.pretrained_model_name_or_path") or arch or "scratch"
+        self.metric_logger.log_header(**build_run_header(
+            cfg=cfg, mesh=self.mesh, model_id=model_id, seq_len=self.seq_len
+        ))
+
         # the jitted step
         self._train_step = self._build_train_step()
         self._eval_step = None  # VLM/seq-cls overrides use the single-slot form
@@ -641,10 +654,14 @@ class TrainFinetuneRecipeForNextTokenPrediction:
 
     # ------------------------------------------------------------------ train
     def _log_event(self, step: int, **fields):
-        """Async structured events (watchdog stalls) into the metric fan-out."""
+        """Async structured events (watchdog stalls, resilience rollbacks)
+        into the metric fan-out and onto the trace timeline."""
         self.metric_logger.log(step, **fields)
         for lg in self.experiment_loggers:
             lg.log(step, **fields)
+        obs = getattr(self, "observability", None)
+        if obs is not None:
+            obs.note_event(step, fields)
 
     def run_train_validation_loop(self):
         obs = self.observability
@@ -652,6 +669,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         # compile billing survives rollback re-entries: a restored pass reuses
         # the already-jitted step, so it must not re-charge the compile bucket
         self._compiled_fns: set[int] = set()
+        # id(step_fn) -> executor from obs.compile_step (the AOT-compiled
+        # object whose costs were extracted; shares no cache with jit)
+        self._step_executors: dict[int, Any] = {}
         self._checked_vocab = False
         outcome = "done"
         try:
@@ -727,19 +747,30 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 # absorb minutes of compile. float() pulls a scalar to
                 # host: a real sync even through remote-execution tunnels
                 # where block_until_ready is a no-op.
+                #
+                # compile_step AOT-compiles BEFORE the first execution (the
+                # step donates its params — afterwards the example buffers are
+                # gone), extracts HLO costs + the roofline once, and hands
+                # back the executor the rest of the run steps through.
                 t0 = time.perf_counter()
-                self.train_params, self.opt_state, metrics = step_fn(
+                exec_fn = obs.compile_step(
+                    step_fn, (self.train_params, self.opt_state, stack, *extra),
+                    step=step,
+                )
+                self.train_params, self.opt_state, metrics = exec_fn(
                     self.train_params, self.opt_state, stack, *extra
                 )
                 float(metrics["loss"])
                 obs.record_compile(time.perf_counter() - t0)
                 compiled_fns.add(id(step_fn))
+                self._step_executors[id(step_fn)] = exec_fn
                 t_last = time.perf_counter()
                 steps_since_log = 0  # compile step excluded from the window
                 window_overhead = 0.0
             else:
+                exec_fn = self._step_executors.get(id(step_fn), step_fn)
                 with obs.track("device_step"):
-                    self.train_params, self.opt_state, metrics = step_fn(
+                    self.train_params, self.opt_state, metrics = exec_fn(
                         self.train_params, self.opt_state, stack, *extra
                     )
                 steps_since_log += 1
@@ -852,6 +883,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                         row["tflops_per_chip"] = None
                         row["mfu"] = None
                 row.update(obs.step_metrics())
+                row.update(obs.roofline_row(dt))
+                # collective on multi-host: every process reaches the log step
+                # (the schedule is deterministic), proc 0 writes the result
+                row.update(obs.host_metrics(dt))
                 self.metric_logger.log(step, **row)
                 for lg in self.experiment_loggers:
                     lg.log(step, **row)
@@ -884,6 +919,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 # to drop the consolidated HF export — the sharded arrays
                 # + client state (all that resume needs) still land.
                 logger.warning("SIGTERM received; checkpointing and exiting")
+                obs.note_event(step, {"event": "preemption"})
                 consolidated = None
                 if (self.resilience.config.enabled
                         and self.checkpointer.config.save_consolidated

@@ -11,11 +11,18 @@ Llama3-8B LoRA on H100 at 402 TFLOPs/s/GPU = 40.6% MFU against 989 bf16 peak
 the attached chip's bf16 peak and define vs_baseline = our_MFU / 0.406 — comparing
 compiler+framework efficiency rather than raw chips (an H100 has ~5x the FLOPs of
 the v5e this runs on).
+
+Failure contract: the LAST stdout line is ALWAYS machine-parseable JSON. When
+the TPU/axon backend cannot initialize, the bench retries in a subprocess on
+the CPU platform with a tiny config (marked ``extra.fallback: "cpu"``, exit 0)
+so the bench trajectory never goes dark; an unrecoverable failure prints
+``{"ok": false, "error": ...}`` and exits non-zero.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -23,16 +30,11 @@ import numpy as np
 
 def device_peak_tflops(device: str) -> float:
     """bf16 peak for MFU math; warns and assumes v5e on unknown devices
-    (shared by bench.py and the tools/ bench scripts)."""
-    peaks = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0, "v4": 275.0, "v6": 918.0}
-    peak = next((v for k, v in peaks.items() if k in device.lower()), None)
-    if peak is None:
-        import sys
+    (shared by bench.py and the tools/ bench scripts). Delegates to the
+    observability spec table — one source of truth with the roofline math."""
+    from automodel_tpu.observability.hlo_costs import device_peak_tflops as _peak
 
-        print(f"WARNING: unknown device {device!r}; assuming v5e 197 TFLOP peak "
-              "(mfu/vs_baseline unreliable)", file=sys.stderr)
-        peak = 197.0
-    return peak
+    return _peak(device)
 
 
 def llama_flops_per_token(cfg, seq_len: int) -> float:
@@ -48,7 +50,7 @@ def llama_flops_per_token(cfg, seq_len: int) -> float:
     return 3.0 * (L * per_layer + embed_head)
 
 
-def _measure(cfg, seq_len: int, micro_batch: int, n_steps: int):
+def _measure(cfg, seq_len: int, micro_batch: int, n_steps: int, backend=None):
     import jax
     import jax.numpy as jnp
     import optax
@@ -76,11 +78,12 @@ def _measure(cfg, seq_len: int, micro_batch: int, n_steps: int):
     # (tools/bench_seq4096_sweep.py): saving q too in remat (-1.3pt, bandwidth),
     # dkv q-block 256 (-2.1pt) or 1024 (+-0), fwd blocks (2048,1024) and
     # micro_batch 3/4 (OOM even with linear-CE — the mlp saved tensors dominate).
-    backend = BackendConfig(dtype="bfloat16", remat_policy="mlp_attn_dots",
-                            attention="flash", attention_segments=False)
+    if backend is None:
+        backend = BackendConfig(dtype="bfloat16", remat_policy="mlp_attn_dots",
+                                attention="flash", attention_segments=False)
     model = LlamaForCausalLM(cfg, backend)
 
-    params = model.init(jax.random.key(0), jnp.bfloat16)
+    params = model.init(jax.random.key(0), jnp.dtype(backend.dtype))
     optimizer = optax.chain(
         optax.scale_by_factored_rms(),
         optax.scale(-1e-5),
@@ -117,7 +120,7 @@ def _measure(cfg, seq_len: int, micro_batch: int, n_steps: int):
     return n_steps * micro_batch * seq_len / dt
 
 
-def main():
+def _full_bench() -> dict:
     import jax
 
     from automodel_tpu.models.llama.model import LlamaConfig
@@ -153,7 +156,8 @@ def main():
     mfu_4k = tps_4k * f_4k / 1e12 / peak
     ref_mfu = 402.0 / 989.0  # reference Llama3-8B LoRA on H100, seq 4096
 
-    print(json.dumps({
+    return {
+        "ok": True,
         "metric": "llama3.2-1b SFT tokens/sec/chip (bf16, seq 2048)",
         "value": round(tps, 1),
         "unit": "tokens/s/chip",
@@ -168,8 +172,113 @@ def main():
             "8b_equiv_tokens_per_sec": round(tps_4k * f_4k / f_8b, 1),
             "device": device,
         },
+    }
+
+
+def _cpu_fallback_bench() -> dict:
+    """Tiny-config CPU measurement: keeps the trajectory numeric (and the JSON
+    contract intact) on a TPU-less host. NOT comparable to chip numbers —
+    marked ``extra.fallback: "cpu"`` and vs_baseline null."""
+    import jax
+
+    from automodel_tpu.models.common.backend import BackendConfig
+    from automodel_tpu.models.llama.model import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=1024,
+        num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
+        head_dim=32, max_position_embeddings=512,
+    )
+    tps = _measure(cfg, seq_len=256, micro_batch=2, n_steps=3,
+                   backend=BackendConfig(dtype="float32"))
+    return {
+        "ok": True,
+        "metric": "llama3.2-1b SFT tokens/sec/chip (bf16, seq 2048)",
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,
+        "extra": {
+            "fallback": "cpu",
+            "fallback_config": "tiny (4L/256d, seq 256, fp32, xla attention)",
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+_BACKEND_ERRORS = ("initialize backend", "UNAVAILABLE", "No visible",
+                   "failed to connect", "DEADLINE_EXCEEDED")
+
+
+def _spawn_cpu_fallback(reason: str) -> int:
+    """Re-run this script with ``--cpu`` in a clean interpreter: the failed
+    backend init poisoned this process's JAX state, and the axon sitecustomize
+    pins jax_platforms at startup — the child both clears JAX_PLATFORMS and
+    re-updates the config (the _spawn_cpu_dryrun pattern)."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = ""
+    try:
+        result = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cpu"],
+            env=env, capture_output=True, text=True, timeout=1800,
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"ok": False, "error": f"cpu fallback timed out; primary: {reason}"}))
+        return 1
+    sys.stderr.write(result.stderr)
+    for line in reversed(result.stdout.splitlines()):
+        try:
+            doc = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(doc, dict) and "ok" in doc:
+            doc.setdefault("extra", {})["fallback_reason"] = reason
+            print(json.dumps(doc))
+            return 0 if doc.get("ok") else 1
+    print(json.dumps({
+        "ok": False,
+        "error": f"cpu fallback rc={result.returncode} with no JSON line; primary: {reason}",
     }))
+    return 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--cpu" in argv:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            print(json.dumps(_cpu_fallback_bench()))
+            return 0
+        except Exception as exc:  # noqa: BLE001 — the JSON contract is the point
+            print(json.dumps({"ok": False, "error": repr(exc)}))
+            return 1
+    try:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            # TPU-less host with a working CPU backend: the full 1B bench
+            # would grind for hours — go straight to the tiny fallback.
+            print("bench: no accelerator attached; running tiny CPU fallback",
+                  file=sys.stderr)
+            doc = _cpu_fallback_bench()
+            doc["extra"]["fallback_reason"] = "default backend is cpu"
+            print(json.dumps(doc))
+            return 0
+        print(json.dumps(_full_bench()))
+        return 0
+    except Exception as exc:  # noqa: BLE001
+        reason = repr(exc)
+        if any(marker in reason for marker in _BACKEND_ERRORS):
+            print(f"bench: backend unavailable ({reason}); retrying on CPU",
+                  file=sys.stderr)
+            return _spawn_cpu_fallback(reason)
+        print(json.dumps({"ok": False, "error": reason}))
+        return 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

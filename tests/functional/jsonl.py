@@ -1,0 +1,24 @@
+"""Shared training.jsonl readers for the functional suite.
+
+The metric stream is self-describing since the perf-observability work: it
+carries a one-time ``{"run_header": true, ...}`` row and event rows
+(``compile_costs``, resilience events) alongside the per-step metric rows.
+Tests that index ``["loss"]`` or count steps must read through
+:func:`metric_rows` rather than assuming every line is a step.
+"""
+
+import json
+
+
+def read_rows(path):
+    """Every row, verbatim — headers and events included."""
+    return [json.loads(line) for line in open(path)]
+
+
+def metric_rows(path):
+    """Only per-step metric rows (the ones carrying a loss)."""
+    return [r for r in read_rows(path) if "loss" in r]
+
+
+def losses(path):
+    return [r["loss"] for r in metric_rows(path)]

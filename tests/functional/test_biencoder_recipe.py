@@ -7,6 +7,7 @@ import textwrap
 import numpy as np
 
 from automodel_tpu.config.loader import load_config
+from tests.functional.jsonl import losses as jl_losses, metric_rows
 from automodel_tpu.recipes.biencoder.train_biencoder import TrainBiencoderRecipe
 
 
@@ -73,7 +74,7 @@ def test_biencoder_contrastive_loss_decreases(tmp_path, cpu_devices):
     pairs = _make_rows(tmp_path)
     recipe = TrainBiencoderRecipe(load_config(_write_cfg(tmp_path, pairs))).setup()
     recipe.run_train_validation_loop()
-    rows = [json.loads(l) for l in open(tmp_path / "out" / "training.jsonl")]
+    rows = metric_rows(tmp_path / "out" / "training.jsonl")
     losses = [r["loss"] for r in rows]
     # 16 queries x 2 passages = 32-way softmax: chance ~ ln(32) = 3.46
     assert losses[0] > 2.0
@@ -87,7 +88,7 @@ def test_biencoder_last_token_pooling(tmp_path, cpu_devices):
     recipe = TrainBiencoderRecipe(
         load_config(_write_cfg(tmp_path, pairs, pooling="last"))).setup()
     recipe.run_train_validation_loop()
-    losses = [json.loads(l)["loss"] for l in open(tmp_path / "out" / "training.jsonl")]
+    losses = jl_losses(tmp_path / "out" / "training.jsonl")
     assert losses[-1] < losses[0] - 0.8
 
 
@@ -137,7 +138,7 @@ def test_biencoder_trains_on_mined_negatives_epoch(tmp_path, cpu_devices):
     cfg.set_by_path("output_dir", str(tmp_path / "out2"))
     recipe = TrainBiencoderRecipe(cfg).setup()
     recipe.run_train_validation_loop()
-    losses = [json.loads(l)["loss"] for l in open(tmp_path / "out2" / "training.jsonl")]
+    losses = jl_losses(tmp_path / "out2" / "training.jsonl")
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
 

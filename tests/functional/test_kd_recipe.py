@@ -7,6 +7,7 @@ import textwrap
 import numpy as np
 
 from automodel_tpu.config.loader import load_config
+from tests.functional.jsonl import losses as jl_losses, metric_rows
 from automodel_tpu.recipes.llm.kd import KnowledgeDistillationRecipe
 
 
@@ -67,7 +68,7 @@ def test_kd_loss_decreases(tmp_path, cpu_devices):
     p.write_text(textwrap.dedent(cfg_text))
     recipe = KnowledgeDistillationRecipe(load_config(p)).setup()
     recipe.run_train_validation_loop()
-    rows = [json.loads(line) for line in open(tmp_path / "out" / "training.jsonl")]
+    rows = metric_rows(tmp_path / "out" / "training.jsonl")
     losses = [r["loss"] for r in rows]
     assert np.isfinite(losses).all()
     # blended objective: CE falls toward data + KL toward (random) teacher; the
@@ -143,7 +144,7 @@ def test_kd_peft_adapter_trains(tmp_path, cpu_devices):
     base_before = np.asarray(recipe.params["layers"]["wq"]).copy()
     adapter_before = np.asarray(recipe.train_params["layers"]["wq"]["lora_b"]).copy()
     recipe.run_train_validation_loop()
-    rows = [json.loads(line) for line in open(tmp_path / "out" / "training.jsonl")]
+    rows = metric_rows(tmp_path / "out" / "training.jsonl")
     losses = [r["loss"] for r in rows]
     assert np.isfinite(losses).all()
     # the blended objective (CE + KL to a random teacher) conflicts at rank-8
@@ -200,7 +201,7 @@ def test_kd_pp_matches_unpipelined_trajectory(tmp_path, cpu_devices):
         r = KnowledgeDistillationRecipe(load_config(p))
         r.setup()
         r.run_train_validation_loop()
-        return [json.loads(l)["loss"] for l in open(tmp_path / tag / "training.jsonl")]
+        return jl_losses(tmp_path / tag / "training.jsonl")
 
     ref = run("kd_pp1", "{dp_shard: 4, tp: 2}")
     got = run("kd_pp2", "{dp_shard: 2, tp: 2, pp: 2}")
@@ -271,7 +272,7 @@ def test_kd_moe_student_pp_matches_unpipelined_trajectory(tmp_path, cpu_devices)
         r = KnowledgeDistillationRecipe(load_config(p))
         r.setup()
         r.run_train_validation_loop()
-        rows = [json.loads(l) for l in open(tmp_path / tag / "training.jsonl")]
+        rows = metric_rows(tmp_path / tag / "training.jsonl")
         assert "moe_load/max_util_mean" in rows[0]
         return [row["loss"] for row in rows]
 
@@ -339,7 +340,7 @@ def test_kd_pp_moe_teacher_runs(tmp_path, cpu_devices):
     p.write_text(textwrap.dedent(cfg_text))
     recipe = KnowledgeDistillationRecipe(load_config(p)).setup()
     recipe.run_train_validation_loop()
-    losses = [json.loads(l)["loss"] for l in open(tmp_path / "out" / "training.jsonl")]
+    losses = jl_losses(tmp_path / "out" / "training.jsonl")
     assert np.isfinite(losses).all() and len(losses) == 2
 
 
@@ -393,7 +394,7 @@ def test_kd_peft_dropout_runs(tmp_path, cpu_devices):
     assert recipe._step_needs_rng
     adapter_before = np.asarray(recipe.train_params["layers"]["wq"]["lora_b"]).copy()
     recipe.run_train_validation_loop()
-    losses = [json.loads(l)["loss"] for l in open(tmp_path / "out" / "training.jsonl")]
+    losses = jl_losses(tmp_path / "out" / "training.jsonl")
     assert np.isfinite(losses).all()
     assert not np.allclose(
         np.asarray(recipe.train_params["layers"]["wq"]["lora_b"]), adapter_before

@@ -1,0 +1,130 @@
+"""Perf-regression gate smoke (tools/bench_gate.py) + the bench JSON contract.
+
+Marked ``perf`` (and ``slow``, out of tier-1): run with ``pytest -m perf``.
+Drives the real CLI through a subprocess the way CI would: train once on CPU,
+write a baseline from the run, gate the same run (exit 0), then gate a
+synthetically 10%-slower run (exit non-zero)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.perf]
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+GATE = os.path.join(REPO, "tools", "bench_gate.py")
+
+
+def _gate(*args):
+    return subprocess.run([sys.executable, GATE, *args],
+                          capture_output=True, text=True, timeout=120)
+
+
+@pytest.fixture(scope="module")
+def train_run(tmp_path_factory, cpu_devices):
+    """One tiny CPU training run shared by the gate scenarios."""
+    from automodel_tpu.config.loader import load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    tmp_path = tmp_path_factory.mktemp("perf_gate")
+    cfg_text = f"""
+    seed: 7
+    output_dir: {tmp_path}/out
+    model:
+      config:
+        architectures: [LlamaForCausalLM]
+        vocab_size: 128
+        hidden_size: 64
+        intermediate_size: 128
+        num_hidden_layers: 2
+        num_attention_heads: 4
+        num_key_value_heads: 2
+        max_position_embeddings: 128
+    distributed:
+      dp_shard: 4
+      tp: 2
+    backend:
+      dtype: float32
+    dataset:
+      _target_: automodel_tpu.data.llm.mock.MockSFTDataset
+      vocab_size: 128
+      seq_len: 32
+      num_samples: 256
+      seed: 0
+      pattern: arith
+    micro_batch_size: 8
+    seq_len: 32
+    step_scheduler:
+      grad_acc_steps: 1
+      max_steps: 8
+      num_epochs: 10
+      handle_sigterm: false
+    optimizer:
+      lr: 1.0e-2
+    checkpoint:
+      enabled: false
+    """
+    p = tmp_path / "cfg.yaml"
+    p.write_text(textwrap.dedent(cfg_text))
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(load_config(p)).setup()
+    recipe.run_train_validation_loop()
+    return tmp_path
+
+
+def test_gate_passes_on_matching_run_and_fails_on_10pct_regression(train_run):
+    run = str(train_run / "out" / "training.jsonl")
+    baseline = str(train_run / "baseline.json")
+
+    wrote = _gate("--run", run, "--baseline", baseline, "--write-baseline")
+    assert wrote.returncode == 0, wrote.stdout + wrote.stderr
+    base = json.load(open(baseline))
+    assert "tps" in base["metrics"]
+
+    same = _gate("--run", run, "--baseline", baseline)
+    assert same.returncode == 0, same.stdout + same.stderr
+    assert "[gate] PASS" in same.stdout
+
+    # synthetic regression: scale every row's tps down 10%
+    slower = str(train_run / "regressed.jsonl")
+    with open(run) as src, open(slower, "w") as dst:
+        for line in src:
+            row = json.loads(line)
+            if row.get("tps") is not None:
+                row["tps"] *= 0.9
+            dst.write(json.dumps(row) + "\n")
+    bad = _gate("--run", slower, "--baseline", baseline)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "REGRESSION" in bad.stdout and "tps" in bad.stdout
+
+
+def test_gate_reads_bench_json_line(train_run, tmp_path):
+    """The gate accepts bench.py's one-line JSON as the run artifact."""
+    line = {"ok": True, "metric": "tok/s", "value": 14380.0, "unit": "tokens/s/chip",
+            "vs_baseline": 1.4, "extra": {"mfu": 0.6}}
+    run = tmp_path / "bench_line.json"
+    run.write_text(json.dumps(line))
+    baseline = tmp_path / "b.json"
+    baseline.write_text(json.dumps({"metrics": {"tps": 14000.0, "mfu": 0.58}}))
+    ok = _gate("--run", str(run), "--baseline", str(baseline))
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+
+
+def test_bench_cpu_fallback_prints_parseable_json(tmp_path):
+    """bench.py on a TPU-less host: exit 0, final stdout line is JSON with
+    ok=true and extra.fallback=cpu (the driver's failure contract)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # 8 virtual devices would slow the tiny bench
+    result = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                            capture_output=True, text=True, timeout=600, env=env)
+    assert result.returncode == 0, result.stderr[-2000:]
+    doc = json.loads(result.stdout.strip().splitlines()[-1])
+    assert doc["ok"] is True
+    assert doc["value"] > 0
+    assert doc["extra"]["fallback"] == "cpu"

@@ -8,6 +8,7 @@ import textwrap
 import numpy as np
 
 from automodel_tpu.config.loader import load_config
+from tests.functional.jsonl import losses as jl_losses, metric_rows
 from automodel_tpu.data.llm.megatron.indexed_dataset import MMapIndexedDatasetBuilder
 from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
 
@@ -75,7 +76,7 @@ def test_megatron_pretrain_loss_decreases(tmp_path, cpu_devices):
     p.write_text(textwrap.dedent(cfg_text))
     recipe = TrainFinetuneRecipeForNextTokenPrediction(load_config(p)).setup()
     recipe.run_train_validation_loop()
-    rows = [json.loads(line) for line in open(tmp_path / "out" / "training.jsonl")]
+    rows = metric_rows(tmp_path / "out" / "training.jsonl")
     losses = [r["loss"] for r in rows]
     assert losses[0] > 4.0
     # the corpus is a deterministic affine map: a 2-layer model learns it fast

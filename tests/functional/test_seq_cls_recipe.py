@@ -7,6 +7,7 @@ import textwrap
 import numpy as np
 
 from automodel_tpu.config.loader import load_config
+from tests.functional.jsonl import losses as jl_losses, metric_rows
 from automodel_tpu.recipes.llm.train_seq_cls import TrainSeqClsRecipe
 
 
@@ -70,7 +71,7 @@ def test_seq_cls_loss_decreases(tmp_path, cpu_devices):
     p.write_text(textwrap.dedent(cfg_text))
     recipe = TrainSeqClsRecipe(load_config(p)).setup()
     recipe.run_train_validation_loop()
-    rows = [json.loads(line) for line in open(tmp_path / "out" / "training.jsonl")]
+    rows = metric_rows(tmp_path / "out" / "training.jsonl")
     losses = [r["loss"] for r in rows]
     assert 0.5 < losses[0] < 1.2  # ~ln(2) at init
     assert losses[-1] < 0.45  # learns the parity rule

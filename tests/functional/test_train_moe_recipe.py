@@ -64,7 +64,9 @@ def _write_cfg(tmp_path, arch="Qwen3MoeForCausalLM", extra_model="", extra="", m
 
 
 def _read_jsonl(path):
-    return [json.loads(line) for line in open(path)]
+    from tests.functional.jsonl import metric_rows
+
+    return metric_rows(path)
 
 
 class TestMoERecipeE2E:

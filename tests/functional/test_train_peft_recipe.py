@@ -67,7 +67,9 @@ def _write_cfg(tmp_path, peft_extra="", max_steps=6, ckpt=False, consolidated=Fa
 
 
 def _read_jsonl(path):
-    return [json.loads(line) for line in open(path)]
+    from tests.functional.jsonl import metric_rows
+
+    return metric_rows(path)
 
 
 class TestPeftRecipeE2E:

@@ -65,7 +65,11 @@ def _write_cfg(tmp_path, extra="", dp_shard=4, tp=2, pp=1, n_layers=2, max_steps
 
 
 def _read_jsonl(path):
-    return [json.loads(line) for line in open(path)]
+    rows = [json.loads(line) for line in open(path)]
+    # run-header and compile_costs rows are stream metadata; resilience event
+    # rows stay — TestResilience asserts on them
+    return [r for r in rows
+            if "run_header" not in r and r.get("event") != "compile_costs"]
 
 
 class TestTrainRecipeE2E:
@@ -95,6 +99,50 @@ class TestTrainRecipeE2E:
         # never inf/0-division garbage
         assert rows[0]["tps"] is None
         assert all(r["tps"] > 0 for r in rows[1:])
+
+    def test_run_header_compile_costs_and_timeline(self, tmp_path, cpu_devices):
+        """The perf-observability artifacts of one training run: the one-time
+        run-header row, the per-compile analytic cost/roofline row, per-step
+        bound diagnosis, and a Perfetto-loadable timeline.json."""
+        cfg = load_config(_write_cfg(tmp_path, ckpt=True))
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        recipe.run_train_validation_loop()
+        raw = [json.loads(line) for line in open(tmp_path / "out" / "training.jsonl")]
+
+        headers = [r for r in raw if r.get("run_header")]
+        assert len(headers) == 1
+        h = headers[0]
+        assert h["jax_version"] and h["jaxlib_version"]
+        assert h["n_devices"] == 8 and h["process_count"] == 1
+        assert h["mesh"]["dp_shard"] == 4 and h["mesh"]["tp"] == 2
+        assert h["model_id"] == "LlamaForCausalLM"
+        assert "git_sha" in h and len(h["config_digest"]) == 16
+
+        compiles = [r for r in raw if r.get("event") == "compile_costs"]
+        assert len(compiles) == 1
+        c = compiles[0]
+        assert c["hlo_flops"] > 0
+        assert c["hlo_bytes_accessed"] > 0
+        assert c["comm_bytes_total"] > 0  # dp=4 x tp=2 sharding emits collectives
+        assert c["roofline_step_time_s"] > 0
+        assert c["roofline_bound"] in ("compute", "memory", "comms")
+
+        metric = [r for r in raw if "loss" in r]
+        assert len(metric) == 6
+        # per-row diagnosis on every post-compile row (row 0 has no step time)
+        for r in metric[1:]:
+            assert r["bound"] in ("compute", "memory", "comms", "input")
+            assert r["roofline_frac"] > 0
+
+        doc = json.load(open(tmp_path / "out" / "timeline.json"))
+        assert doc["displayTimeUnit"] == "ms"
+        for e in doc["traceEvents"]:
+            assert {"name", "cat", "ph", "ts", "pid", "tid"} <= set(e)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert {"compile", "compile_costs", "step", "checkpoint"} <= names
+        steps = [e for e in doc["traceEvents"] if e["name"] == "step"]
+        assert len(steps) == 6
+        assert all(e["ph"] == "X" and e["dur"] > 0 for e in steps)
 
     def test_hsdp_matches_fsdp_trajectory(self, tmp_path, cpu_devices):
         """HSDP (dp_replicate=2 x dp_shard=2 x tp=2 — reference
@@ -281,6 +329,11 @@ class TestResilience:
         # rollback dropped the step-5..6 updates, so trajectories differ by the
         # skipped window only — the final loss must land close to the baseline
         assert losses[10] == pytest.approx(base_losses[10], abs=0.35)
+
+        # the rollback must also land on the unified timeline as an instant
+        tl = json.load(open(tmp_path / "out" / "timeline.json"))
+        tl_names = {e["name"] for e in tl["traceEvents"]}
+        assert "rollback" in tl_names
 
         # resume leg: drop the clean tail checkpoints so the truncated step_8
         # is newest — setup must reject it and walk back to step_4

@@ -8,6 +8,7 @@ import textwrap
 import numpy as np
 
 from automodel_tpu.config.loader import load_config
+from tests.functional.jsonl import losses as jl_losses, metric_rows
 from automodel_tpu.recipes.vlm.finetune import FinetuneRecipeForVLM
 
 
@@ -70,7 +71,7 @@ def _write_cfg(tmp_path, freeze_extra="", max_steps=20):
 
 
 def _losses(tmp_path):
-    return [json.loads(l)["loss"] for l in open(tmp_path / "out" / "training.jsonl")]
+    return jl_losses(tmp_path / "out" / "training.jsonl")
 
 
 def test_vlm_loss_decreases_through_vision(tmp_path, cpu_devices):
@@ -245,7 +246,7 @@ def test_qwen3_vl_finetune_with_lora(tmp_path, cpu_devices):
     recipe.run_train_validation_loop()
     import json
 
-    losses = [json.loads(l)["loss"] for l in open(tmp_path / "out" / "training.jsonl")]
+    losses = jl_losses(tmp_path / "out" / "training.jsonl")
     assert losses[-1] < losses[0] - 0.2, f"lora+vlm loss must fall: {losses}"
 
 
@@ -264,7 +265,7 @@ def test_vlm_pp_matches_unpipelined_trajectory(tmp_path, cpu_devices):
         r = FinetuneRecipeForVLM(load_config(pt))
         r.setup()
         r.run_train_validation_loop()
-        return [json.loads(l)["loss"] for l in open(tmp_path / tag / "training.jsonl")]
+        return jl_losses(tmp_path / tag / "training.jsonl")
 
     ref = run("vlm_pp1", "dp_shard: 8")
     got = run("vlm_pp2", "dp_shard: 4\n  pp: 2")
@@ -356,7 +357,7 @@ def test_qwen3_vl_pp_matches_unpipelined_trajectory(tmp_path, cpu_devices):
         r = FinetuneRecipeForVLM(load_config(_qwen3_vl_cfg(tmp_path, tag, dist)))
         r.setup()
         r.run_train_validation_loop()
-        return [json.loads(l)["loss"] for l in open(tmp_path / tag / "training.jsonl")]
+        return jl_losses(tmp_path / tag / "training.jsonl")
 
     ref = run("qvl_pp1", "{dp_shard: 8}")
     got = run("qvl_pp2", "{dp_shard: 4, pp: 2}")

@@ -1,0 +1,90 @@
+"""Cross-host metric aggregation (observability/aggregate.py): 8 simulated
+hosts via an injected allgather (the suite runs one process; the real path
+uses multihost_utils.process_allgather), straggler flagging, NaN handling."""
+
+import math
+
+import pytest
+
+from automodel_tpu.observability.aggregate import HOST_KEYS, CrossHostAggregator
+
+
+def _fake_allgather(rows):
+    """An allgather_fn returning pre-baked per-host rows (ignores the local vec)."""
+    return lambda vec: [list(r) for r in rows]
+
+
+def _rows(n=8, step=0.5, wait=0.01, hbm=8.0):
+    return [[step, wait, hbm] for _ in range(n)]
+
+
+class TestAggregation:
+    def test_min_median_max_over_8_hosts(self):
+        rows = _rows()
+        for i, r in enumerate(rows):
+            r[0] = 0.5 + i * 0.01  # 0.5 .. 0.57
+        agg = CrossHostAggregator(allgather_fn=_fake_allgather(rows), process_count=8)
+        out = agg.aggregate({"step_time_s": 0.5, "data_wait_s": 0.01, "hbm_gib_peak": 8.0})
+        assert out["host/n"] == 8
+        assert out["host/step_time_s_min"] == 0.5
+        assert out["host/step_time_s_max"] == 0.57
+        assert 0.5 < out["host/step_time_s_median"] < 0.57
+        assert out["host/hbm_gib_peak_max"] == 8.0
+        assert "straggler_host" not in out  # 14% spread is not a straggler
+
+    def test_straggler_flagged_with_host_index_and_ratio(self):
+        rows = _rows()
+        rows[5][0] = 1.7  # host 5 at 3.4x the median
+        agg = CrossHostAggregator(straggler_factor=2.0,
+                                  allgather_fn=_fake_allgather(rows), process_count=8)
+        out = agg.aggregate({"step_time_s": 0.5, "data_wait_s": 0.01, "hbm_gib_peak": 8.0})
+        assert out["straggler_host"] == 5
+        assert out["straggler_ratio"] == pytest.approx(1.7 / 0.5, abs=0.01)
+
+    def test_straggler_threshold_respects_factor(self):
+        rows = _rows()
+        rows[2][0] = 0.9  # 1.8x median
+        strict = CrossHostAggregator(straggler_factor=1.5,
+                                     allgather_fn=_fake_allgather(rows), process_count=8)
+        loose = CrossHostAggregator(straggler_factor=2.0,
+                                    allgather_fn=_fake_allgather(rows), process_count=8)
+        sample = {"step_time_s": 0.5, "data_wait_s": 0.01, "hbm_gib_peak": 8.0}
+        assert strict.aggregate(sample)["straggler_host"] == 2
+        assert "straggler_host" not in loose.aggregate(sample)
+
+    def test_missing_values_travel_as_nan_and_are_excluded(self):
+        rows = _rows()
+        rows[3][2] = math.nan  # host 3 has no HBM telemetry (e.g. CPU)
+        agg = CrossHostAggregator(allgather_fn=_fake_allgather(rows), process_count=8)
+        out = agg.aggregate({"step_time_s": 0.5, "data_wait_s": 0.01, "hbm_gib_peak": None})
+        assert out["host/hbm_gib_peak_max"] == 8.0  # NaN row excluded, not propagated
+        assert out["host/step_time_s_median"] == 0.5
+
+    def test_all_nan_key_omitted(self):
+        rows = [[0.5, 0.01, math.nan] for _ in range(8)]
+        agg = CrossHostAggregator(allgather_fn=_fake_allgather(rows), process_count=8)
+        out = agg.aggregate({"step_time_s": 0.5, "data_wait_s": 0.01, "hbm_gib_peak": None})
+        assert "host/hbm_gib_peak_max" not in out
+        assert out["host/n"] == 8
+
+
+class TestActivation:
+    def test_single_process_is_inactive(self):
+        agg = CrossHostAggregator(allgather_fn=_fake_allgather(_rows(1)), process_count=1)
+        assert not agg.active
+        assert agg.aggregate({"step_time_s": 0.5}) == {}
+
+    def test_bad_factor_raises(self):
+        with pytest.raises(ValueError, match="straggler_factor"):
+            CrossHostAggregator(straggler_factor=1.0)
+
+    def test_allgather_failure_degrades_to_empty(self):
+        def boom(vec):
+            raise RuntimeError("collective failed")
+
+        agg = CrossHostAggregator(allgather_fn=boom, process_count=8)
+        assert agg.aggregate({"step_time_s": 0.5}) == {}
+
+    def test_default_keys_order_matches_sample_packing(self):
+        # the wire format is positional: a key-order change is a protocol break
+        assert HOST_KEYS == ("step_time_s", "data_wait_s", "hbm_gib_peak")

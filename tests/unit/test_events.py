@@ -1,0 +1,97 @@
+"""Unified trace timeline (observability/events.py): Chrome trace-event JSON
+that Perfetto/chrome://tracing loads — field validity, span/instant/complete
+forms, the event cap, and mid-run readability."""
+
+import json
+
+import pytest
+
+from automodel_tpu.observability.events import TraceTimeline
+
+REQUIRED_FIELDS = {"name", "cat", "ph", "ts", "pid", "tid"}
+
+
+def _load(path):
+    doc = json.load(open(path))
+    assert doc["displayTimeUnit"] == "ms"
+    assert isinstance(doc["traceEvents"], list)
+    for ev in doc["traceEvents"]:
+        assert REQUIRED_FIELDS <= set(ev), ev
+        assert ev["ph"] in ("X", "i")
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+    return doc
+
+
+class TestTraceEvents:
+    def test_complete_span_instant_roundtrip(self, tmp_path):
+        p = tmp_path / "timeline.json"
+        tl = TraceTimeline(str(p))
+        with tl.span("checkpoint", cat="phase"):
+            pass
+        tl.complete("step", "step", tl.now(), 0.25, step=7, loss=1.5)
+        tl.instant("stall", step=7, stall_s=12.0)
+        tl.close()
+        doc = _load(p)
+        by_name = {e["name"]: e for e in doc["traceEvents"]}
+        assert set(by_name) == {"checkpoint", "step", "stall"}
+        assert by_name["step"]["ph"] == "X"
+        assert by_name["step"]["dur"] == pytest.approx(0.25e6, rel=1e-6)
+        assert by_name["step"]["args"]["step"] == 7
+        assert by_name["stall"]["ph"] == "i"
+        assert by_name["stall"]["s"] == "p"  # process-scoped instant
+
+    def test_timestamps_are_microseconds_since_construction(self, tmp_path):
+        p = tmp_path / "t.json"
+        tl = TraceTimeline(str(p))
+        tl.complete("a", "x", 1.0, 0.5)
+        tl.close()
+        ev = _load(p)["traceEvents"][0]
+        assert ev["ts"] == pytest.approx(1e6, rel=1e-6)
+        assert ev["dur"] == pytest.approx(0.5e6, rel=1e-6)
+
+    def test_nonscalar_and_nonfinite_args_sanitized(self, tmp_path):
+        p = tmp_path / "t.json"
+        tl = TraceTimeline(str(p))
+        tl.instant("e", bad=float("nan"), obj={"k": 1}, ok=3)
+        tl.close()
+        args = _load(p)["traceEvents"][0]["args"]
+        assert args["bad"] is None
+        assert isinstance(args["obj"], str)
+        assert args["ok"] == 3
+
+    def test_event_cap_records_drop_count(self, tmp_path):
+        p = tmp_path / "t.json"
+        tl = TraceTimeline(str(p), max_events=10)
+        for i in range(25):
+            tl.instant("e", i=i)
+        tl.close()
+        doc = _load(p)
+        assert len(doc["traceEvents"]) == 10
+        assert doc["droppedEventCount"] == 15
+
+    def test_file_is_valid_mid_run(self, tmp_path):
+        """Periodic flushes must leave a loadable file before close()."""
+        p = tmp_path / "t.json"
+        tl = TraceTimeline(str(p), flush_every=2)
+        for i in range(5):
+            tl.instant("e", i=i)
+        assert p.exists()
+        doc = _load(p)  # parse WITHOUT close
+        assert len(doc["traceEvents"]) >= 2
+        tl.close()
+        assert len(_load(p)["traceEvents"]) == 5
+
+    def test_none_path_noops(self):
+        tl = TraceTimeline(None)  # non-proc-0 hosts
+        tl.instant("e")
+        with tl.span("x"):
+            pass
+        tl.close()  # nothing written, nothing raised
+
+    def test_per_host_pid(self, tmp_path):
+        p = tmp_path / "t.json"
+        tl = TraceTimeline(str(p), pid=3)
+        tl.instant("e")
+        tl.close()
+        assert _load(p)["traceEvents"][0]["pid"] == 3

@@ -318,6 +318,44 @@ class TestStallWatchdog:
         with pytest.raises(ValueError, match="threshold_s"):
             StallWatchdog(threshold_s=0.0, dump_dir=str(tmp_path))
 
+    def test_context_fn_merges_goodput_snapshot_into_event(self, tmp_path):
+        """A stall event must carry the run's goodput snapshot + last step so
+        the incident row is diagnosable without cross-referencing other rows."""
+        from automodel_tpu.observability import StallWatchdog
+
+        events = []
+        wd = StallWatchdog(threshold_s=0.05, dump_dir=str(tmp_path),
+                           on_stall=events.append, poll_interval_s=0.01,
+                           context_fn=lambda: {"goodput": 0.42, "goodput/compile": 0.3})
+        wd.start()
+        wd.heartbeat(step=9)
+        deadline = time.monotonic() + 5.0
+        while not events and time.monotonic() < deadline:
+            time.sleep(0.01)
+        wd.stop()
+        assert events and events[0]["event"] == "stall"
+        assert events[0]["step"] == 9  # last completed step
+        assert events[0]["goodput"] == 0.42
+        assert events[0]["goodput/compile"] == 0.3
+
+    def test_context_fn_failure_does_not_eat_the_event(self, tmp_path):
+        from automodel_tpu.observability import StallWatchdog
+
+        def boom():
+            raise RuntimeError("snapshot failed")
+
+        events = []
+        wd = StallWatchdog(threshold_s=0.05, dump_dir=str(tmp_path),
+                           on_stall=events.append, poll_interval_s=0.01,
+                           context_fn=boom)
+        wd.start()
+        wd.heartbeat(step=1)
+        deadline = time.monotonic() + 5.0
+        while not events and time.monotonic() < deadline:
+            time.sleep(0.01)
+        wd.stop()
+        assert events and events[0]["event"] == "stall"
+
 
 class TestMemoryTelemetry:
     def test_cpu_noops_cleanly(self):
@@ -395,6 +433,76 @@ class TestObservabilityManager:
 
         obs = Observability(cfg, out_dir="/tmp/obs-test")
         assert obs.watchdog is not None and obs.profiler is not None
+        obs.close()
+
+    def test_from_config_perf_observability_sections(self, tmp_path):
+        from automodel_tpu.observability import Observability, ObservabilityConfig
+
+        cfg = ObservabilityConfig.from_dict({
+            "hlo_costs": False,
+            "timeline": {"enabled": True, "max_events": 500},
+            "aggregate": {"enabled": True, "straggler_factor": 3.5},
+        })
+        assert cfg.hlo_costs is False
+        assert cfg.timeline is True and cfg.timeline_max_events == 500
+        assert cfg.aggregate is True and cfg.straggler_factor == 3.5
+        # bool shorthands
+        off = ObservabilityConfig.from_dict({"timeline": False, "aggregate": False})
+        assert off.timeline is False and off.aggregate is False
+
+        obs = Observability(cfg, out_dir=str(tmp_path))
+        assert obs.timeline is not None and obs.timeline.max_events == 500
+        assert obs.aggregator is not None and obs.aggregator.straggler_factor == 3.5
+        assert not obs.aggregator.active  # single-process suite: no gathers
+        # hlo_costs disabled: compile_step hands the fn back untouched
+        fn = object()
+        assert obs.compile_step(fn, ()) is fn
+        obs.close()
+
+    def test_guarded_compiled_demotes_to_jit_on_sharding_rejection(self):
+        """A PEFT step re-shards its adapter params inside the step, so step-2
+        inputs no longer match the shardings the AOT object was lowered with.
+        The guard must hand those calls to the jit fallback permanently, not
+        crash the run (plain jit would have recompiled silently)."""
+        from automodel_tpu.observability.manager import _GuardedCompiled
+
+        calls = []
+
+        class Rejecting:
+            def __call__(self, *args):
+                calls.append("aot")
+                raise ValueError(
+                    "Compiled object called with input sharding(s) does not "
+                    "match the sharding(s) the computation was compiled with.")
+
+        fn = _GuardedCompiled(Rejecting(), lambda *a: calls.append("jit") or "ok", (1,))
+        assert fn(1) == "ok"
+        assert fn(1) == "ok"
+        assert calls == ["aot", "jit", "jit"]  # demotion sticks: one AOT attempt
+
+        class Broken:
+            def __call__(self, *args):
+                raise ValueError("something unrelated")
+
+        fn = _GuardedCompiled(Broken(), lambda *a: "ok", (1,))
+        with pytest.raises(ValueError, match="unrelated"):
+            fn(1)
+
+    def test_timeline_written_on_close_with_compile_and_step_spans(self, tmp_path):
+        from automodel_tpu.observability import Observability
+
+        obs = Observability.from_config({"watchdog": False, "memory": False},
+                                        str(tmp_path))
+        obs.record_compile(0.5)
+        obs.on_step_start(1)
+        obs.on_step_end(1)
+        with obs.track("checkpoint"):
+            pass
+        obs.note_event(1, {"resilience/event": "rollback", "resilience/from_step": 1})
+        obs.close()
+        doc = json.load(open(os.path.join(str(tmp_path), "timeline.json")))
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert {"compile", "step", "checkpoint", "rollback"} <= names
 
     def test_disabled_manager_noops(self, tmp_path):
         from automodel_tpu.observability import Observability
