@@ -4,6 +4,7 @@ config, SURVEY.md §4 fixtures)."""
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import numpy as np
@@ -27,6 +28,7 @@ class MockSFTDataset:
         num_samples: int = 1024,
         seed: int = 0,
         pattern: str = "random",
+        item_delay_s: float = 0.0,
     ):
         self.vocab_size = vocab_size
         self.seq_len = seq_len
@@ -35,11 +37,17 @@ class MockSFTDataset:
         if pattern not in ("random", "arith"):
             raise ValueError(f"unknown pattern {pattern!r}")
         self.pattern = pattern
+        # simulated host-side input cost (tokenize/augment/pack): the perf
+        # smoke uses it to make data_wait visible so the overlapped pipeline
+        # has something to hide
+        self.item_delay_s = float(item_delay_s)
 
     def __len__(self) -> int:
         return self.num_samples
 
     def __getitem__(self, i: int) -> dict[str, Any]:
+        if self.item_delay_s:
+            time.sleep(self.item_delay_s)
         rng = np.random.RandomState(self.seed * 100003 + i)
         # seq_len + 1 so the next-token shift still yields seq_len targets
         if self.pattern == "arith":

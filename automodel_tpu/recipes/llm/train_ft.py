@@ -97,6 +97,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         ).with_mesh(self.mesh)
         logger.info("mesh: %s", dict(self.mesh.shape))
 
+        # batch-stack shardings, built once and reused by every device_put
+        # (and by the device prefetcher) instead of per key per batch
+        self._stack_shardings = self._build_stack_shardings()
+        # live only while a train pass runs; _save consults it so checkpoints
+        # under prefetch carry the consumed-position scheduler/dataloader state
+        self._pipeline = None
+
         # backend + model + params
         backend_cfg = cfg.get("backend")
         self.backend = BackendConfig(**backend_cfg.to_dict()) if backend_cfg else BackendConfig()
@@ -644,13 +651,34 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         if "resilience" in client:
             self.resilience.load_state_dict(client["resilience"])
 
+    def _build_stack_shardings(self) -> dict:
+        """Per-stack-key NamedShardings, built once in setup() and reused every
+        batch (rebuilding them per key per step was pure host overhead on the
+        input path); subclasses with extra modalities add their own entries."""
+        return {"tokens": self.rules.sharding((None, "batch", None))}
+
     def _device_put_stack(self, stack):
         """Shard the stacked (n_micro, B, S) token streams over the batch axes;
-        subclasses with extra modalities (VLM media tensors) override per key."""
-        return {
-            k: jax.device_put(v, self.rules.sharding((None, "batch", None)))
-            for k, v in stack.items()
-        }
+        subclasses with extra modalities (VLM media tensors) override per key.
+        jax.device_put only *issues* the H2D transfer — under the prefetch
+        pipeline the copy overlaps the previous step's compute."""
+        sharding = self._stack_shardings["tokens"]
+        return {k: jax.device_put(v, sharding) for k, v in stack.items()}
+
+    def _build_input_pipeline(self):
+        """Input pipeline for one train pass (docs/performance.md): synchronous
+        fetch, or host prefetch thread + device double-buffering behind
+        ``dataloader.prefetch``. Rebuilt per pass — a rollback restores
+        scheduler/dataloader state, and the worker must restart from there."""
+        from automodel_tpu.data.prefetch import InputPipeline, PrefetchConfig
+
+        return InputPipeline(
+            scheduler=self.step_scheduler,
+            dataloader=self.dataloader,
+            stack_fn=stack_batches,
+            put_fn=self._device_put_stack,
+            config=PrefetchConfig.from_config(self.cfg.get("dataloader.prefetch")),
+        )
 
     # ------------------------------------------------------------------ train
     def _log_event(self, step: int, **fields):
@@ -703,18 +731,32 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         """One pass over the step loop inside the mesh context. Returns
         ``"done"`` (data exhausted / max_steps), ``"preempted"`` (SIGTERM saved
         and exited), or ``"rollback"`` (state restored to the last good
-        checkpoint — the caller re-enters)."""
+        checkpoint — the caller re-enters). Owns the input pipeline's
+        lifecycle: built per pass from the (possibly restored) scheduler
+        position, closed on every exit path so no worker thread outlives the
+        pass or keeps mutating scheduler/dataloader state."""
+        pipeline = self._pipeline = self._build_input_pipeline()
+        try:
+            return self._run_step_loop(obs, pipeline)
+        finally:
+            pipeline.close()
+            self._pipeline = None
+
+    def _run_step_loop(self, obs, pipeline) -> str:
         t_last = time.perf_counter()
         steps_since_log = 0
         window_overhead = 0.0  # eval/ckpt seconds to exclude from step_time_s
         compiled_fns = self._compiled_fns
-        it = iter(self.step_scheduler)
         while True:
             with obs.track("data_wait"):
-                batches = next(it, None)
-            if batches is None:
+                # synchronous: fetch + collate + stack + device_put inline.
+                # prefetched: pops an already-transferred stack — this blocks
+                # only when the host worker is behind, so data_wait now
+                # measures true input stalls
+                fetched = pipeline.get()
+            if fetched is None:
                 return "done"
-            stack = stack_batches(batches)
+            stack = fetched.stack
             if not self._checked_vocab:
                 # tokenizer/model vocab mismatch shows up as NaN loss deep in
                 # training; fail loudly on the first batch instead
@@ -728,11 +770,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                                 f">= model vocab_size {vocab}: tokenizer/model mismatch"
                             )
                 self._checked_vocab = True
-            step = self.step_scheduler.step
+            # the consumed step rides on the fetched batch: under prefetch the
+            # scheduler's own counter runs ahead (worker thread)
+            step = fetched.step
             obs.on_step_start(step)
-            with obs.track("data_wait"):
-                # host->device staging is data movement, not device compute
-                stack = self._device_put_stack(stack)
             extra = (self.params,) if self.peft is not None else ()
             if self._step_needs_rng:
                 extra = (*extra, self.rng.key("lora_dropout"))
@@ -795,6 +836,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     bool(metrics.get("nonfinite", False)),
                 )
                 if action == "rollback":
+                    # stop the worker BEFORE restoring: it mutates the very
+                    # scheduler/dataloader state the rollback rewrites, and the
+                    # restore must not race in-flight prefetches
+                    pipeline.close()
                     if self._perform_rollback(step, obs):
                         return "rollback"
                     action = "abort"  # nothing verifiable to roll back to
@@ -819,7 +864,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     f"grad_norm={float(metrics['grad_norm'])} "
                     "(the offending update was skipped; params remain clean)"
                 )
-            if self.step_scheduler.is_log_step:
+            if self.step_scheduler.is_log_step_at(step):
                 with obs.track("device_step"):
                     # the scalar pulls block on the step's device work, so
                     # this wait is device time, not idle
@@ -866,6 +911,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     **extra,
                     **self._static_log_fields,
                 )
+                if pipeline.prefetching:
+                    # stacks buffered ahead of the consumer at log time; a
+                    # persistent 0 with high goodput/data_wait = input-bound
+                    row["prefetch_depth"] = pipeline.ready_depth()
                 if self._flops_per_token is not None:
                     from automodel_tpu.utils.flops import mfu
 
@@ -894,7 +943,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     "step %d | loss %.4f | gnorm %.3f | %s", step, loss, gnorm,
                     f"{step_tokens / dt:.0f} tok/s" if dt else "compile step",
                 )
-            if self.val_dataloader is not None and self.step_scheduler.is_val_step:
+            if self.val_dataloader is not None and self.step_scheduler.is_val_step_at(step):
                 t_pause = time.perf_counter()
                 with obs.track("eval"):
                     self._run_validation(step)
@@ -902,7 +951,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 window_overhead += time.perf_counter() - t_pause
             if (
                 self.checkpointer.config.enabled
-                and self.step_scheduler.is_ckpt_step
+                and self.step_scheduler.is_ckpt_step_at(step)
                 and getattr(self, "_last_saved_step", None) != step
             ):
                 # the best-tracking path may have just saved this very step
@@ -912,7 +961,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 obs.heartbeat(step)
                 window_overhead += time.perf_counter() - t_pause
             obs.on_step_end(step, sync=metrics.get("loss"))
-            if self.step_scheduler.sigterm_received:
+            # agreed at the CONSUMED step (deterministic across hosts even
+            # while the prefetch worker advances the scheduler's own counter)
+            if self.step_scheduler.sigterm_agreed_at(step):
                 # coordinated preemption (docs/resilience.md): the flag is
                 # pod-agreed, so every host reaches this save together.
                 # When the remaining grace window is short, the pod agrees
@@ -1063,6 +1114,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             "dataloader": self.dataloader,
             "resilience": self.resilience,
         }
+        if self._pipeline is not None:
+            # prefetch: the live scheduler/dataloader have been advanced past
+            # the consumed step by the worker — checkpoint the consumed-position
+            # snapshots instead, so resume replays every in-flight batch
+            client.update(self._pipeline.client_states())
         do_consolidated = (self.checkpointer.config.save_consolidated
                            if consolidated is None else consolidated)
         hf_params = None

@@ -217,16 +217,22 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         kw = {k: v for k, v in kw.items() if k in self._model_call_params}
         return self.model(params, batch["input_ids"], **kw)
 
+    def _build_stack_shardings(self):
+        shardings = super()._build_stack_shardings()
+        shardings["replicated"] = self.rules.sharding((None,))
+        return shardings
+
     def _device_put_stack(self, stack):
         """Per-key shardings: (n_micro, B, S) token streams shard over batch;
-        flat media tensors (patches, coords, grids) replicate."""
-        out = {}
-        for k, v in stack.items():
-            if k in self._RESERVED:
-                out[k] = jax.device_put(v, self.rules.sharding((None, "batch", None)))
-            else:
-                out[k] = jax.device_put(v, self.rules.sharding((None,)))
-        return out
+        flat media tensors (patches, coords, grids) replicate. Shardings are
+        built once in setup() — rebuilding NamedShardings per key per batch
+        was pure host overhead on the input path."""
+        tokens = self._stack_shardings["tokens"]
+        replicated = self._stack_shardings["replicated"]
+        return {
+            k: jax.device_put(v, tokens if k in self._RESERVED else replicated)
+            for k, v in stack.items()
+        }
 
     def _build_train_step(self):
         if self.mesh_ctx.pp > 1:

@@ -67,20 +67,29 @@ class StepScheduler:
 
     @property
     def sigterm_received(self) -> bool:
+        """Cross-host-agreed SIGTERM at the scheduler's own step counter."""
+        return self.sigterm_agreed_at(self.step)
+
+    def sigterm_agreed_at(self, step: int) -> bool:
         """Cross-host-agreed SIGTERM: any host's local flag triggers ALL hosts, so
         everyone exits the step loop together and checkpoints (reference
         step_scheduler.py:217 all-gathers the flag) — one preempted host can never
         strand the others inside a collective. The 1-byte allgather runs at most
         once per optimizer step (the result is cached per step, and sticky once
-        True) and every host calls it at the same loop point, so it cannot hang."""
+        True) and every host calls it at the same loop point, so it cannot hang.
+
+        ``step`` keys the cache: under the prefetch pipeline the scheduler's own
+        counter runs ahead of the training loop (and is mutated by the worker
+        thread), so the loop passes its *consumed* step — deterministic across
+        hosts, keeping the collective count uniform."""
         if self._sigterm_agreed:
             return True
-        if self._sigterm_poll is not None and self._sigterm_poll[0] == self.step:
+        if self._sigterm_poll is not None and self._sigterm_poll[0] == step:
             return self._sigterm_poll[1]
         from automodel_tpu.parallel.init import any_process_flag
 
         agreed = any_process_flag(self._sigterm.is_set())
-        self._sigterm_poll = (self.step, agreed)
+        self._sigterm_poll = (step, agreed)
         if agreed:
             self._sigterm_agreed = True
             if self.sigterm_time is None:
@@ -90,6 +99,13 @@ class StepScheduler:
         return agreed
 
     @property
+    def sigterm_local(self) -> bool:
+        """This host's flag only — safe off the main thread (no collectives);
+        the prefetch worker stops on it while the train loop owns the agreed
+        decision."""
+        return self._sigterm.is_set()
+
+    @property
     def sigterm_elapsed_s(self) -> float:
         """Seconds since the preemption signal (0 when none arrived)."""
         return 0.0 if self.sigterm_time is None else time.monotonic() - self.sigterm_time
@@ -97,6 +113,13 @@ class StepScheduler:
     # -- iteration ----------------------------------------------------------
     def __iter__(self) -> Iterator[list[Any]]:
         """Yield lists of microbatches, one list per optimizer step."""
+        return self.batches()
+
+    def batches(self, collective_sigterm: bool = True) -> Iterator[list[Any]]:
+        """The step iterator. ``collective_sigterm=False`` checks only the
+        local SIGTERM flag — required when iteration runs on the prefetch
+        worker thread, where a multi-host collective would race the main
+        loop's own agreed check (and could deadlock the pod)."""
         if self.dataloader is None:
             raise ValueError("StepScheduler has no dataloader")
         while self.epoch < self.num_epochs:
@@ -115,7 +138,8 @@ class StepScheduler:
                     batches = []
                     if self.max_steps is not None and self.step >= self.max_steps:
                         return
-                    if self.sigterm_received:
+                    if (self.sigterm_received if collective_sigterm
+                            else self.sigterm_local):
                         return
             # trailing partial accumulation at epoch end still steps the optimizer
             if batches:
@@ -126,17 +150,29 @@ class StepScheduler:
             self.epoch += 1
 
     # -- cadence ------------------------------------------------------------
+    # The *_at(step) forms exist for the prefetch pipeline: the consumer's
+    # current step is carried on each fetched batch (the scheduler's own
+    # counter runs ahead). The properties keep the synchronous contract.
+    def is_ckpt_step_at(self, step: int) -> bool:
+        return self.ckpt_every_steps > 0 and step > 0 and step % self.ckpt_every_steps == 0
+
+    def is_val_step_at(self, step: int) -> bool:
+        return self.val_every_steps > 0 and step > 0 and step % self.val_every_steps == 0
+
+    def is_log_step_at(self, step: int) -> bool:
+        return self.log_every_steps > 0 and step % self.log_every_steps == 0
+
     @property
     def is_ckpt_step(self) -> bool:
-        return self.ckpt_every_steps > 0 and self.step > 0 and self.step % self.ckpt_every_steps == 0
+        return self.is_ckpt_step_at(self.step)
 
     @property
     def is_val_step(self) -> bool:
-        return self.val_every_steps > 0 and self.step > 0 and self.step % self.val_every_steps == 0
+        return self.is_val_step_at(self.step)
 
     @property
     def is_log_step(self) -> bool:
-        return self.log_every_steps > 0 and self.step % self.log_every_steps == 0
+        return self.is_log_step_at(self.step)
 
     @property
     def done(self) -> bool:

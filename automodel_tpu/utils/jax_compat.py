@@ -1,0 +1,164 @@
+"""Compatibility shims for jax API drift (written against jax>=0.5, run on 0.4.37).
+
+The codebase targets the modern public surface (``jax.shard_map``,
+``jax.sharding.set_mesh``, ``jax.P``, ``pallas.tpu.CompilerParams``); the
+installed jax 0.4.37 predates all four. Where a 1:1 mapping onto the old
+experimental API exists we install it here, once, at package import
+(:mod:`automodel_tpu.__init__`). Anything that cannot be mapped faithfully is
+left absent so tests can ``skipif`` on it with a precise reason instead of
+failing noisily.
+
+Mappings installed (each only when the modern name is missing):
+
+- ``jax.shard_map(f, mesh=, in_specs=, out_specs=, axis_names=, check_vma=)``
+  -> ``jax.experimental.shard_map.shard_map`` with
+  ``auto = mesh.axis_names - axis_names`` (new API names the *manual* axes,
+  old API names the *auto* ones) and ``check_vma`` -> ``check_rep`` (the
+  varying-mesh-axes checker is the renamed replication checker).
+- ``jax.sharding.set_mesh(mesh)`` -> the mesh itself: ``Mesh`` has been a
+  context manager since 0.4.x, and every use here is ``with set_mesh(m): ...``
+  around calls that also pass the mesh explicitly, so entering the mesh
+  context is the faithful 0.4.37 spelling.
+- ``jax.P`` -> ``jax.sharding.PartitionSpec`` (pure rename).
+- ``jax.lax.axis_size(name)`` -> ``jax.lax.psum(1, name)`` — the pre-0.5
+  idiom; psum of a Python constant folds to the concrete axis size.
+- ``jax.lax.pcast(x, names, to="varying")`` -> identity. pcast only changes
+  the varying-mesh-axes *type annotation*, never the value; 0.4.37's
+  ``check_rep`` rewriter discovers replication itself and inserts the
+  pbroadcasts, so there is nothing to annotate. Other ``to=`` directions have
+  no 0.4.37 equivalent and raise.
+- partial-manual shard_map (``auto`` nonempty) is additionally wrapped in
+  ``jax.jit``: 0.4.37 rejects *eager* partial-manual dispatch
+  (NotImplementedError) while the traced path works — the new API allows
+  eager calls, so the wrapper restores that.
+- ``jax.ShapeDtypeStruct(shape, dtype, vma=...)`` -> subclass that swallows
+  the ``vma`` kwarg. Like pcast, vma is checker metadata with no 0.4.37
+  counterpart and no effect on values.
+
+Known NON-mappings (tests must skipif, with these reasons): XLA CPU's SPMD
+partitioner cannot lower a *partial*-manual shard_map whose body takes
+``axis_index`` (PartitionId UNIMPLEMENTED), and hard-aborts (CHECK failure,
+not an exception) compiling a partial-manual ``all_to_all`` — both work on
+TPU, neither is reachable on the 0.4.37 CPU backend.
+- ``pallas.tpu.CompilerParams`` -> ``pallas.tpu.TPUCompilerParams`` (pure
+  rename: 0.5 dropped the TPU prefix when the class moved under ``pltpu``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+__all__ = ["install", "SHIMMED"]
+
+_installed = False
+
+# True when install() found a pre-0.5 jax and put any alias in place. Tests
+# use this (not a version parse) to gate skipifs on drift that has no shim:
+# it is precisely "the modern API was absent at import".
+SHIMMED = False
+
+
+def _compat_shard_map(f=None, *, mesh, in_specs, out_specs, axis_names=None,
+                      check_vma=True, **kw):
+    """``jax.shard_map`` (new API) on top of 0.4.37's experimental shard_map."""
+    import jax
+    from jax.experimental.shard_map import shard_map as _old
+
+    if f is None:  # decorator form: jax.shard_map(mesh=..., ...)(f)
+        return functools.partial(
+            _compat_shard_map, mesh=mesh, in_specs=in_specs,
+            out_specs=out_specs, axis_names=axis_names, check_vma=check_vma,
+            **kw,
+        )
+    if axis_names is None:
+        auto = frozenset()
+    else:
+        all_axes = frozenset(
+            mesh.axis_names if hasattr(mesh, "axis_names") else mesh.shape.keys()
+        )
+        auto = all_axes - frozenset(axis_names)
+
+    def build(check_rep):
+        return _old(f, mesh, in_specs, out_specs, check_rep=check_rep, auto=auto)
+
+    primary = build(check_vma)
+    jit_cache: dict = {}
+
+    def dispatch(fn, key, args, kwargs):
+        # 0.4.37 rejects *eager* partial-manual dispatch (the traced path is
+        # fine, and the new API permits eager calls) — jit restores that
+        # contract, but ONLY for genuinely eager calls: wrapping when already
+        # under an outer trace nests jits around the manual region, which
+        # XLA CPU's partitioner CHECK-fails on.
+        if auto and jax.core.trace_state_clean():
+            fn = jit_cache.setdefault(key, jax.jit(fn))
+        return fn(*args, **kwargs)
+
+    @functools.wraps(f)
+    def call(*args, **kwargs):
+        try:
+            return dispatch(primary, "primary", args, kwargs)
+        except NotImplementedError as e:
+            # 0.4.37's replication checker predates several primitives' rules
+            # (its own message prescribes check_rep=False as the workaround).
+            # The flag only controls checking/rewrite bookkeeping, never the
+            # computed values, so the retry is value-identical.
+            if "replication rule" not in str(e):
+                raise
+            return dispatch(build(False), "norep", args, kwargs)
+
+    return call
+
+
+def install() -> None:
+    """Idempotently install the 0.4.37 compat aliases. Safe to call many times."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+
+    import jax
+    import jax.sharding
+
+    global SHIMMED
+    if not hasattr(jax, "shard_map"):
+        SHIMMED = True
+        jax.shard_map = _compat_shard_map
+    if not hasattr(jax, "P"):
+        jax.P = jax.sharding.PartitionSpec
+    if not hasattr(jax.sharding, "set_mesh"):
+        jax.sharding.set_mesh = lambda mesh: mesh
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = lambda name: jax.lax.psum(1, name)
+    if not hasattr(jax.lax, "pcast"):
+
+        def _pcast(x, axis_name, *, to):
+            if to != "varying":
+                raise NotImplementedError(
+                    f"jax_compat.pcast: only to='varying' maps onto jax 0.4.37 "
+                    f"(identity under the check_rep rewriter); got to={to!r}"
+                )
+            return x
+
+        jax.lax.pcast = _pcast
+
+    import inspect
+
+    if "vma" not in inspect.signature(jax.ShapeDtypeStruct.__init__).parameters:
+        _Orig = jax.ShapeDtypeStruct
+
+        class ShapeDtypeStruct(_Orig):
+            def __init__(self, shape, dtype, *args, vma=None, **kwargs):
+                super().__init__(shape, dtype, *args, **kwargs)
+
+        ShapeDtypeStruct.__name__ = _Orig.__name__
+        ShapeDtypeStruct.__qualname__ = _Orig.__qualname__
+        jax.ShapeDtypeStruct = ShapeDtypeStruct
+
+    try:
+        import jax.experimental.pallas.tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams") and hasattr(pltpu, "TPUCompilerParams"):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:  # pallas not present in some minimal builds
+        pass

@@ -12,11 +12,16 @@ the attached chip's bf16 peak and define vs_baseline = our_MFU / 0.406 — compa
 compiler+framework efficiency rather than raw chips (an H100 has ~5x the FLOPs of
 the v5e this runs on).
 
-Failure contract: the LAST stdout line is ALWAYS machine-parseable JSON. When
-the TPU/axon backend cannot initialize, the bench retries in a subprocess on
-the CPU platform with a tiny config (marked ``extra.fallback: "cpu"``, exit 0)
-so the bench trajectory never goes dark; an unrecoverable failure prints
-``{"ok": false, "error": ...}`` and exits non-zero.
+Failure contract: the LAST stdout line is ALWAYS machine-parseable JSON — the
+``__main__`` guard catches BaseException and flushes stderr before the final
+print, so no traceback can displace or interleave with it. When the TPU/axon
+backend cannot initialize — or inits but dies at the FIRST dispatch (a trivial
+jitted canary probes this; round 5 lost its data point to exactly that) — the
+bench retries in a subprocess on the CPU platform with a tiny config (marked
+``extra.fallback: "cpu"``, exit 0) so the bench trajectory never goes dark; an
+unrecoverable failure prints ``{"ok": false, "error": ...}`` and exits
+non-zero. ``extra.input_pipeline`` reports seconds/step for the same loop with
+the overlapped input pipeline off vs on.
 """
 
 from __future__ import annotations
@@ -120,6 +125,80 @@ def _measure(cfg, seq_len: int, micro_batch: int, n_steps: int, backend=None):
     return n_steps * micro_batch * seq_len / dt
 
 
+def _prefetch_probe(n_steps: int = 8, item_delay_s: float = 0.004) -> dict:
+    """Input-pipeline overlap measurement: seconds/step for an identical tiny
+    loop with the loader synchronous vs overlapped (host prefetch thread +
+    device double-buffering). ``item_delay_s`` stands in for real host-side
+    tokenize/pack cost; the overlapped path hides it behind device compute."""
+    import jax
+    import jax.numpy as jnp
+
+    from automodel_tpu.data.collate import stack_batches
+    from automodel_tpu.data.llm.mock import MockSFTDataset
+    from automodel_tpu.data.loader import DataLoader
+    from automodel_tpu.data.prefetch import InputPipeline, PrefetchConfig
+    from automodel_tpu.training.step_scheduler import StepScheduler
+
+    def collate(samples):
+        return {"x": np.asarray([s["input_ids"] for s in samples], np.int32)}
+
+    def f_impl(x):
+        # device work of the same magnitude as the host-side cost — overlap is
+        # only visible when there is compute to hide the input latency behind
+        v = x.reshape(-1).astype(jnp.float32)[:512]
+        a = jnp.outer(v, v) / 512.0
+        for _ in range(12):
+            a = jnp.tanh(a @ a)
+        return jnp.sum(a)
+
+    f = jax.jit(f_impl)
+
+    def run(enabled: bool) -> float:
+        ds = MockSFTDataset(vocab_size=512, seq_len=128,
+                            num_samples=8 * (n_steps + 2), seed=0,
+                            item_delay_s=item_delay_s)
+        dl = DataLoader(ds, batch_size=8, collate_fn=collate, seed=0)
+        sched = StepScheduler(grad_acc_steps=1, num_epochs=1,
+                              max_steps=n_steps + 1, dataloader=dl,
+                              handle_sigterm=False)
+        pipe = InputPipeline(scheduler=sched, dataloader=dl,
+                             stack_fn=stack_batches, put_fn=jax.device_put,
+                             config=PrefetchConfig(enabled=enabled))
+        try:
+            # first step covers compile + queue spin-up; timed steps follow
+            first = pipe.get()
+            f(first.stack["x"]).block_until_ready()
+            done = 0
+            t0 = time.perf_counter()
+            while done < n_steps:
+                item = pipe.get()
+                if item is None:
+                    break
+                f(item.stack["x"]).block_until_ready()
+                done += 1
+            dt = time.perf_counter() - t0
+        finally:
+            pipe.close()
+        return dt / max(done, 1)
+
+    sync = run(False)
+    overlapped = run(True)
+    return {
+        "sync_s_per_step": round(sync, 5),
+        "prefetch_s_per_step": round(overlapped, 5),
+        "overlap_speedup": round(sync / overlapped, 3) if overlapped > 0 else None,
+    }
+
+
+def _attach_prefetch_probe(doc: dict) -> dict:
+    """Best-effort: the overlap numbers ride along, they never fail the bench."""
+    try:
+        doc["extra"]["input_pipeline"] = _prefetch_probe()
+    except Exception as exc:  # noqa: BLE001
+        doc["extra"]["input_pipeline"] = {"error": repr(exc)}
+    return doc
+
+
 def _full_bench() -> dict:
     import jax
 
@@ -156,7 +235,7 @@ def _full_bench() -> dict:
     mfu_4k = tps_4k * f_4k / 1e12 / peak
     ref_mfu = 402.0 / 989.0  # reference Llama3-8B LoRA on H100, seq 4096
 
-    return {
+    return _attach_prefetch_probe({
         "ok": True,
         "metric": "llama3.2-1b SFT tokens/sec/chip (bf16, seq 2048)",
         "value": round(tps, 1),
@@ -172,7 +251,7 @@ def _full_bench() -> dict:
             "8b_equiv_tokens_per_sec": round(tps_4k * f_4k / f_8b, 1),
             "device": device,
         },
-    }
+    })
 
 
 def _cpu_fallback_bench() -> dict:
@@ -191,7 +270,7 @@ def _cpu_fallback_bench() -> dict:
     )
     tps = _measure(cfg, seq_len=256, micro_batch=2, n_steps=3,
                    backend=BackendConfig(dtype="float32"))
-    return {
+    return _attach_prefetch_probe({
         "ok": True,
         "metric": "llama3.2-1b SFT tokens/sec/chip (bf16, seq 2048)",
         "value": round(tps, 1),
@@ -202,11 +281,28 @@ def _cpu_fallback_bench() -> dict:
             "fallback_config": "tiny (4L/256d, seq 256, fp32, xla attention)",
             "device": str(jax.devices()[0]),
         },
-    }
+    })
 
 
+# Substrings that identify "the accelerator is broken/absent", not "our code is
+# broken". BENCH_r05 widened this set: the TPU can also die at the first real
+# dispatch with libtpu/PJRT-level errors the original init-focused markers
+# missed, leaving rc=1 and a raw traceback where the JSON line should be.
 _BACKEND_ERRORS = ("initialize backend", "UNAVAILABLE", "No visible",
-                   "failed to connect", "DEADLINE_EXCEEDED")
+                   "failed to connect", "DEADLINE_EXCEEDED", "libtpu",
+                   "PJRT", "Device or resource busy", "already in use",
+                   "TPU platform", "halted", "hardware failure")
+
+
+def _canary_dispatch() -> None:
+    """One trivial jitted op through the attached backend. A backend that
+    initializes but cannot execute (driver/libtpu mismatch, wedged chip) fails
+    HERE — unambiguously a backend fault, whatever the exception says — instead
+    of deep inside the 1B bench where it is indistinguishable from a code bug."""
+    import jax
+    import jax.numpy as jnp
+
+    jax.jit(lambda x: x + 1)(jnp.arange(8)).block_until_ready()
 
 
 def _spawn_cpu_fallback(reason: str) -> int:
@@ -225,9 +321,12 @@ def _spawn_cpu_fallback(reason: str) -> int:
             env=env, capture_output=True, text=True, timeout=1800,
         )
     except subprocess.TimeoutExpired:
-        print(json.dumps({"ok": False, "error": f"cpu fallback timed out; primary: {reason}"}))
+        sys.stderr.flush()
+        print(json.dumps({"ok": False, "error": f"cpu fallback timed out; primary: {reason}"}),
+              flush=True)
         return 1
     sys.stderr.write(result.stderr)
+    sys.stderr.flush()
     for line in reversed(result.stdout.splitlines()):
         try:
             doc = json.loads(line)
@@ -235,12 +334,12 @@ def _spawn_cpu_fallback(reason: str) -> int:
             continue
         if isinstance(doc, dict) and "ok" in doc:
             doc.setdefault("extra", {})["fallback_reason"] = reason
-            print(json.dumps(doc))
+            print(json.dumps(doc), flush=True)
             return 0 if doc.get("ok") else 1
     print(json.dumps({
         "ok": False,
         "error": f"cpu fallback rc={result.returncode} with no JSON line; primary: {reason}",
-    }))
+    }), flush=True)
     return 1
 
 
@@ -251,10 +350,11 @@ def main(argv: list[str] | None = None) -> int:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-            print(json.dumps(_cpu_fallback_bench()))
+            print(json.dumps(_cpu_fallback_bench()), flush=True)
             return 0
         except Exception as exc:  # noqa: BLE001 — the JSON contract is the point
-            print(json.dumps({"ok": False, "error": repr(exc)}))
+            sys.stderr.flush()
+            print(json.dumps({"ok": False, "error": repr(exc)}), flush=True)
             return 1
     try:
         import jax
@@ -266,9 +366,15 @@ def main(argv: list[str] | None = None) -> int:
                   file=sys.stderr)
             doc = _cpu_fallback_bench()
             doc["extra"]["fallback_reason"] = "default backend is cpu"
-            print(json.dumps(doc))
+            print(json.dumps(doc), flush=True)
             return 0
-        print(json.dumps(_full_bench()))
+        try:
+            _canary_dispatch()
+        except Exception as exc:  # noqa: BLE001 — any canary failure is a backend fault
+            reason = f"first-dispatch canary failed: {exc!r}"
+            print(f"bench: {reason}; retrying on CPU", file=sys.stderr)
+            return _spawn_cpu_fallback(reason)
+        print(json.dumps(_full_bench()), flush=True)
         return 0
     except Exception as exc:  # noqa: BLE001
         reason = repr(exc)
@@ -276,9 +382,22 @@ def main(argv: list[str] | None = None) -> int:
             print(f"bench: backend unavailable ({reason}); retrying on CPU",
                   file=sys.stderr)
             return _spawn_cpu_fallback(reason)
-        print(json.dumps({"ok": False, "error": reason}))
+        sys.stderr.flush()
+        print(json.dumps({"ok": False, "error": reason}), flush=True)
         return 1
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    # last line of defense for the JSON contract: whatever escapes main() —
+    # KeyboardInterrupt, SystemExit from a library, MemoryError — still ends
+    # stdout with one parseable line instead of a bare traceback (BENCH_r05).
+    try:
+        rc = main()
+    except BaseException as exc:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        sys.stderr.flush()
+        print(json.dumps({"ok": False, "error": repr(exc)}), flush=True)
+        rc = 1
+    sys.exit(rc)

@@ -1,0 +1,116 @@
+"""Overlapped-input-pipeline perf smoke (CPU backend, ``pytest -m perf``).
+
+The mock dataset's ``item_delay_s`` stands in for real host-side input cost
+(tokenize/augment/pack). Synchronously that cost lands in the ``data_wait``
+goodput bucket every step; with the prefetch pipeline the worker thread pays it
+while the device computes, so the consumed fraction must drop measurably.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.perf]
+
+# 8 items/step x 10ms = ~80ms of host input cost per step, against a
+# sub-10ms device step: synchronously data_wait dominates the loop.
+ITEM_DELAY_S = 0.010
+
+PREFETCH = textwrap.dedent("""\
+dataloader:
+  prefetch:
+    enabled: true
+    host_depth: 3
+    device_depth: 2
+""").replace("\n", "\n    ")
+
+
+def _write_cfg(tmp_path, extra=""):
+    cfg = f"""
+    seed: 7
+    output_dir: {tmp_path}/out
+    model:
+      config:
+        architectures: [LlamaForCausalLM]
+        vocab_size: 128
+        hidden_size: 64
+        intermediate_size: 128
+        num_hidden_layers: 2
+        num_attention_heads: 4
+        num_key_value_heads: 2
+        max_position_embeddings: 128
+    distributed:
+      dp_shard: 4
+      tp: 2
+    backend:
+      dtype: float32
+    dataset:
+      _target_: automodel_tpu.data.llm.mock.MockSFTDataset
+      vocab_size: 128
+      seq_len: 32
+      num_samples: 256
+      seed: 0
+      pattern: arith
+      item_delay_s: {ITEM_DELAY_S}
+    micro_batch_size: 8
+    seq_len: 32
+    step_scheduler:
+      grad_acc_steps: 1
+      max_steps: 10
+      num_epochs: 10
+      handle_sigterm: false
+    optimizer:
+      lr: 1.0e-2
+    checkpoint:
+      enabled: false
+    {extra}
+    """
+    p = tmp_path / "cfg.yaml"
+    p.write_text(textwrap.dedent(cfg))
+    return p
+
+
+def _final_row(tmp_path):
+    rows = [json.loads(line) for line in open(tmp_path / "out" / "training.jsonl")]
+    rows = [r for r in rows if "goodput/data_wait" in r]
+    assert rows, "no goodput rows logged"
+    return rows[-1]
+
+
+def _run(tmp_path, extra=""):
+    from automodel_tpu.config.loader import load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    cfg = load_config(_write_cfg(tmp_path, extra=extra))
+    TrainFinetuneRecipeForNextTokenPrediction(cfg).setup().run_train_validation_loop()
+    return _final_row(tmp_path)
+
+
+def test_prefetch_hides_host_input_cost(tmp_path, cpu_devices):
+    sync_dir = tmp_path / "sync"
+    sync_dir.mkdir()
+    sync = _run(sync_dir)
+
+    pf_dir = tmp_path / "prefetch"
+    pf_dir.mkdir()
+    pf = _run(pf_dir, extra=PREFETCH)
+
+    # both runs completed the same schedule
+    assert pf["step"] == sync["step"] == 10
+
+    sync_wait = sync["goodput/data_wait"]
+    pf_wait = pf["goodput/data_wait"]
+    # the injected delay must actually register synchronously — otherwise the
+    # comparison below is vacuous
+    assert sync_wait > 0.03, f"sync data_wait fraction suspiciously low: {sync_wait}"
+    # overlapping strictly reduces consumed data_wait: the worker pays the
+    # per-item cost during device compute, and fills the queue during compile
+    assert pf_wait < sync_wait, (pf_wait, sync_wait)
+    assert sync_wait - pf_wait > 0.02, (
+        f"prefetch did not measurably reduce data_wait: {sync_wait} -> {pf_wait}"
+    )
+    # the goodput (device_step share) must not regress with the pipeline on
+    assert pf["goodput"] >= sync["goodput"]

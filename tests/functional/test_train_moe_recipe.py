@@ -5,9 +5,19 @@ import json
 import textwrap
 
 import numpy as np
+import pytest
 
 from automodel_tpu.config.loader import load_config
 from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+from automodel_tpu.utils import jax_compat
+
+# see tests/unit/test_pipeline.py: pre-0.5 jax + XLA CPU cannot lower the
+# PartitionId the pp ring's axis_index produces under partial-manual shard_map
+pp_partial_manual_compiles = pytest.mark.skipif(
+    jax_compat.SHIMMED,
+    reason="jax<0.5 XLA CPU cannot lower PartitionId under partial-manual "
+    "shard_map (pp ring axis_index)",
+)
 
 
 def _write_cfg(tmp_path, arch="Qwen3MoeForCausalLM", extra_model="", extra="", max_steps=6):
@@ -86,6 +96,7 @@ class TestMoERecipeE2E:
         assert "moe_load/max_util_mean" in rows[0]
         assert rows[0]["moe_load/max_util_mean"] >= 1.0
 
+    @pp_partial_manual_compiles
     def test_qwen3_moe_pp_loss_decreases(self, tmp_path, cpu_devices):
         """PP x EP x DP composition: 4 moe layers pipelined over pp=2."""
         cfg = load_config(_write_cfg(
@@ -109,6 +120,7 @@ class TestMoERecipeE2E:
         wq = recipe.params["moe_layers"]["wq"]
         assert wq.sharding.shard_shape(wq.shape)[0] == 2
 
+    @pp_partial_manual_compiles
     def test_dsv3_pp_gate_bias_updates(self, tmp_path, cpu_devices):
         """MLA + PP: dense prefix replicated, moe stack pipelined, bias balancing on."""
         cfg = load_config(_write_cfg(
@@ -165,6 +177,7 @@ class TestMoERecipeE2E:
 
 
 class TestPPAuxLoss:
+    @pp_partial_manual_compiles
     def test_pp_aux_loss_balancing(self, tmp_path, cpu_devices):
         """pp + router aux-loss (a round-1 fence): the aux term now rides the
         pipeline's per-stage accumulators and joins the loss; trajectory stays

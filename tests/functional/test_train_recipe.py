@@ -10,6 +10,23 @@ import pytest
 
 from automodel_tpu.config.loader import load_config
 from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+from automodel_tpu.utils import jax_compat
+
+# see tests/unit/test_ring_attention.py: pre-0.5 jax + XLA CPU CHECK-aborts
+# (process-killing) compiling the ring kernel under partial-manual shard_map
+ring_cp_compiles = pytest.mark.skipif(
+    jax_compat.SHIMMED,
+    reason="jax<0.5 XLA CPU hard-aborts compiling partial-manual ring "
+    "attention (interpret-mode pallas under shard_map over cp)",
+)
+
+# see tests/unit/test_pipeline.py: pre-0.5 jax + XLA CPU cannot lower the
+# PartitionId the pp ring's axis_index produces under partial-manual shard_map
+pp_partial_manual_compiles = pytest.mark.skipif(
+    jax_compat.SHIMMED,
+    reason="jax<0.5 XLA CPU cannot lower PartitionId under partial-manual "
+    "shard_map (pp ring axis_index)",
+)
 
 
 def _write_cfg(tmp_path, extra="", dp_shard=4, tp=2, pp=1, n_layers=2, max_steps=6,
@@ -177,6 +194,7 @@ class TestTrainRecipeE2E:
         assert np.isfinite(ref).all() and ref[-1] < ref[0]
         np.testing.assert_allclose(got, ref, rtol=1e-4)
 
+    @pp_partial_manual_compiles
     def test_granite_pp_matches_unpipelined_trajectory(self, tmp_path, cpu_devices):
         """Granite's mup scalars under pp: the pipeline embeds OUTSIDE
         decoder_forward, so embedding_multiplier must ride embed_lookup itself
@@ -233,6 +251,7 @@ class TestTrainRecipeE2E:
         for s in (4, 5, 6):
             assert l2[s] == pytest.approx(l1[s], rel=1e-5), f"step {s} diverged"
 
+    @pp_partial_manual_compiles
     def test_pipeline_parallel_loss_decreases(self, tmp_path, cpu_devices):
         cfg = load_config(_write_cfg(tmp_path, dp_shard=2, tp=2, pp=2, n_layers=4, grad_acc=4))
         recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
@@ -389,6 +408,7 @@ class TestNanGuard:
 
 
 class TestContextParallelRing:
+    @ring_cp_compiles
     def test_cp_ring_recipe_loss_decreases(self, tmp_path, cpu_devices):
         """cp=4 ring attention end-to-end through the recipe: loss must decrease,
         and a cp-sharded forward must match the single-device forward."""

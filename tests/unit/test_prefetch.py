@@ -1,0 +1,265 @@
+"""HostPrefetcher/DevicePrefetcher/InputPipeline: ordering, resume accounting,
+error propagation, and shutdown — the contracts the train loop leans on."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from automodel_tpu.data.collate import stack_batches
+from automodel_tpu.data.loader import DataLoader
+from automodel_tpu.data.prefetch import (
+    DevicePrefetcher,
+    HostPrefetcher,
+    InputPipeline,
+    PrefetchConfig,
+    StepBatch,
+)
+from automodel_tpu.training.step_scheduler import StepScheduler
+
+
+def _dataset(n=32, width=4):
+    return [{"x": np.full((width,), i, np.int32)} for i in range(n)]
+
+
+def _collate(samples):
+    return {"x": np.stack([s["x"] for s in samples])}
+
+
+def _make(n=32, grad_acc=2, batch_size=2, num_epochs=1, max_steps=None, seed=3):
+    dl = DataLoader(_dataset(n), batch_size=batch_size, collate_fn=_collate, seed=seed)
+    sched = StepScheduler(
+        grad_acc_steps=grad_acc, num_epochs=num_epochs, max_steps=max_steps,
+        dataloader=dl, handle_sigterm=False,
+    )
+    return sched, dl
+
+
+def _pipeline(sched, dl, enabled, put_fn=None, **cfg):
+    return InputPipeline(
+        scheduler=sched, dataloader=dl, stack_fn=stack_batches,
+        put_fn=put_fn or (lambda s: s),
+        config=PrefetchConfig(enabled=enabled, **cfg),
+    )
+
+
+def _drain(pipeline):
+    out = []
+    while True:
+        item = pipeline.get()
+        if item is None:
+            return out
+        out.append(item)
+
+
+class TestDeterminism:
+    @pytest.mark.parametrize("host_depth,device_depth", [(1, 1), (2, 2), (4, 3)])
+    def test_same_batches_same_order_as_sync(self, host_depth, device_depth):
+        ref = _drain(_pipeline(*_make(), enabled=False))
+        pf = _pipeline(*_make(), enabled=True,
+                       host_depth=host_depth, device_depth=device_depth)
+        got = _drain(pf)
+        pf.close()
+        assert [b.step for b in got] == [b.step for b in ref]
+        for g, r in zip(got, ref):
+            np.testing.assert_array_equal(g.stack["x"], r.stack["x"])
+
+    def test_multi_epoch_order_preserved(self):
+        ref = _drain(_pipeline(*_make(num_epochs=3), enabled=False))
+        pf = _pipeline(*_make(num_epochs=3), enabled=True, host_depth=3)
+        got = _drain(pf)
+        pf.close()
+        assert len(got) == len(ref) and len(ref) > 0
+        for g, r in zip(got, ref):
+            assert (g.step, g.epoch) == (r.step, r.epoch)
+            np.testing.assert_array_equal(g.stack["x"], r.stack["x"])
+
+    def test_end_of_data_is_terminal(self):
+        pf = _pipeline(*_make(max_steps=3), enabled=True)
+        assert len(_drain(pf)) == 3
+        assert pf.get() is None  # stays None, does not hang or raise
+        pf.close()
+
+
+class TestResumeAccounting:
+    def test_client_states_track_consumed_not_produced(self):
+        """With the worker running ahead, the live scheduler's counter exceeds
+        the consumed step; the snapshot must match what was consumed."""
+        sched, dl = _make(n=64, max_steps=10)
+        pf = _pipeline(sched, dl, enabled=True, host_depth=4, device_depth=2)
+        for want_step in (1, 2, 3):
+            item = pf.get()
+            assert item.step == want_step
+            snap = pf.client_states()
+            assert snap["step_scheduler"]["step"] == want_step
+        # the producer meanwhile advanced past the consumer
+        deadline = time.monotonic() + 5.0
+        while sched.step <= 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sched.step > 3
+        pf.close()
+
+    def test_restoring_snapshot_replays_in_flight_batches(self):
+        ref = _drain(_pipeline(*_make(n=64, max_steps=12), enabled=False))
+
+        sched, dl = _make(n=64, max_steps=12)
+        pf = _pipeline(sched, dl, enabled=True, host_depth=4, device_depth=2)
+        consumed = [pf.get() for _ in range(5)]
+        snap = pf.client_states()
+        pf.close()  # in-flight items beyond step 5 are dropped here
+
+        sched2, dl2 = _make(n=64, max_steps=12)
+        sched2.load_state_dict(snap["step_scheduler"])
+        dl2.load_state_dict(snap["dataloader"])
+        resumed = _drain(_pipeline(sched2, dl2, enabled=True))
+
+        replay = consumed + resumed
+        assert [b.step for b in replay] == [b.step for b in ref]
+        for g, r in zip(replay, ref):
+            np.testing.assert_array_equal(g.stack["x"], r.stack["x"])
+
+    def test_sync_mode_has_no_overrides(self):
+        pipe = _pipeline(*_make(max_steps=4), enabled=False)
+        pipe.get()
+        assert pipe.client_states() == {}
+
+
+class TestErrorPropagation:
+    def test_worker_exception_surfaces_at_same_position(self):
+        class Boom(RuntimeError):
+            pass
+
+        def make_stack_fn():
+            calls = {"n": 0}
+
+            def stack_fn(batches):
+                calls["n"] += 1
+                if calls["n"] == 4:
+                    raise Boom("stack 4")
+                return stack_batches(batches)
+
+            return stack_fn
+
+        def run(enabled):
+            sched, dl = _make(n=64, max_steps=10)
+            pipe = InputPipeline(
+                scheduler=sched, dataloader=dl, stack_fn=make_stack_fn(),
+                put_fn=lambda s: s,
+                config=PrefetchConfig(enabled=enabled, host_depth=3, device_depth=2),
+            )
+            got = []
+            try:
+                while True:
+                    item = pipe.get()
+                    if item is None:
+                        return got, None
+                    got.append(item.step)
+            except Boom as e:
+                return got, e
+            finally:
+                pipe.close()
+
+        ref_steps, ref_err = run(enabled=False)
+        pf_steps, pf_err = run(enabled=True)
+        assert ref_err is not None and pf_err is not None
+        assert pf_steps == ref_steps == [1, 2, 3]
+
+    def test_error_is_terminal_and_rereadable(self):
+        def bad_stack(batches):
+            raise ValueError("always")
+
+        sched, dl = _make(max_steps=4)
+        host = HostPrefetcher(sched, dl, bad_stack, depth=2)
+        with pytest.raises(ValueError):
+            host.get()
+        with pytest.raises(ValueError):  # sentinel re-queued, not lost
+            host.get()
+        host.close()
+
+
+class TestShutdown:
+    def test_close_unblocks_worker_stuck_on_full_queue(self):
+        sched, dl = _make(n=64)
+        host = HostPrefetcher(sched, dl, stack_batches, depth=1)
+        deadline = time.monotonic() + 5.0
+        while host.ready < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert host.ready == 1  # queue full, worker blocked in _put
+        t0 = time.monotonic()
+        host.close()
+        assert time.monotonic() - t0 < 5.0
+        assert not host._thread.is_alive()
+
+    def test_close_is_idempotent(self):
+        pipe = _pipeline(*_make(), enabled=True)
+        pipe.get()
+        pipe.close()
+        pipe.close()
+        assert not pipe.prefetching
+
+    def test_close_without_any_get(self):
+        pipe = _pipeline(*_make(), enabled=True)
+        pipe.close()
+
+    def test_sigterm_stops_worker_without_collectives(self):
+        """The worker iterates with collective_sigterm=False: setting the local
+        flag stops production at the next step boundary, from any thread."""
+        sched, dl = _make(n=256, num_epochs=8)
+        host = HostPrefetcher(sched, dl, stack_batches, depth=2)
+        assert isinstance(host.get(), StepBatch)
+        sched._sigterm.set()
+        # drain: the worker must terminate the stream promptly (no deadlock)
+        deadline = time.monotonic() + 10.0
+        while host.get() is not None:
+            assert time.monotonic() < deadline, "worker ignored local SIGTERM"
+        assert not host._thread.is_alive() or host.get() is None
+        host.close()
+
+
+class TestDevicePrefetcher:
+    def test_put_fn_applied_and_depth_respected(self):
+        sched, dl = _make(n=64, max_steps=8)
+        host = HostPrefetcher(sched, dl, stack_batches, depth=8)
+        tagged = []
+
+        def put_fn(stack):
+            tagged.append(stack["x"].sum())
+            return {"x": stack["x"] + 100}
+
+        dev = DevicePrefetcher(host, put_fn, depth=2)
+        first = dev.get()
+        assert (first.stack["x"] >= 100).all()
+        # transfers are issued ahead of consumption, bounded by depth
+        assert 1 <= len(tagged) <= 3
+        assert dev.ready <= 2
+        host.close()
+
+    def test_ready_depth_reports_buffered_items(self):
+        pipe = _pipeline(*_make(n=64, max_steps=8), enabled=True,
+                         host_depth=3, device_depth=2)
+        assert pipe.ready_depth() >= 0
+        pipe.get()
+        deadline = time.monotonic() + 5.0
+        while pipe.ready_depth() < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pipe.ready_depth() >= 1
+        pipe.close()
+
+
+class TestConfig:
+    def test_from_config_none_disabled(self):
+        cfg = PrefetchConfig.from_config(None)
+        assert not cfg.enabled
+
+    def test_from_config_dict(self):
+        cfg = PrefetchConfig.from_config(
+            {"enabled": True, "host_depth": 5, "device_depth": 3}
+        )
+        assert cfg.enabled and cfg.host_depth == 5 and cfg.device_depth == 3
+
+    def test_invalid_depths_raise(self):
+        with pytest.raises(ValueError):
+            PrefetchConfig(host_depth=0)
+        with pytest.raises(ValueError):
+            PrefetchConfig(device_depth=-1)
