@@ -111,6 +111,16 @@ _END = _End()
 _NOT_READY = object()  # get_nowait(): nothing buffered yet (worker still busy)
 
 
+def _snapshot_states(scheduler: Any, dataloader: Any) -> dict[str, Any]:
+    """state_dict snapshots of the two objects the prefetch worker mutates."""
+    snap: dict[str, Any] = {}
+    if hasattr(scheduler, "state_dict"):
+        snap["step_scheduler"] = dict(scheduler.state_dict())
+    if hasattr(dataloader, "state_dict"):
+        snap["dataloader"] = dict(dataloader.state_dict())
+    return snap
+
+
 class HostPrefetcher:
     """Background-thread producer of :class:`StepBatch` items.
 
@@ -148,12 +158,7 @@ class HostPrefetcher:
         return iter(self.scheduler)
 
     def _snapshot(self) -> dict[str, Any]:
-        snap: dict[str, Any] = {}
-        if hasattr(self.scheduler, "state_dict"):
-            snap["step_scheduler"] = dict(self.scheduler.state_dict())
-        if hasattr(self.dataloader, "state_dict"):
-            snap["dataloader"] = dict(self.dataloader.state_dict())
-        return snap
+        return _snapshot_states(self.scheduler, self.dataloader)
 
     def _put(self, item: Any) -> bool:
         """Bounded put that can always be interrupted by close()."""
@@ -201,9 +206,15 @@ class HostPrefetcher:
                 return self._resolve(self._q.get(timeout=0.1))
             except queue.Empty:
                 if not self._thread.is_alive():
-                    # worker died without a sentinel (close() raced it, or it
-                    # was killed): surface end-of-data rather than hang
-                    return None
+                    # the worker may have enqueued its final item(s) and exited
+                    # in the window between the timeout and the liveness check;
+                    # it is dead now, so one non-blocking drain is race-free
+                    try:
+                        return self._resolve(self._q.get_nowait())
+                    except queue.Empty:
+                        # truly empty: end-of-data (close() raced the worker,
+                        # or it was killed without a sentinel)
+                        return None
 
     def get_nowait(self) -> Any:
         """Non-blocking: a StepBatch, None (end), or _NOT_READY."""
@@ -260,8 +271,9 @@ class DevicePrefetcher:
 
     def _top_up(self) -> None:
         """Issue transfers for every host-ready stack, without blocking. Errors
-        are deferred until the already-transferred items are consumed — the
-        exception surfaces at the same batch position as the sync path."""
+        — from the source worker AND from ``put_fn`` itself — are deferred
+        until the already-transferred items are consumed, so the exception
+        surfaces at the same batch position as the sync path."""
         while len(self._buf) < self.depth and not self._exhausted and self._pending_error is None:
             try:
                 item = self.source.get_nowait()
@@ -273,7 +285,12 @@ class DevicePrefetcher:
             if item is None:
                 self._exhausted = True
                 return
-            self._buf.append(self._transfer(item))
+            try:
+                self._buf.append(self._transfer(item))
+            except BaseException as exc:  # noqa: BLE001 — device_put for batch
+                # k+n must not outrank the buffered good batches k..k+n-1
+                self._pending_error = exc
+                return
 
     def get(self) -> StepBatch | None:
         if not self._buf:
@@ -326,6 +343,10 @@ class InputPipeline:
         self._device: DevicePrefetcher | None = None
         self._sync_it: Iterator[list] | None = None
         if self.config.enabled:
+            # snapshot BEFORE the worker thread starts advancing the live
+            # objects: until the first get(), this is the consumed position a
+            # checkpoint must persist (client_states falls back to it)
+            self._initial_state = _snapshot_states(scheduler, dataloader)
             self._host = HostPrefetcher(
                 scheduler, dataloader, stack_fn, depth=self.config.host_depth
             )
@@ -356,6 +377,26 @@ class InputPipeline:
             client_state={},
         )
 
+    def truncated_by_local_sigterm(self) -> bool:
+        """End-of-stream that does NOT mean end of data.
+
+        The prefetch worker iterates with ``collective_sigterm=False`` — it
+        stops on this host's LOCAL flag (collectives are banned off the main
+        thread), so this host's stream can end while data remains and the pod
+        has not agreed to preempt. Treating that as "done" would desync the
+        per-step collectives: the other hosts keep stepping and their agreed
+        check waits for a partner that has moved on to teardown. True here
+        tells the train loop to rebuild the pipeline from the live scheduler
+        position (exactly the last consumed step — the worker stops right
+        after the item the consumer drained) and keep the step rhythm until
+        the pod-agreed check fires.
+        """
+        if not self.prefetching:
+            return False
+        if getattr(self.scheduler, "done", True):
+            return False  # genuine end of data: every host's stream ends here
+        return bool(getattr(self.scheduler, "sigterm_local", False))
+
     def ready_depth(self) -> int:
         """Stacks buffered ahead of the consumer (host queue + device ring) —
         0 means the next step will block on the host: a true input stall."""
@@ -369,11 +410,16 @@ class InputPipeline:
         """Checkpoint overrides for the live scheduler/dataloader objects.
 
         Prefetching: the snapshot attached to the last consumed item (the live
-        objects are up to host_depth+device_depth steps ahead). Synchronous:
-        empty — the live objects are exactly the consumed state.
+        objects are up to host_depth+device_depth steps ahead); before the
+        first item is consumed, the construction-time snapshot — the worker
+        starts advancing the live objects immediately, so even a save issued
+        before the first ``get()`` must see the pre-worker position.
+        Synchronous: empty — the live objects are exactly the consumed state.
         """
-        if not self.prefetching or self._consumed_state is None:
+        if not self.prefetching:
             return {}
+        if self._consumed_state is None:
+            return dict(self._initial_state)
         return dict(self._consumed_state)
 
     def close(self) -> None:

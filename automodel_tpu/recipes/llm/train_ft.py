@@ -739,7 +739,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         try:
             return self._run_step_loop(obs, pipeline)
         finally:
-            pipeline.close()
+            # a SIGTERM truncation inside the loop may have swapped in a
+            # rebuilt pipeline (the original is already closed); close the
+            # live one — close() is idempotent
+            (self._pipeline or pipeline).close()
             self._pipeline = None
 
     def _run_step_loop(self, obs, pipeline) -> str:
@@ -755,6 +758,25 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 # measures true input stalls
                 fetched = pipeline.get()
             if fetched is None:
+                if pipeline.truncated_by_local_sigterm():
+                    # The worker stops on the LOCAL flag only (no collectives
+                    # off the main thread), so on the signaled host the stream
+                    # can end with data remaining while the pod has NOT agreed
+                    # to preempt. Returning "done" here would desync the pod:
+                    # the other hosts keep stepping and their per-step agreed
+                    # allgather waits forever while this host runs teardown/
+                    # final-save collectives — and the grace-window checkpoint
+                    # is lost. Rebuild from the live scheduler position
+                    # (exactly the last consumed step) and keep the step
+                    # rhythm: the next consumed step's agreed check sees this
+                    # host's flag, so every host takes the preemption save
+                    # together at the same step. At most one rebuild per
+                    # signal — the worker always yields >= 1 item before its
+                    # post-yield flag check, and that step's agreed check
+                    # returns True pod-wide.
+                    pipeline.close()
+                    pipeline = self._pipeline = self._build_input_pipeline()
+                    continue
                 return "done"
             stack = fetched.stack
             if not self._checked_vocab:

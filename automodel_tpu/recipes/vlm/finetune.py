@@ -392,13 +392,21 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
             count += n
         self._log_val_loss(step, total, count)
 
-    def _save(self, step: int):
+    def _save(self, step: int, consolidated: bool | None = None):
+        # ``consolidated`` matches the base signature: the inherited preemption
+        # path passes it to drop the HF export under a short grace window
+        self._last_saved_step = step
         client = {
             "rng": self.rng,
             "step_scheduler": self.step_scheduler,
             "dataloader": self.dataloader,
+            "resilience": self.resilience,
             "frozen_keys": list(self.frozen_keys),
         }
+        if self._pipeline is not None:
+            # prefetch: checkpoint the consumed-position snapshots, not the
+            # worker-advanced live scheduler/dataloader (train_ft._save)
+            client.update(self._pipeline.client_states())
         if self.peft is not None:
             from automodel_tpu.peft.lora import merge_lora_params
 
@@ -407,8 +415,10 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         else:
             full = {**self.frozen_params, **self.train_params}
         self.checkpointer.save(
-            step, self.train_params, self.opt_state, client_states=client, hf_params=full
+            step, self.train_params, self.opt_state, client_states=client,
+            hf_params=full, consolidated=consolidated,
         )
+        self.resilience.record_checkpoint(step)
 
 
 def main(cfg: ConfigNode | None = None, argv=None):

@@ -163,6 +163,78 @@ class TestPrefetchResilience:
         # the replacement pass got its own pipeline; the old worker is gone
         assert recipe._pipeline is None
 
+    def test_empty_buffer_truncation_takes_preemption_path(self, tmp_path,
+                                                           cpu_devices, monkeypatch):
+        """The input-bound deadlock case: the flag lands AFTER the consumer's
+        step-K agreed check but BEFORE the worker's post-yield-K flag check,
+        with nothing buffered ahead — the worker ends the stream and
+        pipeline.get() returns None. The loop must not conclude "done" (on a
+        pod the other hosts are still stepping and their agreed allgather
+        would hang); it rebuilds the pipeline, consumes step K+1, and the
+        agreed check preempts the run there."""
+        from automodel_tpu.data import prefetch as prefetch_mod
+
+        K = 3
+        release = threading.Event()
+        pause_at = {"n": K}
+        real_iter_source = prefetch_mod.HostPrefetcher._iter_source
+
+        def paused_iter_source(self):
+            inner = real_iter_source(self)
+
+            def gen():
+                produced = 0
+                for item in inner:
+                    produced += 1
+                    yield item
+                    # resumed here only when the worker asks for the NEXT
+                    # item, i.e. after it stacked+enqueued this one and
+                    # before the underlying iterator's post-yield flag
+                    # check — exactly the window the race needs
+                    if pause_at["n"] is not None and produced == pause_at["n"]:
+                        pause_at["n"] = None
+                        release.wait(timeout=30.0)
+
+            return gen()
+
+        monkeypatch.setattr(prefetch_mod.HostPrefetcher, "_iter_source",
+                            paused_iter_source)
+
+        cfg = load_config(_write_cfg(tmp_path, extra=PREFETCH, ckpt=True,
+                                     ckpt_every=50, max_steps=50, grad_acc=1))
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+
+        real_agreed = recipe.step_scheduler.sigterm_agreed_at
+        fired = {}
+
+        def agreed(step):
+            out = real_agreed(step)
+            if step == K and not out and "at" not in fired:
+                # consumer just cleared step K; raise the flag and only then
+                # let the paused worker reach its flag check — it truncates
+                # with the buffers empty
+                fired["at"] = step
+                recipe.step_scheduler._sigterm.set()
+                recipe.step_scheduler.sigterm_time = time.monotonic()
+                release.set()
+            return out
+
+        monkeypatch.setattr(recipe.step_scheduler, "sigterm_agreed_at", agreed)
+        recipe.run_train_validation_loop()
+        assert fired.get("at") == K
+
+        rows = _rows(tmp_path)
+        steps = [r["step"] for r in rows if "loss" in r]
+        # one rebuild, one more consumed step, then the agreed preemption save
+        assert max(steps) == K + 1
+        import os
+
+        latest = os.path.realpath(tmp_path / "ckpt" / "latest")
+        assert latest.endswith(f"step_{K + 1}")
+        assert recipe._pipeline is None
+        live = [th for th in threading.enumerate() if th.name == "host-prefetch"]
+        assert not live, "prefetch worker leaked past truncation recovery"
+
     def test_sigterm_preemption_drains_without_deadlock(self, tmp_path, cpu_devices):
         cfg = load_config(_write_cfg(tmp_path, extra=PREFETCH, ckpt=True,
                                      ckpt_every=50, max_steps=50, grad_acc=1))

@@ -1,6 +1,7 @@
 """HostPrefetcher/DevicePrefetcher/InputPipeline: ordering, resume accounting,
 error propagation, and shutdown — the contracts the train loop leans on."""
 
+import queue
 import threading
 import time
 
@@ -124,6 +125,24 @@ class TestResumeAccounting:
         pipe.get()
         assert pipe.client_states() == {}
 
+    def test_client_states_before_first_get_is_construction_snapshot(self):
+        """A save issued before the first consumed batch must not persist the
+        live scheduler/dataloader — the worker starts advancing them the
+        moment the pipeline is built."""
+        sched, dl = _make(n=64, max_steps=10)
+        base_sched = dict(sched.state_dict())
+        base_dl = dict(dl.state_dict())
+        pf = _pipeline(sched, dl, enabled=True, host_depth=4)
+        # wait until the worker has provably advanced the live objects
+        deadline = time.monotonic() + 5.0
+        while sched.step == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sched.step > 0
+        snap = pf.client_states()
+        assert snap["step_scheduler"] == base_sched
+        assert snap["dataloader"] == base_dl
+        pf.close()
+
 
 class TestErrorPropagation:
     def test_worker_exception_surfaces_at_same_position(self):
@@ -147,6 +166,48 @@ class TestErrorPropagation:
                 scheduler=sched, dataloader=dl, stack_fn=make_stack_fn(),
                 put_fn=lambda s: s,
                 config=PrefetchConfig(enabled=enabled, host_depth=3, device_depth=2),
+            )
+            got = []
+            try:
+                while True:
+                    item = pipe.get()
+                    if item is None:
+                        return got, None
+                    got.append(item.step)
+            except Boom as e:
+                return got, e
+            finally:
+                pipe.close()
+
+        ref_steps, ref_err = run(enabled=False)
+        pf_steps, pf_err = run(enabled=True)
+        assert ref_err is not None and pf_err is not None
+        assert pf_steps == ref_steps == [1, 2, 3]
+
+    def test_put_fn_error_surfaces_at_same_position_as_sync(self):
+        """A device_put failure for batch k+n is deferred until the buffered
+        good batches k..k+n-1 are consumed — the sync path's raise position."""
+
+        class Boom(RuntimeError):
+            pass
+
+        def make_put_fn():
+            calls = {"n": 0}
+
+            def put_fn(stack):
+                calls["n"] += 1
+                if calls["n"] == 4:
+                    raise Boom("put 4")
+                return stack
+
+            return put_fn
+
+        def run(enabled):
+            sched, dl = _make(n=64, max_steps=10)
+            pipe = InputPipeline(
+                scheduler=sched, dataloader=dl, stack_fn=stack_batches,
+                put_fn=make_put_fn(),
+                config=PrefetchConfig(enabled=enabled, host_depth=4, device_depth=3),
             )
             got = []
             try:
@@ -202,6 +263,35 @@ class TestShutdown:
         pipe = _pipeline(*_make(), enabled=True)
         pipe.close()
 
+    def test_final_items_survive_timeout_vs_worker_exit_race(self, monkeypatch):
+        """The worker can enqueue its last StepBatch + _END and exit inside the
+        window between get()'s queue timeout and the liveness check; get() must
+        drain the (now race-free) queue before concluding end-of-data."""
+        sched, dl = _make(max_steps=2)
+        host = HostPrefetcher(sched, dl, stack_batches, depth=8)
+        host._thread.join(timeout=5.0)  # everything produced, worker gone
+        assert not host._thread.is_alive()
+        # simulate the unlucky timeout: one blocking get() raises Empty even
+        # though the dead worker's items already sit in the queue
+        real_get = host._q.get
+        spurious = {"left": 1}
+
+        def flaky_get(*args, **kwargs):
+            if kwargs.get("timeout") is not None and spurious["left"]:
+                spurious["left"] -= 1
+                raise queue.Empty
+            return real_get(*args, **kwargs)
+
+        monkeypatch.setattr(host._q, "get", flaky_get)
+        got = []
+        while True:
+            item = host.get()
+            if item is None:
+                break
+            got.append(item.step)
+        assert got == [1, 2]  # nothing dropped
+        host.close()
+
     def test_sigterm_stops_worker_without_collectives(self):
         """The worker iterates with collective_sigterm=False: setting the local
         flag stops production at the next step boundary, from any thread."""
@@ -215,6 +305,55 @@ class TestShutdown:
             assert time.monotonic() < deadline, "worker ignored local SIGTERM"
         assert not host._thread.is_alive() or host.get() is None
         host.close()
+
+
+class TestSigtermTruncation:
+    """End-of-stream caused by the LOCAL flag is not end-of-data: the train
+    loop needs to distinguish the two, or a signaled host exits the per-step
+    collective rhythm while the rest of the pod keeps stepping."""
+
+    def _truncate(self, sched, dl):
+        pf = _pipeline(sched, dl, enabled=True)
+        consumed = [pf.get().step]
+        sched._sigterm.set()
+        while True:
+            item = pf.get()
+            if item is None:
+                return pf, consumed
+            consumed.append(item.step)
+
+    def test_truncated_with_data_remaining(self):
+        sched, dl = _make(n=256, num_epochs=8)
+        pf, _ = self._truncate(sched, dl)
+        assert pf.truncated_by_local_sigterm()
+        pf.close()
+
+    def test_not_truncated_at_genuine_end_of_data(self):
+        sched, dl = _make(max_steps=3)
+        pf = _pipeline(sched, dl, enabled=True)
+        assert len(_drain(pf)) == 3
+        sched._sigterm.set()  # flag up, but the data really did end
+        assert not pf.truncated_by_local_sigterm()
+        pf.close()
+
+    def test_sync_mode_never_truncates(self):
+        sched, dl = _make(max_steps=2)
+        pipe = _pipeline(sched, dl, enabled=False)
+        sched._sigterm.set()
+        assert not pipe.truncated_by_local_sigterm()
+
+    def test_rebuild_after_truncation_resumes_at_next_step(self):
+        """The train loop's recovery path: rebuild from the live scheduler
+        position and keep the step rhythm — the fresh worker always yields at
+        least one item (its flag check is post-yield), continuing exactly
+        where truncation hit."""
+        sched, dl = _make(n=256, num_epochs=8)
+        pf, consumed = self._truncate(sched, dl)
+        pf.close()
+        pf2 = _pipeline(sched, dl, enabled=True)  # flag still set
+        nxt = pf2.get()
+        assert nxt is not None and nxt.step == consumed[-1] + 1
+        pf2.close()
 
 
 class TestDevicePrefetcher:
