@@ -126,12 +126,18 @@ def grouped_experts_apply(
     token_ids = sort_idx // K  # source token of each sorted copy
     group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
 
-    xs = x[token_ids]  # (T*K, D) gathered copies, expert-contiguous
+    # named scopes label the dispatch/combine regions in the optimized HLO, so
+    # hlo_costs can attribute GSPMD-inserted reshard collectives to moe_a2a and
+    # the timeline can carry analytic dispatch/combine spans (same labels the
+    # explicit-EP path uses as ep_dispatch/ep_combine)
+    with jax.named_scope("moe_dispatch"):
+        xs = x[token_ids]  # (T*K, D) gathered copies, expert-contiguous
     out = sorted_ragged_ffn(cfg, params, xs, flat_expert[sort_idx], group_sizes)
 
-    w_sorted = weights.reshape(-1)[sort_idx].astype(jnp.float32)
-    y = jnp.zeros((T, D), jnp.float32)
-    y = y.at[token_ids].add(out.astype(jnp.float32) * w_sorted[:, None])
+    with jax.named_scope("moe_combine"):
+        w_sorted = weights.reshape(-1)[sort_idx].astype(jnp.float32)
+        y = jnp.zeros((T, D), jnp.float32)
+        y = y.at[token_ids].add(out.astype(jnp.float32) * w_sorted[:, None])
     return y.astype(x.dtype)
 
 
@@ -171,8 +177,9 @@ def capacity_experts_apply(
     # (T, K, C) slot one-hot for kept copies (dropped copies -> all-zero row)
     slot = jax.nn.one_hot(jnp.where(keep, pos, -1), capacity, dtype=x.dtype)
     expert_oh = onehot.astype(x.dtype)  # (T, K, E); masked tokens already zeroed
-    disp = jnp.einsum("tke,tkc->tec", expert_oh, slot)
-    xd = jnp.einsum("tec,td->ecd", disp, x)  # (E, C, D)
+    with jax.named_scope("moe_dispatch"):
+        disp = jnp.einsum("tke,tkc->tec", expert_oh, slot)
+        xd = jnp.einsum("tec,td->ecd", disp, x)  # (E, C, D)
 
     from jax.ad_checkpoint import checkpoint_name
 
@@ -186,5 +193,6 @@ def capacity_experts_apply(
     if "down_bias" in params:
         out = out + params["down_bias"][:, None, :]
 
-    combine = jnp.einsum("tke,tkc,tk->tec", expert_oh, slot, weights.astype(x.dtype))
-    return jnp.einsum("tec,ecd->td", combine, out)
+    with jax.named_scope("moe_combine"):
+        combine = jnp.einsum("tke,tkc,tk->tec", expert_oh, slot, weights.astype(x.dtype))
+        return jnp.einsum("tec,ecd->td", combine, out)

@@ -1,13 +1,15 @@
 """Unified training observability: goodput accounting, HBM + compile telemetry,
-a stall watchdog, on-demand profiling, HLO cost/roofline accounting, cross-host
-metric aggregation, a unified trace timeline, and a perf-regression gate
-(docs/observability.md)."""
+a stall watchdog, on-demand profiling, HLO cost/roofline accounting, MoE
+routing/dispatch telemetry, cross-host metric aggregation, a unified trace
+timeline, and a perf-regression gate (docs/observability.md)."""
 
+from automodel_tpu.observability import compile_cache
 from automodel_tpu.observability.aggregate import CrossHostAggregator
 from automodel_tpu.observability.events import TraceTimeline
 from automodel_tpu.observability.goodput import BUCKETS, GoodputTracker
 from automodel_tpu.observability.hlo_costs import (
     collective_bytes,
+    collective_bytes_by_axis,
     compiled_cost_metrics,
     device_specs,
     diagnose_bound,
@@ -15,22 +17,31 @@ from automodel_tpu.observability.hlo_costs import (
 )
 from automodel_tpu.observability.manager import Observability, ObservabilityConfig
 from automodel_tpu.observability.memory import device_memory_stats
+from automodel_tpu.observability.moe_stats import MoEStats, moe_step_metrics, routing_entropy
 from automodel_tpu.observability.profiling import OnDemandProfiler
 from automodel_tpu.observability.watchdog import StallWatchdog
+
+# start counting compilation-cache traffic before the recipe's first compile
+compile_cache.install()
 
 __all__ = [
     "BUCKETS",
     "CrossHostAggregator",
     "GoodputTracker",
+    "MoEStats",
     "Observability",
     "ObservabilityConfig",
     "OnDemandProfiler",
     "StallWatchdog",
     "TraceTimeline",
     "collective_bytes",
+    "collective_bytes_by_axis",
+    "compile_cache",
     "compiled_cost_metrics",
     "device_memory_stats",
     "device_specs",
     "diagnose_bound",
+    "moe_step_metrics",
     "roofline_metrics",
+    "routing_entropy",
 ]

@@ -6,7 +6,11 @@ takes longer, because collectives wait for the slowest participant. The
 aggregator all-gathers each host's sample (step wall time, cumulative data
 wait, HBM high-water) at every log step; proc 0 then logs min/median/max per
 key and flags a ``straggler_host`` when one host's step time exceeds the
-median by a configurable factor.
+median by a configurable factor. MoE runs gather one extra key (the host's
+max expert utilization, :data:`MOE_HOST_KEYS`) and analogously flag a
+``hot_expert_host`` — under expert parallelism a single host holding the
+hot experts stalls every a2a combine the same way a slow input pipeline
+stalls every all-reduce.
 
 Collective discipline: ``aggregate()`` must be called by EVERY process at the
 same point (the train loop's log step, which is deterministic across hosts).
@@ -21,10 +25,13 @@ from typing import Any, Callable, Sequence
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["CrossHostAggregator", "HOST_KEYS"]
+__all__ = ["CrossHostAggregator", "HOST_KEYS", "MOE_HOST_KEYS"]
 
 # the per-host sample, in wire order
 HOST_KEYS = ("step_time_s", "data_wait_s", "hbm_gib_peak")
+# MoE runs append the host's max expert utilization (>1 = hot expert); a
+# separate tuple so dense runs keep the exact legacy wire format
+MOE_HOST_KEYS = HOST_KEYS + ("moe_max_util",)
 
 
 def _median(vals: Sequence[float]) -> float:
@@ -89,18 +96,38 @@ class CrossHostAggregator:
             out[f"host/{key}_median"] = round(_median(vals), 4)
             out[f"host/{key}_max"] = round(max(vals), 4)
         self._flag_straggler(rows, out)
+        self._flag_hot_expert(rows, out)
         return out
 
+    def _worst_vs_median(self, rows: list, key: str) -> tuple[float, int] | None:
+        """(worst/median ratio, worst host) for ``key``, or None if degenerate."""
+        if key not in self.keys:
+            return None
+        idx = self.keys.index(key)
+        vals = [(r[idx], host) for host, r in enumerate(rows)
+                if not math.isnan(r[idx])]
+        if len(vals) < 2:
+            return None
+        med = _median([v for v, _ in vals])
+        worst, host = max(vals)
+        if med <= 0:
+            return None
+        return worst / med, host
+
     def _flag_straggler(self, rows: list, out: dict[str, Any]) -> None:
-        idx = self.keys.index("step_time_s") if "step_time_s" in self.keys else None
-        if idx is None:
-            return
-        times = [(r[idx], host) for host, r in enumerate(rows)
-                 if not math.isnan(r[idx])]
-        if len(times) < 2:
-            return
-        med = _median([t for t, _ in times])
-        worst, host = max(times)
-        if med > 0 and worst / med >= self.straggler_factor:
-            out["straggler_host"] = host
-            out["straggler_ratio"] = round(worst / med, 3)
+        hit = self._worst_vs_median(rows, "step_time_s")
+        if hit and hit[0] >= self.straggler_factor:
+            out["straggler_host"] = hit[1]
+            out["straggler_ratio"] = round(hit[0], 3)
+
+    def _flag_hot_expert(self, rows: list, out: dict[str, Any]) -> None:
+        """Flag the host whose local experts run hottest vs the pod median.
+
+        Same worst/median≥factor shape as the straggler flag, applied to
+        ``moe_max_util`` when the MoE key set is in use: the flagged host is
+        where a capacity bump or rebalance would land.
+        """
+        hit = self._worst_vs_median(rows, "moe_max_util")
+        if hit and hit[0] >= self.straggler_factor:
+            out["hot_expert_host"] = hit[1]
+            out["hot_expert_ratio"] = round(hit[0], 3)

@@ -1,0 +1,98 @@
+"""Persistent XLA compile-cache hit/miss accounting for the run_header.
+
+A 1024-chip restart that recompiles every step shape burns minutes of fleet
+time the persistent compilation cache exists to save — but jax only reports
+cache traffic through its internal monitoring events, so nothing in the run
+artifacts says whether the cache is working. This module registers one
+process-wide listener for ``/jax/compilation_cache/cache_hits`` /
+``cache_misses`` (installed at observability package import, before the
+recipe's model-init compiles) and exposes the tallies plus the
+persistent-cache configuration for the MetricLogger ``run_header`` row.
+
+The counts keep accumulating after the header is written; the run-total view
+lands in the ``compile_summary`` event row at teardown
+(:meth:`automodel_tpu.observability.manager.Observability.compile_summary`).
+
+Everything degrades to zeros/False when the jax-internal monitoring API moves
+— reporting must never take the run down.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["install", "counts", "reset", "snapshot"]
+
+_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "hits",
+    "/jax/compilation_cache/cache_misses": "misses",
+    # pre-0.4.30 spelling of a miss
+    "/jax/compilation_cache/cache_misses_because_no_entry": "misses",
+}
+_counts = {"hits": 0, "misses": 0}
+_lock = threading.Lock()
+_installed = False
+
+
+def _listener(event: str, **_kwargs) -> None:
+    key = _EVENTS.get(event)
+    if key is not None:
+        with _lock:
+            _counts[key] += 1
+
+
+def install() -> bool:
+    """Register the monitoring listener once per process; True if active."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_listener)
+        _installed = True
+    except Exception:
+        logger.debug("jax monitoring API unavailable; compile-cache counts "
+                     "stay at zero", exc_info=True)
+    return _installed
+
+
+def counts() -> dict[str, int]:
+    """Hit/miss tallies since install (or zeros if never installed)."""
+    with _lock:
+        return dict(_counts)
+
+
+def reset() -> None:
+    """Zero the tallies (tests only — the listener stays registered)."""
+    with _lock:
+        for k in _counts:
+            _counts[k] = 0
+
+
+def snapshot() -> dict[str, object]:
+    """run_header-ready view: cache config + traffic seen so far.
+
+    Written at setup time, so the counts cover model-init / eval-shape
+    compiles only; the run totals come from ``compile_summary`` at teardown.
+    """
+    out: dict[str, object] = {"listener": _installed, **counts()}
+    try:
+        from jax._src import compilation_cache
+
+        out["persistent_enabled"] = bool(
+            compilation_cache.is_persistent_cache_enabled())
+    except Exception:
+        out["persistent_enabled"] = False
+    try:
+        import jax
+
+        cache_dir = jax.config.jax_compilation_cache_dir
+        if cache_dir:
+            out["dir"] = str(cache_dir)
+    except Exception:
+        logger.debug("compilation cache dir unreadable", exc_info=True)
+    return out

@@ -30,8 +30,11 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "COLLECTIVE_OPS",
     "DTYPE_BYTES",
+    "MOE_DISPATCH_SCOPES",
     "DeviceSpec",
     "collective_bytes",
+    "collective_bytes_by_axis",
+    "scope_output_bytes",
     "device_specs",
     "device_peak_tflops",
     "compiled_cost_metrics",
@@ -51,6 +54,37 @@ _OP_RE = re.compile(
     r"=\s+((?:\([^)]*\))|(?:\S+))\s+(" + "|".join(COLLECTIVE_OPS) + r")(-start)?\("
 )
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+# any instruction's result shape(s): `%name = f32[8,16]{1,0} op(...)` or a tuple
+_RESULT_RE = re.compile(r"=\s+((?:\([^)]*\))|(?:\S+))\s+[\w\-]+\(")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+# `replica_groups={{0,1},{2,3}}` — explicit groups; group size = first group len
+_GROUPS_RE = re.compile(r"replica_groups=\{\{([\d,\s]*)\}")
+# `replica_groups=[4,2]<=[8]` — iota form: 4 groups of size 2
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+
+# named scopes that mark MoE dispatch/combine comms in the optimized HLO:
+# the explicit-EP a2a path (moe/dispatch.py) and the GSPMD dense path
+# (moe/experts.py) both label their reshard/exchange regions with these
+MOE_DISPATCH_SCOPES = ("ep_dispatch", "ep_combine", "moe_dispatch", "moe_combine")
+
+
+def _shapes_total_bytes(shapes_token: str, is_start: str | None = None) -> int:
+    found = _SHAPE_RE.findall(shapes_token)
+    if is_start and len(found) > 1:
+        # async form: the -start tuple is (operand alias, ..., result) —
+        # count only the result or the operand would double the volume
+        found = found[-1:]
+    total = 0
+    for dt, dims in found:
+        nbytes = DTYPE_BYTES.get(dt)
+        if nbytes is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * nbytes
+    return total
 
 
 def collective_bytes(hlo: str) -> dict:
@@ -61,22 +95,108 @@ def collective_bytes(hlo: str) -> dict:
         if not m:
             continue
         shapes, op, is_start = m.group(1), m.group(2), m.group(3)
-        found = _SHAPE_RE.findall(shapes)
-        if is_start and len(found) > 1:
-            # async form: the -start tuple is (operand alias, ..., result) —
-            # count only the result or the operand would double the volume
-            found = found[-1:]
-        total = 0
-        for dt, dims in found:
-            nbytes = DTYPE_BYTES.get(dt)
-            if nbytes is None:
-                continue
-            n = 1
-            for d in dims.split(","):
-                if d:
-                    n *= int(d)
-            total += n * nbytes
+        total = _shapes_total_bytes(shapes, is_start)
         out[op] = out.get(op, 0) + total
+    return out
+
+
+def _group_size(line: str) -> int | None:
+    """Participant count of a collective's replica groups, if parseable."""
+    m = _GROUPS_RE.search(line)
+    if m:
+        ids = [x for x in m.group(1).split(",") if x.strip()]
+        return len(ids) or None
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        return int(m.group(2)) or None
+    return None
+
+
+def collective_bytes_by_axis(hlo: str, mesh_axes: dict | None = None) -> dict:
+    """Attribute collective output bytes to mesh axes (ep vs dp vs tp vs pp).
+
+    Two signals, in priority order:
+
+    1. **Scope**: a collective whose ``op_name`` metadata lies inside one of
+       the :data:`MOE_DISPATCH_SCOPES` is MoE dispatch/combine traffic — it
+       counts toward the ``ep`` axis AND the ``moe_a2a`` bucket (the category
+       the roofline ``bound`` diagnosis reports when expert exchange dominates;
+       ``moe_a2a`` is a subset view, not an extra axis).
+    2. **Group size**: a collective over groups of size g belongs to the
+       unique mesh axis of size g (> 1). Equal-sized axes are genuinely
+       ambiguous from the HLO alone and land in ``unattributed`` — honest
+       beats guessed for a diagnosis people act on.
+
+    Returns ``{axis: bytes, ..., "moe_a2a": bytes, "unattributed": bytes}``
+    with zero-byte axes omitted (``moe_a2a`` is always present when any MoE
+    dispatch scope appears in the module, even at 0 bytes, so its absence
+    means "not an MoE program" rather than "no traffic").
+    """
+    axes = {str(k): int(v) for k, v in (mesh_axes or {}).items()}
+    out: dict[str, int] = {}
+    saw_moe_scope = any(scope in hlo for scope in MOE_DISPATCH_SCOPES)
+    for line in hlo.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        shapes, op, is_start = m.group(1), m.group(2), m.group(3)
+        nbytes = _shapes_total_bytes(shapes, is_start)
+        if not nbytes:
+            continue
+        m_name = _OPNAME_RE.search(line)
+        op_name = m_name.group(1) if m_name else ""
+        in_moe_scope = any(scope in op_name for scope in MOE_DISPATCH_SCOPES)
+        if in_moe_scope:
+            out["moe_a2a"] = out.get("moe_a2a", 0) + nbytes
+            if "ep" in axes:
+                out["ep"] = out.get("ep", 0) + nbytes
+                continue
+        g = _group_size(line)
+        candidates = [ax for ax, size in axes.items() if size == g and size > 1]
+        if len(candidates) == 1:
+            ax = candidates[0]
+            out[ax] = out.get(ax, 0) + nbytes
+            if ax == "ep" and op == "all-to-all" and not in_moe_scope:
+                out["moe_a2a"] = out.get("moe_a2a", 0) + nbytes
+        elif not in_moe_scope:
+            out["unattributed"] = out.get("unattributed", 0) + nbytes
+    if saw_moe_scope:
+        out.setdefault("moe_a2a", 0)
+    return out
+
+
+def scope_output_bytes(hlo: str, scopes: tuple[str, ...]) -> dict:
+    """Per-scope analytic volume: sum of instruction output bytes (and the
+    collective subset) for instructions whose ``op_name`` metadata falls under
+    one of ``scopes``. This is what lets the timeline carry analytic
+    dispatch/combine/expert-compute spans without a device profiler — the
+    optimized HLO already says how many bytes each labeled region produces.
+
+    Returns ``{scope: {"bytes": int, "comm_bytes": int}}`` for scopes present.
+    """
+    out: dict[str, dict[str, int]] = {}
+    for line in hlo.splitlines():
+        m_name = _OPNAME_RE.search(line)
+        if not m_name:
+            continue
+        op_name = m_name.group(1)
+        # innermost wins: scopes nest (".../moe_experts/moe_combine/mul" is
+        # combine work, not expert compute), so take the rightmost match
+        matches = [(op_name.rfind(s), s) for s in scopes if s in op_name]
+        if not matches:
+            continue
+        scope = max(matches)[1]
+        m = _RESULT_RE.search(line)
+        if not m:
+            continue
+        nbytes = _shapes_total_bytes(m.group(1))
+        if not nbytes:
+            continue
+        bucket = out.setdefault(scope, {"bytes": 0, "comm_bytes": 0})
+        bucket["bytes"] += nbytes
+        cm = _OP_RE.search(line)
+        if cm:
+            bucket["comm_bytes"] += _shapes_total_bytes(cm.group(1), cm.group(3))
     return out
 
 
@@ -126,14 +246,20 @@ def device_peak_tflops(device: str) -> float:
 
 
 # ------------------------------------------------------------------ extraction
-def compiled_cost_metrics(compiled: Any) -> dict[str, int]:
+def compiled_cost_metrics(compiled: Any, mesh_axes: dict | None = None,
+                          hlo_text: str | None = None) -> dict[str, int]:
     """Analytic costs of one compiled step, as flat log-row-ready ints.
 
     Returns ``hlo_flops`` / ``hlo_bytes_accessed`` (XLA's own cost analysis of
     the optimized module) plus ``comm_bytes_<kind>`` per collective kind and
-    ``comm_bytes_total`` (regex accounting over the optimized HLO text). Any
-    unavailable source contributes nothing rather than raising — diagnostics
-    must never take the run down.
+    ``comm_bytes_total`` (regex accounting over the optimized HLO text). With
+    ``mesh_axes`` (``{axis: size}``), collective bytes are also attributed per
+    mesh axis as ``comm_bytes_axis_<axis>`` with the MoE dispatch/combine
+    subset surfaced as ``comm_bytes_moe_a2a`` (see
+    :func:`collective_bytes_by_axis`). Any unavailable source contributes
+    nothing rather than raising — diagnostics must never take the run down.
+    ``hlo_text``: pass the module text if the caller already extracted it
+    (``as_text()`` is not free on big programs).
     """
     out: dict[str, int] = {}
     try:
@@ -149,10 +275,17 @@ def compiled_cost_metrics(compiled: Any) -> dict[str, int]:
     except Exception:
         logger.debug("cost_analysis unavailable on this backend", exc_info=True)
     try:
-        comm = collective_bytes(compiled.as_text())
+        hlo = hlo_text if hlo_text is not None else compiled.as_text()
+        comm = collective_bytes(hlo)
         for op, nbytes in sorted(comm.items()):
             out[f"comm_bytes_{op.replace('-', '_')}"] = int(nbytes)
         out["comm_bytes_total"] = int(sum(comm.values()))
+        by_axis = collective_bytes_by_axis(hlo, mesh_axes)
+        moe_a2a = by_axis.pop("moe_a2a", None)
+        for ax, nbytes in sorted(by_axis.items()):
+            out[f"comm_bytes_axis_{ax}"] = int(nbytes)
+        if moe_a2a is not None:
+            out["comm_bytes_moe_a2a"] = int(moe_a2a)
     except Exception:
         logger.debug("optimized HLO text unavailable", exc_info=True)
     return out
@@ -169,12 +302,13 @@ def roofline_metrics(costs: dict[str, int], spec: DeviceSpec) -> dict[str, float
     """
     t_compute = costs.get("hlo_flops", 0) / (spec.peak_bf16_tflops * 1e12)
     t_memory = costs.get("hlo_bytes_accessed", 0) / (spec.hbm_gbps * 1e9)
-    t_comm = costs.get("comm_bytes_total", 0) / (spec.ici_gbps * 1e9)
+    comm_total = costs.get("comm_bytes_total", 0)
+    t_comm = comm_total / (spec.ici_gbps * 1e9)
     components = {"compute": t_compute, "memory": t_memory, "comms": t_comm}
     if max(components.values()) <= 0:
         return {}  # no analytic costs -> no roofline (an all-zero one misleads)
     bound = max(components, key=components.get)
-    return {
+    out = {
         "roofline_t_compute_s": t_compute,
         "roofline_t_memory_s": t_memory,
         "roofline_t_comm_s": t_comm,
@@ -182,6 +316,15 @@ def roofline_metrics(costs: dict[str, int], spec: DeviceSpec) -> dict[str, float
         "roofline_bound": bound,
         "roofline_spec": spec.name,
     }
+    moe_a2a = costs.get("comm_bytes_moe_a2a")
+    if moe_a2a is not None:
+        t_moe = moe_a2a / (spec.ici_gbps * 1e9)
+        out["roofline_t_moe_a2a_s"] = t_moe
+        # comms-bound and mostly dispatch/combine traffic -> the MoE a2a is the
+        # wall, not generic gradient/activation collectives.
+        if bound == "comms" and comm_total > 0 and moe_a2a > 0.5 * comm_total:
+            out["roofline_bound"] = "moe_a2a"
+    return out
 
 
 def diagnose_bound(step_time_s: float | None, roofline: dict[str, Any],

@@ -36,7 +36,8 @@ import signal as _signal
 import time
 from typing import Any, Callable
 
-from automodel_tpu.observability.aggregate import CrossHostAggregator
+from automodel_tpu.observability import compile_cache
+from automodel_tpu.observability.aggregate import MOE_HOST_KEYS, CrossHostAggregator
 from automodel_tpu.observability.events import TraceTimeline
 from automodel_tpu.observability.goodput import GoodputTracker
 from automodel_tpu.observability.hlo_costs import (
@@ -44,6 +45,7 @@ from automodel_tpu.observability.hlo_costs import (
     device_specs,
     diagnose_bound,
     roofline_metrics,
+    scope_output_bytes,
 )
 from automodel_tpu.observability.memory import device_memory_stats
 from automodel_tpu.observability.profiling import OnDemandProfiler
@@ -56,6 +58,15 @@ __all__ = ["ObservabilityConfig", "Observability"]
 # phases long enough to deserve their own timeline span; steps and compiles
 # are spanned by their dedicated hooks
 _TIMELINE_BUCKETS = ("eval", "checkpoint", "rollback")
+
+# timeline span name -> the HLO scope labels that feed it; the explicit-EP a2a
+# path (moe/dispatch.py) and the GSPMD dense path (moe/experts.py) label the
+# same three phases under different scope names
+_MOE_SPAN_SCOPES = {
+    "moe_dispatch": ("ep_dispatch", "moe_dispatch"),
+    "moe_experts": ("ep_experts", "moe_experts"),
+    "moe_combine": ("ep_combine", "moe_combine"),
+}
 
 
 @dataclasses.dataclass
@@ -150,10 +161,12 @@ class _GuardedCompiled:
     jit handles that with a silent recompile; the Compiled object raises.
     """
 
-    def __init__(self, compiled: Any, fallback: Callable, args: Any):
+    def __init__(self, compiled: Any, fallback: Callable, args: Any,
+                 on_demote: Callable[[], None] | None = None):
         self._compiled: Any | None = compiled
         self._fallback = fallback
         self._avals = _tree_avals(args)
+        self._on_demote = on_demote
 
     def __call__(self, *args: Any) -> Any:
         if self._compiled is not None and _tree_avals(args) == self._avals:
@@ -166,6 +179,8 @@ class _GuardedCompiled:
                     "AOT-compiled step rejected re-sharded inputs; "
                     "falling back to jit for the rest of the run")
                 self._compiled = None
+                if self._on_demote is not None:
+                    self._on_demote()
         return self._fallback(*args)
 
 
@@ -182,6 +197,11 @@ class Observability:
         self.out_dir = str(out_dir)
         self.compile_time_s: float | None = None
         self.roofline: dict[str, Any] | None = None
+        # set by the recipe before compile_step ({axis: size}) so collective
+        # bytes get attributed to ep/dp/tp/pp in the cost row
+        self.mesh_axes: dict[str, int] | None = None
+        # AOT-vs-jit accounting across every compile_step of the run
+        self.compile_counts = {"aot": 0, "jit_fallback": 0, "aot_demoted": 0}
         self._metric_sink = metric_sink
         self._step_t0: float | None = None
         on = config.enabled
@@ -244,6 +264,19 @@ class Observability:
         if self.timeline is not None:
             self.timeline.close()
 
+    def compile_summary(self) -> dict[str, Any]:
+        """Run-total AOT/jit-fallback/demotion counts + compile-cache hits.
+
+        The run_header is written before the first compile, so the per-run
+        totals land here instead — the recipe logs this as a
+        ``compile_summary`` event row at teardown.
+        """
+        out = {f"compile_{k}": v for k, v in self.compile_counts.items()}
+        cache = compile_cache.counts()
+        out["compile_cache_hits"] = cache["hits"]
+        out["compile_cache_misses"] = cache["misses"]
+        return out
+
     # ------------------------------------------------------------------ hooks
     def track(self, bucket: str):
         """Goodput context manager; long phases also land on the timeline."""
@@ -267,13 +300,19 @@ class Observability:
             return step_fn
         if not hasattr(step_fn, "lower"):  # plain-function executor (e.g. pp wrapper)
             logger.info("step executor is not a jit callable; no HLO cost row")
+            self.compile_counts["jit_fallback"] += 1
             return step_fn
         try:
             import jax
 
             t0 = time.perf_counter()
             compiled = step_fn.lower(*args).compile()
-            costs = compiled_cost_metrics(compiled)
+            try:
+                hlo = compiled.as_text()  # fetched once; as_text() is not free
+            except Exception:
+                hlo = None
+            costs = compiled_cost_metrics(compiled, mesh_axes=self.mesh_axes,
+                                          hlo_text=hlo)
             spec = device_specs(jax.devices()[0].device_kind)
             roof = roofline_metrics(costs, spec)
             self.roofline = roof or None
@@ -282,9 +321,13 @@ class Observability:
                 for key in ("roofline_t_compute_s", "roofline_t_memory_s",
                             "roofline_t_comm_s", "roofline_step_time_s"):
                     row[key] = round(roof[key], 6)
+                if "roofline_t_moe_a2a_s" in roof:
+                    row["roofline_t_moe_a2a_s"] = round(roof["roofline_t_moe_a2a_s"], 6)
                 row["roofline_bound"] = roof["roofline_bound"]
                 row["roofline_spec"] = roof["roofline_spec"]
             row["cost_extract_s"] = round(time.perf_counter() - t0, 3)
+            self.compile_counts["aot"] += 1
+            row["compile_aot_total"] = self.compile_counts["aot"]
             if self._metric_sink is not None:
                 self._metric_sink(step, **row)
             if self.timeline is not None:
@@ -293,11 +336,42 @@ class Observability:
                     hlo_flops=costs.get("hlo_flops"),
                     comm_bytes_total=costs.get("comm_bytes_total"),
                 )
-            return _GuardedCompiled(compiled, step_fn, args)
+            self._emit_moe_spans(hlo, spec, step)
+            def _demoted():
+                self.compile_counts["aot_demoted"] += 1
+            return _GuardedCompiled(compiled, step_fn, args, on_demote=_demoted)
         except Exception:
             logger.warning("HLO cost extraction failed; step runs through jit",
                            exc_info=True)
+            self.compile_counts["jit_fallback"] += 1
             return step_fn
+
+    def _emit_moe_spans(self, hlo: str | None, spec: Any, step: int) -> None:
+        """Analytic dispatch/experts/combine spans from the compiled module.
+
+        No device profiler needed: the optimized HLO says how many bytes each
+        MoE scope produces, and the chip spec turns that into a floor duration
+        (comm bytes over ICI when the scope communicates, output bytes over
+        HBM otherwise). Spans land sequentially on tid=1, cat="moe" — a
+        per-compile shape of the MoE step for Perfetto, not a measurement.
+        """
+        if self.timeline is None or not hlo:
+            return
+        all_scopes = tuple(s for ss in _MOE_SPAN_SCOPES.values() for s in ss)
+        vols = scope_output_bytes(hlo, all_scopes)
+        if not vols:
+            return
+        t = self.timeline.now()
+        for name, scopes in _MOE_SPAN_SCOPES.items():
+            nbytes = sum(vols[s]["bytes"] for s in scopes if s in vols)
+            comm = sum(vols[s]["comm_bytes"] for s in scopes if s in vols)
+            if not nbytes:
+                continue
+            dur = (comm / (spec.ici_gbps * 1e9) if comm
+                   else nbytes / (spec.hbm_gbps * 1e9))
+            self.timeline.complete(name, "moe", t, dur, tid=1, step=step,
+                                   bytes=nbytes, comm_bytes=comm)
+            t += dur
 
     def record_compile(self, seconds: float) -> None:
         """Cumulative: a delayed-QAT switch compiles a second step mid-run."""
@@ -367,27 +441,47 @@ class Observability:
         if bound is not None:
             out["bound"] = bound
         if step_time_s:
+            # 6 digits: a test-sized model on a fast host can legitimately
+            # achieve < 1e-4 of the analytic roofline — don't round it to 0
             out["roofline_frac"] = round(
-                self.roofline["roofline_step_time_s"] / step_time_s, 4
+                self.roofline["roofline_step_time_s"] / step_time_s, 6
             )
         return out
 
-    def host_metrics(self, step_time_s: float | None) -> dict[str, Any]:
+    def host_metrics(self, step_time_s: float | None,
+                     moe_max_util: float | None = None) -> dict[str, Any]:
         """Cross-host min/median/max + straggler flag for one log step.
 
         Collective on multi-host: every process must reach this call (the log
         step is deterministic across hosts); only proc 0 uses the result.
+        MoE recipes pass their host-local max expert utilization — the wire
+        format then grows the ``moe_max_util`` key (on every host, since the
+        recipe config is identical pod-wide) and a ``hot_expert_host`` flag
+        joins the straggler one.
         """
         if self.aggregator is None or not self.aggregator.active:
             return {}
+        if moe_max_util is not None and "moe_max_util" not in self.aggregator.keys:
+            # first MoE sample: widen the wire format once, identically on
+            # every host (the flag derives from the shared model config)
+            self.aggregator = CrossHostAggregator(
+                self.aggregator.straggler_factor, keys=MOE_HOST_KEYS,
+                allgather_fn=self.aggregator._allgather,
+                process_count=self.aggregator.process_count)
         sample: dict[str, Any] = {"step_time_s": step_time_s}
         if self.goodput is not None:
             sample["data_wait_s"] = round(self.goodput.totals().get("data_wait", 0.0), 4)
         if self._memory:
             sample["hbm_gib_peak"] = device_memory_stats().get("hbm_gib_peak")
+        if moe_max_util is not None:
+            sample["moe_max_util"] = float(moe_max_util)
         out = self.aggregator.aggregate(sample)
         if self.timeline is not None and "straggler_host" in out:
             self.timeline.instant("straggler", cat="event",
                                   host=out["straggler_host"],
                                   ratio=out.get("straggler_ratio"))
+        if self.timeline is not None and "hot_expert_host" in out:
+            self.timeline.instant("hot_expert", cat="event",
+                                  host=out["hot_expert_host"],
+                                  ratio=out.get("hot_expert_ratio"))
         return out

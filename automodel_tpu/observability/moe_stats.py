@@ -1,0 +1,202 @@
+"""MoE routing & expert-parallel telemetry: the per-step ``moe/*`` rows.
+
+The MoE path runs at roughly half the MFU of dense SFT (ROADMAP item 1) and
+the first step to closing that gap is seeing it per step: is routing
+collapsing (entropy), are experts starving (utilization spread, zero-expert
+fraction), is the a2a dispatcher dropping tokens (capacity overflow), and is
+the balancing pressure working (aux-loss trend)? This module turns the
+train-step's accumulated ``expert_load`` / ``dropped_token_frac`` /
+``moe_aux_loss`` metrics into one flat dict of ``moe/*`` keys that rides the
+MetricLogger row, reusing :func:`automodel_tpu.moe.metrics.compute_load_balance_metrics`
+for the utilization math (one source of truth with the ``moe_load/*`` family).
+
+Everything here is host-side numpy post-processing — no device sync beyond
+the scalar pulls the log step already does — and every value is strict-JSON
+safe through ``MetricsSample`` (non-finite floats become null + a
+``*_nonfinite`` flag).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import numpy as np
+
+from automodel_tpu.moe.metrics import compute_load_balance_metrics
+
+__all__ = [
+    "routing_entropy",
+    "moe_step_metrics",
+    "local_expert_coords",
+    "local_expert_max_util",
+    "MoEStats",
+]
+
+
+def routing_entropy(expert_loads: np.ndarray) -> tuple[float, float]:
+    """(mean, min) normalized routing entropy over MoE layers.
+
+    Per layer: Shannon entropy of the expert-load distribution divided by
+    ``ln(E)`` — 1.0 is perfectly uniform routing, 0.0 is total collapse onto
+    one expert. The min names the worst layer (collapse is per-layer; a mean
+    alone hides one dead layer among healthy ones). Layers with zero total
+    load (all-padding microbatch) count as uniform: there was no routing
+    decision to be entropic about.
+    """
+    loads = np.asarray(expert_loads, np.float64)
+    if loads.ndim == 1:
+        loads = loads[None]
+    L, E = loads.shape
+    if E <= 1:
+        return 1.0, 1.0
+    totals = loads.sum(axis=1, keepdims=True)  # (L, 1)
+    p = np.divide(loads, totals, out=np.full_like(loads, 1.0 / E), where=totals > 0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        plogp = np.where(p > 0, p * np.log(p), 0.0)
+    ent = -plogp.sum(axis=1) / math.log(E)  # (L,) in [0, 1]
+    return float(ent.mean()), float(ent.min())
+
+
+def moe_step_metrics(
+    expert_load: np.ndarray,
+    *,
+    dropped_token_frac: float | None = None,
+    aux_loss: float | None = None,
+    aux_loss_ema: float | None = None,
+    step_time_s: float | None = None,
+    device_count: int = 1,
+    mode: str = "brief",
+) -> dict[str, Any]:
+    """One log step's ``moe/*`` row fields from the accumulated step metrics.
+
+    ``expert_load`` is the (L, E) routed-copy count summed over the step's
+    microbatches (and globally over data axes under pjit).
+    ``moe/tokens_per_sec_per_chip`` is expert-GEMM throughput: routed token
+    copies processed per second per chip — the number a grouped-GEMM or
+    dispatch optimization must move (dense ``tps_per_chip`` counts each token
+    once however many experts it visits).
+    """
+    loads = np.asarray(expert_load, np.float64)
+    out: dict[str, Any] = compute_load_balance_metrics(loads, mode=mode, prefix="moe")
+    ent_mean, ent_min = routing_entropy(loads)
+    out["moe/routing_entropy"] = ent_mean
+    out["moe/routing_entropy_min"] = ent_min
+    if dropped_token_frac is not None:
+        out["moe/dropped_token_frac"] = float(dropped_token_frac)
+    if aux_loss is not None:
+        out["moe/aux_loss"] = float(aux_loss)
+        if aux_loss_ema is not None:
+            out["moe/aux_loss_ema"] = float(aux_loss_ema)
+            # positive = balancing pressure rising vs the trend (getting worse)
+            out["moe/aux_loss_trend"] = float(aux_loss) - float(aux_loss_ema)
+    if step_time_s:
+        out["moe/tokens_per_sec_per_chip"] = round(
+            float(loads.sum()) / float(step_time_s) / max(1, int(device_count)), 1
+        )
+    return out
+
+
+def local_expert_coords(mesh: Any, axis: str = "ep") -> list[int] | None:
+    """ep-axis coordinates whose expert shards live on THIS host's devices.
+
+    ``None`` when the mesh has no multi-way expert axis — then every host
+    holds every expert and a "hot expert host" is not a thing. Computed once
+    at setup; the mesh→process placement is static for the run.
+    """
+    names = tuple(getattr(mesh, "axis_names", ()))
+    if axis not in names:
+        return None
+    ax = names.index(axis)
+    if mesh.devices.shape[ax] <= 1:
+        return None
+    import jax
+
+    proc = jax.process_index()
+    coords = {
+        idx[ax]
+        for idx in np.ndindex(mesh.devices.shape)
+        if mesh.devices[idx].process_index == proc
+    }
+    return sorted(coords)
+
+
+def local_expert_max_util(
+    expert_load: np.ndarray, coords: list[int] | None, ep_size: int
+) -> float | None:
+    """Max utilization over this host's expert shard — the hot-expert sample.
+
+    ``expert_load`` is the globally-summed (L, E) table every host holds; the
+    host-local view is the columns of the ep shards in ``coords`` (experts are
+    ep-sharded in contiguous blocks of E/ep). Hosts then all-gather this one
+    scalar and :class:`~automodel_tpu.observability.aggregate.CrossHostAggregator`
+    flags the host whose shard runs hottest vs the pod median.
+    """
+    if coords is None or ep_size <= 1:
+        return None
+    loads = np.asarray(expert_load, np.float64)
+    if loads.ndim == 1:
+        loads = loads[None]
+    L, E = loads.shape
+    if E % ep_size != 0:
+        return None
+    ideal = loads.sum(axis=1, keepdims=True) / E
+    util = np.divide(loads, ideal, out=np.ones_like(loads), where=ideal > 0)
+    shard = E // ep_size
+    cols = [c * shard + j for c in coords if c * shard < E for j in range(shard)]
+    if not cols:
+        return None
+    return float(util[:, cols].max())
+
+
+class MoEStats:
+    """Per-run MoE telemetry state: the aux-loss EMA across log steps.
+
+    One instance per recipe; ``rows()`` is called at each log step with the
+    step's metrics dict and returns the ``moe/*`` fields for the row. The EMA
+    seeds on the first observed aux loss, so ``moe/aux_loss_trend`` starts at
+    0.0 and thereafter tracks drift against the smoothed history.
+    """
+
+    def __init__(self, ema_decay: float = 0.9):
+        if not 0.0 < ema_decay < 1.0:
+            raise ValueError(f"ema_decay must be in (0, 1), got {ema_decay}")
+        self.ema_decay = float(ema_decay)
+        self.aux_loss_ema: float | None = None
+
+    def rows(
+        self,
+        metrics: dict[str, Any],
+        *,
+        grad_acc_steps: int = 1,
+        step_time_s: float | None = None,
+        device_count: int = 1,
+        mode: str = "brief",
+    ) -> dict[str, Any]:
+        """``moe/*`` fields for one log row; {} when the step has no MoE stats."""
+        if "expert_load" not in metrics:
+            return {}
+        expert_load = np.asarray(metrics["expert_load"])
+        dropped = None
+        if "dropped_token_frac" in metrics:
+            # summed over the step's microbatches in the train-step carry
+            dropped = float(np.asarray(metrics["dropped_token_frac"])) / max(
+                1, int(grad_acc_steps)
+            )
+        aux = None
+        if "moe_aux_loss" in metrics:
+            aux = float(np.asarray(metrics["moe_aux_loss"]))
+            if math.isfinite(aux):
+                self.aux_loss_ema = (
+                    aux if self.aux_loss_ema is None
+                    else self.ema_decay * self.aux_loss_ema + (1 - self.ema_decay) * aux
+                )
+        return moe_step_metrics(
+            expert_load,
+            dropped_token_frac=dropped,
+            aux_loss=aux,
+            aux_loss_ema=self.aux_loss_ema,
+            step_time_s=step_time_s,
+            device_count=device_count,
+            mode=mode,
+        )

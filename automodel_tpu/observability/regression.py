@@ -3,10 +3,11 @@
 A perf regression that lands silently costs every future run; this module
 turns "did this PR make training slower?" into an exit code. A run artifact —
 a recipe ``training.jsonl``, a ``benchmark.json`` from the benchmark recipe,
-or the single JSON line ``bench.py`` prints — is reduced to a few headline
-metrics (tps, mfu, step_time_s, goodput) and compared per-metric against a
-committed baseline with direction-aware tolerances: throughput-like metrics
-regress by dropping, step time by rising.
+the single JSON line ``bench.py`` prints, or a ``bench.py --matrix`` capture
+(summary doc or per-row JSONL) — is reduced to gate metrics (tps, mfu,
+step_time_s, goodput; matrix cells become ``matrix/<model>_s<seq>_pf<on|off>/tps``)
+and compared per-metric against a committed baseline with direction-aware
+tolerances: throughput-like metrics regress by dropping, step time by rising.
 
 CLI (also exposed as ``tools/bench_gate.py``)::
 
@@ -77,6 +78,31 @@ def _from_bench_line(doc: dict[str, Any]) -> dict[str, float]:
     return out
 
 
+def _matrix_key(row: dict[str, Any]) -> str:
+    """Stable gate key for one bench-matrix row: matrix/<model>_s<seq>_pf<on|off>."""
+    pf = "on" if row.get("prefetch") else "off"
+    return f"matrix/{row.get('model')}_s{row.get('seq_len')}_pf{pf}"
+
+
+def _from_matrix_rows(rows: Iterable[dict[str, Any]]) -> dict[str, float]:
+    """Flatten ``bench.py --matrix`` rows into per-cell gate metrics.
+
+    Each cell contributes ``<key>/tps`` (and ``<key>/moe_tps`` for MoE rows) so
+    a regression in one cell — say moe s8192 with prefetch — fails the gate by
+    name instead of hiding inside an average. Decoration fields
+    (``a2a_byte_share``, ``steps``) stay out: they are diagnostics, not
+    directional performance metrics.
+    """
+    out: dict[str, float] = {}
+    for row in rows:
+        key = _matrix_key(row)
+        if row.get("tokens_per_sec_per_chip") is not None:
+            out[f"{key}/tps"] = float(row["tokens_per_sec_per_chip"])
+        if row.get("moe/tokens_per_sec_per_chip") is not None:
+            out[f"{key}/moe_tps"] = float(row["moe/tokens_per_sec_per_chip"])
+    return out
+
+
 def _from_benchmark_json(doc: dict[str, Any]) -> dict[str, float]:
     """The benchmark recipe's benchmark.json (recipes/llm/benchmark.py)."""
     out: dict[str, float] = {}
@@ -99,6 +125,8 @@ def load_run_metrics(path: str) -> dict[str, float]:
     except json.JSONDecodeError:
         doc = None
     if isinstance(doc, dict):
+        if isinstance(doc.get("matrix"), list):  # bench.py --matrix summary doc
+            return _from_matrix_rows(doc["matrix"])
         if "metric" in doc and "value" in doc:
             return _from_bench_line(doc)
         if "tokens_per_sec" in doc:
@@ -107,6 +135,11 @@ def load_run_metrics(path: str) -> dict[str, float]:
             return {k: float(v) for k, v in doc["metrics"].items()}
         return summarize_rows([doc])
     rows = [json.loads(ln) for ln in text.splitlines() if ln.strip()]
+    matrix_rows = [r for r in rows if r.get("matrix_row")]
+    if matrix_rows:  # matrix stdout capture: per-row lines + summary doc
+        out = _from_matrix_rows(matrix_rows)
+        out.update(summarize_rows(r for r in rows if not r.get("matrix_row")))
+        return out
     return summarize_rows(rows)
 
 
@@ -162,8 +195,9 @@ def compare(run: dict[str, float], baseline: dict[str, float],
     tols.update(tolerances or {})
     required = set(require)
     out: list[Comparison] = []
+    default_tol = tols.get("default", 0.05)
     for metric, base in sorted(baseline.items()):
-        tol = tols.get(metric, 0.05)
+        tol = tols.get(metric, default_tol)
         got = run.get(metric)
         if got is None or base == 0:
             out.append(Comparison(metric, got, base, None, tol,
@@ -198,7 +232,8 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--baseline", required=True,
                         help="committed baseline JSON ({'metrics': {...}})")
     parser.add_argument("--tolerance", action="append", default=[], metavar="METRIC=FRAC",
-                        help="override a tolerance, e.g. tps=0.08 (default 0.05)")
+                        help="override a tolerance, e.g. tps=0.08; "
+                             "default=0.2 sets the fallback for unlisted metrics")
     parser.add_argument("--require", action="append", default=[], metavar="METRIC",
                         help="fail when METRIC is missing from the run artifact")
     parser.add_argument("--write-baseline", action="store_true",

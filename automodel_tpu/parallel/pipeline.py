@@ -515,8 +515,14 @@ def make_moe_pp_hidden(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
         if V > 1:
             # (V, pp*Lb, E) round-major -> (L, E) global layer order
             load = load.reshape(-1, *load.shape[2:])
-        aux_loss = cfg.moe.aux_loss_coeff * aux["aux"].sum() if emit_aux else 0.0
-        return h_stack, aux_loss, {"expert_load": load}
+        extras = {"expert_load": load}
+        if emit_aux:
+            aux_loss = cfg.moe.aux_loss_coeff * aux["aux"].sum()
+            # unscaled balance loss for the moe/aux_loss telemetry row
+            extras["moe_aux_loss"] = aux["aux"].sum()
+        else:
+            aux_loss = 0.0
+        return h_stack, aux_loss, extras
 
     return hidden_fn
 

@@ -247,6 +247,21 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self.observability = Observability.from_config(
             cfg.get("observability"), out_dir, metric_sink=self._log_event
         )
+        # axis sizes let the compile-cost row attribute collective bytes to
+        # ep/dp/tp/pp (and the roofline grow its moe_a2a bound category)
+        self.observability.mesh_axes = {
+            str(name): int(size) for name, size in self.mesh.shape.items()
+        }
+        # moe/* telemetry rows (routing entropy, utilization spread, dropped
+        # tokens, aux-loss trend); None on dense runs
+        from automodel_tpu.observability.moe_stats import MoEStats, local_expert_coords
+
+        self._moe_stats = MoEStats() if self.moe_metrics_mode is not None else None
+        # this host's ep-shard coordinates: each host samples the utilization
+        # of its OWN experts so the aggregator can name a hot_expert_host
+        self._local_ep_coords = (
+            local_expert_coords(self.mesh) if self._moe_stats is not None else None
+        )
         # per-log-row MFU needs the analytic FLOPs formula; families outside
         # the formula table (VLM towers, audio) skip gracefully
         try:
@@ -266,8 +281,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         if isinstance(getattr(self, "hf_config", None), dict):
             arch = (self.hf_config.get("architectures") or [None])[0]
         model_id = cfg.get("model.pretrained_model_name_or_path") or arch or "scratch"
+        from automodel_tpu.observability import compile_cache
+
         self.metric_logger.log_header(**build_run_header(
-            cfg=cfg, mesh=self.mesh, model_id=model_id, seq_len=self.seq_len
+            cfg=cfg, mesh=self.mesh, model_id=model_id, seq_len=self.seq_len,
+            # persistent-XLA-cache config + hit/miss traffic from the
+            # model-init compiles (run totals land in compile_summary)
+            compile_cache=compile_cache.snapshot(),
         ))
 
         # the jitted step
@@ -467,6 +487,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             loss = loss + self._moe_config.aux_loss_coeff * stats["aux_loss"] * (
                 mb_tokens / num_label_tokens
             )
+            # unscaled balance loss, token-weighted the same way: summed across
+            # microbatches it is the step-level weighted mean for moe/aux_loss
+            aux["moe_aux_loss"] = stats["aux_loss"] * (mb_tokens / num_label_tokens)
         return loss, aux
 
     def _post_update(self):
@@ -721,6 +744,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                         self._save(self.step_scheduler.step)
                     self.checkpointer.wait()
         finally:
+            # run-total AOT/jit-fallback/demotion + compile-cache traffic (the
+            # run_header only sees the setup-time counts)
+            self._log_event(self.step_scheduler.step, event="compile_summary",
+                            **obs.compile_summary())
             obs.close()
             self.metric_logger.close()
             self.val_metric_logger.close()
@@ -910,6 +937,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     for k in ("input_ids", "q_ids", "p_ids") if k in stack
                 ) * jax.process_count()
                 extra = {}
+                moe_max_util = None
                 if "expert_load" in metrics and self.moe_metrics_mode:
                     from automodel_tpu.moe.metrics import compute_load_balance_metrics
 
@@ -921,6 +949,26 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     extra["moe_load/dropped_token_frac"] = float(
                         np.asarray(metrics["dropped_token_frac"])
                     ) / max(1, self.step_scheduler.grad_acc_steps)
+                if self._moe_stats is not None:
+                    # the moe/* family: routing entropy, utilization spread,
+                    # dropped tokens, aux-loss trend, routed tokens/s/chip
+                    extra.update(self._moe_stats.rows(
+                        metrics,
+                        grad_acc_steps=self.step_scheduler.grad_acc_steps,
+                        step_time_s=dt,
+                        device_count=jax.device_count(),
+                        mode=self.moe_metrics_mode,
+                    ))
+                    if "expert_load" in metrics:
+                        from automodel_tpu.observability.moe_stats import (
+                            local_expert_max_util,
+                        )
+
+                        moe_max_util = local_expert_max_util(
+                            np.asarray(metrics["expert_load"]),
+                            self._local_ep_coords,
+                            self.observability.mesh_axes.get("ep", 1),
+                        )
                 row = dict(
                     loss=loss,
                     grad_norm=gnorm,
@@ -956,8 +1004,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 row.update(obs.step_metrics())
                 row.update(obs.roofline_row(dt))
                 # collective on multi-host: every process reaches the log step
-                # (the schedule is deterministic), proc 0 writes the result
-                row.update(obs.host_metrics(dt))
+                # (the schedule is deterministic), proc 0 writes the result;
+                # MoE runs gather max expert utilization too (hot_expert_host)
+                row.update(obs.host_metrics(dt, moe_max_util=moe_max_util))
                 self.metric_logger.log(step, **row)
                 for lg in self.experiment_loggers:
                     lg.log(step, **row)

@@ -284,6 +284,227 @@ def _cpu_fallback_bench() -> dict:
     })
 
 
+# ---------------------------------------------------------------- matrix mode
+MATRIX_SEQ_LENS = (2048, 4096, 8192)
+
+
+def _matrix_dense_model(cpu: bool):
+    from automodel_tpu.models.common.backend import BackendConfig
+    from automodel_tpu.models.llama.model import LlamaConfig, LlamaForCausalLM
+
+    if cpu:
+        cfg = LlamaConfig(
+            vocab_size=2048, hidden_size=256, intermediate_size=1024,
+            num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
+            head_dim=32, max_position_embeddings=512,
+        )
+        backend = BackendConfig(dtype="float32")
+    else:
+        # Llama-3.2-1B dims + the tuned single-chip backend (see _measure)
+        cfg = LlamaConfig(
+            vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+            num_hidden_layers=16, num_attention_heads=32, num_key_value_heads=8,
+            head_dim=64, rope_theta=500000.0, tie_word_embeddings=True,
+            max_position_embeddings=131072,
+        )
+        backend = BackendConfig(dtype="bfloat16", remat_policy="mlp_attn_dots",
+                                attention="flash", attention_segments=False)
+    return LlamaForCausalLM(cfg, backend), cfg.vocab_size
+
+
+def _matrix_moe_model(cpu: bool):
+    from automodel_tpu.models.common.backend import BackendConfig
+    from automodel_tpu.models.qwen3_moe.model import Qwen3MoeForCausalLM
+
+    if cpu:
+        hf = dict(
+            vocab_size=2048, hidden_size=256, intermediate_size=512,
+            moe_intermediate_size=128, num_hidden_layers=4,
+            num_attention_heads=8, num_key_value_heads=4, head_dim=32,
+            max_position_embeddings=512, num_experts=8, num_experts_per_tok=2,
+            norm_topk_prob=True, router_aux_loss_coef=0.01,
+        )
+        backend = BackendConfig(dtype="float32")
+    else:
+        # 1B-class MoE: same token FLOPs ballpark as the dense row so the
+        # dense-vs-moe tokens/s gap in one matrix is the dispatch overhead
+        hf = dict(
+            vocab_size=128256, hidden_size=2048, intermediate_size=4096,
+            moe_intermediate_size=1024, num_hidden_layers=16,
+            num_attention_heads=32, num_key_value_heads=8, head_dim=64,
+            max_position_embeddings=131072, num_experts=16,
+            num_experts_per_tok=2, norm_topk_prob=True,
+            router_aux_loss_coef=0.01,
+        )
+        backend = BackendConfig(dtype="bfloat16", remat_policy="mlp_attn_dots",
+                                attention="flash", attention_segments=False)
+    return Qwen3MoeForCausalLM.from_config(hf, backend), hf["vocab_size"]
+
+
+def _matrix_cell(kind: str, nominal_seq: int, cpu: bool) -> list[dict]:
+    """One {model} x {seq} cell: AOT-compile once, run prefetch off then on.
+
+    Returns the two matrix rows. CPU rows keep the nominal seq as the row
+    label (so baselines line up across hosts) and record the actually
+    measured ``measured_seq_len``; MoE rows add routed tokens/s/chip and the
+    a2a share of collective bytes from the compiled HLO.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from automodel_tpu.data.collate import stack_batches
+    from automodel_tpu.data.llm.mock import MockSFTDataset
+    from automodel_tpu.data.loader import DataLoader
+    from automodel_tpu.data.prefetch import InputPipeline, PrefetchConfig
+    from automodel_tpu.observability.hlo_costs import (
+        collective_bytes,
+        collective_bytes_by_axis,
+    )
+    from automodel_tpu.ops.losses import masked_cross_entropy
+    from automodel_tpu.training.step_scheduler import StepScheduler
+    from automodel_tpu.training.train_step import make_train_step
+
+    is_moe = kind == "moe"
+    model, vocab = _matrix_moe_model(cpu) if is_moe else _matrix_dense_model(cpu)
+    seq_len = min(nominal_seq, 128) if cpu else nominal_seq
+    micro_batch = 2 if cpu else {2048: 4, 4096: 2, 8192: 1}[nominal_seq]
+    n_steps = 3 if cpu else 10
+    devices = jax.device_count()
+
+    def forward_loss(p, batch, num_label_tokens):
+        if is_moe:
+            out, stats = model(
+                p, batch["input_ids"], positions=batch["positions"],
+                segment_ids=batch["segment_ids"],
+                token_mask=batch["segment_ids"] != 0, training=True,
+            )
+            loss = masked_cross_entropy(out, batch["labels"], num_label_tokens)
+            return loss, {"expert_load": stats["expert_load"]}
+        logits = model(p, batch["input_ids"], positions=batch["positions"],
+                       segment_ids=batch["segment_ids"])
+        return masked_cross_entropy(logits, batch["labels"], num_label_tokens)
+
+    optimizer = optax.chain(optax.scale_by_factored_rms(), optax.scale(-1e-5))
+    step = jax.jit(make_train_step(forward_loss, optimizer), donate_argnums=(0, 1))
+
+    params = model.init(jax.random.key(0), jnp.dtype(model.backend.dtype))
+    opt_state = jax.jit(optimizer.init)(params)
+
+    # AOT compile from a synthetic stack of the pipeline's exact shapes; the
+    # optimized HLO also yields the a2a byte share
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (1, micro_batch, seq_len)).astype(np.int32)
+    sample_stack = {
+        "input_ids": ids, "labels": ids.copy(),
+        "positions": np.ascontiguousarray(np.broadcast_to(
+            np.arange(seq_len, dtype=np.int32), ids.shape)),
+        "segment_ids": np.ones_like(ids),
+    }
+    compiled = step.lower(params, opt_state, sample_stack).compile()
+    a2a_share = 0.0
+    try:
+        hlo = compiled.as_text()
+        total = sum(collective_bytes(hlo).values())
+        moe_a2a = collective_bytes_by_axis(hlo).get("moe_a2a", 0)
+        a2a_share = round(moe_a2a / total, 4) if total else 0.0
+    except Exception:  # noqa: BLE001 — a2a share is best-effort decoration
+        pass
+
+    def collate(samples):
+        # MockSFTDataset emits seq_len + 1 ids (next-token shift headroom);
+        # trim to the AOT-compiled width so shapes match the lowered step
+        arr = np.asarray([s["input_ids"] for s in samples], np.int32)[:, :seq_len]
+        return {
+            "input_ids": arr, "labels": arr.copy(),
+            "positions": np.ascontiguousarray(np.broadcast_to(
+                np.arange(arr.shape[-1], dtype=np.int32), arr.shape)),
+            "segment_ids": np.ones_like(arr),
+        }
+
+    def make_pipeline(prefetch: bool) -> InputPipeline:
+        ds = MockSFTDataset(vocab_size=vocab, seq_len=seq_len,
+                            num_samples=micro_batch * (n_steps + 3), seed=0,
+                            item_delay_s=0.002)
+        dl = DataLoader(ds, batch_size=micro_batch, collate_fn=collate, seed=0)
+        sched = StepScheduler(grad_acc_steps=1, num_epochs=1,
+                              max_steps=n_steps + 1, dataloader=dl,
+                              handle_sigterm=False)
+        return InputPipeline(scheduler=sched, dataloader=dl,
+                             stack_fn=stack_batches, put_fn=jax.device_put,
+                             config=PrefetchConfig(enabled=prefetch))
+
+    rows = []
+    for prefetch in (False, True):
+        pipe = make_pipeline(prefetch)
+        try:
+            first = pipe.get()
+            params, opt_state, m = compiled(params, opt_state, first.stack)
+            float(m["loss"])  # host sync: flush warmup before the clock starts
+            done = 0
+            t0 = time.perf_counter()
+            while done < n_steps:
+                item = pipe.get()
+                if item is None:
+                    break
+                params, opt_state, m = compiled(params, opt_state, item.stack)
+                done += 1
+            float(m["loss"])  # host sync closes the timed window
+            dt = time.perf_counter() - t0
+        finally:
+            pipe.close()
+        row = {
+            "matrix_row": True, "model": kind, "seq_len": nominal_seq,
+            "prefetch": prefetch, "steps": max(done, 1),
+            "tokens_per_sec_per_chip": round(
+                done * micro_batch * seq_len / dt / devices, 1),
+        }
+        if cpu:
+            row["fallback"] = "cpu"
+            row["measured_seq_len"] = seq_len
+            row["micro_batch"] = micro_batch
+        if is_moe:
+            # routed token copies through the expert GEMMs — the volume a
+            # grouped-GEMM / fused-dispatch optimization has to move
+            routed_per_step = float(np.asarray(m["expert_load"]).sum())
+            row["moe/tokens_per_sec_per_chip"] = round(
+                routed_per_step * done / dt / devices, 1)
+            row["a2a_byte_share"] = a2a_share
+        rows.append(row)
+    return rows
+
+
+def _matrix_bench(cpu: bool) -> dict:
+    """{dense, moe} x seq {2048,4096,8192} x prefetch {off, on}; one JSON line
+    per row as it lands (partial matrices stay useful if a later cell dies),
+    then a summary doc carrying all rows for the gate."""
+    import jax
+
+    rows: list[dict] = []
+    for kind in ("dense", "moe"):
+        for nominal in MATRIX_SEQ_LENS:
+            for row in _matrix_cell(kind, nominal, cpu):
+                print(json.dumps(row), flush=True)
+                rows.append(row)
+    headline = next(
+        (r["tokens_per_sec_per_chip"] for r in rows
+         if r["model"] == "dense" and r["seq_len"] == 2048 and r["prefetch"]),
+        None,
+    )
+    doc = {
+        "ok": True,
+        "metric": "bench matrix: {dense,moe} x seq x prefetch tokens/s/chip",
+        "value": headline,
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,
+        "matrix": rows,
+        "extra": {"device": str(jax.devices()[0]), "rows": len(rows)},
+    }
+    if cpu:
+        doc["extra"]["fallback"] = "cpu"
+    return doc
+
+
 # Substrings that identify "the accelerator is broken/absent", not "our code is
 # broken". BENCH_r05 widened this set: the TPU can also die at the first real
 # dispatch with libtpu/PJRT-level errors the original init-focused markers
@@ -305,11 +526,14 @@ def _canary_dispatch() -> None:
     jax.jit(lambda x: x + 1)(jnp.arange(8)).block_until_ready()
 
 
-def _spawn_cpu_fallback(reason: str) -> int:
+def _spawn_cpu_fallback(reason: str, extra_args: tuple[str, ...] = ()) -> int:
     """Re-run this script with ``--cpu`` in a clean interpreter: the failed
     backend init poisoned this process's JAX state, and the axon sitecustomize
     pins jax_platforms at startup — the child both clears JAX_PLATFORMS and
-    re-updates the config (the _spawn_cpu_dryrun pattern)."""
+    re-updates the config (the _spawn_cpu_dryrun pattern). ``extra_args``
+    carries mode flags through (``--matrix``); the child's matrix rows are
+    re-emitted ahead of its summary line so the parent's stdout keeps the
+    one-line-per-row contract."""
     import os
     import subprocess
 
@@ -317,7 +541,7 @@ def _spawn_cpu_fallback(reason: str) -> int:
     env["JAX_PLATFORMS"] = ""
     try:
         result = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--cpu"],
+            [sys.executable, os.path.abspath(__file__), "--cpu", *extra_args],
             env=env, capture_output=True, text=True, timeout=1800,
         )
     except subprocess.TimeoutExpired:
@@ -327,15 +551,22 @@ def _spawn_cpu_fallback(reason: str) -> int:
         return 1
     sys.stderr.write(result.stderr)
     sys.stderr.flush()
-    for line in reversed(result.stdout.splitlines()):
+    docs = []
+    for line in result.stdout.splitlines():
         try:
             doc = json.loads(line)
         except (json.JSONDecodeError, ValueError):
             continue
-        if isinstance(doc, dict) and "ok" in doc:
-            doc.setdefault("extra", {})["fallback_reason"] = reason
+        if isinstance(doc, dict):
+            docs.append(doc)
+    final = next((d for d in reversed(docs) if "ok" in d), None)
+    for doc in docs:
+        if doc is not final:
             print(json.dumps(doc), flush=True)
-            return 0 if doc.get("ok") else 1
+    if final is not None:
+        final.setdefault("extra", {})["fallback_reason"] = reason
+        print(json.dumps(final), flush=True)
+        return 0 if final.get("ok") else 1
     print(json.dumps({
         "ok": False,
         "error": f"cpu fallback rc={result.returncode} with no JSON line; primary: {reason}",
@@ -345,12 +576,15 @@ def _spawn_cpu_fallback(reason: str) -> int:
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    matrix = "--matrix" in argv
+    mode_args = ("--matrix",) if matrix else ()
     if "--cpu" in argv:
         try:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-            print(json.dumps(_cpu_fallback_bench()), flush=True)
+            doc = _matrix_bench(cpu=True) if matrix else _cpu_fallback_bench()
+            print(json.dumps(doc), flush=True)
             return 0
         except Exception as exc:  # noqa: BLE001 — the JSON contract is the point
             sys.stderr.flush()
@@ -364,8 +598,8 @@ def main(argv: list[str] | None = None) -> int:
             # would grind for hours — go straight to the tiny fallback.
             print("bench: no accelerator attached; running tiny CPU fallback",
                   file=sys.stderr)
-            doc = _cpu_fallback_bench()
-            doc["extra"]["fallback_reason"] = "default backend is cpu"
+            doc = _matrix_bench(cpu=True) if matrix else _cpu_fallback_bench()
+            doc.setdefault("extra", {})["fallback_reason"] = "default backend is cpu"
             print(json.dumps(doc), flush=True)
             return 0
         try:
@@ -373,31 +607,37 @@ def main(argv: list[str] | None = None) -> int:
         except Exception as exc:  # noqa: BLE001 — any canary failure is a backend fault
             reason = f"first-dispatch canary failed: {exc!r}"
             print(f"bench: {reason}; retrying on CPU", file=sys.stderr)
-            return _spawn_cpu_fallback(reason)
-        print(json.dumps(_full_bench()), flush=True)
+            return _spawn_cpu_fallback(reason, extra_args=mode_args)
+        doc = _matrix_bench(cpu=False) if matrix else _full_bench()
+        print(json.dumps(doc), flush=True)
         return 0
     except Exception as exc:  # noqa: BLE001
         reason = repr(exc)
         if any(marker in reason for marker in _BACKEND_ERRORS):
             print(f"bench: backend unavailable ({reason}); retrying on CPU",
                   file=sys.stderr)
-            return _spawn_cpu_fallback(reason)
+            return _spawn_cpu_fallback(reason, extra_args=mode_args)
         sys.stderr.flush()
         print(json.dumps({"ok": False, "error": reason}), flush=True)
         return 1
 
 
-if __name__ == "__main__":
-    # last line of defense for the JSON contract: whatever escapes main() —
-    # KeyboardInterrupt, SystemExit from a library, MemoryError — still ends
-    # stdout with one parseable line instead of a bare traceback (BENCH_r05).
+def run_cli(argv: list[str] | None = None) -> int:
+    """main() inside the last line of defense for the JSON contract: whatever
+    escapes — KeyboardInterrupt, SystemExit from a library, MemoryError —
+    still ends stdout with one parseable line instead of a bare traceback
+    (BENCH_r05). Split from ``__main__`` so tests can drive the guard
+    in-process."""
     try:
-        rc = main()
+        return main(argv)
     except BaseException as exc:  # noqa: BLE001
         import traceback
 
         traceback.print_exc()
         sys.stderr.flush()
         print(json.dumps({"ok": False, "error": repr(exc)}), flush=True)
-        rc = 1
-    sys.exit(rc)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(run_cli())

@@ -77,7 +77,8 @@ def _write_cfg(tmp_path, extra="", max_steps=6, grad_acc=2, ckpt=False,
 def _rows(tmp_path):
     rows = [json.loads(line) for line in open(tmp_path / "out" / "training.jsonl")]
     return [r for r in rows
-            if "run_header" not in r and r.get("event") != "compile_costs"]
+            if "run_header" not in r
+            and r.get("event") not in ("compile_costs", "compile_summary")]
 
 
 class TestPrefetchTrajectory:

@@ -83,18 +83,36 @@ def _write_cfg(tmp_path, extra="", dp_shard=4, tp=2, pp=1, n_layers=2, max_steps
 
 def _read_jsonl(path):
     rows = [json.loads(line) for line in open(path)]
-    # run-header and compile_costs rows are stream metadata; resilience event
-    # rows stay — TestResilience asserts on them
+    # run-header and compile-accounting rows are stream metadata; resilience
+    # event rows stay — TestResilience asserts on them
     return [r for r in rows
-            if "run_header" not in r and r.get("event") != "compile_costs"]
+            if "run_header" not in r
+            and r.get("event") not in ("compile_costs", "compile_summary")]
+
+
+@pytest.fixture(scope="module")
+def base_run(tmp_path_factory, cpu_devices):
+    """The canonical dense run (dp_shard=4 x tp=2, ckpt at 3 and 6), compiled
+    once and shared by the loss/observability/resume assertions — the compile
+    dominates these tests' wall time. Artifacts are captured eagerly;
+    test_resume_exact may mutate the directory afterwards."""
+    tmp = tmp_path_factory.mktemp("base_run")
+    cfg = load_config(_write_cfg(tmp, ckpt=True))
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+    recipe.run_train_validation_loop()
+    raw = [json.loads(line) for line in open(tmp / "out" / "training.jsonl")]
+    timeline = json.load(open(tmp / "out" / "timeline.json"))
+    return {
+        "tmp": tmp,
+        "raw": raw,
+        "rows": _read_jsonl(tmp / "out" / "training.jsonl"),
+        "timeline": timeline,
+    }
 
 
 class TestTrainRecipeE2E:
-    def test_loss_decreases_sharded(self, tmp_path, cpu_devices):
-        cfg = load_config(_write_cfg(tmp_path))
-        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
-        recipe.run_train_validation_loop()
-        rows = _read_jsonl(tmp_path / "out" / "training.jsonl")
+    def test_loss_decreases_sharded(self, base_run):
+        rows = base_run["rows"]
         assert len(rows) == 6
         losses = [r["loss"] for r in rows]
         # 128-vocab: initial loss ~ln(128)=4.85; learnable data must drop w/ lr=1e-2
@@ -117,14 +135,11 @@ class TestTrainRecipeE2E:
         assert rows[0]["tps"] is None
         assert all(r["tps"] > 0 for r in rows[1:])
 
-    def test_run_header_compile_costs_and_timeline(self, tmp_path, cpu_devices):
+    def test_run_header_compile_costs_and_timeline(self, base_run):
         """The perf-observability artifacts of one training run: the one-time
         run-header row, the per-compile analytic cost/roofline row, per-step
         bound diagnosis, and a Perfetto-loadable timeline.json."""
-        cfg = load_config(_write_cfg(tmp_path, ckpt=True))
-        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
-        recipe.run_train_validation_loop()
-        raw = [json.loads(line) for line in open(tmp_path / "out" / "training.jsonl")]
+        raw = base_run["raw"]
 
         headers = [r for r in raw if r.get("run_header")]
         assert len(headers) == 1
@@ -134,6 +149,10 @@ class TestTrainRecipeE2E:
         assert h["mesh"]["dp_shard"] == 4 and h["mesh"]["tp"] == 2
         assert h["model_id"] == "LlamaForCausalLM"
         assert "git_sha" in h and len(h["config_digest"]) == 16
+        # XLA compile-cache counters ride the header (written pre-compile, so
+        # they cover model-init dispatches; run totals land in compile_summary)
+        cc = h["compile_cache"]
+        assert cc["listener"] is True and "persistent_enabled" in cc
 
         compiles = [r for r in raw if r.get("event") == "compile_costs"]
         assert len(compiles) == 1
@@ -151,7 +170,12 @@ class TestTrainRecipeE2E:
             assert r["bound"] in ("compute", "memory", "comms", "input")
             assert r["roofline_frac"] > 0
 
-        doc = json.load(open(tmp_path / "out" / "timeline.json"))
+        summaries = [r for r in raw if r.get("event") == "compile_summary"]
+        assert len(summaries) == 1
+        assert summaries[0]["compile_aot"] >= 1
+        assert summaries[0]["compile_jit_fallback"] == 0
+
+        doc = base_run["timeline"]
         assert doc["displayTimeUnit"] == "ms"
         for e in doc["traceEvents"]:
             assert {"name", "cat", "ph", "ts", "pid", "tid"} <= set(e)
@@ -227,12 +251,10 @@ class TestTrainRecipeE2E:
         assert np.isfinite(ref).all() and ref[-1] < ref[0]
         np.testing.assert_allclose(got, ref, rtol=1e-4)
 
-    def test_resume_exact(self, tmp_path, cpu_devices):
-        # run 1: 6 steps with ckpt at 3 and final at 6
-        cfg = load_config(_write_cfg(tmp_path, ckpt=True))
-        r1 = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
-        r1.run_train_validation_loop()
-        rows1 = _read_jsonl(tmp_path / "out" / "training.jsonl")
+    def test_resume_exact(self, base_run):
+        # run 1 is the shared fixture: 6 steps with ckpt at 3 and final at 6
+        tmp_path = base_run["tmp"]
+        rows1 = base_run["rows"]
 
         # run 2: resume from step 3 checkpoint by removing later ckpts
         import shutil

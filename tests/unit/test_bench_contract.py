@@ -1,0 +1,206 @@
+"""bench.py's failure contract: the LAST stdout line is ALWAYS parseable JSON.
+
+The driver reads exactly one thing from a bench run — the final stdout line —
+so every escape path (BaseException through run_cli, backend faults routed to
+the CPU fallback, code bugs reported as ``{"ok": false}``) must end stdout
+with a machine-parseable line. Round 5 lost its data point to a canary-level
+backend death that printed a raw traceback; these tests pin the seams that
+prevent a repeat: the run_cli BaseException guard, the canary → fallback
+routing, the backend-marker routing, and the fallback child's row re-emission.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import types
+
+import pytest
+
+import bench
+
+
+def _stdout_docs(capsys):
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert lines, "bench printed nothing to stdout"
+    return lines, [json.loads(ln) for ln in lines]
+
+
+def _fake_backend(monkeypatch, name="tpu"):
+    """Make bench.main think a non-CPU accelerator is attached."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: name)
+
+
+class TestRunCliGuard:
+    def test_baseexception_still_ends_with_json_line(self, monkeypatch, capsys):
+        def boom(argv=None):
+            raise KeyboardInterrupt("ctrl-c mid-bench")
+
+        monkeypatch.setattr(bench, "main", boom)
+        rc = bench.run_cli([])
+        lines, docs = _stdout_docs(capsys)
+        assert rc == 1
+        assert docs[-1]["ok"] is False
+        assert "KeyboardInterrupt" in docs[-1]["error"]
+
+    def test_systemexit_from_library_is_caught(self, monkeypatch, capsys):
+        monkeypatch.setattr(
+            bench, "main", lambda argv=None: (_ for _ in ()).throw(SystemExit(3))
+        )
+        rc = bench.run_cli([])
+        _, docs = _stdout_docs(capsys)
+        assert rc == 1
+        assert docs[-1]["ok"] is False
+
+    def test_clean_run_passes_through_rc(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "main", lambda argv=None: 0)
+        assert bench.run_cli([]) == 0
+
+
+class TestCanaryRouting:
+    def test_canary_failure_routes_to_cpu_fallback(self, monkeypatch, capsys):
+        _fake_backend(monkeypatch)
+        monkeypatch.setattr(
+            bench, "_canary_dispatch",
+            lambda: (_ for _ in ()).throw(RuntimeError("wedged chip")),
+        )
+        calls = []
+
+        def fake_fallback(reason, extra_args=()):
+            calls.append((reason, extra_args))
+            print(json.dumps({"ok": True, "extra": {"fallback": "cpu"}}))
+            return 0
+
+        monkeypatch.setattr(bench, "_spawn_cpu_fallback", fake_fallback)
+        rc = bench.main([])
+        _, docs = _stdout_docs(capsys)
+        assert rc == 0
+        assert len(calls) == 1
+        assert "wedged chip" in calls[0][0]
+        assert docs[-1]["ok"] is True
+
+    def test_canary_failure_carries_matrix_flag_to_fallback(self, monkeypatch, capsys):
+        _fake_backend(monkeypatch)
+        monkeypatch.setattr(
+            bench, "_canary_dispatch",
+            lambda: (_ for _ in ()).throw(RuntimeError("wedged chip")),
+        )
+        calls = []
+
+        def fake_fallback(reason, extra_args=()):
+            calls.append(extra_args)
+            print(json.dumps({"ok": True}))
+            return 0
+
+        monkeypatch.setattr(bench, "_spawn_cpu_fallback", fake_fallback)
+        assert bench.main(["--matrix"]) == 0
+        assert calls == [("--matrix",)]
+
+    def test_backend_marker_in_bench_error_routes_to_fallback(self, monkeypatch, capsys):
+        _fake_backend(monkeypatch)
+        monkeypatch.setattr(bench, "_canary_dispatch", lambda: None)
+        monkeypatch.setattr(
+            bench, "_full_bench",
+            lambda: (_ for _ in ()).throw(RuntimeError("libtpu crashed late")),
+        )
+        monkeypatch.setattr(
+            bench, "_spawn_cpu_fallback",
+            lambda reason, extra_args=(): (print(json.dumps({"ok": True})), 0)[1],
+        )
+        rc = bench.main([])
+        _, docs = _stdout_docs(capsys)
+        assert rc == 0
+        assert docs[-1]["ok"] is True
+
+    def test_code_bug_is_reported_not_masked_by_fallback(self, monkeypatch, capsys):
+        _fake_backend(monkeypatch)
+        monkeypatch.setattr(bench, "_canary_dispatch", lambda: None)
+        monkeypatch.setattr(
+            bench, "_full_bench",
+            lambda: (_ for _ in ()).throw(ValueError("shape mismatch in our code")),
+        )
+
+        def no_fallback(reason, extra_args=()):  # pragma: no cover - must not run
+            raise AssertionError("code bugs must not be laundered through the CPU fallback")
+
+        monkeypatch.setattr(bench, "_spawn_cpu_fallback", no_fallback)
+        rc = bench.main([])
+        _, docs = _stdout_docs(capsys)
+        assert rc == 1
+        assert docs[-1]["ok"] is False
+        assert "shape mismatch" in docs[-1]["error"]
+
+    def test_cpu_mode_error_keeps_json_contract(self, monkeypatch, capsys):
+        monkeypatch.setattr(
+            bench, "_cpu_fallback_bench",
+            lambda: (_ for _ in ()).throw(RuntimeError("tiny bench died")),
+        )
+        rc = bench.main(["--cpu"])
+        _, docs = _stdout_docs(capsys)
+        assert rc == 1
+        assert docs[-1]["ok"] is False
+
+
+class TestFallbackChildReemission:
+    def _fake_child(self, monkeypatch, stdout, returncode=0):
+        def fake_run(cmd, **kwargs):
+            return types.SimpleNamespace(stdout=stdout, stderr="", returncode=returncode)
+
+        monkeypatch.setattr(subprocess, "run", fake_run)
+
+    def test_matrix_rows_reemitted_before_final_doc(self, monkeypatch, capsys):
+        row = {"matrix_row": True, "model": "dense", "seq_len": 2048,
+               "prefetch": True, "tokens_per_sec_per_chip": 10.0}
+        final = {"ok": True, "matrix": [row], "extra": {"fallback": "cpu"}}
+        self._fake_child(
+            monkeypatch,
+            "noise line, not json\n" + json.dumps(row) + "\n" + json.dumps(final) + "\n",
+        )
+        rc = bench._spawn_cpu_fallback("canary died", extra_args=("--matrix",))
+        lines, docs = _stdout_docs(capsys)
+        assert rc == 0
+        assert docs[0]["matrix_row"] is True
+        assert docs[-1]["ok"] is True
+        assert docs[-1]["extra"]["fallback_reason"] == "canary died"
+
+    def test_child_with_no_json_is_a_reported_failure(self, monkeypatch, capsys):
+        self._fake_child(monkeypatch, "traceback only, no json\n", returncode=1)
+        rc = bench._spawn_cpu_fallback("backend gone")
+        _, docs = _stdout_docs(capsys)
+        assert rc == 1
+        assert docs[-1]["ok"] is False
+        assert "backend gone" in docs[-1]["error"]
+
+
+class TestMatrixRowShape:
+    def test_matrix_summary_doc_flattens_for_the_gate(self):
+        from automodel_tpu.observability.regression import load_run_metrics
+
+        rows = [
+            {"matrix_row": True, "model": "dense", "seq_len": 2048,
+             "prefetch": False, "tokens_per_sec_per_chip": 100.0},
+            {"matrix_row": True, "model": "moe", "seq_len": 4096,
+             "prefetch": True, "tokens_per_sec_per_chip": 80.0,
+             "moe/tokens_per_sec_per_chip": 640.0, "a2a_byte_share": 0.2},
+        ]
+        doc = {"ok": True, "metric": "m", "value": 100.0, "matrix": rows}
+        import json as _json
+
+        for text, label in [
+            (_json.dumps(doc), "summary doc"),
+            ("\n".join(_json.dumps(r) for r in rows) + "\n" + _json.dumps(doc),
+             "stdout capture"),
+        ]:
+            import tempfile
+
+            with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+                f.write(text)
+                path = f.name
+            got = load_run_metrics(path)
+            assert got["matrix/dense_s2048_pfoff/tps"] == 100.0, label
+            assert got["matrix/moe_s4096_pfon/tps"] == 80.0, label
+            assert got["matrix/moe_s4096_pfon/moe_tps"] == 640.0, label
+            assert "matrix/moe_s4096_pfon/a2a_share" not in got, label

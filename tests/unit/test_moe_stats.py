@@ -1,0 +1,222 @@
+"""MoE telemetry: load-balance edge cases, the moe/* row family, hot-expert flag.
+
+Locks three contracts the MoE observability stack leans on: (1)
+``compute_load_balance_metrics`` stays well-defined on degenerate loads
+(all-zero layers, a single expert, detailed mode) because a telemetry helper
+that NaNs on an all-padding microbatch poisons the JSONL stream; (2) the
+``moe/*`` rows from :mod:`automodel_tpu.observability.moe_stats` survive the
+MetricLogger's strict-JSON encoding (non-finite → null + ``*_nonfinite``);
+(3) the cross-host aggregator's ``hot_expert_host`` flag fires exactly like
+``straggler_host`` does, on the MoE wire format only.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from automodel_tpu.loggers.metric_logger import MetricsSample
+from automodel_tpu.moe.metrics import compute_load_balance_metrics
+from automodel_tpu.observability.aggregate import (
+    HOST_KEYS,
+    MOE_HOST_KEYS,
+    CrossHostAggregator,
+)
+from automodel_tpu.observability.moe_stats import (
+    MoEStats,
+    local_expert_max_util,
+    moe_step_metrics,
+    routing_entropy,
+)
+
+
+class TestLoadBalanceEdgeCases:
+    def test_all_zero_loads_are_finite(self):
+        m = compute_load_balance_metrics(np.zeros((3, 8)))
+        assert all(math.isfinite(v) for v in m.values())
+        # zero ideal → utilization defined as 1.0 (balanced vacuously)
+        assert m["moe_load/max_util_mean"] == 1.0
+        assert m["moe_load/min_util_mean"] == 1.0
+        assert m["moe_load/util_std_mean"] == 0.0
+        assert m["moe_load/zero_expert_frac"] == 1.0
+
+    def test_single_expert_is_perfectly_balanced(self):
+        m = compute_load_balance_metrics(np.array([[64.0]]))
+        assert m["moe_load/max_util_mean"] == 1.0
+        assert m["moe_load/zero_expert_frac"] == 0.0
+        # top/bottom-k collapses to the one expert
+        assert m["moe_load/top0_expert0_util"] == 1.0
+        assert m["moe_load/bottom0_expert0_util"] == 1.0
+
+    def test_1d_input_promotes_to_single_layer(self):
+        flat = compute_load_balance_metrics(np.array([4.0, 0.0, 4.0, 0.0]))
+        stacked = compute_load_balance_metrics(np.array([[4.0, 0.0, 4.0, 0.0]]))
+        assert flat == stacked
+        assert flat["moe_load/zero_expert_frac"] == 0.5
+
+    def test_detailed_mode_adds_per_layer_rows(self):
+        loads = np.array([[8.0, 0.0], [4.0, 4.0]])
+        brief = compute_load_balance_metrics(loads, mode="brief")
+        detailed = compute_load_balance_metrics(loads, mode="detailed")
+        assert "moe_load/layer0/max_util" not in brief
+        assert detailed["moe_load/layer0/max_util"] == 2.0
+        assert detailed["moe_load/layer1/max_util"] == 1.0
+        assert detailed["moe_load/layer0/min_util"] == 0.0
+        # brief keys are a subset of detailed
+        assert set(brief) <= set(detailed)
+
+    def test_prefix_is_respected(self):
+        m = compute_load_balance_metrics(np.ones((2, 4)), prefix="moe")
+        assert all(k.startswith("moe/") for k in m)
+
+
+class TestRoutingEntropy:
+    def test_uniform_routing_is_one(self):
+        mean, mn = routing_entropy(np.full((3, 8), 16.0))
+        assert mean == pytest.approx(1.0)
+        assert mn == pytest.approx(1.0)
+
+    def test_collapse_is_zero_and_min_names_worst_layer(self):
+        loads = np.array([[10.0, 10.0], [20.0, 0.0]])  # balanced, collapsed
+        mean, mn = routing_entropy(loads)
+        assert mn == pytest.approx(0.0)
+        assert mean == pytest.approx(0.5)
+
+    def test_zero_total_layer_counts_as_uniform(self):
+        mean, mn = routing_entropy(np.zeros((2, 4)))
+        assert mean == 1.0 and mn == 1.0
+
+    def test_single_expert_degenerate(self):
+        assert routing_entropy(np.array([[7.0]])) == (1.0, 1.0)
+
+
+class TestMoeStepMetricsRow:
+    def test_row_keys_and_throughput(self):
+        loads = np.array([[6.0, 2.0], [4.0, 4.0]])
+        row = moe_step_metrics(loads, dropped_token_frac=0.01, aux_loss=0.5,
+                               aux_loss_ema=0.4, step_time_s=2.0, device_count=8)
+        assert row["moe/dropped_token_frac"] == 0.01
+        assert row["moe/aux_loss"] == 0.5
+        assert row["moe/aux_loss_trend"] == pytest.approx(0.1)
+        # 16 routed copies / 2s / 8 chips
+        assert row["moe/tokens_per_sec_per_chip"] == 1.0
+        assert row["moe/max_util_mean"] == pytest.approx((1.5 + 1.0) / 2)
+        assert "moe/routing_entropy" in row and "moe/routing_entropy_min" in row
+
+    def test_optional_fields_stay_absent(self):
+        row = moe_step_metrics(np.ones((1, 4)))
+        assert "moe/dropped_token_frac" not in row
+        assert "moe/aux_loss" not in row
+        assert "moe/tokens_per_sec_per_chip" not in row
+
+    def test_row_is_strict_json_safe(self):
+        row = moe_step_metrics(np.ones((2, 8)), dropped_token_frac=0.0,
+                               aux_loss=1.25, aux_loss_ema=1.0,
+                               step_time_s=1.0, device_count=1)
+        rec = json.loads(MetricsSample(step=3, metrics=row).to_json())
+        assert rec["step"] == 3
+        assert rec["moe/aux_loss"] == 1.25
+        assert not any(k.endswith("_nonfinite") for k in rec)
+
+    def test_nonfinite_aux_loss_becomes_null_plus_flag(self):
+        row = moe_step_metrics(np.ones((1, 4)), aux_loss=float("nan"),
+                               aux_loss_ema=1.0)
+        rec = json.loads(MetricsSample(step=1, metrics=row).to_json())
+        assert rec["moe/aux_loss"] is None
+        assert rec["moe/aux_loss_nonfinite"] is True
+        assert rec["moe/aux_loss_trend"] is None  # nan - ema propagates
+
+
+class TestMoEStatsState:
+    def test_rows_empty_without_expert_load(self):
+        assert MoEStats().rows({"loss": 1.0}) == {}
+
+    def test_ema_seeds_then_smooths(self):
+        stats = MoEStats(ema_decay=0.5)
+        first = stats.rows({"expert_load": np.ones((1, 4)), "moe_aux_loss": 2.0})
+        assert first["moe/aux_loss_trend"] == 0.0  # seeded: ema == aux
+        second = stats.rows({"expert_load": np.ones((1, 4)), "moe_aux_loss": 4.0})
+        # ema = 0.5*2 + 0.5*4 = 3; trend = 4 - 3
+        assert second["moe/aux_loss_ema"] == pytest.approx(3.0)
+        assert second["moe/aux_loss_trend"] == pytest.approx(1.0)
+
+    def test_nonfinite_aux_does_not_corrupt_ema(self):
+        stats = MoEStats(ema_decay=0.5)
+        stats.rows({"expert_load": np.ones((1, 4)), "moe_aux_loss": 2.0})
+        stats.rows({"expert_load": np.ones((1, 4)), "moe_aux_loss": float("nan")})
+        assert stats.aux_loss_ema == 2.0
+
+    def test_dropped_frac_divided_by_grad_acc(self):
+        row = MoEStats().rows(
+            {"expert_load": np.ones((1, 4)), "dropped_token_frac": 0.4},
+            grad_acc_steps=4,
+        )
+        assert row["moe/dropped_token_frac"] == pytest.approx(0.1)
+
+    def test_bad_ema_decay_rejected(self):
+        with pytest.raises(ValueError):
+            MoEStats(ema_decay=1.0)
+
+
+class TestLocalExpertMaxUtil:
+    def test_none_without_ep(self):
+        assert local_expert_max_util(np.ones((1, 8)), None, 1) is None
+        assert local_expert_max_util(np.ones((1, 8)), [0], 1) is None
+
+    def test_picks_this_hosts_shard(self):
+        # E=4, ep=2: host with coord 0 owns experts {0,1}, coord 1 owns {2,3}
+        loads = np.array([[4.0, 0.0, 1.0, 3.0]])  # ideal = 2 → util 2,0,.5,1.5
+        assert local_expert_max_util(loads, [0], 2) == pytest.approx(2.0)
+        assert local_expert_max_util(loads, [1], 2) == pytest.approx(1.5)
+
+    def test_indivisible_expert_count_is_none(self):
+        assert local_expert_max_util(np.ones((1, 6)), [0], 4) is None
+
+
+class TestHotExpertAggregation:
+    def _agg(self, table, keys=MOE_HOST_KEYS, factor=2.0):
+        return CrossHostAggregator(
+            straggler_factor=factor, keys=keys,
+            allgather_fn=lambda vec: table, process_count=len(table),
+        )
+
+    def test_hot_expert_host_flagged(self):
+        # hosts: (step_time, data_wait, hbm, moe_max_util)
+        table = [[1.0, 0.0, 1.0, 1.1], [1.0, 0.0, 1.0, 1.0], [1.0, 0.0, 1.0, 3.0]]
+        out = self._agg(table).aggregate(
+            {"step_time_s": 1.0, "data_wait_s": 0.0, "hbm_gib_peak": 1.0,
+             "moe_max_util": 1.1},
+        )
+        assert out["hot_expert_host"] == 2
+        assert out["hot_expert_ratio"] == pytest.approx(3.0 / 1.1, abs=1e-3)
+        assert "straggler_host" not in out
+        assert out["host/moe_max_util_max"] == 3.0
+
+    def test_balanced_pod_has_no_flag(self):
+        table = [[1.0, 0.0, 1.0, 1.2], [1.0, 0.0, 1.0, 1.1]]
+        out = self._agg(table).aggregate(
+            {"step_time_s": 1.0, "data_wait_s": 0.0, "hbm_gib_peak": 1.0,
+             "moe_max_util": 1.2},
+        )
+        assert "hot_expert_host" not in out
+
+    def test_dense_wire_format_never_flags_hot_expert(self):
+        # legacy HOST_KEYS table: no moe_max_util column, flag must not appear
+        table = [[1.0, 0.0, 1.0], [5.0, 0.0, 1.0], [1.0, 0.0, 1.0]]
+        out = self._agg(table, keys=HOST_KEYS).aggregate(
+            {"step_time_s": 1.0, "data_wait_s": 0.0, "hbm_gib_peak": 1.0},
+        )
+        assert out["straggler_host"] == 1
+        assert "hot_expert_host" not in out
+
+    def test_missing_moe_sample_travels_as_nan(self):
+        table = [[1.0, 0.0, 1.0, math.nan], [1.0, 0.0, 1.0, math.nan]]
+        out = self._agg(table).aggregate(
+            {"step_time_s": 1.0, "data_wait_s": 0.0, "hbm_gib_peak": 1.0,
+             "moe_max_util": None},
+        )
+        assert "hot_expert_host" not in out
+        assert "host/moe_max_util_max" not in out
