@@ -17,7 +17,20 @@ from automodel_tpu.observability.hlo_costs import (
 )
 from automodel_tpu.observability.manager import Observability, ObservabilityConfig
 from automodel_tpu.observability.memory import device_memory_stats
+from automodel_tpu.observability.memory_plan import (
+    MemoryPlan,
+    build_memory_plan,
+    compiled_memory_attribution,
+    reconcile,
+    resolve_hbm_limit_bytes,
+    tree_shard_bytes,
+)
 from automodel_tpu.observability.moe_stats import MoEStats, moe_step_metrics, routing_entropy
+from automodel_tpu.observability.oom import (
+    OOMFlightRecorder,
+    is_oom_error,
+    live_buffer_inventory,
+)
 from automodel_tpu.observability.profiling import OnDemandProfiler
 from automodel_tpu.observability.watchdog import StallWatchdog
 
@@ -28,20 +41,29 @@ __all__ = [
     "BUCKETS",
     "CrossHostAggregator",
     "GoodputTracker",
+    "MemoryPlan",
     "MoEStats",
+    "OOMFlightRecorder",
     "Observability",
     "ObservabilityConfig",
     "OnDemandProfiler",
     "StallWatchdog",
     "TraceTimeline",
+    "build_memory_plan",
     "collective_bytes",
     "collective_bytes_by_axis",
     "compile_cache",
     "compiled_cost_metrics",
+    "compiled_memory_attribution",
     "device_memory_stats",
     "device_specs",
     "diagnose_bound",
+    "is_oom_error",
+    "live_buffer_inventory",
     "moe_step_metrics",
+    "reconcile",
+    "resolve_hbm_limit_bytes",
     "roofline_metrics",
     "routing_entropy",
+    "tree_shard_bytes",
 ]

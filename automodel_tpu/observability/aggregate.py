@@ -27,8 +27,10 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["CrossHostAggregator", "HOST_KEYS", "MOE_HOST_KEYS"]
 
-# the per-host sample, in wire order
-HOST_KEYS = ("step_time_s", "data_wait_s", "hbm_gib_peak")
+# the per-host sample, in wire order; headroom (limit - in_use, from the
+# allocator or the analytic memory plan) travels so proc 0 can flag the host
+# closest to an OOM before the allocator does
+HOST_KEYS = ("step_time_s", "data_wait_s", "hbm_gib_peak", "hbm_headroom_gib")
 # MoE runs append the host's max expert utilization (>1 = hot expert); a
 # separate tuple so dense runs keep the exact legacy wire format
 MOE_HOST_KEYS = HOST_KEYS + ("moe_max_util",)
@@ -51,10 +53,12 @@ class CrossHostAggregator:
     def __init__(self, straggler_factor: float = 2.0,
                  keys: Sequence[str] = HOST_KEYS,
                  allgather_fn: Callable[[Sequence[float]], list] | None = None,
-                 process_count: int | None = None):
+                 process_count: int | None = None,
+                 oom_risk_gib: float = 1.0):
         if straggler_factor <= 1.0:
             raise ValueError(f"straggler_factor must be > 1, got {straggler_factor}")
         self.straggler_factor = float(straggler_factor)
+        self.oom_risk_gib = float(oom_risk_gib)
         self.keys = tuple(keys)
         if allgather_fn is None:
             import jax
@@ -97,6 +101,7 @@ class CrossHostAggregator:
             out[f"host/{key}_max"] = round(max(vals), 4)
         self._flag_straggler(rows, out)
         self._flag_hot_expert(rows, out)
+        self._flag_oom_risk(rows, out)
         return out
 
     def _worst_vs_median(self, rows: list, key: str) -> tuple[float, int] | None:
@@ -131,3 +136,23 @@ class CrossHostAggregator:
         if hit and hit[0] >= self.straggler_factor:
             out["hot_expert_host"] = hit[1]
             out["hot_expert_ratio"] = round(hit[0], 3)
+
+    def _flag_oom_risk(self, rows: list, out: dict[str, Any]) -> None:
+        """Flag the host with the LEAST headroom when it drops below the
+        absolute ``oom_risk_gib`` threshold.
+
+        Absolute, not worst/median: memory is a cliff, not a gradient — a
+        pod where every host sits at 0.5 GiB headroom has a median as bad as
+        its worst, and a ratio test would stay silent right up to the OOM.
+        """
+        if "hbm_headroom_gib" not in self.keys:
+            return
+        idx = self.keys.index("hbm_headroom_gib")
+        vals = [(r[idx], host) for host, r in enumerate(rows)
+                if not math.isnan(r[idx])]
+        if not vals:
+            return
+        worst, host = min(vals)
+        if worst < self.oom_risk_gib:
+            out["oom_risk_host"] = host
+            out["oom_risk_headroom_gib"] = round(worst, 3)

@@ -87,6 +87,17 @@ class TraceTimeline:
             "args": _jsonable_args(args),
         })
 
+    def counter(self, name: str, tid: int = 0, **values: Any) -> None:
+        """A counter sample (Chrome phase "C"): Perfetto renders each series in
+        ``values`` as a stacked track over time — how hbm_gib_in_use/peak
+        become a picture instead of a column of numbers."""
+        self._push({
+            "name": name, "cat": "counter", "ph": "C",
+            "ts": round(self.now() * 1e6, 1),
+            "pid": self.pid, "tid": tid,
+            "args": _jsonable_args(values),
+        })
+
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "phase", tid: int = 0, **args: Any):
         """Context manager emitting a complete event for the wrapped block."""

@@ -210,18 +210,19 @@ class DeviceSpec:
     hbm_gbps: float  # HBM bandwidth, GB/s
     ici_gbps: float  # aggregate interchip-interconnect bandwidth, GB/s
     known: bool = True
+    hbm_gib: float = 0.0  # per-chip HBM capacity, GiB (0 = unknown)
 
 
 # matched by substring against the lowercased device kind, first hit wins;
 # "v5 lite" before "v5p" keeps the v5e tunnel string from matching v5p
 _DEVICE_SPECS = (
-    ("v5 lite", DeviceSpec("v5e", 197.0, 819.0, 200.0)),
-    ("v5e", DeviceSpec("v5e", 197.0, 819.0, 200.0)),
-    ("v5p", DeviceSpec("v5p", 459.0, 2765.0, 600.0)),
-    ("v4", DeviceSpec("v4", 275.0, 1228.0, 300.0)),
-    ("v6", DeviceSpec("v6e", 918.0, 1640.0, 448.0)),
+    ("v5 lite", DeviceSpec("v5e", 197.0, 819.0, 200.0, hbm_gib=16.0)),
+    ("v5e", DeviceSpec("v5e", 197.0, 819.0, 200.0, hbm_gib=16.0)),
+    ("v5p", DeviceSpec("v5p", 459.0, 2765.0, 600.0, hbm_gib=95.0)),
+    ("v4", DeviceSpec("v4", 275.0, 1228.0, 300.0, hbm_gib=32.0)),
+    ("v6", DeviceSpec("v6e", 918.0, 1640.0, 448.0, hbm_gib=32.0)),
 )
-_FALLBACK = DeviceSpec("v5e (assumed)", 197.0, 819.0, 200.0, known=False)
+_FALLBACK = DeviceSpec("v5e (assumed)", 197.0, 819.0, 200.0, known=False, hbm_gib=16.0)
 
 
 def device_specs(device_kind: str) -> DeviceSpec:

@@ -48,6 +48,12 @@ from automodel_tpu.observability.hlo_costs import (
     scope_output_bytes,
 )
 from automodel_tpu.observability.memory import device_memory_stats
+from automodel_tpu.observability.memory_plan import (
+    MemoryPlan,
+    compiled_memory_attribution,
+    reconcile,
+)
+from automodel_tpu.observability.oom import OOMFlightRecorder, is_oom_error
 from automodel_tpu.observability.profiling import OnDemandProfiler
 from automodel_tpu.observability.watchdog import StallWatchdog
 
@@ -74,17 +80,25 @@ class ObservabilityConfig:
     enabled: bool = True
     goodput: bool = True
     memory: bool = True
+    oom_report: bool = True  # OOM flight recorder (needs memory pillar on)
+    oom_keep_rows: int = 20  # metric rows kept for the crash artifact
+    hbm_limit_gib: float | None = None  # per-chip capacity override (mem plan)
     hlo_costs: bool = True
     timeline: bool = True
     timeline_max_events: int = 20000
     aggregate: bool = True
     straggler_factor: float = 2.0
+    oom_risk_gib: float = 1.0  # flag a host when its headroom drops below this
     watchdog: bool = True
     watchdog_threshold_s: float = 600.0
     watchdog_poll_interval_s: float | None = None
     profiler_port: int = 0  # 0 = no profiler server
     trace_steps: int = 5
     trace_signal: str | None = "SIGUSR1"  # None/"none" = no signal handler
+    auto_trace: bool = True  # stall/excursion anomalies arm the profiler
+    auto_trace_max: int = 1  # per-run budget of anomaly-triggered traces
+    excursion_factor: float = 3.0  # step_time > factor x rolling median fires
+    excursion_min_samples: int = 5  # dt samples before excursions are judged
 
     @classmethod
     def from_dict(cls, raw: Any) -> "ObservabilityConfig":
@@ -95,8 +109,19 @@ class ObservabilityConfig:
             raw = raw.to_dict()
         raw = dict(raw)
         kw: dict[str, Any] = {
-            k: raw[k] for k in ("enabled", "goodput", "memory", "hlo_costs") if k in raw
+            k: raw[k] for k in ("enabled", "goodput", "hlo_costs") if k in raw
         }
+        mem = raw.get("memory")
+        if isinstance(mem, bool):
+            kw["memory"] = mem
+        elif isinstance(mem, dict):
+            kw["memory"] = bool(mem.get("enabled", True))
+            if "oom_report" in mem:
+                kw["oom_report"] = bool(mem["oom_report"])
+            if mem.get("oom_keep_rows") is not None:
+                kw["oom_keep_rows"] = int(mem["oom_keep_rows"])
+            if mem.get("hbm_limit_gib") is not None:
+                kw["hbm_limit_gib"] = float(mem["hbm_limit_gib"])
         tl = raw.get("timeline")
         if isinstance(tl, bool):
             kw["timeline"] = tl
@@ -111,6 +136,8 @@ class ObservabilityConfig:
             kw["aggregate"] = bool(agg.get("enabled", True))
             if agg.get("straggler_factor") is not None:
                 kw["straggler_factor"] = float(agg["straggler_factor"])
+            if agg.get("oom_risk_gib") is not None:
+                kw["oom_risk_gib"] = float(agg["oom_risk_gib"])
         wd = raw.get("watchdog")
         if isinstance(wd, bool):
             kw["watchdog"] = wd
@@ -125,6 +152,14 @@ class ObservabilityConfig:
             kw["profiler_port"] = int(prof.get("server_port", 0))
             kw["trace_steps"] = int(prof.get("trace_steps", 5))
             kw["trace_signal"] = prof.get("signal", "SIGUSR1")
+            if "auto_trace" in prof:
+                kw["auto_trace"] = bool(prof["auto_trace"])
+            if prof.get("auto_trace_max") is not None:
+                kw["auto_trace_max"] = int(prof["auto_trace_max"])
+            if prof.get("excursion_factor") is not None:
+                kw["excursion_factor"] = float(prof["excursion_factor"])
+            if prof.get("excursion_min_samples") is not None:
+                kw["excursion_min_samples"] = int(prof["excursion_min_samples"])
         return cls(**kw)
 
     def resolve_signal(self) -> int | None:
@@ -204,9 +239,18 @@ class Observability:
         self.compile_counts = {"aot": 0, "jit_fallback": 0, "aot_demoted": 0}
         self._metric_sink = metric_sink
         self._step_t0: float | None = None
+        # analytic HBM plan (set by the recipe once params/opt_state exist);
+        # compile_step reconciles it against memory_analysis()
+        self.memory_plan: MemoryPlan | None = None
+        # anomaly-triggered profiling: per-run budget + step-time history
+        self._auto_traces = 0
+        self._dt_history: list[float] = []
         on = config.enabled
         self.goodput: GoodputTracker | None = GoodputTracker() if on and config.goodput else None
         self._memory = on and config.memory
+        self.oom: OOMFlightRecorder | None = None
+        if on and config.memory and config.oom_report:
+            self.oom = OOMFlightRecorder(self.out_dir, keep_rows=config.oom_keep_rows)
         self.timeline: TraceTimeline | None = None
         if on and config.timeline:
             import jax
@@ -217,14 +261,18 @@ class Observability:
                                           max_events=config.timeline_max_events)
         self.aggregator: CrossHostAggregator | None = None
         if on and config.aggregate:
-            self.aggregator = CrossHostAggregator(config.straggler_factor)
+            self.aggregator = CrossHostAggregator(
+                config.straggler_factor, oom_risk_gib=config.oom_risk_gib)
         self.watchdog: StallWatchdog | None = None
         if on and config.watchdog:
-            on_stall = None
-            if metric_sink is not None:
-                def on_stall(event: dict, _sink=metric_sink):
-                    _sink(int(event.get("step") or 0),
-                          **{k: v for k, v in event.items() if k != "step"})
+            def on_stall(event: dict, _sink=metric_sink):
+                step = int(event.get("step") or 0)
+                if _sink is not None:
+                    _sink(step, **{k: v for k, v in event.items() if k != "step"})
+                # a stalled run is exactly when a trace is worth its cost:
+                # arm the profiler so the NEXT step (if the run unwedges)
+                # captures what the device was doing
+                self.auto_trace("stall", step, stall_s=event.get("stall_s"))
             self.watchdog = StallWatchdog(
                 threshold_s=config.watchdog_threshold_s,
                 dump_dir=self.out_dir,
@@ -325,6 +373,28 @@ class Observability:
                     row["roofline_t_moe_a2a_s"] = round(roof["roofline_t_moe_a2a_s"], 6)
                 row["roofline_bound"] = roof["roofline_bound"]
                 row["roofline_spec"] = roof["roofline_spec"]
+            if self._memory:
+                # the memory pillar's compile-time half: XLA's own byte
+                # attribution, reconciled against the analytic plan when the
+                # recipe provided one (mem_plan/recon_rel_err)
+                attribution = compiled_memory_attribution(compiled)
+                if attribution:
+                    if self.memory_plan is not None:
+                        row.update(reconcile(self.memory_plan, attribution))
+                        if self.oom is not None:
+                            self.oom.set_plan_row(self.memory_plan.header_row())
+                    else:
+                        row.update({f"mem/{k}_gib": round(v / 2**30, 4)
+                                    for k, v in attribution.items()})
+                if self.timeline is not None and self.memory_plan is not None:
+                    plan = self.memory_plan
+                    self.timeline.counter(
+                        "hbm_plan_gib",
+                        params=round(plan.params_bytes / 2**30, 6),
+                        opt=round(plan.opt_bytes / 2**30, 6),
+                        batch=round(plan.batch_bytes / 2**30, 6),
+                        act_est=round(plan.act_est_bytes / 2**30, 6),
+                    )
             row["cost_extract_s"] = round(time.perf_counter() - t0, 3)
             self.compile_counts["aot"] += 1
             row["compile_aot_total"] = self.compile_counts["aot"]
@@ -417,6 +487,64 @@ class Observability:
         }
         self.timeline.instant(str(name), cat="event", step=step, **args)
 
+    # -------------------------------------------------------------- auto-trace
+    def auto_trace(self, reason: str, step: int, **info: Any) -> bool:
+        """Arm a throttled anomaly-triggered trace; True when actually armed.
+
+        The throttle is a hard per-run budget (``auto_trace_max``): one
+        anomaly explains itself with one trace, and a run degenerating every
+        step must not fill the disk with xprof dumps. Requests while a trace
+        is open or already armed coalesce (the profiler handles that); a
+        manual SIGUSR1 is never budgeted — only anomaly triggers are.
+        """
+        if (self.profiler is None or not self.config.auto_trace
+                or self._auto_traces >= self.config.auto_trace_max):
+            return False
+        if self.profiler.tracing or self.profiler.armed:
+            return False
+        self._auto_traces += 1
+        self.profiler.request_trace()
+        logger.warning("anomaly (%s) armed an auto-trace at step %d (%d/%d this run)",
+                       reason, step, self._auto_traces, self.config.auto_trace_max)
+        if self.timeline is not None:
+            self.timeline.instant("auto_trace", cat="event", step=step,
+                                  reason=reason, **info)
+        if self._metric_sink is not None:
+            self._metric_sink(step, event="auto_trace", auto_trace_reason=reason)
+        return True
+
+    def note_step_time(self, step: int, step_time_s: float | None) -> None:
+        """The in-run regression detector: a step-time excursion beyond
+        ``excursion_factor`` x the rolling median arms an auto-trace. Fed by
+        the recipe at every log step with the same dt the row carries."""
+        if step_time_s is None or step_time_s <= 0:
+            return
+        hist = self._dt_history
+        if len(hist) >= self.config.excursion_min_samples:
+            med = sorted(hist)[len(hist) // 2]
+            if med > 0 and step_time_s > self.config.excursion_factor * med:
+                self.auto_trace("step_time_excursion", step,
+                                step_time_s=round(step_time_s, 4),
+                                median_s=round(med, 4))
+        hist.append(float(step_time_s))
+        if len(hist) > 64:  # rolling window; excursions are vs recent history
+            del hist[0]
+
+    # ------------------------------------------------------------------- OOM
+    def record_row(self, step: int, row: dict[str, Any]) -> None:
+        """Feed the OOM flight recorder's ring of recent metric rows."""
+        if self.oom is not None:
+            self.oom.record_row(step, row)
+
+    def maybe_dump_oom(self, exc: BaseException, step: int | None = None) -> str | None:
+        """Write ``oom_report.json`` when ``exc`` is an allocator exhaustion;
+        returns the report path (the caller re-raises either way)."""
+        if self.oom is None or not is_oom_error(exc):
+            return None
+        if self.oom._plan_row is None and self.memory_plan is not None:
+            self.oom.set_plan_row(self.memory_plan.header_row())
+        return self.oom.dump(exc, step=step)
+
     # ------------------------------------------------------------------ log rows
     def step_metrics(self) -> dict[str, Any]:
         """The per-log-row contribution: compile time, goodput fractions, HBM."""
@@ -426,7 +554,15 @@ class Observability:
         if self.goodput is not None:
             out.update(self.goodput.snapshot())
         if self._memory:
-            out.update(device_memory_stats())
+            stats = device_memory_stats()
+            out.update(stats)
+            if stats and self.timeline is not None:
+                # Perfetto counter track: HBM over the run's wall clock
+                self.timeline.counter(
+                    "hbm_gib",
+                    in_use=stats.get("hbm_gib_in_use"),
+                    peak=stats.get("hbm_gib_peak"),
+                )
         return out
 
     def roofline_row(self, step_time_s: float | None) -> dict[str, Any]:
@@ -467,12 +603,22 @@ class Observability:
             self.aggregator = CrossHostAggregator(
                 self.aggregator.straggler_factor, keys=MOE_HOST_KEYS,
                 allgather_fn=self.aggregator._allgather,
-                process_count=self.aggregator.process_count)
+                process_count=self.aggregator.process_count,
+                oom_risk_gib=self.aggregator.oom_risk_gib)
         sample: dict[str, Any] = {"step_time_s": step_time_s}
         if self.goodput is not None:
             sample["data_wait_s"] = round(self.goodput.totals().get("data_wait", 0.0), 4)
         if self._memory:
-            sample["hbm_gib_peak"] = device_memory_stats().get("hbm_gib_peak")
+            stats = device_memory_stats()
+            sample["hbm_gib_peak"] = stats.get("hbm_gib_peak")
+            # allocator headroom when the platform reports it; the analytic
+            # plan's otherwise — either way the pod's worst host is what the
+            # oom_risk flag needs, and NaN travels where neither is known
+            headroom = stats.get("hbm_headroom_gib")
+            if headroom is None and self.memory_plan is not None:
+                hb = self.memory_plan.headroom_bytes
+                headroom = round(hb / 2**30, 4) if hb is not None else None
+            sample["hbm_headroom_gib"] = headroom
         if moe_max_util is not None:
             sample["moe_max_util"] = float(moe_max_util)
         out = self.aggregator.aggregate(sample)

@@ -16,10 +16,17 @@ __all__ = ["device_memory_stats"]
 
 
 def device_memory_stats(devices=None) -> dict[str, float]:
-    """Max in-use/peak HBM over ``devices`` (default: local), {} when unavailable."""
+    """Max in-use/peak HBM over ``devices`` (default: local), {} when unavailable.
+
+    When the allocator also reports ``bytes_limit``, the MINIMUM limit and the
+    derived ``hbm_headroom_gib`` (tightest limit minus highest in-use — the
+    pessimistic pairing, since the chip closest to its limit is the one that
+    OOMs) join the dict; runtimes without a limit simply omit those keys.
+    """
     devs = list(devices) if devices is not None else jax.local_devices()
     in_use: list[int] = []
     peak: list[int] = []
+    limit: list[int] = []
     for d in devs:
         try:
             stats = d.memory_stats()
@@ -31,9 +38,15 @@ def device_memory_stats(devices=None) -> dict[str, float]:
             in_use.append(int(stats["bytes_in_use"]))
         if stats.get("peak_bytes_in_use") is not None:
             peak.append(int(stats["peak_bytes_in_use"]))
+        if stats.get("bytes_limit"):
+            limit.append(int(stats["bytes_limit"]))
     out: dict[str, float] = {}
     if in_use:
         out["hbm_gib_in_use"] = round(max(in_use) / 2**30, 3)
     if peak:
         out["hbm_gib_peak"] = round(max(peak) / 2**30, 3)
+    if limit:
+        out["hbm_gib_limit"] = round(min(limit) / 2**30, 3)
+        if in_use:
+            out["hbm_headroom_gib"] = round((min(limit) - max(in_use)) / 2**30, 3)
     return out

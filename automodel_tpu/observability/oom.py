@@ -1,0 +1,162 @@
+"""OOM flight recorder: turn a bare RESOURCE_EXHAUSTED into a crash artifact.
+
+An OOM on a long multi-host run is the most expensive kind of failure to
+debug from nothing: the process dies with one allocator line, the buffers
+are gone, and the next attempt costs a full requeue. The recorder keeps the
+cheap context continuously (a ring of the last N metric rows) and harvests
+the expensive context at the moment of death — the live-buffer inventory
+from ``jax.live_arrays()`` grouped by (shape, dtype, sharding), per-device
+allocator counters, and the memory plan — into ``out_dir/oom_report.json``,
+then the train loop re-raises so orchestration still sees the failure.
+
+The report answers the three OOM questions without a repro: *what was
+resident* (inventory: is it params, optimizer moments, a leaked eval batch,
+double-buffered stacks?), *what was the budget* (memory plan + bytes_limit),
+and *what was the run doing* (last rows: was step time or hbm_gib_peak
+creeping before the kill?).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import time
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["is_oom_error", "live_buffer_inventory", "OOMFlightRecorder"]
+
+# substrings that mark an allocator exhaustion across backends/versions:
+# XLA status code ("RESOURCE_EXHAUSTED: Out of memory allocating ..."),
+# the TPU runtime's phrasing, and the BFC allocator's.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """True when ``exc`` (or anything on its cause/context chain) is an
+    allocator exhaustion. Matching is textual — jaxlib raises one
+    ``XlaRuntimeError`` type for every status code."""
+    seen: set[int] = set()
+    node: BaseException | None = exc
+    while node is not None and id(node) not in seen:
+        seen.add(id(node))
+        text = f"{type(node).__name__}: {node}"
+        if any(marker in text for marker in _OOM_MARKERS):
+            return True
+        node = node.__cause__ or node.__context__
+    return False
+
+
+def live_buffer_inventory(max_groups: int = 50) -> dict[str, Any]:
+    """Group ``jax.live_arrays()`` by (shape, dtype, sharding) — the census
+    of what was resident when the allocator gave up.
+
+    Per-group: count, per-device shard bytes, and the group's total GiB
+    (count x shard bytes — the device-local footprint). Sorted by total
+    descending and truncated to ``max_groups`` with the tail summarized, so
+    a run with thousands of small buffers still produces a readable report.
+    """
+    import jax
+
+    from automodel_tpu.observability.memory_plan import _leaf_shard_bytes
+
+    groups: dict[tuple, dict[str, Any]] = {}
+    n_arrays = 0
+    for arr in jax.live_arrays():
+        try:
+            shape = tuple(int(d) for d in arr.shape)
+            dtype = str(arr.dtype)
+            sharding = str(getattr(arr, "sharding", None))
+            shard_bytes = _leaf_shard_bytes(arr)
+        except Exception:  # a deleted/donated buffer mid-iteration
+            continue
+        n_arrays += 1
+        g = groups.setdefault((shape, dtype, sharding), {
+            "shape": list(shape), "dtype": dtype, "sharding": sharding,
+            "count": 0, "shard_bytes": shard_bytes,
+        })
+        g["count"] += 1
+    rows = sorted(groups.values(),
+                  key=lambda g: g["count"] * g["shard_bytes"], reverse=True)
+    for g in rows:
+        g["total_gib"] = round(g["count"] * g["shard_bytes"] / 2**30, 6)
+    kept, tail = rows[:max_groups], rows[max_groups:]
+    out: dict[str, Any] = {
+        "live_arrays": n_arrays,
+        "groups": kept,
+        "total_gib": round(sum(g["count"] * g["shard_bytes"] for g in rows) / 2**30, 6),
+    }
+    if tail:
+        out["truncated_groups"] = len(tail)
+        out["truncated_gib"] = round(
+            sum(g["count"] * g["shard_bytes"] for g in tail) / 2**30, 6)
+    return out
+
+
+def _per_device_stats() -> list[dict[str, Any]]:
+    import jax
+
+    out = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        out.append({
+            "id": int(d.id),
+            "kind": str(d.device_kind),
+            "stats": {k: int(v) for k, v in (stats or {}).items()
+                      if isinstance(v, (int, float))},
+        })
+    return out
+
+
+class OOMFlightRecorder:
+    """Continuously cheap, expensive only at the crash.
+
+    ``record_row`` costs one deque append per log step; ``dump`` walks the
+    live buffers exactly once, when the run is already dead. ``dump`` never
+    raises — a failure to write the report must not mask the original OOM.
+    """
+
+    def __init__(self, out_dir: str, keep_rows: int = 20):
+        self.out_dir = str(out_dir)
+        self.report_path = os.path.join(self.out_dir, "oom_report.json")
+        self._rows: collections.deque = collections.deque(maxlen=max(int(keep_rows), 1))
+        self._plan_row: dict[str, Any] | None = None
+
+    def set_plan_row(self, row: dict[str, Any] | None) -> None:
+        """The mem_plan/* header keys, carried into any future report."""
+        self._plan_row = dict(row) if row else None
+
+    def record_row(self, step: int, row: dict[str, Any]) -> None:
+        """Ring-buffer one metric row (the same dict the loggers saw)."""
+        self._rows.append({"step": int(step), **row})
+
+    def dump(self, exc: BaseException, step: int | None = None) -> str | None:
+        """Write ``oom_report.json``; returns its path, or None on failure."""
+        try:
+            report: dict[str, Any] = {
+                "oom_report": True,
+                "time_unix": time.time(),
+                "step": step,
+                "error": {"type": type(exc).__name__, "message": str(exc)[:4000]},
+                "memory_plan": self._plan_row or {},
+                "devices": _per_device_stats(),
+                "live_buffers": live_buffer_inventory(),
+                "last_rows": list(self._rows),
+            }
+            os.makedirs(self.out_dir, exist_ok=True)
+            tmp = f"{self.report_path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(report, f, indent=2, default=str)
+                f.write("\n")
+            os.replace(tmp, self.report_path)
+            logger.error("OOM flight recorder: report written to %s", self.report_path)
+            return self.report_path
+        except Exception:
+            logger.exception("OOM flight recorder failed (original error re-raised)")
+            return None

@@ -82,7 +82,15 @@ class OnDemandProfiler:
         self._requested = True
 
     def on_step_start(self, step: int) -> None:
-        if not self._requested or self._tracing:
+        if self._tracing:
+            if self._requested:
+                # a re-arm (signal or auto-trace) landed while a window is
+                # already open: the open trace covers "now", so the request
+                # coalesces into it instead of queueing a second window
+                self._requested = False
+                logger.info("trace request coalesced into the open window")
+            return
+        if not self._requested:
             return
         self._requested = False
         path = os.path.join(self.profile_dir, f"step_{step:06d}")
@@ -109,6 +117,7 @@ class OnDemandProfiler:
         logger.info("on-demand trace written under %s", self.profile_dir)
 
     def close(self) -> None:
+        """Idempotent: safe to call any number of times, from any teardown path."""
         if self._tracing:
             try:
                 jax.profiler.stop_trace()
@@ -116,7 +125,19 @@ class OnDemandProfiler:
                 logger.exception("trace still open at close; stop failed")
             self._tracing = False
         if self._handler_installed:
-            signal.signal(self.signum, self._prev_handler or signal.SIG_DFL)
-            self._handler_installed = False
+            # `is not None`, not truthiness: SIG_DFL is 0 (falsy) and a
+            # C-installed handler comes back as None — both must restore
+            # faithfully, and SIG_IGN (a disposition daemonized jobs often
+            # inherit) must come back as SIG_IGN, not SIG_DFL
+            prev = self._prev_handler if self._prev_handler is not None else signal.SIG_DFL
+            try:
+                signal.signal(self.signum, prev)
+            except (ValueError, OSError):
+                # restoring from a non-main thread (interpreter teardown
+                # paths) raises ValueError; the process is exiting anyway
+                logger.warning("could not restore previous %s handler", self.signum)
+            finally:
+                self._handler_installed = False
+                self._prev_handler = None
         self._requested = False
         # no public stop for the profiler server; it lives for the process

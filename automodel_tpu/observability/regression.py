@@ -37,9 +37,21 @@ __all__ = [
     "main",
 ]
 
-DEFAULT_TOLERANCES = {"tps": 0.05, "mfu": 0.05, "step_time_s": 0.05, "goodput": 0.05}
-# regression direction: True = lower is a regression, False = higher is
-HIGHER_IS_BETTER = {"tps": True, "mfu": True, "goodput": True, "step_time_s": False}
+DEFAULT_TOLERANCES = {"tps": 0.05, "mfu": 0.05, "step_time_s": 0.05, "goodput": 0.05,
+                      "hbm_gib_peak": 0.05, "hbm_headroom_gib": 0.05}
+# regression direction: True = lower is a regression, False = higher is.
+# Memory gates both ways: peak HBM regresses by RISING (a model change that
+# quietly grows the footprint eats the retry margin long before it OOMs),
+# headroom regresses by DROPPING.
+HIGHER_IS_BETTER = {"tps": True, "mfu": True, "goodput": True, "step_time_s": False,
+                    "hbm_gib_peak": False, "hbm_headroom_gib": True}
+
+
+def _metric_basename(metric: str) -> str:
+    """Direction/tolerance lookup key for namespaced metrics: the last path
+    segment, so ``matrix/gpt_s1024_pfon/hbm_gib_peak`` gates with the same
+    direction and default tolerance as a bare ``hbm_gib_peak``."""
+    return metric.rsplit("/", 1)[-1]
 
 
 def _median(vals: list[float]) -> float:
@@ -55,6 +67,7 @@ def summarize_rows(rows: Iterable[dict[str, Any]]) -> dict[str, float]:
     ``tps`` — the compile window logs null) so one GC hiccup or the warmup row
     can't decide the gate; ``goodput`` takes the last row (it is cumulative).
     """
+    rows = list(rows)
     metric_rows = [r for r in rows if "loss" in r]
     out: dict[str, float] = {}
     for key in ("tps", "mfu", "step_time_s"):
@@ -64,6 +77,16 @@ def summarize_rows(rows: Iterable[dict[str, Any]]) -> dict[str, float]:
     goodputs = [r["goodput"] for r in metric_rows if r.get("goodput") is not None]
     if goodputs:
         out["goodput"] = float(goodputs[-1])
+    # memory gates: peak is the run's high-water (max, not median — a single
+    # eval-step spike IS the number the allocator has to survive); planned
+    # headroom rides the run_header row, so scan all rows for it
+    peaks = [float(r["hbm_gib_peak"]) for r in metric_rows
+             if r.get("hbm_gib_peak") is not None]
+    if peaks:
+        out["hbm_gib_peak"] = max(peaks)
+    for r in rows:
+        if r.get("mem_plan/hbm_headroom_gib") is not None:
+            out["hbm_headroom_gib"] = float(r["mem_plan/hbm_headroom_gib"])
     return out
 
 
@@ -100,6 +123,8 @@ def _from_matrix_rows(rows: Iterable[dict[str, Any]]) -> dict[str, float]:
             out[f"{key}/tps"] = float(row["tokens_per_sec_per_chip"])
         if row.get("moe/tokens_per_sec_per_chip") is not None:
             out[f"{key}/moe_tps"] = float(row["moe/tokens_per_sec_per_chip"])
+        if row.get("hbm_gib_peak") is not None:
+            out[f"{key}/hbm_gib_peak"] = float(row["hbm_gib_peak"])
     return out
 
 
@@ -191,19 +216,33 @@ def compare(run: dict[str, float], baseline: dict[str, float],
     passes unless listed in ``require`` (a CPU run has no meaningful mfu, but
     a gate explicitly about tps must not pass on an empty artifact).
     """
-    tols = dict(DEFAULT_TOLERANCES)
-    tols.update(tolerances or {})
+    user_tols = dict(tolerances or {})
+    user_default = user_tols.pop("default", None)
     required = set(require)
     out: list[Comparison] = []
-    default_tol = tols.get("default", 0.05)
     for metric, base in sorted(baseline.items()):
-        tol = tols.get(metric, default_tol)
+        basename = _metric_basename(metric)
+        # Tolerance precedence: caller's exact key > built-in exact key >
+        # caller's basename > caller's "default" > built-in basename > 5%.
+        # A widened CLI default (CPU timing jitter) must still reach
+        # namespaced cells the built-ins only know by basename — but never
+        # override a metric the caller named explicitly.
+        if metric in user_tols:
+            tol = user_tols[metric]
+        elif metric in DEFAULT_TOLERANCES:
+            tol = DEFAULT_TOLERANCES[metric]
+        elif basename in user_tols:
+            tol = user_tols[basename]
+        elif user_default is not None:
+            tol = user_default
+        else:
+            tol = DEFAULT_TOLERANCES.get(basename, 0.05)
         got = run.get(metric)
         if got is None or base == 0:
             out.append(Comparison(metric, got, base, None, tol,
                                   ok=metric not in required))
             continue
-        if HIGHER_IS_BETTER.get(metric, True):
+        if HIGHER_IS_BETTER.get(metric, HIGHER_IS_BETTER.get(basename, True)):
             change = (base - got) / abs(base)  # positive = slower/worse
         else:
             change = (got - base) / abs(base)

@@ -252,6 +252,24 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self.observability.mesh_axes = {
             str(name): int(size) for name, size in self.mesh.shape.items()
         }
+        # analytic HBM plan: the sharded params/opt_state give exact per-shard
+        # bytes and the config gives batch/activation estimates, so the
+        # headroom/fits verdict exists BEFORE the first compile; compile_step
+        # later reconciles it against the compiled step's memory_analysis()
+        from automodel_tpu.observability.memory_plan import build_memory_plan
+
+        try:
+            self.observability.memory_plan = build_memory_plan(
+                self.train_params, self.opt_state,
+                micro_batch_size=self.micro_batch_size, seq_len=self.seq_len,
+                grad_acc_steps=int(ss["grad_acc_steps"]),
+                dp_degree=self.mesh_ctx.dp_size,
+                model_config=getattr(self, "hf_config", None) or self.model.config,
+                hbm_limit_override_gib=self.observability.config.hbm_limit_gib,
+            )
+        except Exception:
+            logger.warning("analytic memory plan failed (run continues)",
+                           exc_info=True)
         # moe/* telemetry rows (routing entropy, utilization spread, dropped
         # tokens, aux-loss trend); None on dense runs
         from automodel_tpu.observability.moe_stats import MoEStats, local_expert_coords
@@ -283,11 +301,15 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         model_id = cfg.get("model.pretrained_model_name_or_path") or arch or "scratch"
         from automodel_tpu.observability import compile_cache
 
+        plan = self.observability.memory_plan
         self.metric_logger.log_header(**build_run_header(
             cfg=cfg, mesh=self.mesh, model_id=model_id, seq_len=self.seq_len,
             # persistent-XLA-cache config + hit/miss traffic from the
             # model-init compiles (run totals land in compile_summary)
             compile_cache=compile_cache.snapshot(),
+            # the fit-before-run verdict: a header reader (or a human tailing
+            # the stream) sees whether this config fits its chip before step 0
+            **(plan.header_row() if plan is not None else {}),
         ))
 
         # the jitted step
@@ -743,6 +765,14 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     if outcome != "preempted":
                         self._save(self.step_scheduler.step)
                     self.checkpointer.wait()
+        except BaseException as exc:
+            # OOM flight recorder: when the failure is an allocator
+            # exhaustion, harvest the live-buffer census + memory plan +
+            # per-device counters into oom_report.json while the buffers
+            # still exist, then re-raise — orchestration must still see the
+            # original failure
+            obs.maybe_dump_oom(exc, step=self.step_scheduler.step)
+            raise
         finally:
             # run-total AOT/jit-fallback/demotion + compile-cache traffic (the
             # run_header only sees the setup-time counts)
@@ -1010,6 +1040,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 self.metric_logger.log(step, **row)
                 for lg in self.experiment_loggers:
                     lg.log(step, **row)
+                # the same row feeds the OOM flight recorder's ring (context
+                # for a future crash report) and the excursion detector (a
+                # step-time spike beyond the rolling median arms an auto-trace)
+                obs.record_row(step, row)
+                obs.note_step_time(step, dt)
                 logger.info(
                     "step %d | loss %.4f | gnorm %.3f | %s", step, loss, gnorm,
                     f"{step_tokens / dt:.0f} tok/s" if dt else "compile step",

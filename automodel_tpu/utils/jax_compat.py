@@ -120,6 +120,17 @@ def install() -> None:
     import jax
     import jax.sharding
 
+    # jax>=0.5 defaults threefry to the partitionable implementation; 0.4.37
+    # still defaults it off, where a jitted jax.random.* with sharded
+    # out_shardings produces LAYOUT-DEPENDENT values (each shard counts a
+    # local iota). That made model init differ between mesh shapes — e.g.
+    # lm_head under dp_shard=4 vs dp_replicate=2,dp_shard=2 — so HSDP and
+    # FSDP trajectories diverged from step 1. Partitionable threefry is
+    # sharding-invariant by construction, matching the semantics the code
+    # is written against.
+    if not jax.config.jax_threefry_partitionable:
+        jax.config.update("jax_threefry_partitionable", True)
+
     global SHIMMED
     if not hasattr(jax, "shard_map"):
         SHIMMED = True

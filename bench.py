@@ -361,6 +361,8 @@ def _matrix_cell(kind: str, nominal_seq: int, cpu: bool) -> list[dict]:
         collective_bytes,
         collective_bytes_by_axis,
     )
+    from automodel_tpu.observability.memory import device_memory_stats
+    from automodel_tpu.observability.memory_plan import compiled_memory_attribution
     from automodel_tpu.ops.losses import masked_cross_entropy
     from automodel_tpu.training.step_scheduler import StepScheduler
     from automodel_tpu.training.train_step import make_train_step
@@ -410,6 +412,13 @@ def _matrix_cell(kind: str, nominal_seq: int, cpu: bool) -> list[dict]:
         a2a_share = round(moe_a2a / total, 4) if total else 0.0
     except Exception:  # noqa: BLE001 — a2a share is best-effort decoration
         pass
+    # memory-analysis peak: XLA's own args+out+temp-alias attribution of the
+    # compiled step — available on every backend, deterministic for a given
+    # (model, seq, batch), and the CPU fallback for the hbm_gib_peak gate key
+    # where no allocator counters exist
+    attribution = compiled_memory_attribution(compiled)
+    compiled_peak_gib = (round(attribution["peak_est"] / 2**30, 4)
+                         if attribution else None)
 
     def collate(samples):
         # MockSFTDataset emits seq_len + 1 ids (next-token shift headroom);
@@ -459,6 +468,16 @@ def _matrix_cell(kind: str, nominal_seq: int, cpu: bool) -> list[dict]:
             "tokens_per_sec_per_chip": round(
                 done * micro_batch * seq_len / dt / devices, 1),
         }
+        # gate key: measured allocator high-water where the platform has one
+        # (TPU), else the compiled-step estimate — the source rides along so
+        # a baseline from one never silently gates a run from the other
+        mem_stats = device_memory_stats()
+        if mem_stats.get("hbm_gib_peak") is not None:
+            row["hbm_gib_peak"] = mem_stats["hbm_gib_peak"]
+            row["hbm_source"] = "device"
+        elif compiled_peak_gib is not None:
+            row["hbm_gib_peak"] = compiled_peak_gib
+            row["hbm_source"] = "compiled"
         if cpu:
             row["fallback"] = "cpu"
             row["measured_seq_len"] = seq_len

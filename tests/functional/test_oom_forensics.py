@@ -1,0 +1,175 @@
+"""OOM flight recorder and anomaly-triggered auto-trace, end to end: a real
+recipe run on the 8-device mesh whose step executor dies with a
+RESOURCE_EXHAUSTED after real steps must leave a complete ``oom_report.json``
+behind (and still re-raise); a simulated step-time excursion must produce
+exactly one throttled trace directory under ``profiles/``."""
+
+import json
+import textwrap
+
+import pytest
+
+from automodel_tpu.config.loader import load_config
+from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+
+
+def _write_cfg(tmp_path, extra=""):
+    cfg = f"""
+    seed: 7
+    output_dir: {tmp_path}/out
+    model:
+      config:
+        architectures: [LlamaForCausalLM]
+        vocab_size: 128
+        hidden_size: 64
+        intermediate_size: 128
+        num_hidden_layers: 2
+        num_attention_heads: 4
+        num_key_value_heads: 2
+        max_position_embeddings: 128
+    distributed:
+      dp_shard: 4
+      tp: 2
+    backend:
+      dtype: float32
+    dataset:
+      _target_: automodel_tpu.data.llm.mock.MockSFTDataset
+      vocab_size: 128
+      seq_len: 32
+      num_samples: 128
+      seed: 0
+    micro_batch_size: 8
+    seq_len: 32
+    step_scheduler:
+      grad_acc_steps: 1
+      max_steps: 8
+      num_epochs: 10
+      handle_sigterm: false
+    optimizer:
+      lr: 1.0e-3
+    checkpoint:
+      enabled: false
+    {extra}
+    """
+    p = tmp_path / "cfg.yaml"
+    p.write_text(textwrap.dedent(cfg))
+    return p
+
+
+class TestOOMFlightRecorderE2E:
+    def test_forced_oom_leaves_complete_report_and_reraises(self, tmp_path, cpu_devices):
+        """Kill the run with an allocator-exhaustion error after two REAL
+        steps: the report must carry the memory plan, a live-buffer census,
+        per-device entries, and the metric rows the run actually logged —
+        and the original exception must still reach the caller."""
+        cfg = load_config(_write_cfg(
+            tmp_path, extra="observability:\n      memory:\n        hbm_limit_gib: 64\n"))
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+
+        real_step = recipe._train_step
+        calls = {"n": 0}
+
+        def dying_step(*args):  # plain function: compile_step falls back to jit
+            calls["n"] += 1
+            if calls["n"] >= 3:
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory allocating "
+                    "17179869184 bytes (simulated)")
+            return real_step(*args)
+
+        recipe._train_step = dying_step
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            recipe.run_train_validation_loop()
+
+        report = json.load(open(tmp_path / "out" / "oom_report.json"))
+        assert report["oom_report"] is True
+        assert report["error"]["type"] == "RuntimeError"
+        assert "RESOURCE_EXHAUSTED" in report["error"]["message"]
+        # the analytic plan rode along (hbm_limit_gib override => verdict too)
+        assert report["memory_plan"]["mem_plan/params_gib"] > 0
+        assert report["memory_plan"]["mem_plan/fits"] is True
+        # per-device entries for all 8 virtual devices (stats empty on CPU)
+        assert len(report["devices"]) == 8
+        # live-buffer census: params/opt_state were resident at the crash
+        assert report["live_buffers"]["live_arrays"] > 0
+        assert report["live_buffers"]["groups"]
+        assert report["live_buffers"]["total_gib"] >= 0
+        # the ring captured the real rows logged before death
+        assert report["last_rows"], "expected metric rows before the crash"
+        assert all("loss" in r for r in report["last_rows"])
+
+    def test_non_oom_failures_leave_no_report(self, tmp_path, cpu_devices):
+        cfg = load_config(_write_cfg(tmp_path))
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+
+        def dying_step(*args):
+            raise RuntimeError("INVALID_ARGUMENT: shapes do not match (simulated)")
+
+        recipe._train_step = dying_step
+        with pytest.raises(RuntimeError, match="INVALID_ARGUMENT"):
+            recipe.run_train_validation_loop()
+        assert not (tmp_path / "out" / "oom_report.json").exists()
+
+
+class TestAutoTraceE2E:
+    def test_excursion_produces_exactly_one_trace_dir(self, tmp_path, cpu_devices):
+        """Drive the manager's hooks the way the train loop does, with a real
+        profiler on the CPU backend: the step-time excursion arms a trace, the
+        next step opens a REAL trace window under out/profiles, and a second
+        excursion stays inside the per-run budget — exactly one capture."""
+        import jax.numpy as jnp
+
+        from automodel_tpu.observability import Observability, ObservabilityConfig
+
+        out = tmp_path / "run"
+        obs = Observability(ObservabilityConfig(
+            watchdog=False, aggregate=False, hlo_costs=False,
+            trace_steps=1, trace_signal=None,
+            excursion_factor=3.0, excursion_min_samples=3,
+        ), out_dir=str(out)).start()
+        try:
+            x = jnp.ones((8,))
+            for step in range(3):
+                obs.on_step_start(step)
+                obs.on_step_end(step, sync=x)
+                obs.note_step_time(step, 0.1)
+            assert not obs.profiler.armed
+            obs.note_step_time(3, 2.0)  # 20x the median: anomaly
+            assert obs.profiler.armed
+            # next steps: the armed request opens and closes a real window
+            for step in (4, 5):
+                obs.on_step_start(step)
+                obs.on_step_end(step, sync=x)
+            assert not obs.profiler.tracing
+            profile_dirs = sorted(p.name for p in (out / "profiles").iterdir())
+            assert profile_dirs == ["step_000004"]
+            # a later excursion must NOT buy a second trace (budget = 1)
+            obs.note_step_time(6, 3.0)
+            assert not obs.profiler.armed
+            for step in (7, 8):
+                obs.on_step_start(step)
+                obs.on_step_end(step, sync=x)
+            assert sorted(p.name for p in (out / "profiles").iterdir()) == [
+                "step_000004"]
+        finally:
+            obs.close()
+
+    def test_stall_event_arms_trace_and_logs_row(self, tmp_path, cpu_devices):
+        """The watchdog's on_stall callback routes through auto_trace: a
+        simulated stall event arms the profiler and emits the auto_trace
+        metric row through the sink."""
+        from automodel_tpu.observability import Observability, ObservabilityConfig
+
+        events = []
+        obs = Observability(
+            ObservabilityConfig(watchdog=False, aggregate=False,
+                                trace_signal=None),
+            out_dir=str(tmp_path),
+            metric_sink=lambda step, **f: events.append({"step": step, **f}),
+        ).start()
+        try:
+            assert obs.auto_trace("stall", 11, stall_s=630.0) is True
+            assert obs.profiler.armed
+            assert [e for e in events if e.get("event") == "auto_trace"]
+        finally:
+            obs.close()

@@ -103,6 +103,46 @@ def test_gate_passes_on_matching_run_and_fails_on_10pct_regression(train_run):
     assert "REGRESSION" in bad.stdout and "tps" in bad.stdout
 
 
+def test_mem_plan_keys_ride_run_header(train_run):
+    """The memory-plan smoke: a real recipe run's header must carry the full
+    ``mem_plan/*`` budget, and its compile_costs row the measured ``mem/*``
+    attribution — the keys the memory gate and OOM report build on."""
+    rows = [json.loads(line)
+            for line in open(train_run / "out" / "training.jsonl")]
+    h = [r for r in rows if r.get("run_header")][0]
+    for key in ("mem_plan/params_gib", "mem_plan/opt_gib", "mem_plan/batch_gib",
+                "mem_plan/act_est_gib", "mem_plan/total_gib"):
+        assert h[key] > 0, key
+    c = [r for r in rows if r.get("event") == "compile_costs"][0]
+    assert c["mem/args_gib"] > 0 and c["mem/peak_est_gib"] > 0
+    assert c["mem_plan/recon_rel_err"] is not None
+
+
+def test_gate_memory_keys_direction(tmp_path):
+    """hbm_gib_peak gates lower-is-better through the real CLI — including
+    matrix-namespaced cells, which resolve direction by basename."""
+    baseline = tmp_path / "b.json"
+    baseline.write_text(json.dumps({"metrics": {
+        "tps": 1000.0, "hbm_gib_peak": 10.0,
+        "matrix/dense_s2048_pfon/hbm_gib_peak": 3.0,
+    }}))
+    ok_run = tmp_path / "ok.json"
+    ok_run.write_text(json.dumps({"metrics": {
+        "tps": 1010.0, "hbm_gib_peak": 9.5,
+        "matrix/dense_s2048_pfon/hbm_gib_peak": 2.9,
+    }}))
+    assert _gate("--run", str(ok_run), "--baseline", str(baseline)).returncode == 0
+
+    bad_run = tmp_path / "bad.json"
+    bad_run.write_text(json.dumps({"metrics": {
+        "tps": 1010.0, "hbm_gib_peak": 12.0,  # footprint GREW 20%
+        "matrix/dense_s2048_pfon/hbm_gib_peak": 2.9,
+    }}))
+    bad = _gate("--run", str(bad_run), "--baseline", str(baseline))
+    assert bad.returncode == 1
+    assert "hbm_gib_peak" in bad.stdout
+
+
 def test_gate_reads_bench_json_line(train_run, tmp_path):
     """The gate accepts bench.py's one-line JSON as the run artifact."""
     line = {"ok": True, "metric": "tok/s", "value": 14380.0, "unit": "tokens/s/chip",

@@ -185,6 +185,40 @@ class TestTrainRecipeE2E:
         assert len(steps) == 6
         assert all(e["ph"] == "X" and e["dur"] > 0 for e in steps)
 
+    def test_memory_plan_rides_header_and_reconciles(self, base_run):
+        """The memory pillar's two halves on a real run: the analytic
+        ``mem_plan/*`` budget in the run_header (written BEFORE the first
+        compile), and the compile_costs row carrying XLA's measured ``mem/*``
+        attribution reconciled against it within the documented tolerance."""
+        from automodel_tpu.observability.memory_plan import RECON_TOLERANCE
+
+        raw = base_run["raw"]
+        h = [r for r in raw if r.get("run_header")][0]
+        assert h["mem_plan/params_gib"] > 0
+        assert h["mem_plan/opt_gib"] > 0
+        assert h["mem_plan/batch_gib"] > 0
+        assert h["mem_plan/act_est_gib"] > 0
+        assert h["mem_plan/total_gib"] == pytest.approx(
+            h["mem_plan/params_gib"] + h["mem_plan/opt_gib"]
+            + h["mem_plan/batch_gib"] + h["mem_plan/act_est_gib"], abs=5e-6)
+        # CPU: no allocator bytes_limit and no override => no verdict keys
+        assert "mem_plan/fits" not in h
+
+        c = [r for r in raw if r.get("event") == "compile_costs"][0]
+        assert c["mem/args_gib"] > 0 and c["mem/peak_est_gib"] > 0
+        # XLA's identity: peak = args + out + temp + code - alias
+        assert c["mem/peak_est_gib"] == pytest.approx(
+            c["mem/args_gib"] + c["mem/out_gib"] + c["mem/temp_gib"]
+            + c["mem/code_gib"] - c["mem/alias_gib"], abs=5e-6)
+        # the acceptance bar: analytic args (params+opt+batch) within the
+        # documented tolerance of what the compiled program actually takes
+        assert c["mem_plan/recon_rel_err"] <= RECON_TOLERANCE
+        # the hbm_plan_gib counter landed on the timeline at compile time
+        counters = [e for e in base_run["timeline"]["traceEvents"]
+                    if e["ph"] == "C" and e["name"] == "hbm_plan_gib"]
+        assert len(counters) == 1
+        assert counters[0]["args"]["params"] == h["mem_plan/params_gib"]
+
     def test_hsdp_matches_fsdp_trajectory(self, tmp_path, cpu_devices):
         """HSDP (dp_replicate=2 x dp_shard=2 x tp=2 — reference
         mesh_utils.py:173-190) end-to-end: params replicate across the replica

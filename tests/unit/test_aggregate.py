@@ -14,8 +14,8 @@ def _fake_allgather(rows):
     return lambda vec: [list(r) for r in rows]
 
 
-def _rows(n=8, step=0.5, wait=0.01, hbm=8.0):
-    return [[step, wait, hbm] for _ in range(n)]
+def _rows(n=8, step=0.5, wait=0.01, hbm=8.0, headroom=8.0):
+    return [[step, wait, hbm, headroom] for _ in range(n)]
 
 
 class TestAggregation:
@@ -61,7 +61,7 @@ class TestAggregation:
         assert out["host/step_time_s_median"] == 0.5
 
     def test_all_nan_key_omitted(self):
-        rows = [[0.5, 0.01, math.nan] for _ in range(8)]
+        rows = [[0.5, 0.01, math.nan, math.nan] for _ in range(8)]
         agg = CrossHostAggregator(allgather_fn=_fake_allgather(rows), process_count=8)
         out = agg.aggregate({"step_time_s": 0.5, "data_wait_s": 0.01, "hbm_gib_peak": None})
         assert "host/hbm_gib_peak_max" not in out
@@ -86,5 +86,8 @@ class TestActivation:
         assert agg.aggregate({"step_time_s": 0.5}) == {}
 
     def test_default_keys_order_matches_sample_packing(self):
-        # the wire format is positional: a key-order change is a protocol break
-        assert HOST_KEYS == ("step_time_s", "data_wait_s", "hbm_gib_peak")
+        # the wire format is positional: a key-order change is a protocol
+        # break (headroom joined the wire for the oom_risk flag — appended,
+        # never reordered, so mixed-version pods fail loudly on length)
+        assert HOST_KEYS == ("step_time_s", "data_wait_s", "hbm_gib_peak",
+                             "hbm_headroom_gib")

@@ -1,0 +1,544 @@
+"""Memory observability & forensics: the analytic HBM plan and its
+reconciliation against ``Compiled.memory_analysis()``, the OOM flight
+recorder, anomaly-triggered auto-tracing, the allocator-limit telemetry, the
+cross-host OOM-risk flag, and the direction-aware memory gate keys."""
+
+import json
+import math
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------- plan
+class TestMemoryPlan:
+    def test_tree_shard_bytes_counts_per_device_shards(self, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from automodel_tpu.observability.memory_plan import tree_shard_bytes
+
+        sharded = jax.device_put(
+            jnp.zeros((8, 16), jnp.float32),
+            NamedSharding(mesh8, P(("dp_shard", "cp"), "tp")),
+        )
+        replicated = jax.device_put(
+            jnp.zeros((4,), jnp.float32), NamedSharding(mesh8, P())
+        )
+        # sharded: (8/4) x (16/2) x 4B = 64; replicated: full 16B
+        assert tree_shard_bytes({"a": sharded, "b": replicated}) == 64 + 16
+
+    def test_tree_shard_bytes_abstract_leaves(self):
+        from automodel_tpu.observability.memory_plan import tree_shard_bytes
+
+        tree = {"w": jax.ShapeDtypeStruct((4, 4), jnp.bfloat16), "n": 3}
+        assert tree_shard_bytes(tree) == 4 * 4 * 2  # non-arrays count 0
+
+    def test_build_plan_analytic_math_and_fits_verdict(self):
+        from automodel_tpu.observability.memory_plan import (
+            ACTIVATION_BYTES_PER_TOKEN_LAYER,
+            build_memory_plan,
+        )
+
+        params = {"w": jnp.zeros((16, 16), jnp.float32)}  # 1024 B
+        opt = {"m": jnp.zeros((16, 16), jnp.float32)}  # 1024 B
+        cfg = {"hidden_size": 8, "num_hidden_layers": 2}
+        plan = build_memory_plan(
+            params, opt, micro_batch_size=4, seq_len=16, grad_acc_steps=2,
+            dp_degree=2, model_config=cfg, hbm_limit_override_gib=1.0,
+        )
+        assert plan.params_bytes == 1024 and plan.opt_bytes == 1024
+        # 2 acc x (4/2) batch x 16 seq x 4B x 4 streams
+        assert plan.batch_bytes == 2 * 2 * 16 * 4 * 4
+        # one live microbatch: (2 x 16) tokens x 8 hidden x 2 layers x 14 x 4B
+        assert plan.act_est_bytes == 2 * 16 * 8 * 2 * ACTIVATION_BYTES_PER_TOKEN_LAYER * 4
+        assert plan.hbm_limit_bytes == 2**30
+        assert plan.fits is True and plan.headroom_bytes > 0
+        row = plan.header_row()
+        assert row["mem_plan/total_gib"] == pytest.approx(
+            plan.total_bytes / 2**30, abs=1e-4)
+        assert row["mem_plan/fits"] is True
+        assert row["mem_plan/hbm_headroom_gib"] is not None
+
+    def test_plan_does_not_fit_tiny_override(self):
+        from automodel_tpu.observability.memory_plan import build_memory_plan
+
+        plan = build_memory_plan(
+            {"w": jnp.zeros((1024, 1024), jnp.float32)}, {},
+            micro_batch_size=1, seq_len=8,
+            hbm_limit_override_gib=0.001,  # 1 MiB < 4 MiB of params
+        )
+        assert plan.fits is False
+        assert plan.header_row()["mem_plan/fits"] is False
+
+    def test_unknown_limit_omits_verdict_keys(self):
+        from automodel_tpu.observability.memory_plan import build_memory_plan
+
+        class Cpu:
+            platform = "cpu"
+
+            def memory_stats(self):
+                return None
+
+        plan = build_memory_plan({}, {}, micro_batch_size=1, seq_len=8,
+                                 devices=[Cpu()])
+        assert plan.hbm_limit_bytes is None and plan.fits is None
+        row = plan.header_row()
+        assert "mem_plan/fits" not in row and "mem_plan/hbm_headroom_gib" not in row
+
+    def test_resolve_limit_priority(self):
+        from automodel_tpu.observability.memory_plan import resolve_hbm_limit_bytes
+
+        class WithLimit:
+            platform = "tpu"
+            device_kind = "TPU v5e"
+
+            def __init__(self, limit):
+                self._limit = limit
+
+            def memory_stats(self):
+                return {"bytes_limit": self._limit}
+
+        class NoStats:
+            platform = "tpu"
+            device_kind = "TPU v5e"
+
+            def memory_stats(self):
+                raise RuntimeError("unsupported")
+
+        # override beats everything
+        assert resolve_hbm_limit_bytes(2.0, [WithLimit(2**30)]) == 2 * 2**30
+        # min over reporting devices (tightest chip OOMs first)
+        assert resolve_hbm_limit_bytes(
+            None, [WithLimit(3 * 2**30), WithLimit(2**30)]) == 2**30
+        # no counters but a known TPU kind: the DeviceSpec capacity table
+        assert resolve_hbm_limit_bytes(None, [NoStats()]) == 16 * 2**30
+
+    def test_compiled_attribution_and_reconcile(self):
+        """memory_analysis() works on the CPU backend: attribution must carry
+        the arg/out/temp/code totals and reconcile must land the analytic
+        argument bytes within the documented tolerance for a trivially exact
+        program (identity-ish math over the same arrays the plan counted)."""
+        from automodel_tpu.observability.memory_plan import (
+            MemoryPlan,
+            compiled_memory_attribution,
+            reconcile,
+        )
+
+        x = jnp.zeros((64, 64), jnp.float32)
+
+        @jax.jit
+        def f(a):
+            return a * 2.0 + 1.0
+
+        compiled = f.lower(x).compile()
+        attribution = compiled_memory_attribution(compiled)
+        assert attribution is not None
+        assert attribution["args"] == 64 * 64 * 4
+        assert attribution["out"] == 64 * 64 * 4
+        assert attribution["peak_est"] == (
+            attribution["args"] + attribution["out"] + attribution["temp"]
+            + attribution["code"] - attribution["alias"])
+
+        plan = MemoryPlan(params_bytes=64 * 64 * 4, opt_bytes=0, batch_bytes=0,
+                          act_est_bytes=0, hbm_limit_bytes=2**30)
+        row = reconcile(plan, attribution)
+        assert row["mem_plan/recon_rel_err"] == 0.0
+        assert row["mem/args_gib"] == pytest.approx(64 * 64 * 4 / 2**30, abs=1e-6)
+        # reconcile refines the plan in place with the measured peak
+        assert plan.measured_peak_bytes == attribution["peak_est"]
+        assert row["mem_plan/fits"] is True
+
+    def test_reconcile_warns_beyond_tolerance(self, caplog):
+        from automodel_tpu.observability.memory_plan import MemoryPlan, reconcile
+
+        plan = MemoryPlan(params_bytes=2**20, opt_bytes=0, batch_bytes=0,
+                          act_est_bytes=0)
+        with caplog.at_level("WARNING"):
+            row = reconcile(plan, {"args": 2 * 2**20, "out": 0, "temp": 0,
+                                   "code": 0, "alias": 0, "peak_est": 2 * 2**20})
+        assert row["mem_plan/recon_rel_err"] == 0.5
+        assert any("reconciliation" in r.message for r in caplog.records)
+
+
+# ----------------------------------------------------------------------- oom
+class TestOOMDetection:
+    def test_is_oom_error_markers_and_cause_chain(self):
+        from automodel_tpu.observability.oom import is_oom_error
+
+        assert is_oom_error(RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 123 bytes"))
+        assert is_oom_error(ValueError("device ran out of memory"))
+        assert not is_oom_error(ValueError("shapes do not match"))
+        # the marker may sit behind a wrapping exception
+        inner = RuntimeError("RESOURCE_EXHAUSTED: Error allocating device buffer")
+        outer = RuntimeError("step 7 failed")
+        outer.__cause__ = inner
+        assert is_oom_error(outer)
+        # self-referential chains must terminate
+        loop = RuntimeError("benign")
+        loop.__context__ = loop
+        assert not is_oom_error(loop)
+
+    def test_live_buffer_inventory_groups_by_shape_dtype(self):
+        from automodel_tpu.observability.oom import live_buffer_inventory
+
+        keep = [jnp.zeros((33, 7), jnp.float32) for _ in range(3)]
+        inventory = live_buffer_inventory()
+        assert inventory["live_arrays"] >= 3
+        match = [g for g in inventory["groups"]
+                 if g["shape"] == [33, 7] and g["dtype"] == "float32"]
+        assert match and match[0]["count"] >= 3
+        # groups come sorted by total footprint, largest first
+        totals = [g["total_gib"] for g in inventory["groups"]]
+        assert totals == sorted(totals, reverse=True)
+        del keep
+
+    def test_flight_recorder_dump_is_complete_and_ring_bounded(self, tmp_path):
+        from automodel_tpu.observability.oom import OOMFlightRecorder
+
+        rec = OOMFlightRecorder(str(tmp_path), keep_rows=3)
+        rec.set_plan_row({"mem_plan/total_gib": 1.5})
+        for step in range(10):
+            rec.record_row(step, {"loss": float(step), "hbm_gib_peak": 0.1 * step})
+        path = rec.dump(RuntimeError("RESOURCE_EXHAUSTED: Out of memory"), step=9)
+        assert path == str(tmp_path / "oom_report.json")
+        report = json.load(open(path))
+        assert report["oom_report"] is True and report["step"] == 9
+        assert report["error"]["type"] == "RuntimeError"
+        assert "RESOURCE_EXHAUSTED" in report["error"]["message"]
+        assert report["memory_plan"]["mem_plan/total_gib"] == 1.5
+        assert isinstance(report["devices"], list) and report["devices"]
+        assert "groups" in report["live_buffers"]
+        # the ring kept only the newest keep_rows rows
+        assert [r["step"] for r in report["last_rows"]] == [7, 8, 9]
+
+    def test_dump_never_raises(self, tmp_path, monkeypatch):
+        from automodel_tpu.observability import oom
+
+        rec = oom.OOMFlightRecorder(str(tmp_path / "sub"))
+        monkeypatch.setattr(oom, "live_buffer_inventory",
+                            lambda **kw: (_ for _ in ()).throw(RuntimeError("boom")))
+        assert rec.dump(RuntimeError("Out of memory")) is None  # logged, not raised
+
+
+# ------------------------------------------------------------------ profiler
+class TestProfilerHardening:
+    def test_close_is_idempotent(self, tmp_path):
+        from automodel_tpu.observability import OnDemandProfiler
+
+        p = OnDemandProfiler(str(tmp_path), server_port=0).start()
+        p.close()
+        p.close()  # second close: no raise, no handler churn
+        assert not p.armed
+
+    def test_rearm_while_tracing_coalesces(self, tmp_path):
+        from automodel_tpu.observability import OnDemandProfiler
+
+        p = OnDemandProfiler(str(tmp_path), trace_steps=5, server_port=0,
+                             signum=None)
+        p._tracing = True  # simulate an open window without a real device trace
+        p.request_trace()
+        assert p.armed
+        p.on_step_start(12)
+        # the open window covers "now": the request folds into it instead of
+        # queueing a second trace
+        assert not p.armed and p.tracing
+        p._tracing = False
+
+    def test_close_restores_sig_ign(self, tmp_path):
+        """A daemonized job often inherits SIG_IGN; close() must hand that
+        exact disposition back, not reset to SIG_DFL (SIG_IGN is truthy and
+        SIG_DFL is 0 — the restore must not depend on truthiness)."""
+        from automodel_tpu.observability import OnDemandProfiler
+
+        prev = signal.getsignal(signal.SIGUSR2)
+        try:
+            signal.signal(signal.SIGUSR2, signal.SIG_IGN)
+            p = OnDemandProfiler(str(tmp_path), server_port=0,
+                                 signum=signal.SIGUSR2).start()
+            assert signal.getsignal(signal.SIGUSR2) == p._handle_signal
+            p.close()
+            assert signal.getsignal(signal.SIGUSR2) == signal.SIG_IGN
+        finally:
+            signal.signal(signal.SIGUSR2, prev)
+
+
+# ------------------------------------------------------------ device stats
+class TestDeviceMemoryStatsLimits:
+    def _dev(self, in_use=None, peak=None, limit=None):
+        class Dev:
+            def __init__(self, s):
+                self._s = s
+
+            def memory_stats(self):
+                return self._s
+
+        s = {}
+        if in_use is not None:
+            s["bytes_in_use"] = in_use
+        if peak is not None:
+            s["peak_bytes_in_use"] = peak
+        if limit is not None:
+            s["bytes_limit"] = limit
+        return Dev(s)
+
+    def test_limit_and_headroom_derived(self):
+        from automodel_tpu.observability import device_memory_stats
+
+        out = device_memory_stats([
+            self._dev(in_use=2**30, peak=2 * 2**30, limit=4 * 2**30),
+            self._dev(in_use=2**29, peak=2**30, limit=8 * 2**30),
+        ])
+        assert out["hbm_gib_limit"] == 4.0  # MIN limit: tightest chip
+        # pessimistic pairing: tightest limit minus highest in-use
+        assert out["hbm_headroom_gib"] == 3.0
+
+    def test_missing_bytes_limit_omits_headroom(self):
+        from automodel_tpu.observability import device_memory_stats
+
+        out = device_memory_stats([self._dev(in_use=2**30, peak=2**30)])
+        assert "hbm_gib_limit" not in out and "hbm_headroom_gib" not in out
+        assert out["hbm_gib_in_use"] == 1.0
+
+    def test_raising_and_cpu_devices_yield_empty(self):
+        from automodel_tpu.observability import device_memory_stats
+
+        class Raises:
+            def memory_stats(self):
+                raise RuntimeError("unimplemented")
+
+        class ReturnsNone:
+            def memory_stats(self):
+                return None
+
+        assert device_memory_stats([Raises(), ReturnsNone()]) == {}
+
+    def test_mixed_reporting_and_silent_devices(self):
+        from automodel_tpu.observability import device_memory_stats
+
+        class Silent:
+            def memory_stats(self):
+                return None
+
+        out = device_memory_stats([Silent(), self._dev(in_use=2**29, limit=2**30)])
+        assert out["hbm_gib_in_use"] == 0.5 and out["hbm_headroom_gib"] == 0.5
+
+
+# ----------------------------------------------------------------- aggregate
+class TestOOMRiskFlag:
+    def _agg(self, rows, **kw):
+        from automodel_tpu.observability.aggregate import CrossHostAggregator
+
+        return CrossHostAggregator(allgather_fn=lambda vec: rows,
+                                   process_count=len(rows), **kw)
+
+    def test_host_below_absolute_threshold_is_flagged(self):
+        # keys: step_time_s, data_wait_s, hbm_gib_peak, hbm_headroom_gib
+        rows = [[1.0, 0.0, 10.0, 4.0], [1.0, 0.0, 12.0, 0.4], [1.0, 0.0, 11.0, 5.0]]
+        out = self._agg(rows).aggregate(
+            {"step_time_s": 1.0, "hbm_headroom_gib": 4.0})
+        assert out["oom_risk_host"] == 1
+        assert out["oom_risk_headroom_gib"] == 0.4
+        assert out["host/hbm_headroom_gib_min"] == 0.4
+
+    def test_all_hosts_safe_no_flag_even_when_skewed(self):
+        """Absolute threshold, not worst/median: 4 GiB vs 40 GiB of headroom
+        is a big ratio but zero risk."""
+        rows = [[1.0, 0.0, 10.0, 40.0], [1.0, 0.0, 10.0, 4.0]]
+        out = self._agg(rows).aggregate({"step_time_s": 1.0})
+        assert "oom_risk_host" not in out
+
+    def test_every_host_equally_close_still_flags(self):
+        """The cliff case a ratio test misses: the pod-wide median is as bad
+        as the worst, and the flag must still fire."""
+        rows = [[1.0, 0.0, 10.0, 0.2], [1.0, 0.0, 10.0, 0.2]]
+        out = self._agg(rows).aggregate({"step_time_s": 1.0})
+        assert out["oom_risk_host"] in (0, 1)
+        assert out["oom_risk_headroom_gib"] == 0.2
+
+    def test_nan_headroom_hosts_excluded(self):
+        rows = [[1.0, 0.0, 10.0, math.nan], [1.0, 0.0, 10.0, math.nan]]
+        out = self._agg(rows).aggregate({"step_time_s": 1.0})
+        assert "oom_risk_host" not in out
+
+    def test_threshold_configurable(self):
+        rows = [[1.0, 0.0, 10.0, 2.0], [1.0, 0.0, 10.0, 3.0]]
+        out = self._agg(rows, oom_risk_gib=2.5).aggregate({"step_time_s": 1.0})
+        assert out["oom_risk_host"] == 0
+
+
+# ---------------------------------------------------------------- regression
+class TestMemoryGateKeys:
+    def test_hbm_peak_regresses_by_rising(self):
+        from automodel_tpu.observability.regression import compare
+
+        ok = compare({"hbm_gib_peak": 10.0}, {"hbm_gib_peak": 10.3})
+        assert all(c.ok for c in ok)  # peak DROPPED: an improvement
+        bad = compare({"hbm_gib_peak": 11.0}, {"hbm_gib_peak": 10.0})
+        assert not bad[0].ok and bad[0].change == pytest.approx(0.1)
+
+    def test_headroom_regresses_by_dropping(self):
+        from automodel_tpu.observability.regression import compare
+
+        bad = compare({"hbm_headroom_gib": 1.0}, {"hbm_headroom_gib": 2.0})
+        assert not bad[0].ok
+        ok = compare({"hbm_headroom_gib": 3.0}, {"hbm_headroom_gib": 2.0})
+        assert ok[0].ok
+
+    def test_matrix_namespaced_key_inherits_direction_and_tolerance(self):
+        """matrix/<cell>/hbm_gib_peak has no entry of its own in the
+        direction/tolerance tables; the basename lookup must gate it
+        lower-is-better at the hbm default, not higher-is-better at the
+        fallback."""
+        from automodel_tpu.observability.regression import compare
+
+        key = "matrix/dense_s2048_pfon/hbm_gib_peak"
+        bad = compare({key: 12.0}, {key: 10.0})
+        assert not bad[0].ok  # rose 20% > 5% tol — would PASS if direction defaulted
+        ok = compare({key: 10.2}, {key: 10.0})
+        assert ok[0].ok  # within the 5% hbm default, not the 0.05 'default' key
+
+    def test_summarize_rows_takes_max_peak_and_header_headroom(self):
+        from automodel_tpu.observability.regression import summarize_rows
+
+        rows = [
+            {"run_header": True, "mem_plan/hbm_headroom_gib": 7.5},
+            {"loss": 1.0, "tps": 100.0, "hbm_gib_peak": 9.0},
+            {"loss": 0.9, "tps": 100.0, "hbm_gib_peak": 11.0},  # eval spike
+            {"loss": 0.8, "tps": 100.0, "hbm_gib_peak": 9.5},
+        ]
+        out = summarize_rows(rows)
+        assert out["hbm_gib_peak"] == 11.0  # high-water, not median
+        assert out["hbm_headroom_gib"] == 7.5
+
+    def test_matrix_rows_carry_hbm_key(self):
+        from automodel_tpu.observability.regression import _from_matrix_rows
+
+        rows = [{"matrix_row": True, "model": "dense", "seq_len": 2048,
+                 "prefetch": True, "tokens_per_sec_per_chip": 100.0,
+                 "hbm_gib_peak": 3.25}]
+        out = _from_matrix_rows(rows)
+        assert out["matrix/dense_s2048_pfon/hbm_gib_peak"] == 3.25
+
+
+# ------------------------------------------------------------------ timeline
+class TestCounterEvents:
+    def test_counter_phase_and_values(self, tmp_path):
+        from automodel_tpu.observability.events import TraceTimeline
+
+        path = str(tmp_path / "timeline.json")
+        tl = TraceTimeline(path)
+        tl.counter("hbm_gib", in_use=1.5, peak=2.0)
+        tl.counter("hbm_gib", in_use=1.75, peak=2.0)
+        tl.close()
+        doc = json.load(open(path))
+        counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+        assert len(counters) == 2
+        assert counters[0]["name"] == "hbm_gib"
+        assert counters[0]["args"] == {"in_use": 1.5, "peak": 2.0}
+        assert counters[1]["ts"] >= counters[0]["ts"]
+
+
+# ------------------------------------------------------------------- manager
+class TestAnomalyAutoTrace:
+    def _obs(self, tmp_path, **over):
+        from automodel_tpu.observability import Observability, ObservabilityConfig
+
+        cfg = ObservabilityConfig(watchdog=False, timeline=False,
+                                  aggregate=False, goodput=False, **over)
+        return Observability(cfg, out_dir=str(tmp_path))
+
+    def test_budget_throttles_to_max(self, tmp_path):
+        obs = self._obs(tmp_path, auto_trace_max=1)
+        try:
+            assert obs.auto_trace("stall", 5) is True
+            assert obs.profiler.armed
+            obs.profiler._requested = False  # window consumed
+            assert obs.auto_trace("stall", 6) is False  # budget spent
+            assert not obs.profiler.armed
+        finally:
+            obs.close()
+
+    def test_armed_or_tracing_requests_do_not_burn_budget(self, tmp_path):
+        obs = self._obs(tmp_path, auto_trace_max=2)
+        try:
+            assert obs.auto_trace("stall", 5) is True
+            # a second anomaly while the first request is still pending
+            # coalesces without consuming the remaining budget
+            assert obs.auto_trace("excursion", 5) is False
+            assert obs._auto_traces == 1
+        finally:
+            obs.close()
+
+    def test_disabled_auto_trace_never_arms(self, tmp_path):
+        obs = self._obs(tmp_path, auto_trace=False)
+        try:
+            assert obs.auto_trace("stall", 5) is False
+            assert not obs.profiler.armed
+        finally:
+            obs.close()
+
+    def test_excursion_detector_needs_history_then_fires_once(self, tmp_path):
+        obs = self._obs(tmp_path, excursion_factor=3.0, excursion_min_samples=5)
+        try:
+            for step in range(5):
+                obs.note_step_time(step, 1.0)
+            assert not obs.profiler.armed  # warming up: no judgment yet
+            obs.note_step_time(5, 1.2)  # ordinary jitter
+            assert not obs.profiler.armed
+            obs.note_step_time(6, 5.0)  # 5x the median
+            assert obs.profiler.armed
+            obs.profiler._requested = False
+            obs.note_step_time(7, 6.0)  # budget (default 1) already spent
+            assert not obs.profiler.armed
+        finally:
+            obs.close()
+
+    def test_maybe_dump_oom_writes_report_only_for_oom(self, tmp_path):
+        from automodel_tpu.observability.memory_plan import MemoryPlan
+
+        obs = self._obs(tmp_path)
+        try:
+            obs.memory_plan = MemoryPlan(params_bytes=2**20, opt_bytes=0,
+                                         batch_bytes=0, act_est_bytes=0)
+            assert obs.maybe_dump_oom(ValueError("shape mismatch"), step=3) is None
+            assert not os.path.exists(tmp_path / "oom_report.json")
+            obs.record_row(3, {"loss": 1.0})
+            path = obs.maybe_dump_oom(
+                RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating"), step=3)
+            report = json.load(open(path))
+            assert report["step"] == 3
+            assert report["memory_plan"]["mem_plan/params_gib"] == pytest.approx(
+                2**20 / 2**30, abs=1e-5)
+            assert report["last_rows"][0]["loss"] == 1.0
+        finally:
+            obs.close()
+
+    def test_oom_recorder_disabled_with_memory_pillar(self, tmp_path):
+        obs = self._obs(tmp_path, memory=False)
+        try:
+            assert obs.oom is None
+            assert obs.maybe_dump_oom(RuntimeError("Out of memory")) is None
+        finally:
+            obs.close()
+
+    def test_from_dict_parses_memory_and_profiling_sections(self):
+        from automodel_tpu.observability import ObservabilityConfig
+
+        cfg = ObservabilityConfig.from_dict({
+            "memory": {"enabled": True, "oom_report": False, "oom_keep_rows": 7,
+                       "hbm_limit_gib": 15.5},
+            "aggregate": {"oom_risk_gib": 2.5},
+            "profiling": {"auto_trace": False, "auto_trace_max": 3,
+                          "excursion_factor": 4.0, "excursion_min_samples": 9},
+        })
+        assert cfg.memory and cfg.oom_report is False and cfg.oom_keep_rows == 7
+        assert cfg.hbm_limit_gib == 15.5
+        assert cfg.oom_risk_gib == 2.5
+        assert cfg.auto_trace is False and cfg.auto_trace_max == 3
+        assert cfg.excursion_factor == 4.0 and cfg.excursion_min_samples == 9

@@ -184,8 +184,8 @@ class TestHotExpertAggregation:
         )
 
     def test_hot_expert_host_flagged(self):
-        # hosts: (step_time, data_wait, hbm, moe_max_util)
-        table = [[1.0, 0.0, 1.0, 1.1], [1.0, 0.0, 1.0, 1.0], [1.0, 0.0, 1.0, 3.0]]
+        # hosts: (step_time, data_wait, hbm, headroom, moe_max_util)
+        table = [[1.0, 0.0, 1.0, 8.0, 1.1], [1.0, 0.0, 1.0, 8.0, 1.0], [1.0, 0.0, 1.0, 8.0, 3.0]]
         out = self._agg(table).aggregate(
             {"step_time_s": 1.0, "data_wait_s": 0.0, "hbm_gib_peak": 1.0,
              "moe_max_util": 1.1},
@@ -196,7 +196,7 @@ class TestHotExpertAggregation:
         assert out["host/moe_max_util_max"] == 3.0
 
     def test_balanced_pod_has_no_flag(self):
-        table = [[1.0, 0.0, 1.0, 1.2], [1.0, 0.0, 1.0, 1.1]]
+        table = [[1.0, 0.0, 1.0, 8.0, 1.2], [1.0, 0.0, 1.0, 8.0, 1.1]]
         out = self._agg(table).aggregate(
             {"step_time_s": 1.0, "data_wait_s": 0.0, "hbm_gib_peak": 1.0,
              "moe_max_util": 1.2},
@@ -205,7 +205,7 @@ class TestHotExpertAggregation:
 
     def test_dense_wire_format_never_flags_hot_expert(self):
         # legacy HOST_KEYS table: no moe_max_util column, flag must not appear
-        table = [[1.0, 0.0, 1.0], [5.0, 0.0, 1.0], [1.0, 0.0, 1.0]]
+        table = [[1.0, 0.0, 1.0, 8.0], [5.0, 0.0, 1.0, 8.0], [1.0, 0.0, 1.0, 8.0]]
         out = self._agg(table, keys=HOST_KEYS).aggregate(
             {"step_time_s": 1.0, "data_wait_s": 0.0, "hbm_gib_peak": 1.0},
         )
@@ -213,7 +213,7 @@ class TestHotExpertAggregation:
         assert "hot_expert_host" not in out
 
     def test_missing_moe_sample_travels_as_nan(self):
-        table = [[1.0, 0.0, 1.0, math.nan], [1.0, 0.0, 1.0, math.nan]]
+        table = [[1.0, 0.0, 1.0, 8.0, math.nan], [1.0, 0.0, 1.0, 8.0, math.nan]]
         out = self._agg(table).aggregate(
             {"step_time_s": 1.0, "data_wait_s": 0.0, "hbm_gib_peak": 1.0,
              "moe_max_util": None},
