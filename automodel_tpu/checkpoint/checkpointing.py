@@ -31,11 +31,20 @@ import numpy as np
 from automodel_tpu.checkpoint.manifest import (
     has_manifest, verify_manifest, write_manifest,
 )
+from automodel_tpu.checkpoint.reshard import (
+    TOPOLOGY_KEY, ModelSignatureMismatch, describe_delta, mesh_delta,
+    strip_topology,
+)
 from automodel_tpu.utils.retry import RetryConfig, with_retry
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["CheckpointingConfig", "Checkpointer"]
+__all__ = ["CheckpointingConfig", "Checkpointer", "ModelSignatureMismatch"]
+
+# Pod-agreement sentinel: a joining host with no local checkpoint view abstains
+# from the restore-step minimum instead of dragging it to "nothing restorable"
+# (agreed_restore_step allow_joiners). Fits int64 allgather comfortably.
+_ABSTAIN = 2**31 - 1
 
 
 @dataclasses.dataclass
@@ -62,6 +71,13 @@ class Checkpointer:
         self._ckptr = None
         self._pending = None
         self._retry = RetryConfig.from_dict(config.retry)
+        # elastic-topology protocol (checkpoint/reshard.py): the recipe sets the
+        # current topology (build_topology) so save() records it and load()
+        # classifies mesh changes; event_sink (signature: step, event, **fields)
+        # routes restore-time events — unverified_restore, elastic_restore —
+        # into the resilience metric stream instead of just stderr
+        self.topology: dict | None = None
+        self.event_sink: Callable[..., None] | None = None
 
     # lazily create so importing this module never touches orbax/devices
     @property
@@ -109,9 +125,19 @@ class Checkpointer:
         root = self.config.checkpoint_dir
         link = os.path.join(root, "latest")
         if os.path.islink(link):
-            s = self._parse_step(os.readlink(link))
-            if s is not None:
+            target = os.path.basename(os.readlink(link))
+            s = self._parse_step(target)
+            # the pointer is only authoritative when it resolves to a committed
+            # step: a dangling or stale link (step dir pruned/lost after the
+            # swap) must fall through to the scan instead of naming a step
+            # load() cannot open
+            if s is not None and self._step_complete(os.path.join(root, target)):
                 return s
+            if s is not None:
+                logger.warning(
+                    "latest symlink -> %s is dangling or incomplete; "
+                    "falling back to a directory scan", target,
+                )
         steps = self._step_dirs()
         return steps[-1] if steps else None
 
@@ -125,6 +151,43 @@ class Checkpointer:
         if not os.path.isdir(os.path.join(d, "model")):
             return False
         return not any(".orbax-checkpoint-tmp" in name for name in os.listdir(d))
+
+    def _emit(self, event: str, step: int = 0, **fields: Any) -> None:
+        """Restore/save-time event into the resilience metric stream (no-op
+        until the recipe wires ``event_sink``); reporting never takes down a
+        restore."""
+        if self.event_sink is None:
+            return
+        try:
+            self.event_sink(step, event, **fields)
+        except Exception:
+            logger.debug("checkpoint event sink failed for %s", event, exc_info=True)
+
+    def _gather_host_rows(self, client_states: Mapping[str, Any] | None) -> list[dict] | None:
+        """All-gather each host's dataloader consumed position (collective on
+        multi-host — save() reaches this on every host). None when there is no
+        dataloader state to shard or the gather is unavailable."""
+        if not client_states or "dataloader" not in client_states:
+            return None
+        dl = client_states["dataloader"]
+        state = dl.state_dict() if hasattr(dl, "state_dict") else dict(dl)
+        try:
+            from automodel_tpu.parallel.init import allgather_host_rows
+
+            rows = allgather_host_rows([
+                int(state.get("epoch", 0)),
+                int(state.get("cursor", 0)),
+                int(state.get("batch_size", 0) or 0),
+            ])
+        except Exception:
+            logger.debug("per-host dataloader gather failed; client.json "
+                         "carries the local view only", exc_info=True)
+            return None
+        return [
+            {"process_index": i, "epoch": int(r[0]), "cursor": int(r[1]),
+             "batch_size": int(r[2])}
+            for i, r in enumerate(rows)
+        ]
 
     # -- save ---------------------------------------------------------------
     def save(
@@ -153,16 +216,26 @@ class Checkpointer:
         if opt_state is not None:
             with_retry(self.ckptr.save, os.path.join(d, "optim"), opt_state, force=True,
                        config=self._retry, description="orbax optim save")
+        # per-host consumed-position shards (collective: every host contributes
+        # its dataloader row BEFORE the proc-0-only writes below) — the elastic
+        # restore merges these into the global consumed set when the process
+        # count changes (resilience/elastic.py merge_host_states)
+        host_rows = self._gather_host_rows(client_states)
         if jax.process_index() == 0 and client_states:
+            client_doc = {k: _jsonify(v.state_dict() if hasattr(v, "state_dict") else v)
+                          for k, v in client_states.items()}
+            if host_rows is not None:
+                client_doc["__hosts__"] = {"dataloader": host_rows}
             # tmp + os.replace: a crash mid-write must never leave a truncated
             # client.json that poisons the next resume
-            _write_json_atomic(
-                os.path.join(d, "client.json"),
-                {k: _jsonify(v.state_dict() if hasattr(v, "state_dict") else v)
-                 for k, v in client_states.items()},
-            )
+            _write_json_atomic(os.path.join(d, "client.json"), client_doc)
         if jax.process_index() == 0:
-            _write_json_atomic(os.path.join(d, "signature.json"), _model_signature(params))
+            sig: dict[str, Any] = _model_signature(params)
+            if self.topology is not None:
+                # the saving topology rides the signature file (one atomic
+                # artifact); readers strip it before comparing param signatures
+                sig[TOPOLOGY_KEY] = self.topology
+            _write_json_atomic(os.path.join(d, "signature.json"), sig)
         do_consolidated = (self.config.save_consolidated
                            if consolidated is None else consolidated)
         if do_consolidated and self.state_dict_adapter is not None:
@@ -245,13 +318,21 @@ class Checkpointer:
                     )
             else:
                 logger.warning("checkpoint at %s has no integrity manifest; loading unverified", d)
+                # satellite of docs/resilience.md: an unverified restore must
+                # land in the metric stream/timeline, not just stderr
+                self._emit("unverified_restore", step=step, path=d)
         # model-signature compat check (reference base_recipe.py:768-846): fail
         # with a diff instead of orbax's opaque tree-mismatch errors when the
-        # config changed between save and resume
+        # config changed between save and resume. A changed MESH is not a
+        # changed model — the signature is sharding-independent and the saved
+        # topology is stripped before comparing — so a reshaped pod falls
+        # through to the elastic path below instead of failing here.
+        delta: dict = {}
+        saved_topo = None
         sig_path = os.path.join(d, "signature.json")
         if os.path.exists(sig_path):
             with open(sig_path) as f:
-                saved = json.load(f)
+                saved, saved_topo = strip_topology(json.load(f))
             current = _model_signature(params_template)
             if saved != current:
                 missing = sorted(set(saved) - set(current))[:5]
@@ -259,11 +340,25 @@ class Checkpointer:
                 changed = sorted(
                     k for k in set(saved) & set(current) if saved[k] != current[k]
                 )[:5]
-                raise ValueError(
+                raise ModelSignatureMismatch(
                     f"checkpoint at {d!r} was saved from a different model signature: "
                     f"missing={missing} added={added} changed={changed} "
                     f"(first 5 each; did the model config change between save and resume?)"
                 )
+            delta = mesh_delta(saved_topo, self.topology)
+            if delta:
+                # elastic restore: same model, different topology. Orbax's
+                # StandardRestore reads straight into the new templates'
+                # shardings (the pp-stacked (L, ...) layout is the storage
+                # layout on every mesh), so the arrays need no translation —
+                # announce the reshape and let the caller re-partition host
+                # state from the __elastic__ marker injected below.
+                logger.info(
+                    "elastic restore at step %d: mesh changed (%s); restoring "
+                    "into the new mesh's templates", step, describe_delta(delta),
+                )
+                self._emit("elastic_restore", step=step,
+                           delta=describe_delta(delta))
 
         def _resharded(restored, template):
             # orbax can land scalars/small leaves on a single device; force every
@@ -304,6 +399,14 @@ class Checkpointer:
                     "rng/scheduler/dataloader state", cj, type(e).__name__, e,
                 )
                 client = {}
+        if delta:
+            # the caller (recipe _maybe_resume) pops this marker and
+            # re-partitions dataloader state across the new pod
+            client["__elastic__"] = {
+                "from": saved_topo,
+                "to": self.topology,
+                "delta": {k: list(v) for k, v in delta.items()},
+            }
         return params, opt_state, client
 
     # -- verified / fallback restore (docs/resilience.md) --------------------
@@ -332,15 +435,26 @@ class Checkpointer:
             )
         return None
 
-    def agreed_restore_step(self, exclude: set[int] | None = None) -> int | None:
+    def agreed_restore_step(self, exclude: set[int] | None = None,
+                            allow_joiners: bool = False) -> int | None:
         """The step every host agrees to restore: each host's newest verifiable
         step, all-gathered, minimum taken — so a host whose filesystem view lags
         (checkpoint/checkpointing.py filesystem-skew hazard) can never be asked
         to restore a step it cannot see. Collective on multi-host: every host
-        must call this at the same point."""
+        must call this at the same point.
+
+        ``allow_joiners`` (elastic join/leave, docs/resilience.md): a host with
+        NO verifiable local step abstains from the minimum instead of forcing
+        the whole pod to ``None`` — a freshly-joined host has an empty local
+        view by construction and restores whatever the veterans agree on
+        (checkpoints must live on storage every host can reach). All hosts
+        abstaining still yields None (genuinely fresh run)."""
         from automodel_tpu.parallel.init import agreed_min_int
 
         local = self.newest_verifiable_step(exclude)
+        if allow_joiners:
+            agreed = agreed_min_int(_ABSTAIN if local is None else local)
+            return None if agreed >= _ABSTAIN else agreed
         agreed = agreed_min_int(-1 if local is None else local)
         return None if agreed < 0 else agreed
 
@@ -348,6 +462,7 @@ class Checkpointer:
         self,
         params_template: Any,
         opt_state_template: Any = None,
+        allow_joiners: bool = False,
     ) -> tuple[Any, Any, dict[str, Any], int] | None:
         """Restore the newest checkpoint that verifies, walking back through
         older steps on corruption instead of crashing. Returns
@@ -356,7 +471,7 @@ class Checkpointer:
         the collective restore."""
         exclude: set[int] = set()
         while True:
-            step = self.agreed_restore_step(exclude)
+            step = self.agreed_restore_step(exclude, allow_joiners=allow_joiners)
             if step is None:
                 return None
             try:
@@ -364,9 +479,15 @@ class Checkpointer:
                     params_template, opt_state_template, step=step
                 )
                 return params, opt_state, client, step
+            except ModelSignatureMismatch:
+                # a different MODEL can never be fixed by an older step of the
+                # same run — walking back here would exclude every candidate
+                # and silently start a fresh run on top of an incompatible
+                # checkpoint dir. Surface it.
+                raise
             except ValueError as e:
-                # verification failure (or signature mismatch) on this candidate:
-                # exclude it and walk back to the next verifiable step
+                # verification failure on this candidate: exclude it and walk
+                # back to the next verifiable step
                 logger.warning("restore of step %d failed (%s); trying an older step", step, e)
                 exclude.add(step)
 

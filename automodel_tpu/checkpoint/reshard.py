@@ -1,0 +1,145 @@
+"""Mesh-shape-agnostic restore: topology metadata + change classification.
+
+The reference framework's headline claim is "one config from 1 to 1024 chips by
+changing mesh sizes" — which is only true end-to-end if a checkpoint saved on
+one mesh can restore onto another. The array mechanics already work: params are
+saved as a mesh-independent pytree (the pp-stacked ``(L, ...)`` layout is the
+*storage* layout on every mesh — stage slicing is just a sharding,
+parallel/pipeline.py), ``_model_signature`` is sharding-independent, and Orbax's
+``StandardRestore(template)`` reads straight into the *target* templates'
+shardings. What was missing is the protocol around them:
+
+- ``save()`` must record the saving topology (mesh axis sizes, process count)
+  so ``load()`` can tell "model changed" (hard fail, as always) apart from
+  "mesh changed" (elastic path: restore into the new mesh's templates and
+  re-partition host state);
+- the elastic path must be *observable* (an ``elastic_restore`` event naming
+  the delta) and must hand the data layer what it needs to re-partition
+  consumed positions (resilience/elastic.py).
+
+This module owns the metadata format and the classification; it deliberately
+holds no Orbax code — ``Checkpointer`` stays the only thing that touches
+storage.
+
+The topology rides inside ``signature.json`` under :data:`TOPOLOGY_KEY` (one
+atomic artifact instead of a second sidecar file that could skew); readers
+strip it before comparing parameter signatures, so pre-elastic checkpoints
+(no key) and pre-elastic readers (ignore unknown keys? no — old readers would
+see a signature mismatch) are handled: old checkpoints load fine under new
+code, and the key is only written when the recipe provides a topology.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Mapping
+
+__all__ = [
+    "TOPOLOGY_KEY",
+    "ModelSignatureMismatch",
+    "build_topology",
+    "mesh_delta",
+    "read_topology",
+    "strip_topology",
+]
+
+# Key inside signature.json carrying the saving topology. Leading/trailing
+# dunders keep it disjoint from jax.tree_util.keystr() param paths (which
+# always start with a bracket/dot accessor).
+TOPOLOGY_KEY = "__topology__"
+
+
+class ModelSignatureMismatch(ValueError):
+    """The checkpoint was saved from a *different model* (shape/dtype diff).
+
+    Distinct from a mesh change, which restores fine, and from an integrity
+    failure, which walks back to an older step: a model change can never be
+    fixed by another checkpoint of the same run, so the verified-restore
+    walk-back must re-raise it instead of silently excluding every step and
+    starting a fresh run on top of an incompatible checkpoint dir.
+    Subclasses ``ValueError`` so pre-elastic callers that caught the generic
+    signature error keep working.
+    """
+
+
+def build_topology(mesh_ctx: Any, process_count: int | None = None) -> dict:
+    """The saving topology a checkpoint records: mesh axis sizes + pod shape.
+
+    ``mesh_ctx`` is a ``parallel.mesh.MeshContext`` (or anything with a
+    ``.shape`` dict). Host count is recorded separately from the mesh because
+    the data layer partitions by *process*, not by device: a reshape that
+    keeps the process count keeps the global batch size, while a join/leave
+    changes it and forces a consumed-position re-partition.
+    """
+    import jax
+
+    if process_count is None:
+        process_count = jax.process_count()
+    shape = dict(mesh_ctx.shape) if hasattr(mesh_ctx, "shape") else dict(mesh_ctx)
+    return {
+        "mesh": {str(k): int(v) for k, v in shape.items()},
+        "process_count": int(process_count),
+        "world_size": int(
+            getattr(mesh_ctx, "world_size", 0)
+            or _prod(int(v) for v in shape.values())
+        ),
+    }
+
+
+def _prod(it) -> int:
+    out = 1
+    for v in it:
+        out *= v
+    return out
+
+
+def strip_topology(signature: Mapping[str, Any]) -> tuple[dict, dict | None]:
+    """``signature.json`` contents -> (param signature, topology or None)."""
+    sig = dict(signature)
+    topo = sig.pop(TOPOLOGY_KEY, None)
+    return sig, (dict(topo) if isinstance(topo, Mapping) else None)
+
+
+def read_topology(step_dir: str) -> dict | None:
+    """The topology a step dir was saved under, or None (pre-elastic save,
+    missing/corrupt signature — the caller falls back to same-mesh semantics)."""
+    path = os.path.join(step_dir, "signature.json")
+    try:
+        with open(path) as f:
+            _, topo = strip_topology(json.load(f))
+        return topo
+    except (OSError, ValueError):
+        return None
+
+
+def mesh_delta(saved: Mapping[str, Any] | None,
+               current: Mapping[str, Any] | None) -> dict[str, tuple[int, int]]:
+    """Axis-by-axis change between two topologies: ``{axis: (old, new)}``.
+
+    Empty dict = same topology (or either side unknown — without both
+    records there is nothing to classify, and same-mesh semantics are the
+    safe default). Includes ``process_count`` so a join/leave with unchanged
+    device-mesh shape still registers as elastic (the data partition and
+    ``client.json`` host rows change with the process count).
+    """
+    if not saved or not current:
+        return {}
+    delta: dict[str, tuple[int, int]] = {}
+    old_mesh = dict(saved.get("mesh") or {})
+    new_mesh = dict(current.get("mesh") or {})
+    for axis in sorted(set(old_mesh) | set(new_mesh)):
+        old, new = int(old_mesh.get(axis, 1)), int(new_mesh.get(axis, 1))
+        if old != new:
+            delta[axis] = (old, new)
+    for scalar in ("process_count", "world_size"):
+        old = int(saved.get(scalar) or 0)
+        new = int(current.get(scalar) or 0)
+        if old and new and old != new:
+            delta[scalar] = (old, new)
+    return delta
+
+
+def describe_delta(delta: Mapping[str, tuple[int, int]]) -> str:
+    """Human-readable one-liner for logs/events: ``dp_shard 8->4, tp 1->2``."""
+    return ", ".join(f"{axis} {old}->{new}" for axis, (old, new) in delta.items())

@@ -138,11 +138,36 @@ class DataLoader:
             self._cursor -= nb
             self.epoch += 1
 
+    @property
+    def consumed_examples(self) -> int:
+        """Examples consumed from the current epoch's permutation. The cursor
+        is a GLOBAL batch index, so the consumed set is exactly the first
+        ``cursor * batch_size`` entries of the (seed, epoch) permutation —
+        independent of the process count. This invariant is what makes elastic
+        resume (resilience/elastic.py) pure arithmetic."""
+        return self._cursor * self.batch_size
+
     # -- resumable state ----------------------------------------------------
     def state_dict(self) -> dict:
-        return {"epoch": self.epoch, "cursor": self._cursor, "seed": self.seed}
+        # batch_size/process_count record the saving pod's geometry: an elastic
+        # resume on a different process count converts the cursor into the new
+        # pod's global-batch units (resilience/elastic.py)
+        return {
+            "epoch": self.epoch,
+            "cursor": self._cursor,
+            "seed": self.seed,
+            "batch_size": self.batch_size,
+            "process_count": self.process_count,
+        }
 
     def load_state_dict(self, state: dict) -> None:
+        saved_bs = int(state.get("batch_size", self.batch_size) or self.batch_size)
+        if saved_bs != self.batch_size:
+            raise ValueError(
+                f"dataloader state was saved with global batch_size {saved_bs} "
+                f"but this loader uses {self.batch_size}; re-partition the state "
+                "first (resilience/elastic.py repartition_dataloader_state)"
+            )
         self.epoch = int(state["epoch"])
         self._cursor = int(state["cursor"])
         self.seed = int(state.get("seed", self.seed))

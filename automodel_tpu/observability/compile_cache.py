@@ -24,7 +24,7 @@ import threading
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["install", "counts", "reset", "snapshot"]
+__all__ = ["configure", "install", "counts", "reset", "snapshot"]
 
 _EVENTS = {
     "/jax/compilation_cache/cache_hits": "hits",
@@ -58,6 +58,62 @@ def install() -> bool:
         logger.debug("jax monitoring API unavailable; compile-cache counts "
                      "stay at zero", exc_info=True)
     return _installed
+
+
+def configure(raw: object) -> dict[str, object]:
+    """Enable the persistent compilation cache from a ``compile_cache:`` config
+    section — the warm-restart half of elastic resume (docs/resilience.md).
+
+    Must run before the first compile of the process (the recipe calls it at
+    the very top of ``setup()``, ahead of jit model init), because entries are
+    only written for compiles that happen while the cache is configured.
+
+    .. code-block:: yaml
+
+        compile_cache:
+          dir: /tmp/xla_cache      # enables the cache; absent/null = off
+          min_entry_size_bytes: 0  # default 0: cache even tiny programs
+          min_compile_time_secs: 0 # default 0: jax's 1s floor would skip
+                                   # every fast compile and fake a cold cache
+
+    Returns what was applied (empty when disabled); never raises — a run must
+    not die because caching could not be set up.
+    """
+    if raw is None:
+        return {}
+    if hasattr(raw, "to_dict"):
+        raw = raw.to_dict()
+    d = dict(raw)  # type: ignore[arg-type]
+    cache_dir = d.get("dir")
+    if not cache_dir:
+        return {}
+    applied: dict[str, object] = {}
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        applied["dir"] = str(cache_dir)
+        for key, opt in (
+            ("min_entry_size_bytes", "jax_persistent_cache_min_entry_size_bytes"),
+            ("min_compile_time_secs", "jax_persistent_cache_min_compile_time_secs"),
+        ):
+            val = d.get(key, 0)
+            try:
+                # coerce to the flag's current type (int vs float) — read via
+                # attribute: config.read() raises for context-managed flags
+                current = getattr(jax.config, opt)
+                jax.config.update(opt, type(current)(val))
+                applied[key] = val
+            except Exception:
+                logger.debug("compile cache option %s unsupported", opt,
+                             exc_info=True)
+    except Exception:
+        logger.warning("persistent compilation cache could not be configured; "
+                       "restarts will recompile from scratch", exc_info=True)
+        return applied
+    install()
+    logger.info("persistent compilation cache enabled at %s", cache_dir)
+    return applied
 
 
 def counts() -> dict[str, int]:

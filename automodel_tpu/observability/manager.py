@@ -179,43 +179,76 @@ def _tree_avals(args: Any) -> Any:
     )
 
 
+def _avals_key(args: Any) -> Any:
+    """Hashable form of :func:`_tree_avals` — the variant-dict key."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(_tree_avals(args))
+    return (treedef, tuple(leaves))
+
+
 class _GuardedCompiled:
-    """Run the AOT-compiled step; fall back to the jit fn on a shape change.
+    """Dispatch steps to AOT-compiled variants by shape; jit is the last resort.
 
     The jit dispatch cache does NOT share entries with an AOT compile of the
     same function, so after extracting costs from ``lowered.compile()`` the
     loop must execute through that same compiled object or it would pay the
-    full compile twice. The guard exists because the step scheduler can emit a
-    trailing partial accumulation (fewer microbatches in the stack): that
-    shape goes through the jit path, which compiles it as before.
+    full compile twice. The executor keys compiled variants by the argument
+    shape/dtype fingerprint because the step scheduler can emit more than one
+    step shape — the steady accumulation stack plus a trailing partial stack
+    at the epoch tail. Warm restart (docs/resilience.md) pre-compiles the
+    trailing shape via :meth:`add_variant`, so every shape the scheduler emits
+    runs AOT; an *unplanned* shape falls back to jit and is counted
+    (``aot_shape_fallback``) so the compile_summary row exposes it.
 
-    A sharding change demotes to the jit path permanently: the AOT object
-    bakes in the input shardings seen at lowering, but a step whose outputs
-    carry different shardings than its inputs (e.g. adapter params re-sharded
-    by constraints inside the step) feeds those back as step-2 inputs. Plain
-    jit handles that with a silent recompile; the Compiled object raises.
+    A sharding change demotes that variant to the jit path permanently: the
+    AOT object bakes in the input shardings seen at lowering, but a step whose
+    outputs carry different shardings than its inputs (e.g. adapter params
+    re-sharded by constraints inside the step) feeds those back as step-2
+    inputs. Plain jit handles that with a silent recompile; the Compiled
+    object raises.
     """
 
     def __init__(self, compiled: Any, fallback: Callable, args: Any,
-                 on_demote: Callable[[], None] | None = None):
-        self._compiled: Any | None = compiled
+                 on_demote: Callable[[], None] | None = None,
+                 on_shape_fallback: Callable[[], None] | None = None):
+        self._variants: dict[Any, Any] = {_avals_key(args): compiled}
         self._fallback = fallback
-        self._avals = _tree_avals(args)
         self._on_demote = on_demote
+        self._on_shape_fallback = on_shape_fallback
+        self._warned_shapes: set[Any] = set()
+
+    def add_variant(self, args: Any, compiled: Any) -> None:
+        """Register an AOT-compiled variant for this argument shape."""
+        self._variants[_avals_key(args)] = compiled
+
+    @property
+    def num_variants(self) -> int:
+        return sum(1 for v in self._variants.values() if v is not None)
 
     def __call__(self, *args: Any) -> Any:
-        if self._compiled is not None and _tree_avals(args) == self._avals:
+        key = _avals_key(args)
+        compiled = self._variants.get(key)
+        if compiled is not None:
             try:
-                return self._compiled(*args)
+                return compiled(*args)
             except ValueError as e:
                 if "Compiled object called with input" not in str(e):
                     raise
                 logger.warning(
-                    "AOT-compiled step rejected re-sharded inputs; "
-                    "falling back to jit for the rest of the run")
-                self._compiled = None
+                    "AOT-compiled step variant rejected re-sharded inputs; "
+                    "falling back to jit for this shape for the rest of the run")
+                self._variants[key] = None
                 if self._on_demote is not None:
                     self._on_demote()
+        elif key not in self._variants:
+            # unseen shape: no variant was pre-compiled for it — jit picks it
+            # up, but the miss is counted so warm-restart coverage is auditable
+            if key not in self._warned_shapes:
+                self._warned_shapes.add(key)
+                logger.info("step shape has no AOT variant; running through jit")
+            if self._on_shape_fallback is not None:
+                self._on_shape_fallback()
         return self._fallback(*args)
 
 
@@ -235,8 +268,13 @@ class Observability:
         # set by the recipe before compile_step ({axis: size}) so collective
         # bytes get attributed to ep/dp/tp/pp in the cost row
         self.mesh_axes: dict[str, int] | None = None
-        # AOT-vs-jit accounting across every compile_step of the run
-        self.compile_counts = {"aot": 0, "jit_fallback": 0, "aot_demoted": 0}
+        # AOT-vs-jit accounting across every compile_step of the run:
+        # aot = primary AOT compiles, aot_variant = extra shapes pre-compiled
+        # by warmup, aot_demoted = variants that rejected re-sharded inputs,
+        # aot_shape_fallback = steps whose shape had no variant (ran via jit),
+        # jit_fallback = step fns that never got an AOT executor at all
+        self.compile_counts = {"aot": 0, "jit_fallback": 0, "aot_demoted": 0,
+                               "aot_variant": 0, "aot_shape_fallback": 0}
         self._metric_sink = metric_sink
         self._step_t0: float | None = None
         # analytic HBM plan (set by the recipe once params/opt_state exist);
@@ -409,12 +447,44 @@ class Observability:
             self._emit_moe_spans(hlo, spec, step)
             def _demoted():
                 self.compile_counts["aot_demoted"] += 1
-            return _GuardedCompiled(compiled, step_fn, args, on_demote=_demoted)
+            def _shape_fallback():
+                self.compile_counts["aot_shape_fallback"] += 1
+            return _GuardedCompiled(compiled, step_fn, args, on_demote=_demoted,
+                                    on_shape_fallback=_shape_fallback)
         except Exception:
             logger.warning("HLO cost extraction failed; step runs through jit",
                            exc_info=True)
             self.compile_counts["jit_fallback"] += 1
             return step_fn
+
+    def precompile_variant(self, executor: Callable, step_fn: Callable,
+                           args: tuple, step: int = 0) -> bool:
+        """AOT-compile one extra step shape into an existing executor.
+
+        The warm-restart half of elastic resume (docs/resilience.md): the
+        recipe calls this for every step shape the scheduler can emit beyond
+        the steady one — e.g. the trailing partial accumulation — so no shape
+        demotes to a mid-run jit compile. With a persistent compilation cache
+        configured (observability/compile_cache.py) the lowering hits the
+        cache and the "compile" is a deserialization. No-op (False) when the
+        executor is not an AOT dispatcher or the compile fails.
+        """
+        if not isinstance(executor, _GuardedCompiled) or not hasattr(step_fn, "lower"):
+            return False
+        try:
+            t0 = time.perf_counter()
+            compiled = step_fn.lower(*args).compile()
+            executor.add_variant(args, compiled)
+            self.compile_counts["aot_variant"] += 1
+            if self._metric_sink is not None:
+                self._metric_sink(step, event="compile_variant",
+                                  compile_s=round(time.perf_counter() - t0, 3),
+                                  variants=executor.num_variants)
+            return True
+        except Exception:
+            logger.warning("AOT warmup variant compile failed; that shape will "
+                           "run through jit", exc_info=True)
+            return False
 
     def _emit_moe_spans(self, hlo: str | None, spec: Any, step: int) -> None:
         """Analytic dispatch/experts/combine spans from the compiled module.

@@ -46,6 +46,7 @@ import numpy as np
 from automodel_tpu.config.loader import ConfigNode
 from automodel_tpu.config.cli_overrides import parse_args_and_load_config
 from automodel_tpu.checkpoint.checkpointing import Checkpointer, CheckpointingConfig
+from automodel_tpu.checkpoint.reshard import build_topology
 from automodel_tpu.data.collate import sft_collate, stack_batches
 from automodel_tpu.data.loader import DataLoader
 from automodel_tpu.loggers.log_utils import setup_logging
@@ -84,6 +85,16 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self._check_nan_grads = bool(self.cfg.get("distributed.check_for_nan_in_grad", False))
         cfg = self.cfg
         setup_logging(cfg.get("log_level", "INFO"))
+        # persistent XLA compile cache (warm restart, docs/resilience.md): must
+        # be configured before the FIRST compile of the process — the jit model
+        # init a few lines down already writes/reads cache entries
+        from automodel_tpu.observability import compile_cache
+
+        compile_cache.configure(cfg.get("compile_cache"))
+        # events fired before the metric loggers exist (restore-time elastic/
+        # unverified events during _maybe_resume) buffer here; flushed once the
+        # loggers come up
+        self._deferred_events: list[tuple[int, dict]] = []
         self.dist = initialize_distributed(auto=bool(cfg.get("distributed.auto_init", False)))
         self.rng = StatefulRNG(seed=int(cfg.get("seed", 42)))
 
@@ -224,6 +235,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             metric_sink=lambda step, **f: self._log_event(step, **f),
         )
         self.chaos = self.resilience.chaos
+        # elastic-topology protocol (checkpoint/reshard.py): every save records
+        # the saving mesh/pod shape, and restore-time events (elastic_restore,
+        # unverified_restore) ride the resilience metric stream
+        self.checkpointer.topology = build_topology(self.mesh_ctx)
+        self.checkpointer.event_sink = self.resilience.emit
         self._maybe_resume()
 
         # metrics: JSONL always on; wandb/mlflow when configured (reference
@@ -240,6 +256,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         from automodel_tpu.loggers.experiment_loggers import build_experiment_loggers
 
         self.experiment_loggers = build_experiment_loggers(cfg)
+        # restore-time events buffered before the loggers existed land now, in
+        # order, ahead of any step row
+        for ev_step, ev_fields in self._deferred_events:
+            self._log_event(ev_step, **ev_fields)
+        self._deferred_events.clear()
 
         # observability (docs/observability.md): goodput accounting, HBM +
         # compile telemetry, stall watchdog, on-demand profiling. Stall events
@@ -675,12 +696,40 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         # back to the newest step that passes its integrity manifest, agreed
         # across hosts (docs/resilience.md). load_latest_verified returns None
         # only when NO restorable checkpoint exists — a fresh run.
-        restored = self.checkpointer.load_latest_verified(self.train_params, self.opt_state)
+        el = self.resilience.config.elastic
+        restored = self.checkpointer.load_latest_verified(
+            self.train_params, self.opt_state,
+            # join/leave: a freshly-joined host has no local checkpoint view and
+            # abstains from the pod-agreed restore step instead of forcing a
+            # fresh run (checkpoints live on storage every host can reach)
+            allow_joiners=bool(el.enabled and el.allow_joiners),
+        )
         if restored is None:
             return
         self.train_params, self.opt_state, client, step = restored
         logger.info("resuming from step %d", step)
+        elastic = client.pop("__elastic__", None)
+        host_rows = (client.pop("__hosts__", None) or {}).get("dataloader")
+        if elastic is not None and el.enabled:
+            self._repartition_client_state(client, host_rows, step)
         self._apply_client_state(client)
+
+    def _repartition_client_state(self, client: dict, host_rows, step: int):
+        """Elastic resume (docs/resilience.md): Orbax already resharded the
+        arrays into the new mesh's templates; what is left is the host state.
+        The saved dataloader cursor counts the OLD pod's global batches —
+        convert it into this pod's units so no example is double-trained or
+        silently dropped across the reshape."""
+        from automodel_tpu.resilience.elastic import repartition_dataloader_state
+
+        state = client.get("dataloader")
+        if state is None:
+            return
+        new_state, info = repartition_dataloader_state(
+            state, self.dataloader.batch_size, host_rows=host_rows
+        )
+        client["dataloader"] = new_state
+        self._log_event(step, event="elastic_data_repartition", **info)
 
     def _apply_client_state(self, client: dict):
         """Restore the host-side training services a checkpoint carries; shared
@@ -725,10 +774,51 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             config=PrefetchConfig.from_config(self.cfg.get("dataloader.prefetch")),
         )
 
+    def _warmup_step_variants(self, obs, step_fn, exec_fn, stack, extra, step):
+        """AOT warmup (docs/resilience.md "warm restart"): pre-compile every
+        step shape the scheduler can emit beyond the steady one — today the
+        trailing partial-accumulation stack at the epoch tail — into the
+        executor's variant table, so no shape demotes to a mid-run jit compile.
+        With the persistent compile cache configured, a restarted run's warmup
+        deserializes instead of compiling. Warmup stacks are built host-side
+        and pushed through the SAME device_put path as real batches so their
+        shardings match exactly (device-side slicing could silently differ and
+        fake an AOT rejection). Gated by ``compile_cache.warmup`` (default off:
+        it fronts the epoch-tail compile cost at step 0)."""
+        if not bool(self.cfg.get("compile_cache.warmup", False)):
+            return
+        from automodel_tpu.resilience.elastic import plan_warmup_micro_counts
+
+        for n_micro in plan_warmup_micro_counts(
+            self.dataloader.num_batches, self.step_scheduler.grad_acc_steps
+        ):
+            host_stack = {
+                k: np.zeros((n_micro,) + tuple(v.shape[1:]), dtype=v.dtype)
+                for k, v in stack.items()
+            }
+            t0 = time.perf_counter()
+            ok = obs.precompile_variant(
+                exec_fn, step_fn,
+                (self.train_params, self.opt_state,
+                 self._device_put_stack(host_stack), *extra),
+                step=step,
+            )
+            if ok:
+                obs.record_compile(time.perf_counter() - t0)
+                logger.info(
+                    "warmup: pre-compiled trailing %d-microbatch step shape "
+                    "in %.1fs", n_micro, time.perf_counter() - t0,
+                )
+
     # ------------------------------------------------------------------ train
     def _log_event(self, step: int, **fields):
         """Async structured events (watchdog stalls, resilience rollbacks)
         into the metric fan-out and onto the trace timeline."""
+        if getattr(self, "metric_logger", None) is None:
+            # restore-time events (elastic_restore, unverified_restore) fire
+            # during _maybe_resume, before the loggers exist
+            self._deferred_events.append((step, dict(fields)))
+            return
         self.metric_logger.log(step, **fields)
         for lg in self.experiment_loggers:
             lg.log(step, **fields)
@@ -884,6 +974,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 obs.record_compile(time.perf_counter() - t0)
                 compiled_fns.add(id(step_fn))
                 self._step_executors[id(step_fn)] = exec_fn
+                # warm restart (docs/resilience.md): pre-compile the other step
+                # shapes the scheduler can emit so none demotes to mid-run jit
+                self._warmup_step_variants(obs, step_fn, exec_fn, stack, extra, step)
                 t_last = time.perf_counter()
                 steps_since_log = 0  # compile step excluded from the window
                 window_overhead = 0.0
@@ -1066,6 +1159,19 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     self._save(step)
                 obs.heartbeat(step)
                 window_overhead += time.perf_counter() - t_pause
+            if self.chaos is not None and self.chaos.should_elastic(step):
+                # topology-change injection (resilience/chaos.py): checkpoint,
+                # then die carrying the resized mesh — the harness restarts the
+                # recipe on it and resume takes the elastic restore path
+                new_mesh = self.chaos.elastic_change(step)
+                if (self.checkpointer.config.enabled
+                        and getattr(self, "_last_saved_step", None) != step):
+                    with obs.track("checkpoint"):
+                        self._save(step)
+                self.checkpointer.wait()
+                from automodel_tpu.resilience.elastic import ElasticTopologyChange
+
+                raise ElasticTopologyChange(step, new_mesh)
             obs.on_step_end(step, sync=metrics.get("loss"))
             # agreed at the CONSUMED step (deterministic across hosts even
             # while the prefetch worker advances the scheduler's own counter)

@@ -1,11 +1,17 @@
 """Fault-tolerant training: anomaly rollback, checkpoint integrity + fallback
-restore, coordinated preemption, transient-fault retry, and a deterministic
-fault-injection harness (docs/resilience.md)."""
+restore, coordinated preemption, elastic topology (mesh-shape-agnostic resume),
+transient-fault retry, and a deterministic fault-injection harness
+(docs/resilience.md)."""
 
 from automodel_tpu.resilience.anomaly import AnomalyDetector, RecoveryPolicy, Verdict
 from automodel_tpu.resilience.chaos import ChaosConfig, ChaosInjector, FlakyIO
 from automodel_tpu.resilience.config import (
-    AnomalyConfig, PreemptionConfig, ResilienceConfig, RollbackConfig,
+    AnomalyConfig, ElasticConfig, PreemptionConfig, ResilienceConfig,
+    RollbackConfig,
+)
+from automodel_tpu.resilience.elastic import (
+    ElasticTopologyChange, merge_host_states, plan_warmup_micro_counts,
+    repartition_dataloader_state,
 )
 from automodel_tpu.resilience.manager import ResilienceManager
 
@@ -14,6 +20,8 @@ __all__ = [
     "AnomalyDetector",
     "ChaosConfig",
     "ChaosInjector",
+    "ElasticConfig",
+    "ElasticTopologyChange",
     "FlakyIO",
     "PreemptionConfig",
     "RecoveryPolicy",
@@ -21,4 +29,7 @@ __all__ = [
     "ResilienceManager",
     "RollbackConfig",
     "Verdict",
+    "merge_host_states",
+    "plan_warmup_micro_counts",
+    "repartition_dataloader_state",
 ]

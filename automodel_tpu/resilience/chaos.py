@@ -15,6 +15,12 @@ classes the resilience layer must survive, all CPU-runnable:
 - **Transient I/O errors** (:class:`FlakyIO`): a callable that raises
   ``ConnectionError`` N times before succeeding, for exercising
   ``utils/retry.py`` wiring end-to-end.
+- **Topology change** (``elastic_steps`` + ``elastic_mesh``): at the named
+  steps the run checkpoints and dies with
+  :class:`~automodel_tpu.resilience.elastic.ElasticTopologyChange`, carrying
+  the resized mesh the harness must restart on — the in-process equivalent of
+  a preemption that hands back a different slice, driving the elastic restore
+  path (docs/resilience.md) without hand-built checkpoints.
 
 Injection is step-keyed and config-driven, so a chaos run is exactly
 reproducible (tools/chaos_smoke.py asserts recovery on a mock recipe).
@@ -41,6 +47,9 @@ class ChaosConfig:
     corrupt_ckpt_steps: tuple[int, ...] = ()
     # which file of the step dir to truncate; the first match wins
     corrupt_target: str = "largest"  # "largest" | "client.json" | "manifest.json"
+    # topology change: checkpoint + die at these steps, restart on elastic_mesh
+    elastic_steps: tuple[int, ...] = ()
+    elastic_mesh: dict | None = None  # e.g. {"dp_shard": 4} — axes of the resized slice
 
     @classmethod
     def from_dict(cls, raw: Any) -> "ChaosConfig":
@@ -49,11 +58,16 @@ class ChaosConfig:
         if hasattr(raw, "to_dict"):
             raw = raw.to_dict()
         d = dict(raw)
+        mesh = d.get("elastic_mesh")
+        if hasattr(mesh, "to_dict"):
+            mesh = mesh.to_dict()
         return cls(
             enabled=bool(d.get("enabled", False)),
             nan_grad_steps=tuple(int(s) for s in (d.get("nan_grad_steps") or ())),
             corrupt_ckpt_steps=tuple(int(s) for s in (d.get("corrupt_ckpt_steps") or ())),
             corrupt_target=str(d.get("corrupt_target", "largest")),
+            elastic_steps=tuple(int(s) for s in (d.get("elastic_steps") or ())),
+            elastic_mesh={str(k): int(v) for k, v in dict(mesh).items()} if mesh else None,
         )
 
 
@@ -64,6 +78,7 @@ class ChaosInjector:
         self.config = config
         self._fired_nan: set[int] = set()
         self._fired_corrupt: set[int] = set()
+        self._fired_elastic: set[int] = set()
 
     @property
     def enabled(self) -> bool:
@@ -125,6 +140,28 @@ class ChaosInjector:
             target, size, max(size // 2, 1), step,
         )
         return target
+
+    # -- topology change -----------------------------------------------------
+    def should_elastic(self, step: int) -> bool:
+        return (
+            self.enabled
+            and step in self.config.elastic_steps
+            and self.config.elastic_mesh is not None
+            and step not in self._fired_elastic
+        )
+
+    def elastic_change(self, step: int) -> dict:
+        """Mark the injection fired and return the resized mesh axes. The
+        caller checkpoints, then raises
+        :class:`~automodel_tpu.resilience.elastic.ElasticTopologyChange` so the
+        harness restarts on the new shape (tools/elastic_smoke.py)."""
+        self._fired_elastic.add(step)
+        mesh = dict(self.config.elastic_mesh or {})
+        logger.warning(
+            "chaos: injecting topology change at step %d; restart mesh %s",
+            step, mesh,
+        )
+        return mesh
 
     def _pick_target(self, step_dir: str) -> str | None:
         name = self.config.corrupt_target

@@ -34,7 +34,8 @@ from typing import Any
 
 from automodel_tpu.utils.retry import RetryConfig
 
-__all__ = ["AnomalyConfig", "RollbackConfig", "PreemptionConfig", "ResilienceConfig"]
+__all__ = ["AnomalyConfig", "ElasticConfig", "RollbackConfig", "PreemptionConfig",
+           "ResilienceConfig"]
 
 
 def _sub(raw: Any) -> dict:
@@ -74,12 +75,26 @@ class PreemptionConfig:
 
 
 @dataclasses.dataclass
+class ElasticConfig:
+    """Mesh-shape-agnostic restore (docs/resilience.md "Elastic restore").
+
+    ``enabled`` gates the elastic resume path in the recipe (topology-aware
+    checkpoints are always written — they cost one JSON key); ``allow_joiners``
+    lets a host with no local checkpoint view abstain from the pod-agreed
+    restore step instead of forcing a fresh run (join/leave)."""
+
+    enabled: bool = True
+    allow_joiners: bool = True
+
+
+@dataclasses.dataclass
 class ResilienceConfig:
     enabled: bool = True
     anomaly: AnomalyConfig = dataclasses.field(default_factory=AnomalyConfig)
     rollback: RollbackConfig = dataclasses.field(default_factory=RollbackConfig)
     preemption: PreemptionConfig = dataclasses.field(default_factory=PreemptionConfig)
     retry: RetryConfig = dataclasses.field(default_factory=RetryConfig)
+    elastic: ElasticConfig = dataclasses.field(default_factory=ElasticConfig)
     max_skipped_updates: int = 3
     chaos: dict = dataclasses.field(default_factory=dict)
 
@@ -95,6 +110,7 @@ class ResilienceConfig:
             rollback=RollbackConfig(**_known(RollbackConfig, _sub(d.get("rollback")))),
             preemption=PreemptionConfig(**_known(PreemptionConfig, _sub(d.get("preemption")))),
             retry=RetryConfig.from_dict(d.get("retry")),
+            elastic=ElasticConfig(**_known(ElasticConfig, _sub(d.get("elastic")))),
             max_skipped_updates=int(d.get("max_skipped_updates", 3)),
             chaos=_sub(d.get("chaos")),
         )

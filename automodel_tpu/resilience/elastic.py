@@ -1,0 +1,153 @@
+"""Elastic topology: host-state re-partitioning and topology-change injection.
+
+``checkpoint/reshard.py`` classifies a restore as elastic (mesh changed, model
+unchanged) and Orbax mechanically re-shards the arrays into the new mesh's
+templates. What arrays alone cannot carry is the *host* state: the dataloader
+cursor counts global batches, and the global batch size is
+``micro_batch_size * process_count`` — so a join/leave (changed process count)
+changes what one cursor tick means. This module converts the saved consumed
+position into the new pod's units deterministically, so no example is
+double-trained or silently dropped across the reshape.
+
+The accounting rides the loader's global-cursor design (data/loader.py): the
+consumed-example set of an epoch is exactly the first ``cursor * batch_size``
+entries of the seed+epoch permutation, *independent of the process count* —
+each process reads a slice of every global batch, so every host's
+``state_dict()`` is identical and the merge is a consistency check, not a
+union. Re-partitioning is then pure arithmetic in example space.
+
+Also here: :class:`ElasticTopologyChange`, the control-flow signal the chaos
+harness raises to simulate "preempted, restarted on a resized slice" without
+leaving the process (resilience/chaos.py ``elastic_steps``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Mapping, Sequence
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ElasticTopologyChange",
+    "merge_host_states",
+    "plan_warmup_micro_counts",
+    "repartition_dataloader_state",
+]
+
+
+class ElasticTopologyChange(RuntimeError):
+    """Raised by the chaos harness at a scheduled step: the run 'dies' and must
+    be restarted on ``new_mesh``. The in-process equivalent of the scheduler
+    handing back a different slice — the catcher rebuilds the recipe with the
+    resized mesh and resumes through the elastic restore path."""
+
+    def __init__(self, step: int, new_mesh: dict):
+        self.step = int(step)
+        self.new_mesh = dict(new_mesh)
+        super().__init__(
+            f"chaos: topology change injected at step {self.step}; "
+            f"restart with mesh {self.new_mesh}"
+        )
+
+
+def merge_host_states(host_rows: Sequence[Mapping[str, Any]] | None,
+                      fallback: Mapping[str, Any]) -> tuple[dict, dict]:
+    """Merge per-host consumed-position shards into the global consumed state.
+
+    Under the global-cursor design every host's row is identical; a divergent
+    row means some host checkpointed a stale view (e.g. a prefetch worker
+    raced the save on that host). The merge takes the *minimum* cursor — the
+    conservative side: a too-small cursor re-feeds a batch the optimizer never
+    saw on every host (explicitly reported), a too-large one silently drops
+    data. Returns ``(merged_state, info)`` where info carries any skew for the
+    ``elastic_restore`` event.
+    """
+    merged = dict(fallback)
+    info: dict[str, Any] = {}
+    rows = [dict(r) for r in (host_rows or []) if isinstance(r, Mapping)]
+    if not rows:
+        return merged, info
+    cursors = [int(r.get("cursor", merged.get("cursor", 0))) for r in rows]
+    epochs = [int(r.get("epoch", merged.get("epoch", 0))) for r in rows]
+    # order rows by (epoch, cursor): the minimum consumed position wins
+    lo = min(range(len(rows)), key=lambda i: (epochs[i], cursors[i]))
+    merged.update({k: rows[lo][k] for k in ("epoch", "cursor") if k in rows[lo]})
+    if len(set(zip(epochs, cursors))) > 1:
+        info["host_cursor_skew"] = max(cursors) - min(cursors)
+        logger.warning(
+            "elastic: per-host consumed positions diverge (epochs=%s cursors=%s); "
+            "using the minimum — up to %d global batches will be re-fed",
+            epochs, cursors, info["host_cursor_skew"],
+        )
+    return merged, info
+
+
+def repartition_dataloader_state(
+    saved_state: Mapping[str, Any],
+    new_batch_size: int,
+    host_rows: Sequence[Mapping[str, Any]] | None = None,
+) -> tuple[dict, dict]:
+    """Convert a saved dataloader state into the new pod's global-batch units.
+
+    ``saved_state`` must carry the saving ``batch_size`` (data/loader.py
+    state_dict; legacy states without it are assumed same-size — the only
+    sound reading, and exact for every same-process-count reshape since the
+    batch size is ``micro_batch_size * process_count``, a function of the pod,
+    not the mesh). Returns ``(new_state, info)``:
+
+    - consumed examples = ``cursor * saved_batch_size`` (global-cursor
+      invariant: the first N entries of the epoch permutation);
+    - new cursor = ``consumed // new_batch_size``. When the division is exact
+      — every shrink/grow by a divisor-aligned factor, e.g. 4 hosts -> 2 —
+      resume is example-exact. A non-divisible reshape cannot be represented
+      by a batch cursor; the remainder examples are RE-FED (never dropped:
+      dropping examples silently biases the epoch, re-feeding at most one
+      partial global batch is visible in the loss curve and in
+      ``info['refed_examples']``).
+    """
+    saved = dict(saved_state)
+    new_bs = int(new_batch_size)
+    if new_bs <= 0:
+        raise ValueError(f"new_batch_size must be positive, got {new_bs}")
+    merged, info = merge_host_states(host_rows, saved)
+    old_bs = int(merged.get("batch_size") or new_bs)
+    cursor = int(merged.get("cursor", 0))
+    consumed = cursor * old_bs
+    new_cursor, rem = divmod(consumed, new_bs)
+    out = dict(merged)
+    out["cursor"] = new_cursor
+    out["batch_size"] = new_bs
+    info.update(
+        consumed_examples=consumed,
+        old_batch_size=old_bs,
+        new_batch_size=new_bs,
+        new_cursor=new_cursor,
+    )
+    if rem:
+        info["refed_examples"] = rem
+        logger.warning(
+            "elastic: consumed position %d examples is not a multiple of the new "
+            "global batch size %d; %d examples will be re-fed (cursor rounded "
+            "down — nothing is dropped)", consumed, new_bs, rem,
+        )
+    # epoch length in batches changes with the batch size; the loader re-derives
+    # it from len(dataset), so epoch/seed pass through unchanged
+    return out, info
+
+
+def plan_warmup_micro_counts(num_batches: int | None, grad_acc_steps: int) -> list[int]:
+    """Microbatch counts of every step shape the scheduler can emit.
+
+    The steady-state step carries ``grad_acc_steps`` microbatches; the epoch
+    tail can emit one trailing partial accumulation of ``num_batches %
+    grad_acc_steps`` (training/step_scheduler.py). AOT warmup pre-compiles the
+    trailing shape so it executes through a compiled variant instead of
+    silently demoting to a mid-run jit compile. Returns the *extra* counts to
+    pre-compile (the steady shape compiles on first use).
+    """
+    acc = max(int(grad_acc_steps), 1)
+    if num_batches is None or acc <= 1:
+        return []
+    trailing = int(num_batches) % acc
+    return [trailing] if 0 < trailing < acc else []

@@ -534,6 +534,45 @@ _BACKEND_ERRORS = ("initialize backend", "UNAVAILABLE", "No visible",
                    "TPU platform", "halted", "hardware failure")
 
 
+def _init_backend(max_attempts: int = 3) -> str:
+    """Attach the JAX backend with bounded retry + exponential backoff
+    (``utils/retry.py`` policy curve). A TPU attach can fail transiently while
+    a previous holder releases the chips ("Device or resource busy",
+    UNAVAILABLE) — sleeping through the handoff beats falling straight to the
+    tiny CPU bench. Only errors matching ``_BACKEND_ERRORS`` retry; anything
+    else is a code bug and raises immediately. On exhaustion the LAST named
+    init error raises, and main() routes it into the guaranteed final JSON
+    line (``fallback_reason`` on the CPU-fallback doc, or the ``error`` field
+    when even that fails)."""
+    from automodel_tpu.utils.retry import RetryConfig
+
+    policy = RetryConfig(max_attempts=max_attempts, base_delay_s=1.0,
+                         max_delay_s=15.0)
+    last: Exception | None = None
+    for attempt in range(max(int(max_attempts), 1)):
+        try:
+            import jax
+
+            return jax.default_backend()  # first real backend touch
+        except Exception as exc:  # noqa: BLE001 — filtered just below
+            if not any(marker in repr(exc) for marker in _BACKEND_ERRORS):
+                raise
+            last = exc
+            if attempt + 1 >= max_attempts:
+                break
+            d = policy.delay(attempt)
+            print(
+                f"bench: backend init failed (attempt {attempt + 1}/"
+                f"{max_attempts}): {exc!r} — retrying in {d:.1f}s",
+                file=sys.stderr,
+            )
+            time.sleep(d)
+    assert last is not None
+    raise RuntimeError(
+        f"backend init failed after {max_attempts} attempts: {last!r}"
+    ) from last
+
+
 def _canary_dispatch() -> None:
     """One trivial jitted op through the attached backend. A backend that
     initializes but cannot execute (driver/libtpu mismatch, wedged chip) fails
@@ -610,9 +649,12 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps({"ok": False, "error": repr(exc)}), flush=True)
             return 1
     try:
+        # retried attach: a transient init failure (chip handoff, UNAVAILABLE)
+        # gets backoff before the exception routes to the CPU fallback below
+        backend = _init_backend()
         import jax
 
-        if jax.default_backend() == "cpu":
+        if backend == "cpu":
             # TPU-less host with a working CPU backend: the full 1B bench
             # would grind for hours — go straight to the tiny fallback.
             print("bench: no accelerator attached; running tiny CPU fallback",

@@ -1,0 +1,24 @@
+"""Pytest entry for the elastic smoke (tools/elastic_smoke.py,
+docs/resilience.md "Elastic restore & warm restart").
+
+Marked ``elastic`` + ``slow`` so it stays out of the tier-1 ``-m 'not slow'``
+suite; run explicitly with ``pytest -m elastic``. Each training phase runs in
+its own subprocess pinned to a different virtual-device count — the one
+scenario the in-process coverage (tests/functional/test_elastic.py) cannot
+exercise.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "tools"))
+
+
+@pytest.mark.elastic
+@pytest.mark.slow
+def test_elastic_smoke(tmp_path):
+    import elastic_smoke
+
+    assert elastic_smoke.main(str(tmp_path)) == 0
