@@ -4,7 +4,18 @@ routing/dispatch telemetry, cross-host metric aggregation, a unified trace
 timeline, and a perf-regression gate (docs/observability.md)."""
 
 from automodel_tpu.observability import compile_cache
-from automodel_tpu.observability.aggregate import CrossHostAggregator
+from automodel_tpu.observability.aggregate import CrossHostAggregator, host_keys
+from automodel_tpu.observability.dynamics import (
+    DynamicsConfig,
+    DynamicsStats,
+    DynamicsTracker,
+    SpikeFlightRecorder,
+    bucket_for_path,
+    dynamics_tree,
+    first_nonfinite_bucket,
+    flatten_dynamics,
+    nonfinite_provenance,
+)
 from automodel_tpu.observability.events import TraceTimeline
 from automodel_tpu.observability.goodput import BUCKETS, GoodputTracker
 from automodel_tpu.observability.hlo_costs import (
@@ -40,6 +51,9 @@ compile_cache.install()
 __all__ = [
     "BUCKETS",
     "CrossHostAggregator",
+    "DynamicsConfig",
+    "DynamicsStats",
+    "DynamicsTracker",
     "GoodputTracker",
     "MemoryPlan",
     "MoEStats",
@@ -47,9 +61,16 @@ __all__ = [
     "Observability",
     "ObservabilityConfig",
     "OnDemandProfiler",
+    "SpikeFlightRecorder",
     "StallWatchdog",
     "TraceTimeline",
+    "bucket_for_path",
     "build_memory_plan",
+    "dynamics_tree",
+    "first_nonfinite_bucket",
+    "flatten_dynamics",
+    "host_keys",
+    "nonfinite_provenance",
     "collective_bytes",
     "collective_bytes_by_axis",
     "compile_cache",

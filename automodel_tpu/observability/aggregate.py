@@ -25,7 +25,8 @@ from typing import Any, Callable, Sequence
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["CrossHostAggregator", "HOST_KEYS", "MOE_HOST_KEYS"]
+__all__ = ["CrossHostAggregator", "HOST_KEYS", "MOE_HOST_KEYS",
+           "DYNAMICS_HOST_KEYS", "host_keys"]
 
 # the per-host sample, in wire order; headroom (limit - in_use, from the
 # allocator or the analytic memory plan) travels so proc 0 can flag the host
@@ -34,6 +35,20 @@ HOST_KEYS = ("step_time_s", "data_wait_s", "hbm_gib_peak", "hbm_headroom_gib")
 # MoE runs append the host's max expert utilization (>1 = hot expert); a
 # separate tuple so dense runs keep the exact legacy wire format
 MOE_HOST_KEYS = HOST_KEYS + ("moe_max_util",)
+# dynamics runs append the host's view of the (replicated) global grad norm:
+# every host must see the same scalar, so cross-host disagreement is replica
+# desync — bitrot in a collective, a bad chip, or divergent param state
+DYNAMICS_HOST_KEYS = ("grad_norm",)
+
+
+def host_keys(moe: bool = False, dynamics: bool = False) -> tuple[str, ...]:
+    """The wire key tuple for a run's pillar mix; extensions append in a
+    fixed order so every host derives an identical format from the shared
+    config (the aggregate contract — no negotiation on the wire)."""
+    keys = MOE_HOST_KEYS if moe else HOST_KEYS
+    if dynamics:
+        keys = keys + DYNAMICS_HOST_KEYS
+    return keys
 
 
 def _median(vals: Sequence[float]) -> float:
@@ -54,11 +69,13 @@ class CrossHostAggregator:
                  keys: Sequence[str] = HOST_KEYS,
                  allgather_fn: Callable[[Sequence[float]], list] | None = None,
                  process_count: int | None = None,
-                 oom_risk_gib: float = 1.0):
+                 oom_risk_gib: float = 1.0,
+                 divergence_rtol: float = 1e-4):
         if straggler_factor <= 1.0:
             raise ValueError(f"straggler_factor must be > 1, got {straggler_factor}")
         self.straggler_factor = float(straggler_factor)
         self.oom_risk_gib = float(oom_risk_gib)
+        self.divergence_rtol = float(divergence_rtol)
         self.keys = tuple(keys)
         if allgather_fn is None:
             import jax
@@ -102,6 +119,7 @@ class CrossHostAggregator:
         self._flag_straggler(rows, out)
         self._flag_hot_expert(rows, out)
         self._flag_oom_risk(rows, out)
+        self._flag_divergent(rows, out)
         return out
 
     def _worst_vs_median(self, rows: list, key: str) -> tuple[float, int] | None:
@@ -156,3 +174,35 @@ class CrossHostAggregator:
         if worst < self.oom_risk_gib:
             out["oom_risk_host"] = host
             out["oom_risk_headroom_gib"] = round(worst, 3)
+
+    def _flag_divergent(self, rows: list, out: dict[str, Any]) -> None:
+        """Flag the host whose view of the replicated grad norm disagrees.
+
+        ``grad_norm`` is a pod-replicated scalar: XLA reduces it across every
+        data axis, so each host must read back the same value up to float
+        noise. Relative deviation beyond ``divergence_rtol`` is not a hot
+        input or a slow chip — it is replica desync (a corrupted collective,
+        a flipped bit in param state, a host that silently restarted with
+        stale weights) and the flagged host is where the state dump belongs.
+        A NaN on exactly one host flags that host for the same reason.
+        """
+        if "grad_norm" not in self.keys:
+            return
+        idx = self.keys.index("grad_norm")
+        vals = [(r[idx], host) for host, r in enumerate(rows)]
+        finite = [(v, h) for v, h in vals if not math.isnan(v)]
+        if len(vals) < 2:
+            return
+        nan_hosts = [h for v, h in vals if math.isnan(v)]
+        if nan_hosts and finite:
+            out["divergent_host"] = nan_hosts[0]
+            out["divergence_rel"] = math.inf
+            return
+        if len(finite) < 2:
+            return
+        med = _median([v for v, _ in finite])
+        scale = max(abs(med), 1e-12)
+        rel, host = max((abs(v - med) / scale, h) for v, h in finite)
+        if rel > self.divergence_rtol:
+            out["divergent_host"] = host
+            out["divergence_rel"] = round(rel, 6)

@@ -1,0 +1,614 @@
+"""Training-dynamics & numerics telemetry: the per-layer ``dynamics/*`` rows.
+
+The train loop's native signal is two scalars (loss, grad_norm) and one
+boolean (nonfinite). That is enough to *detect* a divergence and not nearly
+enough to *localize* one — a loss spike at step 40k names no layer, and a
+tripped nonfinite guard says "somewhere". This module is the missing axis of
+the observability lab (docs/observability.md): what the optimizer is actually
+doing to the weights, per top-level module subtree.
+
+Two halves, mirroring the memory pillar's split:
+
+**In-graph** (called from ``training/train_step.py`` inside jit): pure
+reductions over the grad/param/update/optimizer-moment pytrees, bucketed by
+top-level module path using the same block taxonomy as the profiler scopes
+(``utils/tracing.py scope_blocks``: attention / mlp / moe). Each bucket
+reduces to four scalars — grad norm, param norm, update-to-weight ratio,
+first-moment norm — so nothing but replicated scalars ever leaves the device:
+the sums run sharded and XLA inserts the same cross-device reduction
+``optax.global_norm`` already pays for. A ``num`` pseudo-bucket carries the
+numerics counters (grad amax + fp8 e4m3/e5m2 saturation fractions on the grad
+path, ``ops/fp8.py`` constants) and, under ``guard_nonfinite``, a per-subtree
+isfinite map gives nonfinite provenance: the skip event can name the first
+offending subtree instead of a bare boolean.
+
+**Host-side**: per-layer EMA trends and excursion attribution
+(:class:`DynamicsStats`), and a loss-spike flight recorder modeled on
+``observability/oom.py`` — continuously cheap (ring buffers of recent
+dynamics/metric rows), expensive only at the excursion, when it dumps
+``spike_report.json`` with the per-layer history, the suspect layer, the
+offending batch fingerprint, and the last N metric rows. ``dump`` never
+raises; a failed report must not take down the run it is documenting.
+
+Overhead contract (docs/observability.md "Training dynamics & numerics"): the
+per-bucket reductions are computed every step when the pillar is enabled (they
+fuse into the step like ``global_norm`` does), while the *host sync* — pulling
+the ~two dozen scalars — happens only every ``dynamics.every_n_steps``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import logging
+import os
+import signal as _signal
+import threading
+import time
+import zlib
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "DynamicsConfig",
+    "DynamicsStats",
+    "DynamicsTracker",
+    "SpikeFlightRecorder",
+    "batch_fingerprint",
+    "bucket_for_path",
+    "dynamics_tree",
+    "first_nonfinite_bucket",
+    "flatten_dynamics",
+    "nonfinite_provenance",
+    "subtree_sq_norms",
+]
+
+# leaf-name -> block taxonomy, matching the profiler scope names the layer
+# bodies install (transformer.py/moe_transformer.py scope_blocks: "attention",
+# "mlp", "moe"). Prefix match on ANY path component, so the dense tree
+# ("layers", "wq"), the LoRA tree ("layers", "wq", "lora_a") and the MoE tree
+# ("layers", "moe", "w_gate") all land where a profiler trace would put them.
+_MOE_PREFIXES = ("moe", "router", "expert", "shared_expert")
+_ATTN_PREFIXES = ("wq", "wk", "wv", "wo", "bq", "bk", "bv", "bo",
+                  "attn", "q_norm", "k_norm", "sink")
+_MLP_PREFIXES = ("w_gate", "w_up", "w_down", "mlp", "c_fc", "c_proj")
+
+# the pseudo-bucket carrying tree-wide numerics counters; never produced by
+# path classification (it has no leading module-path component)
+NUMERICS_BUCKET = "num"
+
+
+def _matches(name: str, prefixes: tuple[str, ...]) -> bool:
+    return any(name.startswith(p) for p in prefixes)
+
+
+def bucket_for_path(path: tuple) -> str:
+    """Top-level-module bucket for one pytree leaf path.
+
+    Non-layer top-level entries ("embed", "final_norm", "lm_head") are their
+    own buckets; anything under "layers" is classified into the scope-block
+    taxonomy ("layers.attention" / "layers.mlp" / "layers.moe", fallback
+    "layers.other"). Unknown structures degrade to their first path component
+    so PEFT/custom trees still bucket deterministically.
+    """
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "name", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        parts.append(str(p if key is None else key))
+    if not parts:
+        return "params"
+    head = parts[0]
+    if head != "layers":
+        return head
+    for name in parts[1:]:
+        if _matches(name, _MOE_PREFIXES):
+            return "layers.moe"
+        if _matches(name, _ATTN_PREFIXES):
+            return "layers.attention"
+        if _matches(name, _MLP_PREFIXES):
+            return "layers.mlp"
+    return "layers.other"
+
+
+def _float_leaves_with_buckets(tree: Any):
+    """(bucket, f32 leaf) pairs for every floating leaf of ``tree``."""
+    import jax
+    import jax.numpy as jnp
+
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if not hasattr(leaf, "dtype") or not jnp.issubdtype(leaf.dtype, jnp.floating):
+            continue
+        out.append((bucket_for_path(path), leaf))
+    return out
+
+
+def subtree_sq_norms(tree: Any) -> dict[str, Any]:
+    """Per-bucket sum of squares (fp32), as replicated device scalars.
+
+    Reductions only — each sharded leaf reduces in place and XLA derives the
+    cross-device sum from the sharding; no tensor is gathered to host.
+    """
+    import jax.numpy as jnp
+
+    out: dict[str, Any] = {}
+    for bucket, leaf in _float_leaves_with_buckets(tree):
+        sq = jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        out[bucket] = sq if bucket not in out else out[bucket] + sq
+    return out
+
+
+def _subtree_all_finite(tree: Any) -> dict[str, Any]:
+    """Per-bucket all-isfinite flags (device bool scalars)."""
+    import jax.numpy as jnp
+
+    out: dict[str, Any] = {}
+    for bucket, leaf in _float_leaves_with_buckets(tree):
+        ok = jnp.all(jnp.isfinite(leaf))
+        out[bucket] = ok if bucket not in out else out[bucket] & ok
+    return out
+
+
+def _find_moment_tree(opt_state: Any) -> Any:
+    """First first-moment accumulator found in an optax state tree, or None
+    (optimizers without one — adafactor, plain sgd — simply omit the
+    ``moment_norm`` metric). The walk itself lives with the optimizer
+    builders, which own the state shapes it must understand."""
+    from automodel_tpu.optim.builder import first_moment_tree
+
+    return first_moment_tree(opt_state)
+
+
+def dynamics_tree(grads: Any, params: Any, updates: Any,
+                  opt_state: Any = None) -> dict[str, dict[str, Any]]:
+    """The compact per-subtree dynamics pytree the jitted step returns.
+
+    ``{bucket: {grad_norm, param_norm, upd_ratio[, moment_norm]}}`` plus the
+    ``num`` pseudo-bucket with tree-wide numerics counters: grad amax and the
+    fraction of grad values past the fp8 e4m3/e5m2 representable maxima
+    (``ops/fp8.py``) — the saturation-overflow signal a precision downshift
+    must watch. All values are fp32 device scalars; call inside jit.
+    """
+    import jax.numpy as jnp
+
+    from automodel_tpu.ops.fp8 import E4M3_MAX, E5M2_MAX
+
+    g_sq = subtree_sq_norms(grads)
+    p_sq = subtree_sq_norms(params)
+    u_sq = subtree_sq_norms(updates)
+    m_sq: dict[str, Any] = {}
+    moments = _find_moment_tree(opt_state) if opt_state is not None else None
+    if moments is not None:
+        m_sq = subtree_sq_norms(moments)
+
+    out: dict[str, dict[str, Any]] = {}
+    for bucket in g_sq:
+        row = {
+            "grad_norm": jnp.sqrt(g_sq[bucket]),
+            "param_norm": jnp.sqrt(p_sq.get(bucket, jnp.float32(0.0))),
+            "upd_ratio": jnp.sqrt(u_sq.get(bucket, jnp.float32(0.0)))
+            / jnp.maximum(jnp.sqrt(p_sq.get(bucket, jnp.float32(0.0))), 1e-12),
+        }
+        if bucket in m_sq:
+            row["moment_norm"] = jnp.sqrt(m_sq[bucket])
+        out[bucket] = row
+
+    # numerics counters on the grad path: amax + saturation fractions vs the
+    # fp8 formats the bwd/fwd quantizers use, and the nonfinite value count
+    amax = jnp.float32(0.0)
+    e4m3_sat = jnp.float32(0.0)
+    e5m2_sat = jnp.float32(0.0)
+    nonfinite_ct = jnp.float32(0.0)
+    count = jnp.float32(0.0)
+    for _, leaf in _float_leaves_with_buckets(grads):
+        a = jnp.abs(leaf.astype(jnp.float32))
+        amax = jnp.maximum(amax, jnp.max(a))
+        e4m3_sat = e4m3_sat + jnp.sum(a >= E4M3_MAX)
+        e5m2_sat = e5m2_sat + jnp.sum(a >= E5M2_MAX)
+        nonfinite_ct = nonfinite_ct + jnp.sum(~jnp.isfinite(leaf))
+        count = count + jnp.float32(leaf.size)
+    denom = jnp.maximum(count, 1.0)
+    out[NUMERICS_BUCKET] = {
+        "grad_amax": amax,
+        "e4m3_sat_frac": e4m3_sat / denom,
+        "e5m2_sat_frac": e5m2_sat / denom,
+        "nonfinite_ct": nonfinite_ct,
+    }
+    return out
+
+
+def nonfinite_provenance(grads: Any, loss: Any) -> dict[str, Any]:
+    """Per-subtree nonfinite flags (True = bucket carries a nonfinite grad).
+
+    Joined by a ``loss`` entry so a nonfinite loss with finite grads (a fwd
+    overflow the bwd zeroed) still names its origin. Device bools; the host
+    names the first offending bucket via :func:`first_nonfinite_bucket`.
+    """
+    import jax.numpy as jnp
+
+    finite = _subtree_all_finite(grads)
+    out = {bucket: ~ok for bucket, ok in finite.items()}
+    out["loss"] = ~jnp.isfinite(loss)
+    return out
+
+
+def first_nonfinite_bucket(nonfinite_map: dict[str, Any]) -> str | None:
+    """First offending subtree in canonical order, from host-side values."""
+    import numpy as np
+
+    named = [b for b in sorted(nonfinite_map) if b != "loss"]
+    for bucket in named:
+        if bool(np.asarray(nonfinite_map[bucket])):
+            return bucket
+    if "loss" in nonfinite_map and bool(np.asarray(nonfinite_map["loss"])):
+        return "loss"
+    return None
+
+
+def flatten_dynamics(tree: dict[str, dict[str, Any]],
+                     ndigits: int = 6) -> dict[str, float]:
+    """Device dynamics pytree -> flat ``dynamics/<layer>/<metric>`` floats."""
+    import numpy as np
+
+    out: dict[str, float] = {}
+    for bucket in sorted(tree):
+        for metric in sorted(tree[bucket]):
+            val = float(np.asarray(tree[bucket][metric]))
+            out[f"dynamics/{bucket}/{metric}"] = round(val, ndigits)
+    return out
+
+
+def batch_fingerprint(stack: Any) -> dict[str, Any]:
+    """Cheap identity of one batch stack for the spike report: shapes + a
+    CRC32 of the host-addressable token-id shards. Host-local by design
+    (multi-host arrays only expose addressable shards) and never raises —
+    the fingerprint is forensic garnish, not load-bearing."""
+    import numpy as np
+
+    out: dict[str, Any] = {}
+    try:
+        for key in ("input_ids", "q_ids", "p_ids", "labels"):
+            arr = stack.get(key) if hasattr(stack, "get") else None
+            if arr is None:
+                continue
+            out[f"{key}_shape"] = list(getattr(arr, "shape", ()))
+            shards = getattr(arr, "addressable_shards", None)
+            crc = 0
+            if shards is not None:
+                for shard in shards:
+                    crc = zlib.crc32(np.ascontiguousarray(shard.data).tobytes(), crc)
+            else:
+                crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
+            out[f"{key}_crc32"] = int(crc)
+    except Exception:
+        logger.debug("batch fingerprint failed", exc_info=True)
+        out["fingerprint_error"] = True
+    return out
+
+
+# --------------------------------------------------------------------- config
+@dataclasses.dataclass
+class DynamicsConfig:
+    enabled: bool = False
+    every_n_steps: int = 10  # host-sync cadence for the dynamics scalars
+    ema_decay: float = 0.9  # per-layer trend EMA
+    history: int = 50  # dynamics rows kept for the spike report
+    spike_zscore: float = 6.0  # loss z-score that trips the flight recorder
+    spike_window: int = 32  # rolling losses behind the z-score
+    spike_min_history: int = 8  # losses before excursions are judged
+    spike_keep_rows: int = 20  # metric rows ringed into the report
+    spike_cooldown_steps: int = 50  # min steps between self-triggered dumps
+    snapshot_signal: str | None = "SIGUSR2"  # on-demand snapshot; None = off
+
+    @classmethod
+    def from_dict(cls, raw: Any) -> "DynamicsConfig":
+        """Build from the ``observability.dynamics`` YAML subsection."""
+        if raw is None:
+            return cls()
+        if isinstance(raw, bool):
+            return cls(enabled=raw)
+        if hasattr(raw, "to_dict"):
+            raw = raw.to_dict()
+        d = dict(raw)
+        kw: dict[str, Any] = {"enabled": bool(d.get("enabled", True))}
+        for field, cast in (("every_n_steps", int), ("ema_decay", float),
+                            ("history", int), ("spike_zscore", float),
+                            ("spike_window", int), ("spike_min_history", int),
+                            ("spike_keep_rows", int),
+                            ("spike_cooldown_steps", int)):
+            if d.get(field) is not None:
+                kw[field] = cast(d[field])
+        if "snapshot_signal" in d:
+            sig = d["snapshot_signal"]
+            kw["snapshot_signal"] = None if (not sig or str(sig).lower() == "none") else str(sig)
+        return cls(**kw)
+
+    def resolve_signal(self) -> int | None:
+        if not self.snapshot_signal:
+            return None
+        return getattr(_signal, str(self.snapshot_signal).upper())
+
+
+class DynamicsStats:
+    """Per-layer EMA trends + excursion attribution, host-side.
+
+    ``update(flat_row)`` folds one cadence row into per-(layer, metric) EMAs
+    and returns the EMA keys to append to the row
+    (``dynamics/<layer>/grad_norm_ema``). ``suspect()`` names the layer whose
+    current value deviates most from its own trend — the attribution a
+    rollback verdict cites. The ratio compares against the EMA *before* the
+    current sample so a genuine step change scores its full excursion.
+
+    A param-norm excursion outranks any grad-norm excursion: backprop spreads
+    a corrupted layer's gradient blowup to every subtree upstream of it (the
+    worst grad ratio typically lands far from the fault), while the weights
+    themselves only jump in the subtree that was actually mutated. Among
+    param-norm excursions past ``_PARAM_EXCURSION`` the largest wins; with
+    none (e.g. a bad batch: loss spikes, weights are fine) the worst grad-norm
+    ratio attributes as before.
+    """
+
+    # metrics whose excursions are attribution-worthy; upd_ratio tracks lr
+    # schedule moves too closely to blame a layer with
+    _ATTRIB_METRICS = ("grad_norm", "param_norm")
+    # a >10x jump in a subtree's weight norm in one cadence window is never
+    # healthy optimization — treat it as the fault site
+    _PARAM_EXCURSION = 10.0
+
+    def __init__(self, ema_decay: float = 0.9):
+        if not 0.0 < ema_decay < 1.0:
+            raise ValueError(f"ema_decay must be in (0, 1), got {ema_decay}")
+        self.ema_decay = float(ema_decay)
+        self._ema: dict[str, float] = {}  # "layer/metric" -> ema
+        self._last_suspect: tuple[str, str, float] | None = None
+
+    def update(self, flat_row: dict[str, float]) -> dict[str, float]:
+        best: tuple[float, str, str] | None = None
+        best_param: tuple[float, str, str] | None = None
+        out: dict[str, float] = {}
+        for key, val in flat_row.items():
+            if not key.startswith("dynamics/"):
+                continue
+            _, layer, metric = key.split("/", 2)
+            if layer == NUMERICS_BUCKET:
+                continue
+            ref = f"{layer}/{metric}"
+            prev = self._ema.get(ref)
+            if (metric in self._ATTRIB_METRICS and prev is not None
+                    and val == val):  # NaN never attributes via ratio
+                ratio = val / max(prev, 1e-12)
+                if best is None or ratio > best[0]:
+                    best = (ratio, layer, metric)
+                if (metric == "param_norm" and ratio > self._PARAM_EXCURSION
+                        and (best_param is None or ratio > best_param[0])):
+                    best_param = (ratio, layer, metric)
+            if val == val:  # nonfinite samples must not poison the trend
+                self._ema[ref] = (val if prev is None
+                                  else self.ema_decay * prev
+                                  + (1 - self.ema_decay) * val)
+            if metric == "grad_norm" and ref in self._ema:
+                out[f"dynamics/{layer}/grad_norm_ema"] = round(self._ema[ref], 6)
+        # corrupted weights localize via param_norm; grad blowups propagate
+        if best_param is not None:
+            best = best_param
+        if best is not None:
+            self._last_suspect = (best[1], best[2], round(best[0], 4))
+        return out
+
+    def suspect(self) -> tuple[str, str, float] | None:
+        """(layer, metric, ratio-vs-trend) of the worst recent excursion."""
+        return self._last_suspect
+
+
+class SpikeFlightRecorder:
+    """Continuously cheap, expensive only at the excursion (oom.py contract).
+
+    ``observe`` keeps a rolling loss window and returns the z-score when the
+    current loss is an excursion; ``record_dynamics``/``record_row`` are deque
+    appends. ``dump`` writes ``spike_report.json`` atomically and NEVER raises
+    — the report documents a failing run, it must not become the failure.
+    """
+
+    def __init__(self, out_dir: str, zscore_threshold: float = 6.0,
+                 window: int = 32, min_history: int = 8,
+                 keep_rows: int = 20, history: int = 50,
+                 cooldown_steps: int = 50):
+        self.out_dir = str(out_dir)
+        self.report_path = os.path.join(self.out_dir, "spike_report.json")
+        self.zscore_threshold = float(zscore_threshold)
+        self.min_history = max(int(min_history), 2)
+        self.cooldown_steps = int(cooldown_steps)
+        self._losses: collections.deque = collections.deque(maxlen=max(int(window), 2))
+        self._dyn_rows: collections.deque = collections.deque(maxlen=max(int(history), 1))
+        self._rows: collections.deque = collections.deque(maxlen=max(int(keep_rows), 1))
+        self._last_dump_step: int | None = None
+        self.dumps = 0
+
+    def observe(self, step: int, loss: float) -> float | None:
+        """z-score when ``loss`` is an excursion vs the rolling window, else
+        None. Excursions (and nonfinite losses, scored as inf) never enter the
+        window — a spike must not inflate the std it is judged against."""
+        import math
+
+        if not math.isfinite(loss):
+            return math.inf
+        if len(self._losses) >= self.min_history:
+            n = len(self._losses)
+            mean = sum(self._losses) / n
+            var = sum((x - mean) ** 2 for x in self._losses) / n
+            std = max(math.sqrt(var), 1e-3, 1e-3 * abs(mean))
+            z = (loss - mean) / std
+            if z > self.zscore_threshold:
+                return z
+        self._losses.append(float(loss))
+        return None
+
+    def record_dynamics(self, step: int, flat_row: dict[str, float]) -> None:
+        self._dyn_rows.append({"step": int(step), **flat_row})
+
+    def record_row(self, step: int, row: dict[str, Any]) -> None:
+        self._rows.append({"step": int(step), **row})
+
+    def in_cooldown(self, step: int) -> bool:
+        return (self._last_dump_step is not None
+                and step - self._last_dump_step < self.cooldown_steps)
+
+    def dump(self, step: int, reason: str, loss: float | None = None,
+             zscore: float | None = None,
+             suspect: tuple[str, str, float] | None = None,
+             batch: dict[str, Any] | None = None) -> str | None:
+        """Write ``spike_report.json``; returns its path, or None on failure."""
+        try:
+            self._last_dump_step = int(step)
+            report: dict[str, Any] = {
+                "spike_report": True,
+                "time_unix": time.time(),
+                "step": int(step),
+                "reason": str(reason),
+                "loss": loss,
+                "zscore": zscore,
+                "suspect": (None if suspect is None else
+                            {"layer": suspect[0], "metric": suspect[1],
+                             "ratio_vs_ema": suspect[2]}),
+                "batch": batch or {},
+                "loss_window": [round(x, 6) for x in self._losses],
+                "dynamics_history": list(self._dyn_rows),
+                "last_rows": list(self._rows),
+            }
+            os.makedirs(self.out_dir, exist_ok=True)
+            tmp = f"{self.report_path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(report, f, indent=2, default=str)
+                f.write("\n")
+            os.replace(tmp, self.report_path)
+            self.dumps += 1
+            logger.error("loss-spike flight recorder: report written to %s "
+                         "(reason=%s, suspect=%s)", self.report_path, reason,
+                         report["suspect"])
+            return self.report_path
+        except Exception:
+            logger.exception("spike flight recorder failed (run continues)")
+            return None
+
+
+class DynamicsTracker:
+    """The manager-facing bundle: cadence, EMA stats, flight recorder, and the
+    SIGUSR2 on-demand snapshot (mirror of the profiler's SIGUSR1 hook — the
+    handler only sets a flag; the dump happens on the train-loop thread)."""
+
+    def __init__(self, config: DynamicsConfig, out_dir: str,
+                 metric_sink: Callable[..., None] | None = None):
+        self.config = config
+        self.out_dir = str(out_dir)
+        self.stats = DynamicsStats(config.ema_decay)
+        self.recorder = SpikeFlightRecorder(
+            out_dir,
+            zscore_threshold=config.spike_zscore,
+            window=config.spike_window,
+            min_history=config.spike_min_history,
+            keep_rows=config.spike_keep_rows,
+            history=config.history,
+            cooldown_steps=config.spike_cooldown_steps,
+        )
+        self._sink = metric_sink
+        self.signum = config.resolve_signal()
+        self.snapshot_path = os.path.join(self.out_dir, "dynamics_snapshot.json")
+        self._snapshot_requested = False
+        self._prev_handler: Any = None
+        self._handler_installed = False
+        from automodel_tpu.ops.fp8 import AmaxHistory
+
+        self.amax_history = AmaxHistory()
+
+    # ------------------------------------------------------------- cadence/rows
+    def due(self, step: int) -> bool:
+        return step % max(int(self.config.every_n_steps), 1) == 0
+
+    def row(self, step: int, dyn_tree: dict[str, dict[str, Any]]) -> dict[str, float]:
+        """One cadence sample: flatten the device pytree, fold EMAs, join the
+        fp8 amax history, feed the flight-recorder ring."""
+        flat = flatten_dynamics(dyn_tree)
+        flat.update(self.stats.update(flat))
+        amax = flat.get(f"dynamics/{NUMERICS_BUCKET}/grad_amax")
+        if amax is not None:
+            flat.update(self.amax_history.update(amax))
+        self.recorder.record_dynamics(step, flat)
+        return flat
+
+    def grad_norm_of(self, flat_row: dict[str, float] | None) -> float | None:
+        """The whole-tree grad amax proxy the cross-host wire carries is the
+        per-step global grad_norm the recipe already has; this helper exists
+        for symmetry when only a dynamics row is at hand."""
+        if not flat_row:
+            return None
+        sq = sum(v * v for k, v in flat_row.items()
+                 if k.endswith("/grad_norm") and k.count("/") == 2)
+        return sq ** 0.5 if sq else None
+
+    # ----------------------------------------------------------------- signal
+    def start(self) -> "DynamicsTracker":
+        if self.signum is not None and not self._handler_installed:
+            if threading.current_thread() is not threading.main_thread():
+                logger.warning("dynamics snapshot handler not installed (non-main thread)")
+            else:
+                self._prev_handler = _signal.signal(self.signum, self._handle_signal)
+                self._handler_installed = True
+        return self
+
+    def _handle_signal(self, signum, frame) -> None:
+        self._snapshot_requested = True  # flag only: json/io is not signal-safe
+
+    def request_snapshot(self) -> None:
+        """Programmatic equivalent of SIGUSR2."""
+        self._snapshot_requested = True
+
+    def maybe_snapshot(self, step: int) -> str | None:
+        """Called at step boundaries: drain a pending SIGUSR2 request into an
+        on-demand snapshot of the dynamics state. Never raises."""
+        if not self._snapshot_requested:
+            return None
+        self._snapshot_requested = False
+        try:
+            doc = {
+                "dynamics_snapshot": True,
+                "time_unix": time.time(),
+                "step": int(step),
+                "ema": {k: round(v, 6) for k, v in sorted(self.stats._ema.items())},
+                "suspect": self.stats.suspect(),
+                "loss_window": [round(x, 6) for x in self.recorder._losses],
+                "dynamics_history": list(self.recorder._dyn_rows),
+            }
+            os.makedirs(self.out_dir, exist_ok=True)
+            tmp = f"{self.snapshot_path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=2, default=str)
+                f.write("\n")
+            os.replace(tmp, self.snapshot_path)
+            logger.info("dynamics snapshot written to %s", self.snapshot_path)
+            if self._sink is not None:
+                self._sink(step, event="dynamics_snapshot", path=self.snapshot_path)
+            return self.snapshot_path
+        except Exception:
+            logger.exception("dynamics snapshot failed (run continues)")
+            return None
+
+    def close(self) -> None:
+        """Idempotent; restores the previous handler SIG_IGN-faithfully (the
+        same `is not None` dance as OnDemandProfiler.close — SIG_DFL is falsy
+        and a C-installed handler reads back as None)."""
+        if self._handler_installed:
+            prev = self._prev_handler if self._prev_handler is not None else _signal.SIG_DFL
+            try:
+                _signal.signal(self.signum, prev)
+            except (ValueError, OSError):
+                logger.warning("could not restore previous %s handler", self.signum)
+            finally:
+                self._handler_installed = False
+                self._prev_handler = None
+        self._snapshot_requested = False

@@ -98,6 +98,21 @@ class TraceTimeline:
             "args": _jsonable_args(values),
         })
 
+    def counters_from_flat(self, flat: dict[str, Any], prefix: str = "dynamics",
+                           tid: int = 0) -> None:
+        """Fan a flat ``<prefix>/<group>/<metric>`` row into per-metric counter
+        tracks: one Chrome counter per metric, one series per group — so
+        ``dynamics/layers.mlp/grad_norm`` and its siblings render as a stacked
+        ``dynamics/grad_norm`` track with a line per layer bucket."""
+        by_metric: dict[str, dict[str, Any]] = {}
+        for key, val in flat.items():
+            parts = key.split("/")
+            if len(parts) != 3 or parts[0] != prefix:
+                continue
+            by_metric.setdefault(parts[2], {})[parts[1]] = val
+        for metric, series in by_metric.items():
+            self.counter(f"{prefix}/{metric}", tid=tid, **series)
+
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "phase", tid: int = 0, **args: Any):
         """Context manager emitting a complete event for the wrapped block."""

@@ -24,6 +24,7 @@ no-ops cleanly where its backing API is unavailable):
       aggregate: {enabled: true, straggler_factor: 2.0}
       watchdog: {enabled: true, threshold_s: 600}
       profiling: {server_port: 0, trace_steps: 5, signal: SIGUSR1}
+      dynamics: {enabled: true, every_n_steps: 10, spike_zscore: 6.0}
 """
 
 from __future__ import annotations
@@ -37,7 +38,8 @@ import time
 from typing import Any, Callable
 
 from automodel_tpu.observability import compile_cache
-from automodel_tpu.observability.aggregate import MOE_HOST_KEYS, CrossHostAggregator
+from automodel_tpu.observability.aggregate import CrossHostAggregator, host_keys
+from automodel_tpu.observability.dynamics import DynamicsConfig, DynamicsTracker
 from automodel_tpu.observability.events import TraceTimeline
 from automodel_tpu.observability.goodput import GoodputTracker
 from automodel_tpu.observability.hlo_costs import (
@@ -89,6 +91,8 @@ class ObservabilityConfig:
     aggregate: bool = True
     straggler_factor: float = 2.0
     oom_risk_gib: float = 1.0  # flag a host when its headroom drops below this
+    divergence_rtol: float = 1e-4  # replicated-scalar disagreement = desync
+    dynamics: DynamicsConfig = dataclasses.field(default_factory=DynamicsConfig)
     watchdog: bool = True
     watchdog_threshold_s: float = 600.0
     watchdog_poll_interval_s: float | None = None
@@ -138,6 +142,10 @@ class ObservabilityConfig:
                 kw["straggler_factor"] = float(agg["straggler_factor"])
             if agg.get("oom_risk_gib") is not None:
                 kw["oom_risk_gib"] = float(agg["oom_risk_gib"])
+            if agg.get("divergence_rtol") is not None:
+                kw["divergence_rtol"] = float(agg["divergence_rtol"])
+        if "dynamics" in raw:
+            kw["dynamics"] = DynamicsConfig.from_dict(raw["dynamics"])
         wd = raw.get("watchdog")
         if isinstance(wd, bool):
             kw["watchdog"] = wd
@@ -300,7 +308,12 @@ class Observability:
         self.aggregator: CrossHostAggregator | None = None
         if on and config.aggregate:
             self.aggregator = CrossHostAggregator(
-                config.straggler_factor, oom_risk_gib=config.oom_risk_gib)
+                config.straggler_factor, oom_risk_gib=config.oom_risk_gib,
+                divergence_rtol=config.divergence_rtol)
+        self.dynamics: DynamicsTracker | None = None
+        if on and config.dynamics.enabled:
+            self.dynamics = DynamicsTracker(config.dynamics, self.out_dir,
+                                            metric_sink=metric_sink)
         self.watchdog: StallWatchdog | None = None
         if on and config.watchdog:
             def on_stall(event: dict, _sink=metric_sink):
@@ -340,6 +353,8 @@ class Observability:
             self.watchdog.start()
         if self.profiler is not None:
             self.profiler.start()
+        if self.dynamics is not None:
+            self.dynamics.start()
         return self
 
     def close(self) -> None:
@@ -347,8 +362,15 @@ class Observability:
             self.watchdog.stop()
         if self.profiler is not None:
             self.profiler.close()
+        if self.dynamics is not None:
+            self.dynamics.close()
         if self.timeline is not None:
             self.timeline.close()
+
+    @property
+    def dynamics_enabled(self) -> bool:
+        """True when the train step should be built with ``dynamics=True``."""
+        return self.dynamics is not None
 
     def compile_summary(self) -> dict[str, Any]:
         """Run-total AOT/jit-fallback/demotion counts + compile-cache hits.
@@ -537,10 +559,24 @@ class Observability:
     def on_step_end(self, step: int, sync: Any = None) -> None:
         if self.profiler is not None:
             self.profiler.on_step_end(step, sync)
+        if self.dynamics is not None:
+            self.dynamics.maybe_snapshot(step)
         if self.timeline is not None and self._step_t0 is not None:
             self.timeline.complete("step", "step", self._step_t0,
                                    self.timeline.now() - self._step_t0, step=step)
             self._step_t0 = None
+
+    def dynamics_row(self, step: int, dyn_tree: Any) -> dict[str, float]:
+        """One cadence sample: fold the device dynamics pytree into the
+        tracker (EMAs, amax history, flight-recorder ring) and mirror the
+        per-layer series onto Chrome counter tracks. Returns the flat
+        ``dynamics/*`` keys the recipe merges into its log row."""
+        if self.dynamics is None:
+            return {}
+        flat = self.dynamics.row(step, dyn_tree)
+        if self.timeline is not None:
+            self.timeline.counters_from_flat(flat)
+        return flat
 
     def note_event(self, step: int, fields: dict[str, Any]) -> None:
         """Route structured events (stalls, resilience rollbacks/preemptions)
@@ -602,9 +638,13 @@ class Observability:
 
     # ------------------------------------------------------------------- OOM
     def record_row(self, step: int, row: dict[str, Any]) -> None:
-        """Feed the OOM flight recorder's ring of recent metric rows."""
+        """Feed the flight recorders' rings of recent metric rows (the OOM
+        one and the loss-spike one share the "context for a future crash
+        artifact" contract)."""
         if self.oom is not None:
             self.oom.record_row(step, row)
+        if self.dynamics is not None:
+            self.dynamics.recorder.record_row(step, row)
 
     def maybe_dump_oom(self, exc: BaseException, step: int | None = None) -> str | None:
         """Write ``oom_report.json`` when ``exc`` is an allocator exhaustion;
@@ -655,7 +695,8 @@ class Observability:
         return out
 
     def host_metrics(self, step_time_s: float | None,
-                     moe_max_util: float | None = None) -> dict[str, Any]:
+                     moe_max_util: float | None = None,
+                     grad_norm: float | None = None) -> dict[str, Any]:
         """Cross-host min/median/max + straggler flag for one log step.
 
         Collective on multi-host: every process must reach this call (the log
@@ -663,18 +704,25 @@ class Observability:
         MoE recipes pass their host-local max expert utilization — the wire
         format then grows the ``moe_max_util`` key (on every host, since the
         recipe config is identical pod-wide) and a ``hot_expert_host`` flag
-        joins the straggler one.
+        joins the straggler one. Dynamics runs pass the step's replicated
+        ``grad_norm``, growing the wire identically; disagreement across
+        hosts raises the ``divergent_host`` flag (replica desync).
         """
         if self.aggregator is None or not self.aggregator.active:
             return {}
-        if moe_max_util is not None and "moe_max_util" not in self.aggregator.keys:
-            # first MoE sample: widen the wire format once, identically on
-            # every host (the flag derives from the shared model config)
+        wanted = host_keys(
+            moe=moe_max_util is not None or "moe_max_util" in self.aggregator.keys,
+            dynamics=grad_norm is not None or "grad_norm" in self.aggregator.keys)
+        if wanted != self.aggregator.keys:
+            # first MoE/dynamics sample: widen the wire format once,
+            # identically on every host (the flags derive from config shared
+            # pod-wide, so every process rebuilds at the same log step)
             self.aggregator = CrossHostAggregator(
-                self.aggregator.straggler_factor, keys=MOE_HOST_KEYS,
+                self.aggregator.straggler_factor, keys=wanted,
                 allgather_fn=self.aggregator._allgather,
                 process_count=self.aggregator.process_count,
-                oom_risk_gib=self.aggregator.oom_risk_gib)
+                oom_risk_gib=self.aggregator.oom_risk_gib,
+                divergence_rtol=self.aggregator.divergence_rtol)
         sample: dict[str, Any] = {"step_time_s": step_time_s}
         if self.goodput is not None:
             sample["data_wait_s"] = round(self.goodput.totals().get("data_wait", 0.0), 4)
@@ -691,6 +739,8 @@ class Observability:
             sample["hbm_headroom_gib"] = headroom
         if moe_max_util is not None:
             sample["moe_max_util"] = float(moe_max_util)
+        if grad_norm is not None:
+            sample["grad_norm"] = float(grad_norm)
         out = self.aggregator.aggregate(sample)
         if self.timeline is not None and "straggler_host" in out:
             self.timeline.instant("straggler", cat="event",
@@ -700,4 +750,8 @@ class Observability:
             self.timeline.instant("hot_expert", cat="event",
                                   host=out["hot_expert_host"],
                                   ratio=out.get("hot_expert_ratio"))
+        if self.timeline is not None and "divergent_host" in out:
+            self.timeline.instant("divergent_replica", cat="event",
+                                  host=out["divergent_host"],
+                                  rel=out.get("divergence_rel"))
         return out

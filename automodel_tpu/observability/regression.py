@@ -102,9 +102,20 @@ def _from_bench_line(doc: dict[str, Any]) -> dict[str, float]:
 
 
 def _matrix_key(row: dict[str, Any]) -> str:
-    """Stable gate key for one bench-matrix row: matrix/<model>_s<seq>_pf<on|off>."""
+    """Stable gate key for one bench-matrix row: matrix/<model>_s<seq>_pf<on|off>.
+
+    Rows measured with the dynamics telemetry in-graph (``bench.py --dynamics``)
+    get a ``_dyn`` suffix: a different measurement condition must never gate
+    against the plain baseline cell by accident — it gets its own cells (and
+    its own baseline via ``--write-baseline``). The headline ``bench.py`` line
+    intentionally keeps the bare ``tps`` key either way: comparing the
+    dynamics-on dense row against the committed BASELINE.json tps within gate
+    tolerance is exactly how the overhead bound is *proven* rather than
+    asserted (docs/observability.md).
+    """
     pf = "on" if row.get("prefetch") else "off"
-    return f"matrix/{row.get('model')}_s{row.get('seq_len')}_pf{pf}"
+    dyn = "_dyn" if row.get("dynamics") else ""
+    return f"matrix/{row.get('model')}_s{row.get('seq_len')}_pf{pf}{dyn}"
 
 
 def _from_matrix_rows(rows: Iterable[dict[str, Any]]) -> dict[str, float]:

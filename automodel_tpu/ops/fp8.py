@@ -14,13 +14,47 @@ reference's filter_fqns default.
 
 from __future__ import annotations
 
+import collections
+
 import jax
 import jax.numpy as jnp
 
-__all__ = ["fp8_matmul", "project"]
+__all__ = ["AmaxHistory", "E4M3_MAX", "E5M2_MAX", "fp8_matmul", "project"]
 
-_E4M3_MAX = 448.0
-_E5M2_MAX = 57344.0
+# representable maxima of the two training formats; public so the dynamics
+# telemetry (observability/dynamics.py) can count grad values past the point
+# where the e4m3 fwd / e5m2 bwd quantizers would saturate
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+_E4M3_MAX = E4M3_MAX
+_E5M2_MAX = E5M2_MAX
+
+
+class AmaxHistory:
+    """Host-side rolling amax window (the delayed-scaling bookkeeping shape,
+    torchao Float8 history semantics): ``update(amax)`` folds one grad-path
+    amax sample and returns the ``dynamics/num/*`` row fields — the window
+    max (what a delayed-scaling recipe would derive its scale from) and the
+    current sample's headroom to e5m2 saturation in doublings."""
+
+    def __init__(self, window: int = 16):
+        self._window: collections.deque = collections.deque(maxlen=max(int(window), 1))
+
+    def update(self, amax: float) -> dict[str, float]:
+        import math
+
+        out: dict[str, float] = {}
+        a = float(amax)
+        if math.isfinite(a):
+            self._window.append(a)
+        if not self._window:
+            return out
+        hist_max = max(self._window)
+        out["dynamics/num/amax_hist_max"] = round(hist_max, 6)
+        if hist_max > 0:
+            out["dynamics/num/e5m2_margin_log2"] = round(
+                math.log2(E5M2_MAX / hist_max), 3)
+        return out
 
 
 def _quant(x: jnp.ndarray, dtype, fmax: float) -> tuple[jnp.ndarray, jnp.ndarray]:

@@ -1,4 +1,4 @@
-from automodel_tpu.optim.builder import build_optimizer
+from automodel_tpu.optim.builder import build_optimizer, first_moment_tree
 from automodel_tpu.optim.dion import build_dion_optimizer, dion
 from automodel_tpu.optim.scheduler import OptimizerParamScheduler, build_lr_schedule
 
@@ -8,4 +8,5 @@ __all__ = [
     "build_lr_schedule",
     "build_optimizer",
     "dion",
+    "first_moment_tree",
 ]

@@ -16,7 +16,31 @@ from typing import Any, Callable
 import jax
 import optax
 
-__all__ = ["build_optimizer", "no_decay_mask"]
+__all__ = ["build_optimizer", "first_moment_tree", "no_decay_mask"]
+
+
+def first_moment_tree(opt_state: Any) -> Any:
+    """First first-moment accumulator in an optax state tree, or None.
+
+    The dynamics pillar (observability/dynamics.py) reports a per-subtree
+    ``moment_norm``, which needs the optimizer's own view of the gradient
+    trend: walk the chain's state tuples breadth-first for a pytree-valued
+    field named ``mu`` (the adam families, including
+    :func:`low_mem_scale_by_adam`'s bf16 state) or ``trace`` (momentum SGD,
+    :func:`int8_trace`). Optimizers without a moment (adafactor, plain sgd)
+    return None and the telemetry row simply omits the metric. Works inside
+    jit — it only rearranges tree references, no value ops.
+    """
+    stack = [opt_state]
+    while stack:
+        node = stack.pop(0)
+        for field in ("mu", "trace"):
+            sub = getattr(node, field, None)
+            if sub is not None and not hasattr(sub, "dtype"):
+                return sub
+        if isinstance(node, (tuple, list)):
+            stack.extend(node)
+    return None
 
 
 def no_decay_mask(params: Any) -> Any:

@@ -73,6 +73,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
     _pre_qat_step = None
     _qat_start_step = 0
     _step_needs_rng = False
+    _dynamics = False  # set by _build_train_step when the dynamics pillar is on
     # static per-run fields a subclass wants appended to every training.jsonl row
     # (the KD recipe logs kd_ratio/temperature per row, reference kd.py:456)
     _static_log_fields: dict = {}
@@ -553,6 +554,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         # must zero non-finite updates so the tree the host later rolls back
         # from (or keeps, on skip_update) is never poisoned
         self._guard_nonfinite = self._check_nan_grads or self.resilience.guards_updates
+        # the dynamics pillar asks the jitted step for the per-subtree telemetry
+        # pytree; the reductions fuse into the step, the host syncs on cadence
+        self._dynamics = self.observability.dynamics_enabled
         qfn = self._qat_param_fn()
         qat_cfg = self.cfg.get("qat")
         qat_start = int(qat_cfg.get("fake_quant_after_n_steps") or 0) if qat_cfg else 0
@@ -605,14 +609,16 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     return make_pp_train_step(pp_peft_loss, self.optimizer,
                                               guard_nonfinite=self._guard_nonfinite,
                                               with_frozen=True,
-                                              pass_rng=use_dropout)
+                                              pass_rng=use_dropout,
+                                              dynamics=self._dynamics)
                 # qat x pp: quantize the stacked layer params (and head/embed)
                 # BEFORE the manual region — fake-quant is elementwise, GSPMD
                 # partitions it over the pp-sharded layer dim like any other op
                 return make_pp_train_step(lambda p, bs, n: pp_loss(q(p), bs, n),
                                           self.optimizer,
                                           post_update=pp_post_update,
-                                          guard_nonfinite=self._guard_nonfinite)
+                                          guard_nonfinite=self._guard_nonfinite,
+                                          dynamics=self._dynamics)
             if self.peft is not None:
                 from automodel_tpu.peft.lora import lora_merged_loss
 
@@ -627,11 +633,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 self._step_needs_rng = use_dropout
                 return make_train_step(peft_loss, self.optimizer, with_frozen=True,
                                        guard_nonfinite=self._guard_nonfinite,
-                                       pass_rng=use_dropout)
+                                       pass_rng=use_dropout,
+                                       dynamics=self._dynamics)
             return make_train_step(
                 lambda p, b, n: self._forward_loss(q(p), b, n),
                 self.optimizer, post_update=self._post_update(),
                 guard_nonfinite=self._guard_nonfinite,
+                dynamics=self._dynamics,
             )
 
         step = build(with_qat=True)
@@ -897,6 +905,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         steps_since_log = 0
         window_overhead = 0.0  # eval/ckpt seconds to exclude from step_time_s
         compiled_fns = self._compiled_fns
+        last_dyn_row: dict = {}  # latest cadence sample; merged into log rows
         while True:
             with obs.track("data_wait"):
                 # synchronous: fetch + collate + stack + device_put inline.
@@ -994,9 +1003,21 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 self.train_params, metrics = self.chaos.poison(
                     step, self.train_params, metrics
                 )
+            if self.chaos is not None and self.chaos.should_spike(step):
+                # finite-spike injection: one layer's params blow up, metrics
+                # stay clean — the NEXT step's loss z-score and per-layer
+                # dynamics must detect it organically and name the layer
+                self.train_params = self.chaos.spike(step, self.train_params)
             if self.peft is None:
                 self.params = self.train_params
             obs.heartbeat(step)
+            # dynamics pillar (observability/dynamics.py): fold the step's
+            # per-subtree telemetry on cadence, run the loss-spike flight
+            # recorder, and derive the per-layer attribution (layer_hint) the
+            # resilience verdicts and skip/raise events cite
+            dyn_row, layer_hint = self._dynamics_host_step(obs, step, metrics, stack)
+            if dyn_row:
+                last_dyn_row = dyn_row
             if self.resilience.active:
                 # same-step anomaly handling (docs/resilience.md): one
                 # scalar device->host sync per step buys detection before
@@ -1006,6 +1027,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     float(metrics["loss"]),
                     float(metrics["grad_norm"]),
                     bool(metrics.get("nonfinite", False)),
+                    layer=layer_hint,
                 )
                 if action == "rollback":
                     # stop the worker BEFORE restoring: it mutates the very
@@ -1019,7 +1041,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     raise RuntimeError(
                         f"resilience: unrecoverable training anomaly at step {step} "
                         f"(loss={float(metrics['loss'])}, "
-                        f"grad_norm={float(metrics['grad_norm'])}); "
+                        f"grad_norm={float(metrics['grad_norm'])}"
+                        + (f", layer={layer_hint}" if layer_hint else "") + "); "
                         "rollback budget exhausted or no verifiable checkpoint"
                     )
                 # skip_update: the jitted guard already zeroed the bad
@@ -1033,8 +1056,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 raise RuntimeError(
                     f"non-finite training signal at step {step}: "
                     f"loss={float(metrics['loss'])} "
-                    f"grad_norm={float(metrics['grad_norm'])} "
-                    "(the offending update was skipped; params remain clean)"
+                    f"grad_norm={float(metrics['grad_norm'])}"
+                    + (f" first nonfinite subtree={layer_hint}" if layer_hint else "")
+                    + " (the offending update was skipped; params remain clean)"
                 )
             if self.step_scheduler.is_log_step_at(step):
                 with obs.track("device_step"):
@@ -1124,12 +1148,20 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     else:  # compile-only window: keys present, no rate yet
                         row["tflops_per_chip"] = None
                         row["mfu"] = None
+                if last_dyn_row:
+                    # the most recent cadence sample of the per-layer dynamics
+                    # telemetry rides the log row (dynamics/<layer>/<metric>)
+                    row.update(last_dyn_row)
                 row.update(obs.step_metrics())
                 row.update(obs.roofline_row(dt))
                 # collective on multi-host: every process reaches the log step
                 # (the schedule is deterministic), proc 0 writes the result;
-                # MoE runs gather max expert utilization too (hot_expert_host)
-                row.update(obs.host_metrics(dt, moe_max_util=moe_max_util))
+                # MoE runs gather max expert utilization too (hot_expert_host);
+                # dynamics runs gather the replicated grad_norm so cross-host
+                # disagreement raises divergent_host (replica desync)
+                row.update(obs.host_metrics(
+                    dt, moe_max_util=moe_max_util,
+                    grad_norm=gnorm if self._dynamics else None))
                 self.metric_logger.log(step, **row)
                 for lg in self.experiment_loggers:
                     lg.log(step, **row)
@@ -1192,6 +1224,63 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 with obs.track("checkpoint"):
                     self._save(step, consolidated=consolidated)
                 return "preempted"
+
+    def _dynamics_host_step(self, obs, step: int, metrics: dict,
+                            stack) -> tuple[dict, str | None]:
+        """Host half of the dynamics pillar for one step.
+
+        Returns ``(dyn_row, layer_hint)``: the flat ``dynamics/*`` row when
+        this step is a cadence (or excursion) sample, else ``{}``; and the
+        per-layer attribution — nonfinite provenance when the guard tripped,
+        otherwise the flight recorder's EMA-excursion suspect on a loss
+        spike — that the resilience verdicts and skip/raise messages cite.
+
+        The per-bucket reductions already ran in-graph; what is gated on the
+        cadence here is only the device->host sync of the ~two dozen scalars
+        (the overhead contract, docs/observability.md). A loss z-score
+        excursion forces an off-cadence sample so the spike report and the
+        attribution see the offending step itself, and dumps
+        ``spike_report.json`` (never raises) outside its cooldown.
+        """
+        tracker = obs.dynamics
+        if tracker is None or "dynamics" not in metrics:
+            return {}, None
+        layer_hint = None
+        import math as _math
+
+        from automodel_tpu.observability.dynamics import (
+            batch_fingerprint,
+            first_nonfinite_bucket,
+        )
+
+        # the recorder needs the loss each step it observes; piggyback on the
+        # per-step sync resilience already pays, else observe on cadence only
+        observe = self.resilience.active or tracker.due(step)
+        zscore = None
+        loss_h = None
+        if observe:
+            loss_h = float(metrics["loss"])
+            zscore = tracker.recorder.observe(step, loss_h)
+        dyn_row: dict = {}
+        if tracker.due(step) or zscore is not None:
+            dyn_row = obs.dynamics_row(step, metrics["dynamics"])
+        if "nonfinite_map" in metrics and bool(
+                np.asarray(metrics.get("nonfinite", False))):
+            layer_hint = first_nonfinite_bucket(metrics["nonfinite_map"])
+        if zscore is not None:
+            suspect = tracker.stats.suspect()
+            if layer_hint is None and suspect is not None:
+                layer_hint = suspect[0]
+            if not tracker.recorder.in_cooldown(step):
+                path = tracker.recorder.dump(
+                    step, "loss_zscore", loss=loss_h,
+                    zscore=None if _math.isinf(zscore) else round(zscore, 3),
+                    suspect=suspect, batch=batch_fingerprint(stack),
+                )
+                if path is not None:
+                    self.resilience.emit(step, "spike_report",
+                                         path=path, layer=layer_hint)
+        return dyn_row, layer_hint
 
     def _perform_rollback(self, bad_step: int, obs) -> bool:
         """In-process restore from the newest pod-agreed verifiable checkpoint

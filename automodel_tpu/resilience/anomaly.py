@@ -49,6 +49,10 @@ class Verdict:
     loss: float
     grad_norm: float
     zscore: float | None = None
+    # per-layer attribution from the dynamics pillar (observability/dynamics.py):
+    # the subtree the nonfinite provenance or EMA-excursion analysis blames, so
+    # a rollback verdict cites WHICH layer went bad, not just that one did
+    layer: str | None = None
 
     @property
     def anomalous(self) -> bool:
@@ -76,16 +80,22 @@ class AnomalyDetector:
         return (loss - mean) / std
 
     def observe(self, step: int, loss: float, grad_norm: float,
-                nonfinite: bool = False) -> Verdict:
-        """Classify one step; clean observations extend the rolling window."""
+                nonfinite: bool = False, layer: str | None = None) -> Verdict:
+        """Classify one step; clean observations extend the rolling window.
+
+        ``layer`` is the dynamics pillar's attribution for this step (the
+        subtree the nonfinite provenance or trend-excursion analysis blames);
+        it rides every anomalous verdict so downstream events cite it. A
+        clean verdict drops it — attribution is only meaningful at an anomaly.
+        """
         if nonfinite or not (math.isfinite(loss) and math.isfinite(grad_norm)):
-            return Verdict("nonfinite", step, loss, grad_norm)
+            return Verdict("nonfinite", step, loss, grad_norm, layer=layer)
         gt = self.config.grad_norm_threshold
         if gt is not None and grad_norm > float(gt):
-            return Verdict("grad_spike", step, loss, grad_norm)
+            return Verdict("grad_spike", step, loss, grad_norm, layer=layer)
         z = self._loss_zscore(loss)
         if z is not None and z > float(self.config.zscore_threshold):
-            return Verdict("loss_spike", step, loss, grad_norm, zscore=z)
+            return Verdict("loss_spike", step, loss, grad_norm, zscore=z, layer=layer)
         self._window.append(loss)
         return Verdict("ok", step, loss, grad_norm, zscore=z)
 

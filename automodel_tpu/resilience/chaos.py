@@ -9,6 +9,11 @@ classes the resilience layer must survive, all CPU-runnable:
   the step's params are poisoned with a NaN leaf and its metrics report a
   non-finite loss/grad-norm — the worst case where a corrupt update already
   landed, so ONLY a checkpoint rollback recovers.
+- **Finite gradient spike** (``grad_spike_steps``): the named layer's params
+  are scaled by ``grad_spike_factor`` with metrics left UNTOUCHED — the fault
+  must be detected organically at the next step (loss spike + per-layer
+  dynamics excursion), exercising the loss-spike flight recorder's layer
+  attribution end-to-end (observability/dynamics.py).
 - **Truncated checkpoint** (``corrupt_ckpt_steps``): right after the save of a
   named step commits, one of its files is truncated in place — the next
   restore must detect it via the integrity manifest and walk back.
@@ -44,6 +49,10 @@ __all__ = ["ChaosConfig", "ChaosInjector", "FlakyIO"]
 class ChaosConfig:
     enabled: bool = False
     nan_grad_steps: tuple[int, ...] = ()
+    # finite spike: scale one layer's params, leave metrics clean (organic detection)
+    grad_spike_steps: tuple[int, ...] = ()
+    grad_spike_factor: float = 1e3
+    grad_spike_layer: str = "lm_head"  # scales logits directly -> certain loss spike
     corrupt_ckpt_steps: tuple[int, ...] = ()
     # which file of the step dir to truncate; the first match wins
     corrupt_target: str = "largest"  # "largest" | "client.json" | "manifest.json"
@@ -64,6 +73,9 @@ class ChaosConfig:
         return cls(
             enabled=bool(d.get("enabled", False)),
             nan_grad_steps=tuple(int(s) for s in (d.get("nan_grad_steps") or ())),
+            grad_spike_steps=tuple(int(s) for s in (d.get("grad_spike_steps") or ())),
+            grad_spike_factor=float(d.get("grad_spike_factor", 1e3)),
+            grad_spike_layer=str(d.get("grad_spike_layer", "lm_head")),
             corrupt_ckpt_steps=tuple(int(s) for s in (d.get("corrupt_ckpt_steps") or ())),
             corrupt_target=str(d.get("corrupt_target", "largest")),
             elastic_steps=tuple(int(s) for s in (d.get("elastic_steps") or ())),
@@ -77,6 +89,7 @@ class ChaosInjector:
     def __init__(self, config: ChaosConfig):
         self.config = config
         self._fired_nan: set[int] = set()
+        self._fired_spike: set[int] = set()
         self._fired_corrupt: set[int] = set()
         self._fired_elastic: set[int] = set()
 
@@ -116,6 +129,55 @@ class ChaosInjector:
         if "nonfinite" in metrics:
             metrics["nonfinite"] = jnp.asarray(True)
         return jax.tree.unflatten(treedef, out), metrics
+
+    # -- finite gradient spike -----------------------------------------------
+    def should_spike(self, step: int) -> bool:
+        return (
+            self.enabled
+            and step in self.config.grad_spike_steps
+            and step not in self._fired_spike
+        )
+
+    def spike(self, step: int, params: Any) -> Any:
+        """Scale the params of the configured layer by ``grad_spike_factor``,
+        leaving metrics alone: unlike :meth:`poison`, nothing reports the
+        fault — the next step's loss z-score and the per-layer dynamics
+        telemetry must find it and name the layer on their own. Falls back to
+        the first float leaf when no path matches the configured layer name."""
+        import jax
+        import jax.numpy as jnp
+
+        self._fired_spike.add(step)
+        factor = float(self.config.grad_spike_factor)
+        needle = self.config.grad_spike_layer
+        logger.warning("chaos: scaling layer %r params by %g at step %d",
+                       needle, factor, step)
+        flat = jax.tree_util.tree_flatten_with_path(params)
+        leaves_with_path, treedef = flat
+        hit = False
+        out = []
+        for path, leaf in leaves_with_path:
+            name = "/".join(str(getattr(p, "key", getattr(p, "name", p)))
+                            for p in path)
+            if needle in name and hasattr(leaf, "dtype") \
+                    and jnp.issubdtype(leaf.dtype, jnp.floating):
+                out.append(leaf * jnp.asarray(factor, leaf.dtype))
+                hit = True
+            else:
+                out.append(leaf)
+        if not hit:
+            logger.warning("chaos: no param path matched %r; spiking the first "
+                           "float leaf instead", needle)
+            out2 = []
+            for leaf in out:
+                if not hit and hasattr(leaf, "dtype") \
+                        and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+                    out2.append(leaf * jnp.asarray(factor, leaf.dtype))
+                    hit = True
+                else:
+                    out2.append(leaf)
+            out = out2
+        return jax.tree.unflatten(treedef, out)
 
     # -- checkpoint corruption -----------------------------------------------
     def should_corrupt(self, step: int) -> bool:

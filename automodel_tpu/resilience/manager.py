@@ -50,6 +50,7 @@ class ResilienceManager:
             ChaosInjector(chaos_cfg) if config.enabled and chaos_cfg.enabled else None
         )
         self.last_good_step: int | None = None
+        self.last_verdict = None  # most recent Verdict (layer attribution rides it)
         self.events = 0
 
     @classmethod
@@ -79,15 +80,21 @@ class ResilienceManager:
 
     # ------------------------------------------------------------------ steps
     def on_step(self, step: int, loss: float, grad_norm: float,
-                nonfinite: bool = False) -> str:
-        """Classify the step's training signal and decide the action."""
+                nonfinite: bool = False, layer: str | None = None) -> str:
+        """Classify the step's training signal and decide the action.
+
+        ``layer`` is the dynamics pillar's per-layer attribution for this step
+        (nonfinite provenance, or the EMA-excursion suspect) — when set, every
+        non-ok event and the eventual rollback verdict cite it.
+        """
         if not self.active:
             return OK
-        verdict = self.detector.observe(step, float(loss), float(grad_norm), bool(nonfinite))
+        verdict = self.detector.observe(step, float(loss), float(grad_norm),
+                                        bool(nonfinite), layer=layer)
+        self.last_verdict = verdict
         action = self.policy.decide(verdict)
         if action != OK:
-            self.emit(
-                step, action,
+            fields: dict[str, Any] = dict(
                 reason=verdict.kind,
                 loss=verdict.loss,
                 grad_norm=verdict.grad_norm,
@@ -95,6 +102,9 @@ class ResilienceManager:
                 consecutive_skips=self.policy.consecutive_skips,
                 rollbacks_used=self.policy.rollbacks_used,
             )
+            if verdict.layer is not None:
+                fields["layer"] = verdict.layer
+            self.emit(step, action, **fields)
         return action
 
     def record_checkpoint(self, step: int) -> None:
@@ -109,14 +119,19 @@ class ResilienceManager:
             return None
         return self.checkpointer.agreed_restore_step()
 
-    def note_rollback(self, from_step: int, to_step: int, skipped_steps: int) -> None:
+    def note_rollback(self, from_step: int, to_step: int, skipped_steps: int,
+                      layer: str | None = None) -> None:
         self.policy.on_rollback()
         self.detector.reset()
-        self.emit(
-            from_step, "rollback_done",
+        fields: dict[str, Any] = dict(
             from_step=from_step, to_step=to_step, skipped_steps=skipped_steps,
             rollbacks_used=self.policy.rollbacks_used,
         )
+        if layer is None and self.last_verdict is not None:
+            layer = self.last_verdict.layer
+        if layer is not None:
+            fields["layer"] = layer
+        self.emit(from_step, "rollback_done", **fields)
 
     # ------------------------------------------------------------------ preemption
     def skip_consolidated_export(self, elapsed_since_sigterm_s: float) -> bool:

@@ -45,6 +45,20 @@ def _guard_nonfinite_update(new_updates, new_opt_state, opt_state, grad_norm, lo
     return new_updates, new_opt_state, ~ok
 
 
+def _dynamics_metrics(metrics, grads, params, new_updates, new_opt_state,
+                      loss, guard_nonfinite):
+    """Shared dense/pp dynamics assembly so both step builders emit an identical
+    metric contract (key-set parity is unit-tested). Reductions only — every
+    value is a replicated scalar, no tensor leaves the device sharded."""
+    from automodel_tpu.observability.dynamics import (
+        dynamics_tree, nonfinite_provenance)
+
+    metrics["dynamics"] = dynamics_tree(grads, params, new_updates, new_opt_state)
+    if guard_nonfinite:
+        metrics["nonfinite_map"] = nonfinite_provenance(grads, loss)
+    return metrics
+
+
 def make_train_step(
     forward_loss: Callable[..., jnp.ndarray],
     optimizer: optax.GradientTransformation,
@@ -52,6 +66,7 @@ def make_train_step(
     with_frozen: bool = False,
     guard_nonfinite: bool = False,
     pass_rng: bool = False,
+    dynamics: bool = False,
 ):
     """Build the accumulating train step.
 
@@ -118,6 +133,12 @@ def make_train_step(
             new_updates, new_opt_state, nonfinite = _guard_nonfinite_update(
                 new_updates, new_opt_state, opt_state, grad_norm, loss
             )
+        dyn = None
+        if dynamics:
+            # pre-update params: upd_ratio compares this step's update against
+            # the weights it is about to move
+            dyn = dict(grads=grads, params=params, updates=new_updates,
+                       opt_state=new_opt_state)
         params = optax.apply_updates(params, new_updates)
         opt_state = new_opt_state
         if post_update is not None:
@@ -130,6 +151,10 @@ def make_train_step(
         }
         if guard_nonfinite:
             metrics["nonfinite"] = nonfinite
+        if dynamics:
+            metrics = _dynamics_metrics(
+                metrics, dyn["grads"], dyn["params"], dyn["updates"],
+                dyn["opt_state"], loss, guard_nonfinite)
         return params, opt_state, metrics
 
     return train_step
@@ -142,6 +167,7 @@ def make_pp_train_step(
     guard_nonfinite: bool = False,
     with_frozen: bool = False,
     pass_rng: bool = False,
+    dynamics: bool = False,
 ):
     """Train step for pipeline parallelism: ``forward_loss`` consumes the WHOLE
     (n_micro, ...) batch stack at once — microbatching happens inside the pipeline
@@ -182,6 +208,10 @@ def make_pp_train_step(
             new_updates, new_opt_state, nonfinite = _guard_nonfinite_update(
                 new_updates, new_opt_state, opt_state, grad_norm, loss
             )
+        dyn = None
+        if dynamics:
+            dyn = dict(grads=grads, params=params, updates=new_updates,
+                       opt_state=new_opt_state)
         params = optax.apply_updates(params, new_updates)
         opt_state = new_opt_state
         if post_update is not None:
@@ -194,6 +224,10 @@ def make_pp_train_step(
         }
         if guard_nonfinite:
             metrics["nonfinite"] = nonfinite
+        if dynamics:
+            metrics = _dynamics_metrics(
+                metrics, dyn["grads"], dyn["params"], dyn["updates"],
+                dyn["opt_state"], loss, guard_nonfinite)
         return params, opt_state, metrics
 
     return train_step

@@ -55,7 +55,8 @@ def llama_flops_per_token(cfg, seq_len: int) -> float:
     return 3.0 * (L * per_layer + embed_head)
 
 
-def _measure(cfg, seq_len: int, micro_batch: int, n_steps: int, backend=None):
+def _measure(cfg, seq_len: int, micro_batch: int, n_steps: int, backend=None,
+             dynamics: bool = False):
     import jax
     import jax.numpy as jnp
     import optax
@@ -100,7 +101,10 @@ def _measure(cfg, seq_len: int, micro_batch: int, n_steps: int, backend=None):
                        segment_ids=batch["segment_ids"])
         return masked_cross_entropy(logits, batch["labels"], num_label_tokens)
 
-    step = jax.jit(make_train_step(forward_loss, optimizer), donate_argnums=(0, 1))
+    # --dynamics: the per-subtree telemetry reductions ride in-graph (the
+    # overhead the gate tolerance must absorb, docs/observability.md)
+    step = jax.jit(make_train_step(forward_loss, optimizer, dynamics=dynamics),
+                   donate_argnums=(0, 1))
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (1, micro_batch, seq_len)).astype(np.int32)
@@ -199,7 +203,7 @@ def _attach_prefetch_probe(doc: dict) -> dict:
     return doc
 
 
-def _full_bench() -> dict:
+def _full_bench(dynamics: bool = False) -> dict:
     import jax
 
     from automodel_tpu.models.llama.model import LlamaConfig
@@ -217,8 +221,8 @@ def _full_bench() -> dict:
         tie_word_embeddings=True,
         max_position_embeddings=131072,
     )
-    tps = _measure(cfg, seq_len=2048, micro_batch=4, n_steps=20)
-    tps_4k = _measure(cfg, seq_len=4096, micro_batch=2, n_steps=10)
+    tps = _measure(cfg, seq_len=2048, micro_batch=4, n_steps=20, dynamics=dynamics)
+    tps_4k = _measure(cfg, seq_len=4096, micro_batch=2, n_steps=10, dynamics=dynamics)
 
     device = str(jax.devices()[0])
     peak = device_peak_tflops(device)
@@ -250,11 +254,12 @@ def _full_bench() -> dict:
             "assumed_peak_tflops": peak,
             "8b_equiv_tokens_per_sec": round(tps_4k * f_4k / f_8b, 1),
             "device": device,
+            "dynamics": dynamics,
         },
     })
 
 
-def _cpu_fallback_bench() -> dict:
+def _cpu_fallback_bench(dynamics: bool = False) -> dict:
     """Tiny-config CPU measurement: keeps the trajectory numeric (and the JSON
     contract intact) on a TPU-less host. NOT comparable to chip numbers —
     marked ``extra.fallback: "cpu"`` and vs_baseline null."""
@@ -269,7 +274,7 @@ def _cpu_fallback_bench() -> dict:
         head_dim=32, max_position_embeddings=512,
     )
     tps = _measure(cfg, seq_len=256, micro_batch=2, n_steps=3,
-                   backend=BackendConfig(dtype="float32"))
+                   backend=BackendConfig(dtype="float32"), dynamics=dynamics)
     return _attach_prefetch_probe({
         "ok": True,
         "metric": "llama3.2-1b SFT tokens/sec/chip (bf16, seq 2048)",
@@ -280,6 +285,7 @@ def _cpu_fallback_bench() -> dict:
             "fallback": "cpu",
             "fallback_config": "tiny (4L/256d, seq 256, fp32, xla attention)",
             "device": str(jax.devices()[0]),
+            "dynamics": dynamics,
         },
     })
 
@@ -341,7 +347,8 @@ def _matrix_moe_model(cpu: bool):
     return Qwen3MoeForCausalLM.from_config(hf, backend), hf["vocab_size"]
 
 
-def _matrix_cell(kind: str, nominal_seq: int, cpu: bool) -> list[dict]:
+def _matrix_cell(kind: str, nominal_seq: int, cpu: bool,
+                 dynamics: bool = False) -> list[dict]:
     """One {model} x {seq} cell: AOT-compile once, run prefetch off then on.
 
     Returns the two matrix rows. CPU rows keep the nominal seq as the row
@@ -388,7 +395,8 @@ def _matrix_cell(kind: str, nominal_seq: int, cpu: bool) -> list[dict]:
         return masked_cross_entropy(logits, batch["labels"], num_label_tokens)
 
     optimizer = optax.chain(optax.scale_by_factored_rms(), optax.scale(-1e-5))
-    step = jax.jit(make_train_step(forward_loss, optimizer), donate_argnums=(0, 1))
+    step = jax.jit(make_train_step(forward_loss, optimizer, dynamics=dynamics),
+                   donate_argnums=(0, 1))
 
     params = model.init(jax.random.key(0), jnp.dtype(model.backend.dtype))
     opt_state = jax.jit(optimizer.init)(params)
@@ -468,6 +476,10 @@ def _matrix_cell(kind: str, nominal_seq: int, cpu: bool) -> list[dict]:
             "tokens_per_sec_per_chip": round(
                 done * micro_batch * seq_len / dt / devices, 1),
         }
+        if dynamics:
+            # condition marker: a dynamics-on row must not be compared against
+            # a dynamics-off baseline without knowing it
+            row["dynamics"] = True
         # gate key: measured allocator high-water where the platform has one
         # (TPU), else the compiled-step estimate — the source rides along so
         # a baseline from one never silently gates a run from the other
@@ -493,7 +505,7 @@ def _matrix_cell(kind: str, nominal_seq: int, cpu: bool) -> list[dict]:
     return rows
 
 
-def _matrix_bench(cpu: bool) -> dict:
+def _matrix_bench(cpu: bool, dynamics: bool = False) -> dict:
     """{dense, moe} x seq {2048,4096,8192} x prefetch {off, on}; one JSON line
     per row as it lands (partial matrices stay useful if a later cell dies),
     then a summary doc carrying all rows for the gate."""
@@ -502,7 +514,7 @@ def _matrix_bench(cpu: bool) -> dict:
     rows: list[dict] = []
     for kind in ("dense", "moe"):
         for nominal in MATRIX_SEQ_LENS:
-            for row in _matrix_cell(kind, nominal, cpu):
+            for row in _matrix_cell(kind, nominal, cpu, dynamics=dynamics):
                 print(json.dumps(row), flush=True)
                 rows.append(row)
     headline = next(
@@ -635,13 +647,19 @@ def _spawn_cpu_fallback(reason: str, extra_args: tuple[str, ...] = ()) -> int:
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     matrix = "--matrix" in argv
-    mode_args = ("--matrix",) if matrix else ()
+    # --dynamics: build the measured step with the per-subtree telemetry
+    # reductions in-graph, proving the overhead stays inside the gate
+    # tolerance instead of asserting it (docs/observability.md)
+    dynamics = "--dynamics" in argv
+    mode_args = (("--matrix",) if matrix else ()) + (
+        ("--dynamics",) if dynamics else ())
     if "--cpu" in argv:
         try:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-            doc = _matrix_bench(cpu=True) if matrix else _cpu_fallback_bench()
+            doc = (_matrix_bench(cpu=True, dynamics=dynamics) if matrix
+                   else _cpu_fallback_bench(dynamics=dynamics))
             print(json.dumps(doc), flush=True)
             return 0
         except Exception as exc:  # noqa: BLE001 — the JSON contract is the point
@@ -659,7 +677,8 @@ def main(argv: list[str] | None = None) -> int:
             # would grind for hours — go straight to the tiny fallback.
             print("bench: no accelerator attached; running tiny CPU fallback",
                   file=sys.stderr)
-            doc = _matrix_bench(cpu=True) if matrix else _cpu_fallback_bench()
+            doc = (_matrix_bench(cpu=True, dynamics=dynamics) if matrix
+                   else _cpu_fallback_bench(dynamics=dynamics))
             doc.setdefault("extra", {})["fallback_reason"] = "default backend is cpu"
             print(json.dumps(doc), flush=True)
             return 0
@@ -669,7 +688,8 @@ def main(argv: list[str] | None = None) -> int:
             reason = f"first-dispatch canary failed: {exc!r}"
             print(f"bench: {reason}; retrying on CPU", file=sys.stderr)
             return _spawn_cpu_fallback(reason, extra_args=mode_args)
-        doc = _matrix_bench(cpu=False) if matrix else _full_bench()
+        doc = (_matrix_bench(cpu=False, dynamics=dynamics) if matrix
+               else _full_bench(dynamics=dynamics))
         print(json.dumps(doc), flush=True)
         return 0
     except Exception as exc:  # noqa: BLE001

@@ -1,0 +1,114 @@
+"""Chaos-driven loss-spike detection end-to-end (docs/observability.md,
+"Training dynamics & numerics").
+
+A finite gradient spike — one layer's params scaled by 1e3 with metrics left
+untouched — must be detected *organically* at the next step: the loss z-score
+trips the flight recorder, ``spike_report.json`` names the poisoned layer via
+the per-layer EMA excursion, the anomaly verdict escalates to a rollback that
+cites the same layer, and training recovers cleanly to the final step.
+"""
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+from automodel_tpu.config.loader import load_config
+from automodel_tpu.recipes.llm.train_ft import (
+    TrainFinetuneRecipeForNextTokenPrediction,
+)
+
+from .test_train_recipe import _read_jsonl, _write_cfg
+
+
+class TestDynamicsChaosSpike:
+    # spike lm_head at step 6 (after the step-4 checkpoint): metrics at step 6
+    # stay clean, step 7's loss explodes; dynamics on every step so the spiked
+    # step itself is a sample and the param-norm EMA excursion names lm_head
+    _extra = textwrap.dedent("""\
+    observability:
+      dynamics:
+        enabled: true
+        every_n_steps: 1
+        spike_min_history: 4
+        spike_zscore: 6.0
+    resilience:
+      enabled: true
+      anomaly: {window: 20, min_history: 5}
+      max_skipped_updates: 0
+      rollback: {max_rollbacks: 2, skip_steps: 0}
+      chaos:
+        enabled: true
+        grad_spike_steps: [6]
+        grad_spike_factor: 1000.0
+        grad_spike_layer: lm_head
+    """).replace("\n", "\n    ")
+
+    @pytest.fixture(scope="class")
+    def chaos_run(self, tmp_path_factory, cpu_devices):
+        tmp = tmp_path_factory.mktemp("dyn_chaos")
+        cfg = load_config(_write_cfg(tmp, extra=self._extra, ckpt=True,
+                                     max_steps=10, grad_acc=1))
+        cfg["step_scheduler"]["ckpt_every_steps"] = 4
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+        recipe.run_train_validation_loop()
+        return {
+            "tmp": tmp,
+            "rows": _read_jsonl(tmp / "out" / "training.jsonl"),
+            "report": json.loads((tmp / "out" / "spike_report.json").read_text()),
+        }
+
+    def test_spike_report_names_poisoned_layer(self, chaos_run):
+        report = chaos_run["report"]
+        assert report["reason"] == "loss_zscore"
+        assert report["step"] == 7
+        assert report["suspect"]["layer"] == "lm_head"
+        # the excursion ratio is the param-norm blowup vs its EMA: ~1e3
+        assert report["suspect"]["ratio_vs_ema"] > 100.0
+        # forensics context rode along: the loss window, the dynamics ring
+        # (including the spiked step itself), and the batch fingerprint
+        assert len(report["loss_window"]) >= 4
+        assert any("dynamics/lm_head/param_norm" in row
+                   for row in report["dynamics_history"])
+        assert "input_ids_shape" in report["batch"]
+        # the dump is mirrored onto the metric stream as a resilience event
+        rows = chaos_run["rows"]
+        spike_events = [r for r in rows
+                        if r.get("resilience/event") == "spike_report"]
+        assert spike_events and spike_events[0]["resilience/layer"] == "lm_head"
+        assert spike_events[0]["resilience/path"].endswith("spike_report.json")
+
+    def test_rollback_verdict_cites_layer_and_recovers(self, chaos_run):
+        rows = chaos_run["rows"]
+        events = [r["resilience/event"] for r in rows if "resilience/event" in r]
+        assert "rollback" in events and "rollback_done" in events
+        # the spiked update landed in params, so recovery is a checkpoint
+        # rollback — and the verdict cites the layer the dynamics named
+        done = next(r for r in rows
+                    if r.get("resilience/event") == "rollback_done")
+        assert done["resilience/from_step"] == 7
+        assert done["resilience/to_step"] == 4
+        assert done["resilience/layer"] == "lm_head"
+
+        # clean recovery: the poisoned step never logs a metric row, the rerun
+        # trajectory is finite throughout and reaches max_steps
+        losses = {r["step"]: r["loss"] for r in rows if "loss" in r}
+        assert 7 not in losses or np.isfinite(losses[7])
+        assert all(np.isfinite(v) for v in losses.values())
+        assert max(losses) == 10
+        assert losses[10] < 10.0  # back on a sane trajectory, not the spike
+
+    def test_dynamics_rows_ride_the_metric_stream(self, chaos_run):
+        rows = chaos_run["rows"]
+        metric_rows = [r for r in rows if "loss" in r]
+        keyed = [r for r in metric_rows
+                 if "dynamics/lm_head/grad_norm" in r]
+        assert keyed, "no metric row carried the per-layer dynamics sample"
+        r = keyed[0]
+        for bucket in ("lm_head", "embed", "layers.attention", "layers.mlp"):
+            assert f"dynamics/{bucket}/grad_norm" in r
+            assert f"dynamics/{bucket}/param_norm" in r
+            assert f"dynamics/{bucket}/upd_ratio" in r
+        assert "dynamics/num/grad_amax" in r
+        assert "dynamics/lm_head/grad_norm_ema" in r

@@ -104,7 +104,7 @@ class TestCanaryRouting:
         monkeypatch.setattr(bench, "_canary_dispatch", lambda: None)
         monkeypatch.setattr(
             bench, "_full_bench",
-            lambda: (_ for _ in ()).throw(RuntimeError("libtpu crashed late")),
+            lambda **kw: (_ for _ in ()).throw(RuntimeError("libtpu crashed late")),
         )
         monkeypatch.setattr(
             bench, "_spawn_cpu_fallback",
@@ -120,7 +120,7 @@ class TestCanaryRouting:
         monkeypatch.setattr(bench, "_canary_dispatch", lambda: None)
         monkeypatch.setattr(
             bench, "_full_bench",
-            lambda: (_ for _ in ()).throw(ValueError("shape mismatch in our code")),
+            lambda **kw: (_ for _ in ()).throw(ValueError("shape mismatch in our code")),
         )
 
         def no_fallback(reason, extra_args=()):  # pragma: no cover - must not run
@@ -136,7 +136,7 @@ class TestCanaryRouting:
     def test_cpu_mode_error_keeps_json_contract(self, monkeypatch, capsys):
         monkeypatch.setattr(
             bench, "_cpu_fallback_bench",
-            lambda: (_ for _ in ()).throw(RuntimeError("tiny bench died")),
+            lambda **kw: (_ for _ in ()).throw(RuntimeError("tiny bench died")),
         )
         rc = bench.main(["--cpu"])
         _, docs = _stdout_docs(capsys)

@@ -1,0 +1,591 @@
+"""Training-dynamics & numerics telemetry (observability/dynamics.py).
+
+Hand-checked per-subtree norm math, sharded-vs-replicated equality on the
+8-device mesh, nonfinite provenance, the loss-spike flight recorder's
+never-raise contract, SIGUSR2 snapshot handler hygiene, dense/pp metric
+key-set parity, cross-host grad-norm divergence flagging, and the layer
+attribution that rides anomaly verdicts into rollback events.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from automodel_tpu.observability.dynamics import (
+    DynamicsConfig,
+    DynamicsStats,
+    DynamicsTracker,
+    SpikeFlightRecorder,
+    batch_fingerprint,
+    bucket_for_path,
+    dynamics_tree,
+    first_nonfinite_bucket,
+    flatten_dynamics,
+    nonfinite_provenance,
+    subtree_sq_norms,
+)
+
+
+def _paths_of(tree):
+    return {
+        bucket_for_path(path)
+        for path, _ in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
+
+
+def _toy_params():
+    return {
+        "embed": jnp.asarray([3.0, 4.0]),
+        "layers": {
+            "wq": jnp.asarray([1.0, 2.0, 2.0]),
+            "w_up": jnp.asarray([2.0]),
+        },
+        "lm_head": jnp.asarray([6.0, 8.0]),
+    }
+
+
+class TestBucketTaxonomy:
+    def test_top_level_modules_are_own_buckets(self):
+        tree = {"embed": jnp.zeros(2), "final_norm": jnp.zeros(2),
+                "lm_head": jnp.zeros(2)}
+        assert _paths_of(tree) == {"embed", "final_norm", "lm_head"}
+
+    def test_layer_leaves_follow_scope_blocks(self):
+        tree = {"layers": {
+            "wq": jnp.zeros(2), "wo": jnp.zeros(2), "q_norm": jnp.zeros(2),
+            "w_gate": jnp.zeros(2), "w_down": jnp.zeros(2),
+            "moe": {"w_gate": jnp.zeros(2)}, "router": jnp.zeros(2),
+            "input_norm": jnp.zeros(2),
+        }}
+        got = _paths_of(tree)
+        assert got == {"layers.attention", "layers.mlp", "layers.moe",
+                       "layers.other"}
+
+    def test_moe_wins_over_mlp_inside_moe_subtree(self):
+        # ("layers", "moe", "w_gate"): the moe component must classify before
+        # the mlp-prefix w_gate does
+        tree = {"layers": {"moe": {"w_gate": jnp.zeros(2)}}}
+        assert _paths_of(tree) == {"layers.moe"}
+
+    def test_peft_tree_buckets_with_base_name(self):
+        tree = {"layers": {"wq": {"lora_a": jnp.zeros(2), "lora_b": jnp.zeros(2)}}}
+        assert _paths_of(tree) == {"layers.attention"}
+
+
+class TestSubtreeNorms:
+    def test_hand_checked_sums_of_squares(self):
+        sq = subtree_sq_norms(_toy_params())
+        assert float(sq["embed"]) == pytest.approx(25.0)
+        assert float(sq["layers.attention"]) == pytest.approx(9.0)
+        assert float(sq["layers.mlp"]) == pytest.approx(4.0)
+        assert float(sq["lm_head"]) == pytest.approx(100.0)
+
+    def test_non_float_leaves_ignored(self):
+        sq = subtree_sq_norms({"embed": jnp.asarray([3.0, 4.0]),
+                               "step": jnp.asarray(7, jnp.int32)})
+        assert set(sq) == {"embed"}
+
+    def test_sharded_matches_replicated_on_mesh8(self, mesh8):
+        """The reductions are sharding-transparent: same scalars whether the
+        leaves live sharded across the mesh or replicated, with partitionable
+        threefry active (the training default)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        prev = jax.config.jax_threefry_partitionable
+        jax.config.update("jax_threefry_partitionable", True)
+        try:
+            host = {
+                "embed": np.linspace(-1.0, 1.0, 64, dtype=np.float32).reshape(8, 8),
+                "layers": {"wq": np.arange(32, dtype=np.float32).reshape(8, 4)},
+            }
+            axis = mesh8.axis_names[0]
+            sharded = jax.tree.map(
+                lambda x: jax.device_put(x, NamedSharding(mesh8, P(axis))), host)
+            replicated = jax.tree.map(
+                lambda x: jax.device_put(x, NamedSharding(mesh8, P())), host)
+            sq_s = jax.jit(subtree_sq_norms)(sharded)
+            sq_r = jax.jit(subtree_sq_norms)(replicated)
+            for bucket in sq_r:
+                assert float(sq_s[bucket]) == pytest.approx(
+                    float(sq_r[bucket]), rel=1e-6)
+        finally:
+            jax.config.update("jax_threefry_partitionable", prev)
+
+
+class TestDynamicsTree:
+    def test_hand_checked_norms_and_ratio(self):
+        params = _toy_params()
+        grads = jax.tree.map(lambda p: 0.1 * jnp.ones_like(p), params)
+        updates = jax.tree.map(lambda p: 0.01 * jnp.ones_like(p), params)
+        tree = dynamics_tree(grads, params, updates)
+        emb = tree["embed"]
+        assert float(emb["grad_norm"]) == pytest.approx(0.1 * math.sqrt(2))
+        assert float(emb["param_norm"]) == pytest.approx(5.0)
+        assert float(emb["upd_ratio"]) == pytest.approx(0.01 * math.sqrt(2) / 5.0)
+        assert "moment_norm" not in emb  # no opt_state passed
+
+    def test_moment_norm_from_adam_state(self):
+        params = _toy_params()
+        opt = optax.adam(1e-3)
+        state = opt.init(params)
+        grads = jax.tree.map(jnp.ones_like, params)
+        _, state = opt.update(grads, state, params)
+        tree = dynamics_tree(grads, params, grads, state)
+        # adam mu after one step = (1-b1)*g; per-bucket norm follows leaf counts
+        assert float(tree["embed"]["moment_norm"]) == pytest.approx(
+            0.1 * math.sqrt(2), rel=1e-5)
+        assert float(tree["layers.attention"]["moment_norm"]) == pytest.approx(
+            0.1 * math.sqrt(3), rel=1e-5)
+
+    def test_stateless_optimizer_omits_moment_norm(self):
+        params = _toy_params()
+        opt = optax.sgd(1e-2)
+        state = opt.init(params)
+        grads = jax.tree.map(jnp.ones_like, params)
+        tree = dynamics_tree(grads, params, grads, state)
+        assert all("moment_norm" not in row for b, row in tree.items() if b != "num")
+
+    def test_numerics_bucket_hand_checked(self):
+        grads = {"embed": jnp.asarray([1.0, 500.0, 1e5, jnp.inf])}
+        params = {"embed": jnp.ones(4)}
+        tree = dynamics_tree(grads, params, grads)
+        num = tree["num"]
+        assert not math.isfinite(float(num["grad_amax"]))
+        assert float(num["e4m3_sat_frac"]) == pytest.approx(3 / 4)  # >= 448
+        assert float(num["e5m2_sat_frac"]) == pytest.approx(2 / 4)  # >= 57344
+        assert float(num["nonfinite_ct"]) == 1.0
+
+    def test_flatten_key_contract(self):
+        params = _toy_params()
+        grads = jax.tree.map(jnp.ones_like, params)
+        flat = flatten_dynamics(dynamics_tree(grads, params, grads))
+        assert "dynamics/layers.attention/grad_norm" in flat
+        assert "dynamics/layers.mlp/upd_ratio" in flat
+        assert "dynamics/num/grad_amax" in flat
+        assert all(isinstance(v, float) for v in flat.values())
+
+
+class TestNonfiniteProvenance:
+    def test_names_offending_subtree(self):
+        grads = {"embed": jnp.ones(2),
+                 "layers": {"wq": jnp.asarray([1.0, jnp.nan])}}
+        prov = jax.jit(nonfinite_provenance)(grads, jnp.float32(1.0))
+        assert first_nonfinite_bucket(jax.device_get(prov)) == "layers.attention"
+
+    def test_loss_only_nonfinite_names_loss(self):
+        grads = {"embed": jnp.ones(2)}
+        prov = nonfinite_provenance(grads, jnp.float32(jnp.inf))
+        assert first_nonfinite_bucket(jax.device_get(prov)) == "loss"
+
+    def test_all_finite_returns_none(self):
+        prov = nonfinite_provenance({"embed": jnp.ones(2)}, jnp.float32(1.0))
+        assert first_nonfinite_bucket(jax.device_get(prov)) is None
+
+
+class TestDenseVsPipelineParity:
+    def test_metric_keyset_parity(self):
+        """make_train_step and make_pp_train_step must emit the same dynamics
+        metric contract (same top-level keys, same buckets, same per-bucket
+        metrics, same nonfinite_map keys)."""
+        from automodel_tpu.training.train_step import (
+            make_pp_train_step, make_train_step)
+
+        params = _toy_params()
+        opt = optax.adam(1e-3)
+
+        def fwd_micro(p, batch, n):
+            return jnp.sum(p["embed"]) * jnp.mean(batch["labels"] * 0.0 + 1.0) / n
+
+        def fwd_stack(p, stack, n):
+            return jnp.sum(p["embed"]) * jnp.mean(stack["labels"] * 0.0 + 1.0) / n
+
+        stack = {"labels": jnp.ones((2, 4), jnp.int32)}
+        dense = make_train_step(fwd_micro, opt, guard_nonfinite=True, dynamics=True)
+        pp = make_pp_train_step(fwd_stack, opt, guard_nonfinite=True, dynamics=True)
+        *_, m_dense = jax.jit(dense)(params, opt.init(params), stack)
+        *_, m_pp = jax.jit(pp)(params, opt.init(params), stack)
+
+        assert sorted(m_dense) == sorted(m_pp)
+        assert sorted(m_dense["dynamics"]) == sorted(m_pp["dynamics"])
+        for bucket in m_dense["dynamics"]:
+            assert sorted(m_dense["dynamics"][bucket]) == sorted(
+                m_pp["dynamics"][bucket]), bucket
+        assert sorted(m_dense["nonfinite_map"]) == sorted(m_pp["nonfinite_map"])
+
+    def test_dynamics_off_adds_no_keys(self):
+        from automodel_tpu.training.train_step import make_train_step
+
+        params = _toy_params()
+        opt = optax.adam(1e-3)
+
+        def fwd(p, batch, n):
+            return jnp.sum(p["embed"]) / n
+
+        stack = {"labels": jnp.ones((2, 4), jnp.int32)}
+        step = make_train_step(fwd, opt)
+        *_, metrics = jax.jit(step)(params, opt.init(params), stack)
+        assert "dynamics" not in metrics and "nonfinite_map" not in metrics
+
+
+class TestDynamicsStats:
+    def test_ema_seeds_then_smooths(self):
+        stats = DynamicsStats(ema_decay=0.9)
+        out = stats.update({"dynamics/embed/grad_norm": 1.0})
+        assert out["dynamics/embed/grad_norm_ema"] == pytest.approx(1.0)
+        out = stats.update({"dynamics/embed/grad_norm": 2.0})
+        assert out["dynamics/embed/grad_norm_ema"] == pytest.approx(1.1)
+
+    def test_suspect_names_worst_excursion(self):
+        stats = DynamicsStats()
+        base = {"dynamics/embed/grad_norm": 1.0,
+                "dynamics/layers.mlp/grad_norm": 1.0}
+        stats.update(base)
+        stats.update({"dynamics/embed/grad_norm": 1.1,
+                      "dynamics/layers.mlp/grad_norm": 50.0})
+        layer, metric, ratio = stats.suspect()
+        assert (layer, metric) == ("layers.mlp", "grad_norm")
+        assert ratio == pytest.approx(50.0, rel=0.01)
+
+    def test_param_norm_excursion_outranks_grad_norm(self):
+        # corrupted lm_head weights: every upstream subtree's grad blows up
+        # MORE than the fault's param norm did, but the weights only jumped
+        # in lm_head — param-norm excursions localize, grad blowups propagate
+        stats = DynamicsStats()
+        stats.update({"dynamics/lm_head/param_norm": 2.5,
+                      "dynamics/lm_head/grad_norm": 0.5,
+                      "dynamics/final_norm/grad_norm": 0.05})
+        stats.update({"dynamics/lm_head/param_norm": 2500.0,
+                      "dynamics/lm_head/grad_norm": 1.0,
+                      "dynamics/final_norm/grad_norm": 250.0})
+        layer, metric, ratio = stats.suspect()
+        assert (layer, metric) == ("lm_head", "param_norm")
+        assert ratio == pytest.approx(1000.0, rel=0.01)
+
+    def test_grad_norm_attributes_when_weights_are_clean(self):
+        # a bad batch spikes grads without moving any param norm: grad-norm
+        # attribution still works (no param excursion to outrank it)
+        stats = DynamicsStats()
+        stats.update({"dynamics/embed/grad_norm": 1.0,
+                      "dynamics/embed/param_norm": 4.0})
+        stats.update({"dynamics/embed/grad_norm": 80.0,
+                      "dynamics/embed/param_norm": 4.01})
+        layer, metric, _ = stats.suspect()
+        assert (layer, metric) == ("embed", "grad_norm")
+
+    def test_upd_ratio_never_attributes(self):
+        # upd_ratio tracks the lr schedule; a warmup must not blame a layer
+        stats = DynamicsStats()
+        stats.update({"dynamics/embed/upd_ratio": 1e-6})
+        stats.update({"dynamics/embed/upd_ratio": 1e-2})
+        assert stats.suspect() is None
+
+    def test_nan_sample_does_not_poison_trend(self):
+        stats = DynamicsStats()
+        stats.update({"dynamics/embed/grad_norm": 1.0})
+        stats.update({"dynamics/embed/grad_norm": float("nan")})
+        out = stats.update({"dynamics/embed/grad_norm": 1.0})
+        assert math.isfinite(out["dynamics/embed/grad_norm_ema"])
+
+    def test_num_bucket_excluded(self):
+        stats = DynamicsStats()
+        stats.update({"dynamics/num/grad_amax": 1.0})
+        stats.update({"dynamics/num/grad_amax": 1e9})
+        assert stats.suspect() is None
+
+
+class TestSpikeFlightRecorder:
+    def _warm(self, rec, n=16, loss=2.0):
+        for i in range(n):
+            assert rec.observe(i, loss + 0.001 * (i % 3)) is None
+
+    def test_excursion_returns_zscore_and_stays_out_of_window(self, tmp_path):
+        rec = SpikeFlightRecorder(str(tmp_path), zscore_threshold=6.0)
+        self._warm(rec)
+        z = rec.observe(16, 50.0)
+        assert z is not None and z > 6.0
+        # the spike never entered the window: the next baseline loss is clean
+        assert rec.observe(17, 2.0) is None
+
+    def test_nonfinite_loss_scores_inf(self, tmp_path):
+        rec = SpikeFlightRecorder(str(tmp_path))
+        assert rec.observe(0, float("nan")) == math.inf
+        assert rec.observe(1, float("inf")) == math.inf
+
+    def test_no_judgement_before_min_history(self, tmp_path):
+        rec = SpikeFlightRecorder(str(tmp_path), min_history=8)
+        for i in range(7):
+            assert rec.observe(i, 1000.0 if i == 6 else 1.0) is None
+
+    def test_dump_writes_report_with_suspect_and_batch(self, tmp_path):
+        rec = SpikeFlightRecorder(str(tmp_path))
+        self._warm(rec)
+        rec.record_dynamics(15, {"dynamics/layers.mlp/grad_norm": 42.0})
+        rec.record_row(15, {"loss": 2.0})
+        path = rec.dump(16, "loss_zscore", loss=50.0, zscore=12.3,
+                        suspect=("layers.mlp", "grad_norm", 40.0),
+                        batch={"input_ids_shape": [2, 4]})
+        doc = json.loads((tmp_path / "spike_report.json").read_text())
+        assert path == str(tmp_path / "spike_report.json")
+        assert doc["suspect"] == {"layer": "layers.mlp", "metric": "grad_norm",
+                                  "ratio_vs_ema": 40.0}
+        assert doc["batch"]["input_ids_shape"] == [2, 4]
+        assert doc["dynamics_history"][-1]["dynamics/layers.mlp/grad_norm"] == 42.0
+        assert len(doc["loss_window"]) == 16
+
+    def test_dump_never_raises(self, tmp_path, monkeypatch):
+        rec = SpikeFlightRecorder(str(tmp_path))
+
+        def boom(*a, **k):
+            raise OSError("disk full")
+
+        monkeypatch.setattr("builtins.open", boom)
+        assert rec.dump(5, "loss_zscore") is None  # logged, not raised
+
+    def test_cooldown_rate_limits(self, tmp_path):
+        rec = SpikeFlightRecorder(str(tmp_path), cooldown_steps=50)
+        rec.dump(100, "loss_zscore")
+        assert rec.in_cooldown(120)
+        assert not rec.in_cooldown(151)
+
+
+class TestBatchFingerprint:
+    def test_shapes_and_crc(self):
+        stack = {"input_ids": np.arange(8, dtype=np.int32).reshape(2, 4),
+                 "labels": np.ones((2, 4), np.int32)}
+        fp = batch_fingerprint(stack)
+        assert fp["input_ids_shape"] == [2, 4]
+        assert isinstance(fp["input_ids_crc32"], int)
+        # content-sensitive: a different batch fingerprints differently
+        fp2 = batch_fingerprint({"input_ids": np.zeros((2, 4), np.int32)})
+        assert fp2["input_ids_crc32"] != fp["input_ids_crc32"]
+
+    def test_never_raises(self):
+        class Evil:
+            def get(self, key):
+                raise RuntimeError("boom")
+
+        assert batch_fingerprint(Evil()) == {"fingerprint_error": True}
+
+
+class TestDynamicsTracker:
+    def _tracker(self, tmp_path, **kw):
+        cfg = DynamicsConfig(enabled=True, **kw)
+        return DynamicsTracker(cfg, str(tmp_path))
+
+    def test_cadence(self, tmp_path):
+        t = self._tracker(tmp_path, every_n_steps=10)
+        assert t.due(0) and t.due(10) and not t.due(7)
+
+    def test_row_folds_ema_and_amax_history(self, tmp_path):
+        t = self._tracker(tmp_path)
+        params = _toy_params()
+        grads = jax.tree.map(jnp.ones_like, params)
+        flat = t.row(0, dynamics_tree(grads, params, grads))
+        assert "dynamics/embed/grad_norm_ema" in flat
+        assert "dynamics/num/amax_hist_max" in flat
+        assert "dynamics/num/e5m2_margin_log2" in flat
+        assert len(t.recorder._dyn_rows) == 1
+
+    def test_sigusr2_snapshot_roundtrip(self, tmp_path):
+        t = self._tracker(tmp_path).start()
+        try:
+            assert t.maybe_snapshot(1) is None  # nothing pending
+            signal.raise_signal(signal.SIGUSR2)
+            path = t.maybe_snapshot(2)
+            assert path is not None
+            doc = json.loads((tmp_path / "dynamics_snapshot.json").read_text())
+            assert doc["dynamics_snapshot"] and doc["step"] == 2
+            assert t.maybe_snapshot(3) is None  # request drained
+        finally:
+            t.close()
+
+    def test_handler_restore_is_sig_ign_faithful(self, tmp_path):
+        prev = signal.signal(signal.SIGUSR2, signal.SIG_IGN)
+        try:
+            t = self._tracker(tmp_path).start()
+            assert signal.getsignal(signal.SIGUSR2) == t._handle_signal
+            t.close()
+            assert signal.getsignal(signal.SIGUSR2) == signal.SIG_IGN
+            t.close()  # idempotent
+            assert signal.getsignal(signal.SIGUSR2) == signal.SIG_IGN
+        finally:
+            signal.signal(signal.SIGUSR2, prev)
+
+    def test_signal_none_disables_handler(self, tmp_path):
+        before = signal.getsignal(signal.SIGUSR2)
+        t = DynamicsTracker(DynamicsConfig(enabled=True, snapshot_signal=None),
+                            str(tmp_path)).start()
+        assert signal.getsignal(signal.SIGUSR2) == before
+        t.close()
+
+    def test_config_from_dict_bool_and_dict(self):
+        assert DynamicsConfig.from_dict(True).enabled
+        assert not DynamicsConfig.from_dict(False).enabled
+        cfg = DynamicsConfig.from_dict({"every_n_steps": 5, "spike_zscore": 4.0})
+        assert cfg.enabled and cfg.every_n_steps == 5 and cfg.spike_zscore == 4.0
+        assert DynamicsConfig.from_dict(
+            {"snapshot_signal": "none"}).resolve_signal() is None
+
+
+class TestAmaxHistory:
+    def test_rolling_max_and_margin(self):
+        from automodel_tpu.ops.fp8 import E5M2_MAX, AmaxHistory
+
+        h = AmaxHistory(window=4)
+        out = h.update(100.0)
+        assert out["dynamics/num/amax_hist_max"] == pytest.approx(100.0)
+        assert out["dynamics/num/e5m2_margin_log2"] == pytest.approx(
+            math.log2(E5M2_MAX / 100.0), abs=1e-3)
+        h.update(500.0)
+        assert h.update(10.0)["dynamics/num/amax_hist_max"] == pytest.approx(500.0)
+        for _ in range(4):  # 500 rolls out of the window
+            out = h.update(10.0)
+        assert out["dynamics/num/amax_hist_max"] == pytest.approx(10.0)
+
+    def test_nonfinite_samples_skipped(self):
+        from automodel_tpu.ops.fp8 import AmaxHistory
+
+        h = AmaxHistory()
+        assert h.update(float("inf")) == {}  # empty window -> no row fields
+        assert h.update(2.0)["dynamics/num/amax_hist_max"] == pytest.approx(2.0)
+
+
+class TestCrossHostDivergence:
+    def _agg(self, rows, keys, rtol=1e-4):
+        from automodel_tpu.observability.aggregate import CrossHostAggregator
+
+        return CrossHostAggregator(
+            keys=keys, allgather_fn=lambda vec: [list(r) for r in rows],
+            process_count=len(rows), divergence_rtol=rtol)
+
+    def test_host_keys_widening(self):
+        from automodel_tpu.observability.aggregate import (
+            DYNAMICS_HOST_KEYS, HOST_KEYS, MOE_HOST_KEYS, host_keys)
+
+        assert host_keys() == HOST_KEYS
+        assert host_keys(moe=True) == MOE_HOST_KEYS
+        assert host_keys(dynamics=True) == HOST_KEYS + DYNAMICS_HOST_KEYS
+        assert host_keys(moe=True, dynamics=True) == (
+            MOE_HOST_KEYS + DYNAMICS_HOST_KEYS)
+
+    def test_agreeing_replicas_not_flagged(self):
+        from automodel_tpu.observability.aggregate import host_keys
+
+        keys = host_keys(dynamics=True)
+        rows = [[0.5, 0.01, 8.0, 8.0, 1.25] for _ in range(8)]
+        out = self._agg(rows, keys).aggregate(
+            {"step_time_s": 0.5, "grad_norm": 1.25})
+        assert "divergent_host" not in out
+        assert out["host/grad_norm_max"] == pytest.approx(1.25)
+
+    def test_desynced_replica_flagged(self):
+        from automodel_tpu.observability.aggregate import host_keys
+
+        keys = host_keys(dynamics=True)
+        rows = [[0.5, 0.01, 8.0, 8.0, 1.25] for _ in range(8)]
+        rows[3][4] = 1.30  # 4% off the replicated scalar: desync, not noise
+        out = self._agg(rows, keys).aggregate(
+            {"step_time_s": 0.5, "grad_norm": 1.25})
+        assert out["divergent_host"] == 3
+        assert out["divergence_rel"] == pytest.approx(0.04, rel=0.05)
+
+    def test_single_nan_host_flagged_infinite(self):
+        from automodel_tpu.observability.aggregate import host_keys
+
+        keys = host_keys(dynamics=True)
+        rows = [[0.5, 0.01, 8.0, 8.0, 1.25] for _ in range(8)]
+        rows[6][4] = math.nan
+        out = self._agg(rows, keys).aggregate(
+            {"step_time_s": 0.5, "grad_norm": 1.25})
+        assert out["divergent_host"] == 6
+        assert out["divergence_rel"] == math.inf
+
+    def test_float_noise_within_rtol_ignored(self):
+        from automodel_tpu.observability.aggregate import host_keys
+
+        keys = host_keys(dynamics=True)
+        rows = [[0.5, 0.01, 8.0, 8.0, 1.25 + i * 1e-8] for i in range(8)]
+        out = self._agg(rows, keys).aggregate(
+            {"step_time_s": 0.5, "grad_norm": 1.25})
+        assert "divergent_host" not in out
+
+    def test_legacy_wire_has_no_divergence_keys(self):
+        rows = [[0.5, 0.01, 8.0, 8.0] for _ in range(8)]
+        from automodel_tpu.observability.aggregate import HOST_KEYS
+
+        out = self._agg(rows, HOST_KEYS).aggregate({"step_time_s": 0.5})
+        assert "divergent_host" not in out
+
+
+class TestLayerAttribution:
+    def _manager(self, sink):
+        from automodel_tpu.resilience.manager import ResilienceManager
+
+        return ResilienceManager.from_config(
+            {"enabled": True,
+             "anomaly": {"window": 8, "min_history": 4, "zscore_threshold": 6.0},
+             "max_skipped_updates": 2},
+            metric_sink=sink)
+
+    def test_nonfinite_verdict_carries_layer(self):
+        events = []
+        mgr = self._manager(lambda step, **f: events.append((step, f)))
+        action = mgr.on_step(5, float("nan"), 1.0, nonfinite=True,
+                             layer="layers.attention")
+        assert action == "skip_update"
+        assert mgr.last_verdict.layer == "layers.attention"
+        assert events[-1][1]["resilience/layer"] == "layers.attention"
+
+    def test_rollback_done_cites_layer_from_last_verdict(self):
+        events = []
+        mgr = self._manager(lambda step, **f: events.append((step, f)))
+        mgr.on_step(5, float("nan"), 1.0, nonfinite=True, layer="layers.mlp")
+        mgr.note_rollback(from_step=5, to_step=0, skipped_steps=5)
+        done = [f for _, f in events
+                if f.get("resilience/event") == "rollback_done"]
+        assert done and done[0]["resilience/layer"] == "layers.mlp"
+
+    def test_clean_step_has_no_layer(self):
+        mgr = self._manager(lambda step, **f: None)
+        for i in range(6):
+            mgr.on_step(i, 2.0, 1.0)
+        assert mgr.last_verdict.layer is None
+
+
+class TestTimelineCounters:
+    def test_counters_from_flat_groups_by_metric(self, tmp_path):
+        from automodel_tpu.observability.events import TraceTimeline
+
+        tl = TraceTimeline(str(tmp_path / "timeline.json"))
+        tl.counters_from_flat({
+            "dynamics/embed/grad_norm": 1.0,
+            "dynamics/layers.mlp/grad_norm": 2.0,
+            "dynamics/embed/param_norm": 3.0,
+            "dynamics/num/grad_amax": 4.0,
+            "not/a/dynamics-key": 5.0,
+            "dynamics/two_part_only": 6.0,
+        })
+        counters = [e for e in tl._events if e["ph"] == "C"]
+        by_name = {e["name"]: e["args"] for e in counters}
+        assert by_name["dynamics/grad_norm"] == {"embed": 1.0, "layers.mlp": 2.0}
+        assert by_name["dynamics/param_norm"] == {"embed": 3.0}
+        assert by_name["dynamics/grad_amax"] == {"num": 4.0}
+        assert "not/a/dynamics-key" not in by_name
+        assert len(counters) == 3
+
+
+class TestRegressionGateDynamicsRows:
+    def test_matrix_key_dyn_suffix(self):
+        from automodel_tpu.observability.regression import _matrix_key
+
+        row = {"model": "dense", "seq_len": 2048, "prefetch": False}
+        assert _matrix_key(row) == "matrix/dense_s2048_pfoff"
+        assert _matrix_key({**row, "dynamics": True}) == "matrix/dense_s2048_pfoff_dyn"

@@ -1,0 +1,110 @@
+"""Metric-key lint (tools/check_metric_keys.py): emitted keys <-> docs.
+
+Tier-1: the lint itself must pass on the repo (both directions), and the
+extraction/matching machinery must behave — wildcard compatibility, docstring
+exclusion, prefix fan-out — so a green lint means something.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def _load_lint():
+    path = REPO / "tools" / "check_metric_keys.py"
+    spec = importlib.util.spec_from_file_location("check_metric_keys", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestPatternMatching:
+    def test_literal_equality(self):
+        lint = _load_lint()
+        assert lint.patterns_match("moe/aux_loss", "moe/aux_loss")
+        assert not lint.patterns_match("moe/aux_loss", "moe/aux_loss_ema")
+
+    def test_wildcard_segment(self):
+        lint = _load_lint()
+        assert lint.patterns_match("dynamics/*/grad_norm", "dynamics/layers.mlp/grad_norm")
+        assert lint.patterns_match("dynamics/layers.mlp/grad_norm", "dynamics/*/grad_norm")
+        assert not lint.patterns_match("dynamics/*/grad_norm", "dynamics/layers.mlp/param_norm")
+
+    def test_partial_wildcard_within_segment(self):
+        lint = _load_lint()
+        # f-string `top{rank}_expert{e}_util` vs docs `top{rank}_expert{e}_util`
+        assert lint.patterns_match(
+            "moe_load/top*_expert*_util", "moe_load/top*_expert*_util")
+        assert lint.patterns_match("mem/*_gib", "mem/args_gib")
+        assert not lint.patterns_match("mem/*_gib", "mem_plan/fits")
+
+    def test_trailing_glob_absorbs_segments(self):
+        lint = _load_lint()
+        assert lint.patterns_match("dynamics/*", "dynamics/layers.mlp/grad_norm")
+        assert lint.patterns_match("mem_plan/*", "mem_plan/fits")
+        # but a mid-pattern wildcard is one segment only
+        assert not lint.patterns_match("dynamics/*/grad_norm", "dynamics/grad_norm")
+
+    def test_bare_family_shorthand_is_not_documentation(self):
+        lint = _load_lint()
+        assert lint._is_bare_shorthand("moe_load/*")
+        assert not lint._is_bare_shorthand("moe_load/max_util_mean")
+        undoc, _ = lint.check(
+            {"moe_load/invented_key": ["x.py:1"]}, {"moe_load/*": ["moe_load/*"]})
+        assert "moe_load/invented_key" in undoc
+
+
+class TestCodeExtraction:
+    def test_known_keys_extracted(self):
+        lint = _load_lint()
+        code = lint.code_patterns()
+        # a literal, an f-string with module-const substitution, and the
+        # prefix= fan-out from moe/metrics.py must all be present
+        assert "mem_plan/params_gib" in code
+        assert "dynamics/num/grad_amax" in code
+        assert "moe_load/max_util_mean" in code and "moe/max_util_mean" in code
+        # emit sites are file:line strings inside the repo
+        site = code["mem_plan/params_gib"][0]
+        assert site.startswith("automodel_tpu/") and ":" in site
+
+    def test_docstring_keys_excluded(self):
+        lint = _load_lint()
+        code = lint.code_patterns()
+        # dynamics.py's module docstring mentions the family; the collected
+        # patterns must all come from executable strings (no pattern should
+        # be a prose fragment with spaces)
+        assert all(" " not in pat for pat in code)
+
+    def test_doc_side_extraction(self):
+        lint = _load_lint()
+        docs = lint.doc_patterns()
+        assert "goodput/rollback" in docs
+        # docs placeholders normalize to the same wildcard spelling
+        assert "dynamics/*/grad_norm" in docs or "dynamics/*/*" in docs
+
+
+class TestRepoIsClean:
+    def test_lint_passes_on_repo(self):
+        lint = _load_lint()
+        undocumented, unemitted = lint.check(lint.code_patterns(), lint.doc_patterns())
+        assert not undocumented, (
+            "metric keys emitted but missing from docs/observability.md: "
+            f"{sorted(undocumented)}")
+        assert not unemitted, (
+            "metric keys documented but emitted nowhere: "
+            f"{sorted(unemitted)}")
+
+    def test_cli_exit_zero(self):
+        lint = _load_lint()
+        assert lint.main([]) == 0
+
+    def test_invented_key_would_fail(self):
+        """The lint is not vacuous: an undocumented key trips it."""
+        lint = _load_lint()
+        code = lint.code_patterns()
+        code["dynamics/zzz_invented/bogus_metric"] = ["fake.py:1"]
+        undocumented, _ = lint.check(code, lint.doc_patterns())
+        assert "dynamics/zzz_invented/bogus_metric" in undocumented

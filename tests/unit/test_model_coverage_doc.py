@@ -1,0 +1,52 @@
+"""docs/model-coverage.md freshness (tools/gen_model_coverage.py).
+
+The coverage doc is generated from MODEL_REGISTRY / structural aliasing
+tables; a new family landing without a regeneration must fail CI here, not
+drift silently.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def _load_gen():
+    path = REPO / "tools" / "gen_model_coverage.py"
+    spec = importlib.util.spec_from_file_location("gen_model_coverage", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_doc_is_fresh():
+    gen = _load_gen()
+    committed = (REPO / "docs" / "model-coverage.md").read_text()
+    assert committed == gen.render(), (
+        "docs/model-coverage.md is stale — regenerate with "
+        "`python tools/gen_model_coverage.py`")
+
+
+def test_doc_covers_registry():
+    """Every registered architecture appears in the rendered doc."""
+    gen = _load_gen()
+    text = gen.render()
+    registry = gen._load(
+        REPO / "automodel_tpu" / "models" / "registry.py", "_cov_reg_test")
+    for arch in registry.MODEL_REGISTRY:
+        assert f"`{arch}`" in text
+    structural = gen._load(
+        REPO / "automodel_tpu" / "models" / "structural.py", "_cov_struct_test")
+    for arch in (*structural._ARCH_DELTAS, *structural._DENYLIST):
+        assert f"`{arch}`" in text
+
+
+def test_check_mode_detects_staleness(tmp_path, monkeypatch):
+    gen = _load_gen()
+    assert gen.main(["--check"]) == 0
+    stale = tmp_path / "model-coverage.md"
+    stale.write_text("# stale\n")
+    monkeypatch.setattr(gen, "DOC", stale)
+    assert gen.main(["--check"]) == 1
