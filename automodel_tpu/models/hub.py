@@ -45,6 +45,20 @@ def resolve_pretrained_path(path_or_id: str, *, revision: str | None = None,
             f"{path_or_id!r} is neither a local HF model directory nor a "
             "hub repo id (expected 'org/name')"
         )
+    # id-shaped AND path-like: 'checkpoints/model' where checkpoints/ exists
+    # locally is almost always a typo'd local path (missing file, wrong cwd),
+    # and silently treating it as org='checkpoints' would surface as a
+    # baffling hub 404. Refuse and name both readings instead of guessing.
+    first_seg, sep, _ = path_or_id.partition("/")
+    if sep and os.path.isdir(first_seg):
+        raise FileNotFoundError(
+            f"{path_or_id!r} is ambiguous: it parses as hub repo id "
+            f"'{path_or_id}', but {first_seg!r} is also a local directory "
+            f"(and {path_or_id!r} itself does not exist). If you meant a "
+            f"local path, fix it so the full path exists; if you meant the "
+            f"hub repo, rename or move the local {first_seg!r} directory "
+            "or run from a different working directory."
+        )
     return _download(path_or_id, revision=revision, allow_patterns=allow_patterns)
 
 

@@ -1,7 +1,8 @@
 """Unified training observability: goodput accounting, HBM + compile telemetry,
 a stall watchdog, on-demand profiling, HLO cost/roofline accounting, MoE
 routing/dispatch telemetry, cross-host metric aggregation, a unified trace
-timeline, and a perf-regression gate (docs/observability.md)."""
+timeline, measured trace attribution + the tuner signals bundle, and a
+perf-regression gate (docs/observability.md)."""
 
 from automodel_tpu.observability import compile_cache
 from automodel_tpu.observability.aggregate import CrossHostAggregator, host_keys
@@ -43,6 +44,16 @@ from automodel_tpu.observability.oom import (
     live_buffer_inventory,
 )
 from automodel_tpu.observability.profiling import OnDemandProfiler
+from automodel_tpu.observability.signals import (
+    build_signals,
+    validate_signals,
+    write_signals,
+)
+from automodel_tpu.observability.trace_analysis import (
+    TraceReport,
+    analyze_trace,
+    reconcile_with_roofline,
+)
 from automodel_tpu.observability.watchdog import StallWatchdog
 
 # start counting compilation-cache traffic before the recipe's first compile
@@ -63,9 +74,12 @@ __all__ = [
     "OnDemandProfiler",
     "SpikeFlightRecorder",
     "StallWatchdog",
+    "TraceReport",
     "TraceTimeline",
+    "analyze_trace",
     "bucket_for_path",
     "build_memory_plan",
+    "build_signals",
     "dynamics_tree",
     "first_nonfinite_bucket",
     "flatten_dynamics",
@@ -83,8 +97,11 @@ __all__ = [
     "live_buffer_inventory",
     "moe_step_metrics",
     "reconcile",
+    "reconcile_with_roofline",
     "resolve_hbm_limit_bytes",
     "roofline_metrics",
     "routing_entropy",
     "tree_shard_bytes",
+    "validate_signals",
+    "write_signals",
 ]

@@ -276,6 +276,15 @@ class Observability:
         # set by the recipe before compile_step ({axis: size}) so collective
         # bytes get attributed to ep/dp/tp/pp in the cost row
         self.mesh_axes: dict[str, int] | None = None
+        # set by the recipe ({"model": ..., "seq_len": ...}) to identify the
+        # (model, mesh, seq) cell in the signals.json bundle
+        self.cell_info: dict[str, Any] | None = None
+        # compile_step keeps the module text + analytic costs so completed
+        # traces can be classified against named scopes (trace_analysis.py)
+        self._hlo_text: str | None = None
+        self._costs: dict[str, Any] | None = None
+        # summary_row + reconciliation of the most recent analyzed trace
+        self.trace_summary: dict[str, Any] | None = None
         # AOT-vs-jit accounting across every compile_step of the run:
         # aot = primary AOT compiles, aot_variant = extra shapes pre-compiled
         # by warmup, aot_demoted = variants that rejected re-sharded inputs,
@@ -362,6 +371,12 @@ class Observability:
             self.watchdog.stop()
         if self.profiler is not None:
             self.profiler.close()
+            # a window the run end cut short still gets its analysis
+            trace = self.profiler.take_completed_trace()
+            if trace is not None:
+                self.analyze_trace(trace, step=-1,
+                                   steps_hint=self.profiler.last_window_steps)
+        self.write_signals()
         if self.dynamics is not None:
             self.dynamics.close()
         if self.timeline is not None:
@@ -421,6 +436,8 @@ class Observability:
                 hlo = None
             costs = compiled_cost_metrics(compiled, mesh_axes=self.mesh_axes,
                                           hlo_text=hlo)
+            self._hlo_text = hlo
+            self._costs = costs
             spec = device_specs(jax.devices()[0].device_kind)
             roof = roofline_metrics(costs, spec)
             self.roofline = roof or None
@@ -559,6 +576,10 @@ class Observability:
     def on_step_end(self, step: int, sync: Any = None) -> None:
         if self.profiler is not None:
             self.profiler.on_step_end(step, sync)
+            trace = self.profiler.take_completed_trace()
+            if trace is not None:
+                self.analyze_trace(trace, step,
+                                   steps_hint=self.profiler.last_window_steps)
         if self.dynamics is not None:
             self.dynamics.maybe_snapshot(step)
         if self.timeline is not None and self._step_t0 is not None:
@@ -635,6 +656,123 @@ class Observability:
         hist.append(float(step_time_s))
         if len(hist) > 64:  # rolling window; excursions are vs recent history
             del hist[0]
+
+    # ----------------------------------------------------------- trace analysis
+    def analyze_trace(self, trace_dir: str, step: int = 0,
+                      steps_hint: int | None = None) -> Any:
+        """Machine-read one completed profiler trace (docs/observability.md
+        "Measured trace attribution & signals").
+
+        Runs automatically after every closed trace window — anomaly-triggered
+        or on-demand — and on explicit call. Produces, guarded so analysis can
+        never take the run down: an atomic ``out_dir/trace_report.json``, a
+        ``trace_summary`` metric row carrying the ``measured_*`` /
+        ``overlap_frac`` keys + the analytic-vs-measured verdict, measured
+        spans on the Chrome-trace timeline, and a refreshed ``signals.json``.
+        Returns the TraceReport (None when the trace is empty or analysis
+        failed). Proc 0 only on multi-host — the trace is host-local and the
+        artifacts belong to the coordinator.
+        """
+        import jax
+
+        if jax.process_index() != 0:
+            return None
+        try:
+            from automodel_tpu.observability import trace_analysis as ta
+
+            report = ta.analyze_trace(trace_dir, hlo_text=self._hlo_text,
+                                      mesh_axes=self.mesh_axes,
+                                      steps_hint=steps_hint)
+            if report is None:
+                return None
+            row = report.summary_row()
+            row.update(ta.reconcile_with_roofline(report, self.roofline))
+            self.trace_summary = row
+            self._write_trace_report(report, row)
+            if self._metric_sink is not None:
+                self._metric_sink(max(step, 0), event="trace_summary", **row)
+            self._emit_measured_spans(report, step)
+            self.write_signals()
+            return report
+        except Exception:
+            logger.warning("trace analysis failed for %s", trace_dir,
+                           exc_info=True)
+            return None
+
+    def _write_trace_report(self, report: Any, row: dict[str, Any]) -> None:
+        import json
+        import tempfile
+
+        doc = report.to_dict()
+        doc["reconciliation"] = {
+            k.split("/", 1)[-1]: v for k, v in row.items()
+            if k.startswith("trace/") and not k.startswith("trace/scope/")
+            and k not in ("trace/steps", "trace/events", "trace/window_s")
+        }
+        doc["roofline"] = self.roofline
+        path = os.path.join(self.out_dir, "trace_report.json")
+        os.makedirs(self.out_dir, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=self.out_dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            raise
+
+    def _emit_measured_spans(self, report: Any, step: int) -> None:
+        """Measured per-category spans next to the analytic MoE ones.
+
+        Same rendering convention as :meth:`_emit_moe_spans` — sequential
+        spans whose durations are the per-step measured times (tid=2,
+        cat="measured") — but these ARE measurements, not floor estimates.
+        """
+        if self.timeline is None:
+            return
+        t = self.timeline.now()
+        for name, dur in (("compute", report.compute_s),
+                          ("comm", report.comm_s),
+                          ("moe_a2a", report.moe_a2a_s),
+                          ("host", report.host_s)):
+            if dur <= 0:
+                continue
+            self.timeline.complete(name, "measured", t, dur, tid=2, step=step,
+                                   overlap_frac=round(report.overlap_frac, 4))
+            t += dur
+
+    def write_signals(self) -> str | None:
+        """Assemble + atomically write ``out_dir/signals.json`` (signals.py)
+        from whatever sources exist right now; refreshed after every trace
+        analysis and once more at close. Proc 0 only; never raises."""
+        import jax
+
+        if not self.config.enabled:
+            return None
+        try:
+            if jax.process_index() != 0:
+                return None
+        except Exception:
+            return None
+        try:
+            from automodel_tpu.observability import signals as sig
+
+            doc = sig.build_signals(
+                cell=self.cell_info,
+                mesh_axes=self.mesh_axes,
+                roofline=self.roofline,
+                costs=self._costs,
+                trace_summary=self.trace_summary,
+                memory_plan=self.memory_plan,
+                compile_summary=self.compile_summary(),
+            )
+            path = os.path.join(self.out_dir, "signals.json")
+            sig.write_signals(path, doc)
+            return path
+        except Exception:
+            logger.warning("signals.json write failed", exc_info=True)
+            return None
 
     # ------------------------------------------------------------------- OOM
     def record_row(self, step: int, row: dict[str, Any]) -> None:

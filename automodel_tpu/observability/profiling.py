@@ -45,6 +45,14 @@ class OnDemandProfiler:
         self._requested = False
         self._tracing = False
         self._stop_after = -1
+        self._start_step = -1
+        self._trace_path: str | None = None
+        self._completed_trace: str | None = None
+        #: steps the last closed window actually covered (None when the window
+        #: was cut short at run end, where coverage is unknown) — the manager
+        #: forwards this to trace_analysis as ``steps_hint`` so per-step
+        #: numbers don't rely on the multiplicity estimate
+        self.last_window_steps: int | None = None
         self._server: Any = None
         self._prev_handler: Any = None
         self._handler_installed = False
@@ -81,6 +89,16 @@ class OnDemandProfiler:
         """Programmatic equivalent of SIGUSR1."""
         self._requested = True
 
+    def take_completed_trace(self) -> str | None:
+        """Path of the most recently closed trace window, once.
+
+        The manager polls this after ``on_step_end`` — a non-None return is
+        the "a trace just completed, analyze it" handoff (trace_analysis.py);
+        the path is cleared so each window is analyzed exactly once.
+        """
+        path, self._completed_trace = self._completed_trace, None
+        return path
+
     def on_step_start(self, step: int) -> None:
         if self._tracing:
             if self._requested:
@@ -101,6 +119,8 @@ class OnDemandProfiler:
             logger.exception("on-demand trace failed to start at step %d", step)
             return
         self._tracing = True
+        self._trace_path = path
+        self._start_step = step
         self._stop_after = step + self.trace_steps - 1
         logger.info("on-demand trace: steps %d..%d -> %s", step, self._stop_after, path)
 
@@ -111,6 +131,8 @@ class OnDemandProfiler:
             jax.block_until_ready(sync)  # the trace must contain COMPLETE steps
         try:
             jax.profiler.stop_trace()
+            self._completed_trace = self._trace_path
+            self.last_window_steps = step - self._start_step + 1
         except Exception:
             logger.exception("on-demand trace failed to stop cleanly")
         self._tracing = False
@@ -121,6 +143,10 @@ class OnDemandProfiler:
         if self._tracing:
             try:
                 jax.profiler.stop_trace()
+                # a window cut short by run end is still a complete artifact,
+                # but its step coverage is unknown
+                self._completed_trace = self._trace_path
+                self.last_window_steps = None
             except Exception:
                 logger.exception("trace still open at close; stop failed")
             self._tracing = False

@@ -38,13 +38,23 @@ __all__ = [
 ]
 
 DEFAULT_TOLERANCES = {"tps": 0.05, "mfu": 0.05, "step_time_s": 0.05, "goodput": 0.05,
-                      "hbm_gib_peak": 0.05, "hbm_headroom_gib": 0.05}
+                      "hbm_gib_peak": 0.05, "hbm_headroom_gib": 0.05,
+                      # measured-profile keys (bench.py --profile): a single
+                      # traced step jitters more than a 10-step average
+                      "measured_step_time_s": 0.15, "overlap_frac": 0.1,
+                      "measured_frac_compute": 0.1, "measured_frac_comm": 0.1,
+                      "measured_frac_moe_a2a": 0.1, "measured_frac_host": 0.1}
 # regression direction: True = lower is a regression, False = higher is.
 # Memory gates both ways: peak HBM regresses by RISING (a model change that
 # quietly grows the footprint eats the retry margin long before it OOMs),
-# headroom regresses by DROPPING.
+# headroom regresses by DROPPING. Measured-profile directions: overlap and
+# the compute share of the step regress by dropping (less hidden comms, more
+# exposed); the comm/moe_a2a/host shares regress by rising.
 HIGHER_IS_BETTER = {"tps": True, "mfu": True, "goodput": True, "step_time_s": False,
-                    "hbm_gib_peak": False, "hbm_headroom_gib": True}
+                    "hbm_gib_peak": False, "hbm_headroom_gib": True,
+                    "measured_step_time_s": False, "overlap_frac": True,
+                    "measured_frac_compute": True, "measured_frac_comm": False,
+                    "measured_frac_moe_a2a": False, "measured_frac_host": False}
 
 
 def _metric_basename(metric: str) -> str:
@@ -123,9 +133,12 @@ def _from_matrix_rows(rows: Iterable[dict[str, Any]]) -> dict[str, float]:
 
     Each cell contributes ``<key>/tps`` (and ``<key>/moe_tps`` for MoE rows) so
     a regression in one cell — say moe s8192 with prefetch — fails the gate by
-    name instead of hiding inside an average. Decoration fields
-    (``a2a_byte_share``, ``steps``) stay out: they are diagnostics, not
-    directional performance metrics.
+    name instead of hiding inside an average. ``bench.py --profile`` rows add
+    the measured-profile keys (``<key>/measured_*`` + ``<key>/overlap_frac``,
+    every basename in HIGHER_IS_BETTER) so compute/comms overlap is gated,
+    not just throughput. Decoration fields (``a2a_byte_share``, ``steps``,
+    ``measured_seq_len``, the ``measured_bound`` string) stay out: they are
+    diagnostics, not directional performance metrics.
     """
     out: dict[str, float] = {}
     for row in rows:
@@ -136,6 +149,11 @@ def _from_matrix_rows(rows: Iterable[dict[str, Any]]) -> dict[str, float]:
             out[f"{key}/moe_tps"] = float(row["moe/tokens_per_sec_per_chip"])
         if row.get("hbm_gib_peak") is not None:
             out[f"{key}/hbm_gib_peak"] = float(row["hbm_gib_peak"])
+        for k, v in row.items():
+            if (k in ("measured_step_time_s", "overlap_frac")
+                    or k.startswith("measured_frac_")) \
+                    and isinstance(v, (int, float)):
+                out[f"{key}/{k}"] = float(v)
     return out
 
 
@@ -250,8 +268,12 @@ def compare(run: dict[str, float], baseline: dict[str, float],
             tol = DEFAULT_TOLERANCES.get(basename, 0.05)
         got = run.get(metric)
         if got is None or base == 0:
+            # `require` guards against the metric being MISSING from the run;
+            # a present value against a zero baseline has no relative move to
+            # gate (overlap_frac is legitimately 0 on single-axis runs) and
+            # must not fail just because it was required
             out.append(Comparison(metric, got, base, None, tol,
-                                  ok=metric not in required))
+                                  ok=got is not None or metric not in required))
             continue
         if HIGHER_IS_BETTER.get(metric, HIGHER_IS_BETTER.get(basename, True)):
             change = (base - got) / abs(base)  # positive = slower/worse

@@ -288,6 +288,21 @@ def ring_attention_local(
     return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, n, dv).astype(q.dtype)
 
 
+def _flash_interpret_mode(global_seq: int, cp: int, impl: str | None,
+                          block_q: int | None, block_k: int | None) -> bool:
+    """True iff :func:`ring_attention_local` will run interpret-mode pallas.
+
+    Mirrors the local body's decision: the flash path is taken when it isn't
+    disabled (``impl="dense"``) and the per-shard seq lengths tile, and it
+    interprets only off-TPU. Only that combination needs ``check_vma=False``
+    on the enclosing shard_map (see make_ring_attention).
+    """
+    if impl == "dense" or jax.default_backend() == "tpu":
+        return False
+    sq = global_seq // cp
+    return _pick_block(sq, block_q or 1024) > 0 and _pick_block(sq, block_k or 1024) > 0
+
+
 def make_ring_attention(
     mesh: Mesh,
     *,
@@ -332,14 +347,15 @@ def make_ring_attention(
             ),
             out_specs=P(None, cp_axis, None, None),
             axis_names={cp_axis},
-            # interpret-mode pallas lowering internally mixes varying and
-            # unvarying operands (dynamic_slice), which the vma checker
-            # rejects; JAX's own error message prescribes check_vma=False.
-            # Unconditional (not interpret-only) on purpose: flipping the
-            # check on for the real-TPU path would ship a configuration no
-            # test environment here can exercise (cp needs >1 chip) —
-            # revisit when a multi-chip TPU runner exists
-            check_vma=False,
+            # interpret-mode pallas lowering (the flash path off-TPU)
+            # internally mixes varying and unvarying operands
+            # (dynamic_slice), which the vma checker rejects; JAX's own
+            # error message prescribes check_vma=False there. Real-TPU runs
+            # (and the dense fallback anywhere) keep the varying-mesh-axes
+            # consistency check — it's exactly the multi-chip configurations
+            # that benefit from it
+            check_vma=not _flash_interpret_mode(
+                q.shape[1], mesh.shape[cp_axis], impl, block_q, block_k),
         )(q, k, v, positions, segment_ids)
 
     return fn

@@ -38,7 +38,23 @@ from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPr
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["TrainBiencoderRecipe", "main"]
+__all__ = ["TrainBiencoderRecipe", "main", "positive_ranks"]
+
+
+def positive_ranks(scores: jax.Array, labels: jax.Array) -> jax.Array:
+    """1-based rank of each query's positive within its score row.
+
+    Deterministic under ties: rank = 1 + strictly-better columns + tied
+    columns with a smaller index (torch.topk's first-occurrence convention,
+    exactly). In-batch duplicates produce tied fp32 scores, and counting only
+    strict wins would score every duplicate as rank 1 — inflating acc@1/MRR
+    on datasets with repeated passages.
+    """
+    labels = labels.astype(jnp.int32)
+    pos = jnp.take_along_axis(scores, labels[:, None], axis=-1)
+    cols = jnp.arange(scores.shape[-1])[None, :]
+    tied_before = ((scores == pos) & (cols < labels[:, None])).sum(-1)
+    return 1 + (scores > pos).sum(-1) + tied_before
 
 
 class TrainBiencoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
@@ -110,14 +126,9 @@ class TrainBiencoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
                     params = merge_lora_params(frozen, params, self.peft)
                 scores, labels = self._scores_and_labels(params, batch)
                 logp = jax.nn.log_softmax(scores, axis=-1)
-                pos = jnp.take_along_axis(
-                    scores, labels[:, None].astype(jnp.int32), axis=-1)
                 nll = -jnp.take_along_axis(
                     logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
-                # rank = 1 + number of strictly-better columns (ties favor us,
-                # matching torch.topk's first-occurrence convention closely
-                # enough for distinct fp32 scores)
-                rank = 1 + (scores > pos).sum(-1)
+                rank = positive_ranks(scores, labels)
                 return (nll.sum(), (rank == 1).sum(), (rank <= recall_k).sum(),
                         (1.0 / rank.astype(jnp.float32)).sum())
 

@@ -274,6 +274,16 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self.observability.mesh_axes = {
             str(name): int(size) for name, size in self.mesh.shape.items()
         }
+        # identifies this run's cell in signals.json (tuners match on it);
+        # same model-id fallback chain as the run header below
+        _arch = None
+        if isinstance(getattr(self, "hf_config", None), dict):
+            _arch = (self.hf_config.get("architectures") or [None])[0]
+        self.observability.cell_info = {
+            "model": str(cfg.get("model.pretrained_model_name_or_path")
+                         or _arch or "scratch"),
+            "seq_len": int(self.seq_len),
+        }
         # analytic HBM plan: the sharded params/opt_state give exact per-shard
         # bytes and the config gives batch/activation estimates, so the
         # headroom/fits verdict exists BEFORE the first compile; compile_step
@@ -1377,13 +1387,25 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         summed across hosts like the loss."""
         extra_sums = extra_sums or {}
         if jax.process_count() > 1:
+            import numpy as np
             from jax.experimental import multihost_utils
 
-            agg = multihost_utils.process_allgather(
-                jnp.asarray([total, float(count), *extra_sums.values()], jnp.float64)
-            )
-            total, count = float(agg[:, 0].sum()), float(agg[:, 1].sum())
-            extra_sums = {k: float(agg[:, 2 + i].sum())
+            # ship each host sum as an f32 hi/lo (Dekker) pair and rebuild in
+            # np.float64 on the host: jnp.float64 silently downcasts to f32
+            # without jax_enable_x64, which loses the low-order bits of large
+            # token-weighted loss sums exactly when the pod is big enough for
+            # them to matter
+            vals = np.asarray([total, float(count), *extra_sums.values()],
+                              np.float64)
+            hi = vals.astype(np.float32)
+            lo = (vals - hi.astype(np.float64)).astype(np.float32)
+            agg = np.asarray(multihost_utils.process_allgather(
+                jnp.asarray(np.stack([hi, lo]), jnp.float32)))
+            # agg: [hosts, 2, K] -> exact per-host f64 values, summed in f64
+            sums = (agg[:, 0, :].astype(np.float64)
+                    + agg[:, 1, :].astype(np.float64)).sum(axis=0)
+            total, count = float(sums[0]), float(sums[1])
+            extra_sums = {k: float(sums[2 + i])
                           for i, k in enumerate(extra_sums)}
         if count:
             val_loss = total / count

@@ -348,13 +348,19 @@ def _matrix_moe_model(cpu: bool):
 
 
 def _matrix_cell(kind: str, nominal_seq: int, cpu: bool,
-                 dynamics: bool = False) -> list[dict]:
+                 dynamics: bool = False,
+                 profile: bool = False) -> tuple[list[dict], dict | None]:
     """One {model} x {seq} cell: AOT-compile once, run prefetch off then on.
 
-    Returns the two matrix rows. CPU rows keep the nominal seq as the row
+    Returns ``(rows, signals_cell)``. CPU rows keep the nominal seq as the row
     label (so baselines line up across hosts) and record the actually
     measured ``measured_seq_len``; MoE rows add routed tokens/s/chip and the
-    a2a share of collective bytes from the compiled HLO.
+    a2a share of collective bytes from the compiled HLO. With ``profile``,
+    one extra step runs under a ``jax.profiler`` trace after the timed loops
+    and the measured category breakdown (``measured_*`` + ``overlap_frac``,
+    observability/trace_analysis.py) lands on the prefetch-on row — the
+    production config — plus a schema-shaped signals cell (signals.py) for
+    the summary doc; without it ``signals_cell`` is None.
     """
     import jax
     import jax.numpy as jnp
@@ -413,6 +419,7 @@ def _matrix_cell(kind: str, nominal_seq: int, cpu: bool,
     }
     compiled = step.lower(params, opt_state, sample_stack).compile()
     a2a_share = 0.0
+    hlo = None
     try:
         hlo = compiled.as_text()
         total = sum(collective_bytes(hlo).values())
@@ -502,21 +509,92 @@ def _matrix_cell(kind: str, nominal_seq: int, cpu: bool,
                 routed_per_step * done / dt / devices, 1)
             row["a2a_byte_share"] = a2a_share
         rows.append(row)
-    return rows
+    signals_cell = None
+    if profile:
+        # one profiled step AFTER the timed loops: params/opt_state are warm
+        # and nothing downstream needs them (donation deletes the inputs)
+        measured, signals_cell = _profile_cell_step(
+            compiled, params, opt_state, sample_stack, hlo,
+            cell={"model": kind, "seq_len": nominal_seq})
+        rows[-1].update(measured)  # the prefetch-on (production) row
+    return rows, signals_cell
 
 
-def _matrix_bench(cpu: bool, dynamics: bool = False) -> dict:
+def _profile_cell_step(compiled, params, opt_state, sample_stack, hlo,
+                       cell) -> tuple[dict, dict | None]:
+    """One step under a jax.profiler trace -> measured row keys + signals cell.
+
+    Best-effort decoration like the a2a share: any failure returns empty and
+    the bench rows stand on their timed numbers alone.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from automodel_tpu.observability import signals as sig
+    from automodel_tpu.observability import trace_analysis as ta
+    from automodel_tpu.observability.hlo_costs import (
+        compiled_cost_metrics,
+        device_specs,
+        roofline_metrics,
+    )
+
+    td = tempfile.mkdtemp(prefix="bench_trace_")
+    try:
+        try:
+            batch = jax.device_put(sample_stack)
+            jax.profiler.start_trace(td)
+            try:
+                _p, _o, m = compiled(params, opt_state, batch)
+                float(m["loss"])  # host sync: the trace must hold the whole step
+            finally:
+                jax.profiler.stop_trace()
+            report = ta.analyze_trace(td, hlo_text=hlo, steps_hint=1)
+        finally:
+            shutil.rmtree(td, ignore_errors=True)
+        if report is None:
+            return {}, None
+        costs = compiled_cost_metrics(compiled, hlo_text=hlo)
+        roof = roofline_metrics(costs, device_specs(jax.devices()[0].device_kind))
+        summary = report.summary_row()
+        summary.update(ta.reconcile_with_roofline(report, roof))
+        measured = {k: summary[k] for k in
+                    ("measured_step_time_s", "measured_t_compute_s",
+                     "measured_t_comm_s", "measured_t_moe_a2a_s",
+                     "measured_t_host_s", "measured_frac_compute",
+                     "measured_frac_comm", "measured_frac_moe_a2a",
+                     "measured_frac_host", "overlap_frac", "measured_bound")
+                    if k in summary}
+        signals_cell = sig.build_cell(cell=cell, roofline=roof or None,
+                                      costs=costs, trace_summary=summary)
+        return measured, signals_cell
+    except Exception as exc:  # noqa: BLE001 — profiling must not kill the bench
+        print(f"bench: profiled step failed ({exc!r}); rows carry no "
+              "measured_* keys", file=sys.stderr)
+        return {}, None
+
+
+def _matrix_bench(cpu: bool, dynamics: bool = False,
+                  profile: bool = False) -> dict:
     """{dense, moe} x seq {2048,4096,8192} x prefetch {off, on}; one JSON line
     per row as it lands (partial matrices stay useful if a later cell dies),
-    then a summary doc carrying all rows for the gate."""
+    then a summary doc carrying all rows for the gate. With ``profile``, each
+    cell also runs one traced step (measured_* row keys) and the summary doc
+    carries a ``signals`` bundle (observability/signals.py schema)."""
     import jax
 
     rows: list[dict] = []
+    signal_cells: list[dict] = []
     for kind in ("dense", "moe"):
         for nominal in MATRIX_SEQ_LENS:
-            for row in _matrix_cell(kind, nominal, cpu, dynamics=dynamics):
+            cell_rows, signals_cell = _matrix_cell(
+                kind, nominal, cpu, dynamics=dynamics, profile=profile)
+            for row in cell_rows:
                 print(json.dumps(row), flush=True)
                 rows.append(row)
+            if signals_cell is not None:
+                signal_cells.append(signals_cell)
     headline = next(
         (r["tokens_per_sec_per_chip"] for r in rows
          if r["model"] == "dense" and r["seq_len"] == 2048 and r["prefetch"]),
@@ -531,6 +609,10 @@ def _matrix_bench(cpu: bool, dynamics: bool = False) -> dict:
         "matrix": rows,
         "extra": {"device": str(jax.devices()[0]), "rows": len(rows)},
     }
+    if signal_cells:
+        from automodel_tpu.observability.signals import build_signals
+
+        doc["signals"] = build_signals(signal_cells)
     if cpu:
         doc["extra"]["fallback"] = "cpu"
     return doc
@@ -651,15 +733,19 @@ def main(argv: list[str] | None = None) -> int:
     # reductions in-graph, proving the overhead stays inside the gate
     # tolerance instead of asserting it (docs/observability.md)
     dynamics = "--dynamics" in argv
+    # --profile: one traced step per matrix cell -> measured_* gate keys +
+    # the signals bundle on the summary doc (matrix mode only)
+    profile = "--profile" in argv
     mode_args = (("--matrix",) if matrix else ()) + (
-        ("--dynamics",) if dynamics else ())
+        ("--dynamics",) if dynamics else ()) + (
+        ("--profile",) if profile else ())
     if "--cpu" in argv:
         try:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-            doc = (_matrix_bench(cpu=True, dynamics=dynamics) if matrix
-                   else _cpu_fallback_bench(dynamics=dynamics))
+            doc = (_matrix_bench(cpu=True, dynamics=dynamics, profile=profile)
+                   if matrix else _cpu_fallback_bench(dynamics=dynamics))
             print(json.dumps(doc), flush=True)
             return 0
         except Exception as exc:  # noqa: BLE001 — the JSON contract is the point
@@ -677,8 +763,8 @@ def main(argv: list[str] | None = None) -> int:
             # would grind for hours — go straight to the tiny fallback.
             print("bench: no accelerator attached; running tiny CPU fallback",
                   file=sys.stderr)
-            doc = (_matrix_bench(cpu=True, dynamics=dynamics) if matrix
-                   else _cpu_fallback_bench(dynamics=dynamics))
+            doc = (_matrix_bench(cpu=True, dynamics=dynamics, profile=profile)
+                   if matrix else _cpu_fallback_bench(dynamics=dynamics))
             doc.setdefault("extra", {})["fallback_reason"] = "default backend is cpu"
             print(json.dumps(doc), flush=True)
             return 0
@@ -688,8 +774,8 @@ def main(argv: list[str] | None = None) -> int:
             reason = f"first-dispatch canary failed: {exc!r}"
             print(f"bench: {reason}; retrying on CPU", file=sys.stderr)
             return _spawn_cpu_fallback(reason, extra_args=mode_args)
-        doc = (_matrix_bench(cpu=False, dynamics=dynamics) if matrix
-               else _full_bench(dynamics=dynamics))
+        doc = (_matrix_bench(cpu=False, dynamics=dynamics, profile=profile)
+               if matrix else _full_bench(dynamics=dynamics))
         print(json.dumps(doc), flush=True)
         return 0
     except Exception as exc:  # noqa: BLE001

@@ -204,3 +204,50 @@ class TestMatrixRowShape:
             assert got["matrix/moe_s4096_pfon/tps"] == 80.0, label
             assert got["matrix/moe_s4096_pfon/moe_tps"] == 640.0, label
             assert "matrix/moe_s4096_pfon/a2a_share" not in got, label
+
+
+class TestProfiledCellStep:
+    """bench.py --profile: one traced step per cell -> measured_* row keys and
+    a schema-valid signals cell; any failure degrades to empty, never raises."""
+
+    def test_measured_keys_and_signals_cell(self):
+        import jax
+        import jax.numpy as jnp
+
+        def step(params, opt_state, batch):
+            loss = jnp.sum((batch @ params) ** 2) + opt_state
+            return params, opt_state, {"loss": loss}
+
+        params = jnp.ones((16, 16), jnp.float32)
+        opt_state = jnp.float32(0.0)
+        batch = jnp.ones((8, 16), jnp.float32)
+        compiled = jax.jit(step).lower(params, opt_state, batch).compile()
+        hlo = compiled.as_text()
+
+        measured, cell = bench._profile_cell_step(
+            compiled, params, opt_state, batch, hlo,
+            {"model": "dense", "seq_len": 2048})
+        assert measured, "profiled step produced no measured keys"
+        assert measured["measured_step_time_s"] > 0
+        assert 0.0 <= measured["overlap_frac"] <= 1.0
+        assert measured["measured_bound"] in (
+            "compute", "comms", "moe_a2a", "input")
+        for key in ("measured_frac_compute", "measured_frac_comm",
+                    "measured_frac_moe_a2a", "measured_frac_host"):
+            assert key in measured, key
+
+        from automodel_tpu.observability.signals import (
+            build_signals,
+            validate_signals,
+        )
+
+        assert cell is not None
+        assert validate_signals(build_signals([cell])) == []
+        assert cell["cell"]["seq_len"] == 2048
+        assert cell["measured"] is not None
+
+    def test_failure_degrades_to_empty(self, capsys):
+        measured, cell = bench._profile_cell_step(
+            None, None, None, None, None, {"model": "x", "seq_len": 1})
+        assert measured == {} and cell is None
+        assert "measured_* keys" in capsys.readouterr().err

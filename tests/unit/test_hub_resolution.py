@@ -62,6 +62,29 @@ class TestResolution:
         with pytest.raises(FileNotFoundError):
             resolve_pretrained_path("too/many/segments")
 
+    def test_ambiguous_id_shaped_path_raises_naming_both(self, tmp_path,
+                                                         monkeypatch):
+        """'checkpoints/model' where checkpoints/ exists is almost always a
+        typo'd local path — refuse with both readings instead of a hub 404."""
+        (tmp_path / "checkpoints").mkdir()
+        monkeypatch.chdir(tmp_path)
+
+        def boom(*a, **k):
+            raise AssertionError("ambiguous input must not hit the hub")
+
+        monkeypatch.setattr(hub, "_download", boom)
+        with pytest.raises(FileNotFoundError, match="ambiguous") as exc:
+            resolve_pretrained_path("checkpoints/model")
+        msg = str(exc.value)
+        assert "hub repo id" in msg and "local directory" in msg
+
+    def test_unambiguous_org_still_downloads(self, tmp_path, monkeypatch):
+        # no local 'org' directory: plain hub id, resolves normally
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.setattr(hub, "_snapshot_download",
+                            lambda *a, **k: "/cache/snap")
+        assert resolve_pretrained_path("org/model") == "/cache/snap"
+
 
 class TestProcessZeroGating:
     """The download rides parallel.init.main_process_first; fake the process

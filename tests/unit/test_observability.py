@@ -415,6 +415,31 @@ class TestOnDemandProfiler:
         assert p.armed
         p.close()
 
+    def test_closed_window_records_exact_step_coverage(self, tmp_path):
+        """A window closed at its step boundary knows exactly how many steps
+        it covered (the analyzer's steps_hint); a window cut short by run end
+        does not, and must report None."""
+        from automodel_tpu.observability import OnDemandProfiler
+
+        p = OnDemandProfiler(str(tmp_path), trace_steps=2, server_port=0,
+                             signum=None)
+        p.start()
+        p.request_trace()
+        p.on_step_start(5)  # opens: window spans steps 5..6
+        assert p.tracing and p.last_window_steps is None
+        p.on_step_end(5)
+        assert p.tracing  # still inside the window
+        p.on_step_end(6)
+        assert not p.tracing
+        assert p.last_window_steps == 2
+        assert p.take_completed_trace() is not None
+        # second window cut short by close(): coverage unknown
+        p.request_trace()
+        p.on_step_start(9)
+        p.close()
+        assert p.take_completed_trace() is not None
+        assert p.last_window_steps is None
+
 
 class TestObservabilityManager:
     def test_from_config_nested_sections(self):

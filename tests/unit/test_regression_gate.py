@@ -115,7 +115,66 @@ class TestCompare:
         res = compare({"mfu": 0.0}, {"mfu": 0.0})
         assert res[0].ok and res[0].change is None
         assert "not comparable" in res[0].line()
-        assert not compare({"mfu": 0.0}, {"mfu": 0.0}, require=("mfu",))[0].ok
+        # `require` guards MISSING metrics, not zero baselines: a present 0.0
+        # (overlap_frac on a single-axis run) passes even when required ...
+        assert compare({"mfu": 0.0}, {"mfu": 0.0}, require=("mfu",))[0].ok
+        # ... while an absent required metric still fails
+        assert not compare({}, {"mfu": 0.0}, require=("mfu",))[0].ok
+
+
+class TestMeasuredKeys:
+    """bench.py --profile rows: measured-profile keys flatten per cell and
+    gate with the right directions (overlap up = good, comm frac up = bad)."""
+
+    ROW = {
+        "matrix_row": True, "model": "gpt", "seq_len": 1024, "prefetch": True,
+        "tokens_per_sec_per_chip": 5000.0,
+        "measured_step_time_s": 0.2, "overlap_frac": 0.4,
+        "measured_frac_compute": 0.7, "measured_frac_comm": 0.2,
+        "measured_frac_moe_a2a": 0.0, "measured_frac_host": 0.1,
+        "measured_bound": "compute",  # diagnostic string: must NOT flatten
+    }
+
+    def test_matrix_flattening(self, tmp_path):
+        p = tmp_path / "matrix.json"
+        p.write_text(json.dumps({"matrix": [self.ROW]}))
+        out = load_run_metrics(str(p))
+        key = "matrix/gpt_s1024_pfon"
+        assert out[f"{key}/overlap_frac"] == 0.4
+        assert out[f"{key}/measured_step_time_s"] == 0.2
+        assert out[f"{key}/measured_frac_comm"] == 0.2
+        assert f"{key}/measured_bound" not in out
+
+    def test_jsonl_capture_flattening(self, tmp_path):
+        off_row = dict(self.ROW, prefetch=False, overlap_frac=0.1)
+        p = _write_jsonl(tmp_path / "matrix.jsonl", [self.ROW, off_row])
+        out = load_run_metrics(p)
+        assert out["matrix/gpt_s1024_pfon/overlap_frac"] == 0.4
+        assert out["matrix/gpt_s1024_pfoff/overlap_frac"] == 0.1
+
+    def test_overlap_frac_higher_is_better(self):
+        key = "matrix/gpt_s1024_pfon/overlap_frac"
+        worse = compare({key: 0.3}, {key: 0.5})
+        assert not worse[0].ok
+        better = compare({key: 0.7}, {key: 0.5})
+        assert better[0].ok
+
+    def test_comm_frac_lower_is_better(self):
+        key = "matrix/gpt_s1024_pfon/measured_frac_comm"
+        worse = compare({key: 0.4}, {key: 0.2})
+        assert not worse[0].ok
+        assert compare({key: 0.1}, {key: 0.2})[0].ok
+
+    def test_measured_step_time_lower_is_better(self):
+        key = "matrix/gpt_s1024_pfon/measured_step_time_s"
+        assert not compare({key: 0.3}, {key: 0.2})[0].ok
+        assert compare({key: 0.15}, {key: 0.2})[0].ok
+
+    def test_default_tolerances_present(self):
+        for base in ("measured_step_time_s", "overlap_frac",
+                     "measured_frac_compute", "measured_frac_comm",
+                     "measured_frac_moe_a2a", "measured_frac_host"):
+            assert base in DEFAULT_TOLERANCES, base
 
 
 class TestCli:

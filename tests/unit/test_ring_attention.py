@@ -236,3 +236,33 @@ class TestFlashRing:
         dense_hlo = lower("dense")
         assert quad in dense_hlo, "negative control: dense ring should be quadratic"
         assert quad not in flash_hlo, "flash ring leaked a quadratic intermediate"
+
+
+class TestFlashInterpretMode:
+    """check_vma gating: the vma check is dropped only for interpret-mode
+    pallas (flash off-TPU); dense and real-TPU paths keep it."""
+
+    def test_flash_off_tpu_is_interpret(self):
+        from automodel_tpu.parallel.ring_attention import _flash_interpret_mode
+
+        assert jax.default_backend() != "tpu"  # suite runs on CPU
+        assert _flash_interpret_mode(4096, 4, None, None, None) is True
+        assert _flash_interpret_mode(4096, 4, "flash", 256, 256) is True
+
+    def test_dense_never_interprets(self):
+        from automodel_tpu.parallel.ring_attention import _flash_interpret_mode
+
+        assert _flash_interpret_mode(4096, 4, "dense", None, None) is False
+
+    def test_untileable_seq_falls_back_to_dense(self):
+        from automodel_tpu.parallel.ring_attention import _flash_interpret_mode
+
+        # 100-per-shard doesn't tile into >=8 power-of-two blocks: the local
+        # body takes the dense path, so the vma check stays on
+        assert _flash_interpret_mode(400, 4, None, None, None) is False
+
+    def test_tpu_backend_keeps_check(self, monkeypatch):
+        from automodel_tpu.parallel import ring_attention as ra
+
+        monkeypatch.setattr(ra.jax, "default_backend", lambda: "tpu")
+        assert ra._flash_interpret_mode(4096, 4, None, None, None) is False

@@ -1,0 +1,117 @@
+"""Validation-metric correctness: biencoder rank tie-breaking and the
+cross-host val-loss aggregation (f32-exact hi/lo transport)."""
+
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.recipes.biencoder.train_biencoder import positive_ranks
+from automodel_tpu.recipes.llm.train_ft import (
+    TrainFinetuneRecipeForNextTokenPrediction,
+)
+
+
+class TestPositiveRanks:
+    def test_distinct_scores(self):
+        scores = jnp.asarray([[0.1, 0.9, 0.5], [0.7, 0.2, 0.3]])
+        labels = jnp.asarray([1, 0])
+        assert positive_ranks(scores, labels).tolist() == [1, 1]
+        assert positive_ranks(scores, jnp.asarray([0, 1])).tolist() == [3, 3]
+
+    def test_ties_break_by_first_occurrence(self):
+        # positive at col 2 ties with cols 0 and 3; only col 0 precedes it
+        scores = jnp.asarray([[1.0, 0.5, 1.0, 1.0]])
+        assert int(positive_ranks(scores, jnp.asarray([2]))[0]) == 2
+        assert int(positive_ranks(scores, jnp.asarray([0]))[0]) == 1
+        assert int(positive_ranks(scores, jnp.asarray([3]))[0]) == 3
+
+    def test_all_tied_is_column_order(self):
+        """In-batch duplicate passages: every column ties. The old
+        strict-wins rank scored ALL of them rank 1 (acc@1 = 100% on a
+        degenerate batch); first-occurrence gives the honest column order."""
+        scores = jnp.zeros((4, 4))
+        labels = jnp.asarray([0, 1, 2, 3])
+        assert positive_ranks(scores, labels).tolist() == [1, 2, 3, 4]
+
+    def test_matches_numpy_argsort_on_random(self):
+        rng = np.random.default_rng(0)
+        scores = rng.choice([0.0, 0.25, 0.5, 1.0], size=(16, 12))
+        labels = rng.integers(0, 12, size=16)
+        got = positive_ranks(jnp.asarray(scores), jnp.asarray(labels))
+        # stable argsort descending == first-occurrence ranking
+        order = np.argsort(-scores, axis=-1, kind="stable")
+        want = [int(np.where(order[i] == labels[i])[0][0]) + 1
+                for i in range(16)]
+        assert got.tolist() == want
+
+
+class _CapturingLogger:
+    def __init__(self):
+        self.rows = []
+
+    def log(self, step, **kw):
+        self.rows.append((step, kw))
+
+
+def _bare_recipe():
+    rec = TrainFinetuneRecipeForNextTokenPrediction.__new__(
+        TrainFinetuneRecipeForNextTokenPrediction)
+    rec.val_metric_logger = _CapturingLogger()
+    rec.experiment_loggers = []
+    rec.checkpointer = SimpleNamespace(config=SimpleNamespace(enabled=False))
+    return rec
+
+
+class TestValLossAggregation:
+    def test_single_host_plain_division(self):
+        rec = _bare_recipe()
+        rec._log_val_loss(5, 12.0, 4.0, extra_sums={"val_acc1": 2.0})
+        ((step, row),) = rec.val_metric_logger.rows
+        assert step == 5
+        assert row["val_loss"] == pytest.approx(3.0)
+        assert row["val_acc1"] == pytest.approx(0.5)
+
+    def test_multihost_sum_is_f64_exact(self, monkeypatch):
+        """The per-host sums cross the allgather as f32 hi/lo pairs and are
+        rebuilt in np.float64: a value f32 can't represent (2^25 + 1) must
+        survive the trip bit-exactly. The old jnp.float64 transport silently
+        downcast to f32 (x64 is disabled) and lost the +1."""
+        from jax.experimental import multihost_utils
+
+        host_b = np.asarray([1.0, 1.0], np.float64)  # total=1, count=1
+
+        def fake_allgather(x):
+            mine = np.asarray(x)  # [2, K] hi/lo from this "host"
+            theirs = np.stack([host_b.astype(np.float32),
+                               (host_b - host_b.astype(np.float32)
+                                .astype(np.float64)).astype(np.float32)])
+            return np.stack([mine, theirs])
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(multihost_utils, "process_allgather",
+                            fake_allgather)
+        rec = _bare_recipe()
+        rec._log_val_loss(1, float(2**25 + 1), 1.0)
+        ((_, row),) = rec.val_metric_logger.rows
+        # (2^25 + 1 + 1) / 2 == 16777217.0 exactly; an f32 round-trip of the
+        # total would have produced 16777216.5
+        assert row["val_loss"] == 16777217.0
+
+    def test_multihost_extra_sums_share_denominator(self, monkeypatch):
+        from jax.experimental import multihost_utils
+
+        def fake_allgather(x):
+            mine = np.asarray(x)
+            return np.stack([mine, mine])  # both hosts identical
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(multihost_utils, "process_allgather",
+                            fake_allgather)
+        rec = _bare_recipe()
+        rec._log_val_loss(2, 6.0, 3.0, extra_sums={"val_mrr": 1.5})
+        ((_, row),) = rec.val_metric_logger.rows
+        assert row["val_loss"] == pytest.approx(2.0)  # 12 / 6
+        assert row["val_mrr"] == pytest.approx(0.5)  # 3 / 6

@@ -45,16 +45,26 @@ DOC = REPO / "docs" / "observability.md"
 
 # the namespaced families under contract ("mem" before "moe" is irrelevant —
 # matching is anchored) plus the bare "goodput" headline scalar
-FAMILIES = ("goodput", "mem_plan", "mem", "moe_load", "moe", "dynamics")
+FAMILIES = ("goodput", "mem_plan", "mem", "moe_load", "moe", "dynamics",
+            "trace", "signals")
 _FAMILY_RE = re.compile(r"^(?:%s)/[^ ]+$" % "|".join(FAMILIES))
-BARE_KEYS = {"goodput"}
+BARE_KEYS = {"goodput", "overlap_frac"}
+# bare-prefix family: the measured trace-attribution keys ride log rows
+# without a slash namespace (measured_frac_compute, measured_t_comm_s,
+# measured_comm_axis_<ax>_s, measured_bound, ...); "*" appears in normalized
+# f-string/doc-placeholder patterns
+_BARE_PREFIX_RE = re.compile(r"^measured_[\w*]+$")
 
 # strings that carry a family prefix but are not metric keys (paths, globs)
 _NOT_A_KEY = re.compile(r"\.(py|json|jsonl|yaml|md)\b|[ :(),]|\.\*")
 
 
 def _pattern_ok(p: str) -> bool:
-    return p in BARE_KEYS or (bool(_FAMILY_RE.match(p)) and not _NOT_A_KEY.search(p))
+    if p.endswith(("_", "/")):  # a startswith() prefix literal, not a key
+        return False
+    if p in BARE_KEYS or _BARE_PREFIX_RE.match(p):
+        return not _NOT_A_KEY.search(p)
+    return bool(_FAMILY_RE.match(p)) and not _NOT_A_KEY.search(p)
 
 
 # ---------------------------------------------------------------- code side

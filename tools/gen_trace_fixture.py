@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Regenerate the golden XPlane fixture for tests/unit/test_trace_analysis.py.
+
+Runs a tiny jitted "step" (named scopes: attention, mlp) three times under
+``jax.profiler.trace`` on the CPU backend and commits two artifacts:
+
+- tests/fixtures/trace/golden.xplane.pb  — the raw profiler protobuf
+- tests/fixtures/trace/golden_hlo.txt    — the compiled step's HLO text
+  (scope-annotated instruction names, so classification can be tested
+  against the same program that produced the trace)
+
+The fixture is committed so the parser tests never depend on the profiler
+actually working in CI; rerun this script only when the fixture needs to
+change shape (then re-check the constants in test_trace_analysis.py):
+
+    JAX_PLATFORMS=cpu python tools/gen_trace_fixture.py
+"""
+from __future__ import annotations
+
+import os
+import pathlib
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+
+FIXTURE_DIR = pathlib.Path(__file__).resolve().parents[1] / "tests" / "fixtures" / "trace"
+STEPS = 3
+
+
+def _step(x, w1, w2):
+    with jax.named_scope("attention"):
+        s = x @ x.T
+        p = jax.nn.softmax(s, axis=-1)
+        a = p @ x
+    with jax.named_scope("mlp"):
+        h = jnp.tanh(a @ w1)
+        y = h @ w2
+    return y.sum()
+
+
+def main() -> int:
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (128, 64), jnp.float32)
+    w1 = jax.random.normal(jax.random.fold_in(key, 1), (64, 256), jnp.float32)
+    w2 = jax.random.normal(jax.random.fold_in(key, 2), (256, 64), jnp.float32)
+
+    step = jax.jit(_step)
+    hlo = step.lower(x, w1, w2).compile().as_text()
+    float(step(x, w1, w2))  # warm up outside the trace window
+
+    with tempfile.TemporaryDirectory() as td:
+        jax.profiler.start_trace(td)
+        try:
+            for _ in range(STEPS):
+                float(step(x, w1, w2))
+        finally:
+            jax.profiler.stop_trace()
+        planes = sorted(pathlib.Path(td).rglob("*.xplane.pb"))
+        if not planes:
+            print("no .xplane.pb produced — profiler unavailable?", file=sys.stderr)
+            return 1
+        FIXTURE_DIR.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(planes[0], FIXTURE_DIR / "golden.xplane.pb")
+    (FIXTURE_DIR / "golden_hlo.txt").write_text(hlo)
+    print(f"wrote {FIXTURE_DIR / 'golden.xplane.pb'} "
+          f"({(FIXTURE_DIR / 'golden.xplane.pb').stat().st_size} bytes), "
+          f"golden_hlo.txt ({len(hlo)} chars), steps={STEPS}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
