@@ -167,6 +167,16 @@ def _from_benchmark_json(doc: dict[str, Any]) -> dict[str, float]:
     return out
 
 
+def _from_tuner_doc(doc: dict[str, Any]) -> dict[str, float]:
+    """``bench.py --tune`` summary doc: the winner's gate-ready metrics ride
+    under ``tuner.metrics`` as ``tuned/<cell>/<basename>`` keys, so the same
+    stdout capture that announced the winner gates against the merged
+    baseline."""
+    metrics = (doc.get("tuner") or {}).get("metrics") or {}
+    return {k: float(v) for k, v in metrics.items()
+            if isinstance(v, (int, float))}
+
+
 def load_run_metrics(path: str) -> dict[str, float]:
     """Dispatch on content, not extension: JSONL rows, a bench line, or
     benchmark.json all reduce to the same gate-metric dict."""
@@ -182,19 +192,23 @@ def load_run_metrics(path: str) -> dict[str, float]:
         if isinstance(doc.get("matrix"), list):  # bench.py --matrix summary doc
             return _from_matrix_rows(doc["matrix"])
         if "metric" in doc and "value" in doc:
-            return _from_bench_line(doc)
+            return {**_from_bench_line(doc), **_from_tuner_doc(doc)}
         if "tokens_per_sec" in doc:
             return _from_benchmark_json(doc)
         if "metrics" in doc:  # a baseline file doubles as a synthetic run
             return {k: float(v) for k, v in doc["metrics"].items()}
         return summarize_rows([doc])
     rows = [json.loads(ln) for ln in text.splitlines() if ln.strip()]
+    tuner: dict[str, float] = {}
+    for r in rows:
+        tuner.update(_from_tuner_doc(r))
     matrix_rows = [r for r in rows if r.get("matrix_row")]
     if matrix_rows:  # matrix stdout capture: per-row lines + summary doc
         out = _from_matrix_rows(matrix_rows)
         out.update(summarize_rows(r for r in rows if not r.get("matrix_row")))
+        out.update(tuner)
         return out
-    return summarize_rows(rows)
+    return {**summarize_rows(rows), **tuner}
 
 
 def load_baseline(path: str) -> dict[str, float]:
@@ -205,10 +219,28 @@ def load_baseline(path: str) -> dict[str, float]:
 
 
 def write_baseline(path: str, metrics: dict[str, float],
-                   meta: dict[str, Any] | None = None) -> None:
-    doc = {"metrics": {k: round(float(v), 6) for k, v in metrics.items()}}
+                   meta: dict[str, Any] | None = None,
+                   merge: bool = False) -> None:
+    """Write (or, with ``merge``, update) a baseline file.
+
+    ``merge=True`` is how the autotuner lands a winning cell in the committed
+    BASELINE.json without erasing it: the existing document's non-metric
+    fields (north_star, configs, metrics_meta, ...) and every other metric
+    survive; only the given metrics are added/replaced, and ``meta`` lands
+    under ``metrics_meta.tuner`` instead of clobbering the document meta.
+    """
+    doc: dict[str, Any] = {}
+    if merge and os.path.exists(path):
+        with open(path) as f:
+            doc = json.load(f)
+    existing = doc.get("metrics") if isinstance(doc.get("metrics"), dict) else {}
+    rounded = {k: round(float(v), 6) for k, v in metrics.items()}
+    doc["metrics"] = {**existing, **rounded}
     if meta:
-        doc["meta"] = meta
+        if merge:
+            doc.setdefault("metrics_meta", {})["tuner"] = meta
+        else:
+            doc["meta"] = meta
     tmp = f"{path}.tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=2, sort_keys=True)
@@ -308,21 +340,36 @@ def main(argv: list[str] | None = None) -> int:
                              "default=0.2 sets the fallback for unlisted metrics")
     parser.add_argument("--require", action="append", default=[], metavar="METRIC",
                         help="fail when METRIC is missing from the run artifact")
+    parser.add_argument("--only", action="append", default=[], metavar="METRIC",
+                        help="gate only baseline metrics matching METRIC (exact "
+                             "key or basename, repeatable) — how CI gates just "
+                             "the deterministic keys of a CPU smoke cell")
     parser.add_argument("--write-baseline", action="store_true",
                         help="write the run's metrics to --baseline and exit 0")
+    parser.add_argument("--merge-baseline", action="store_true",
+                        help="like --write-baseline but update in place: other "
+                             "metrics and non-metric document fields survive "
+                             "(the autotuner's path into a committed baseline)")
     args = parser.parse_args(argv)
 
     try:
         tolerances = _parse_tolerances(args.tolerance)
         run = load_run_metrics(args.run)
-        if args.write_baseline:
-            write_baseline(args.baseline, run, meta={"source": os.path.abspath(args.run)})
-            print(f"[gate] baseline written: {args.baseline} <- {sorted(run)}")
+        if args.write_baseline or args.merge_baseline:
+            write_baseline(args.baseline, run,
+                           meta={"source": os.path.abspath(args.run)},
+                           merge=args.merge_baseline)
+            verb = "merged into" if args.merge_baseline else "written:"
+            print(f"[gate] baseline {verb} {args.baseline} <- {sorted(run)}")
             return 0
         baseline = load_baseline(args.baseline)
     except (OSError, ValueError, json.JSONDecodeError) as exc:
         print(f"[gate] ERROR: {exc}")
         return 2
+    if args.only:
+        only = set(args.only)
+        baseline = {k: v for k, v in baseline.items()
+                    if k in only or _metric_basename(k) in only}
     if not baseline:
         print(f"[gate] ERROR: no gate metrics in baseline {args.baseline}")
         return 2

@@ -86,6 +86,17 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self._check_nan_grads = bool(self.cfg.get("distributed.check_for_nan_in_grad", False))
         cfg = self.cfg
         setup_logging(cfg.get("log_level", "INFO"))
+        # tuned_config: a bench.py --tune winner (tuned/<cell>.yaml). Applied
+        # FIRST so every consumer below — backend, microbatch, prefetch,
+        # step_scheduler — sees the tuned values; the returned provenance
+        # (tuned_config/tuned_cell/tuned_digest) rides the run header so a
+        # training.jsonl always says which autotuner verdict shaped it.
+        self._tuned_provenance: dict | None = None
+        tuned_path = cfg.get("tuned_config")
+        if tuned_path:
+            from automodel_tpu.tuning import apply_tuned_config
+
+            self._tuned_provenance = apply_tuned_config(cfg, str(tuned_path))
         # persistent XLA compile cache (warm restart, docs/resilience.md): must
         # be configured before the FIRST compile of the process — the jit model
         # init a few lines down already writes/reads cache entries
@@ -342,6 +353,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             # the fit-before-run verdict: a header reader (or a human tailing
             # the stream) sees whether this config fits its chip before step 0
             **(plan.header_row() if plan is not None else {}),
+            # autotuner provenance: which tuned/<cell>.yaml (and which ledger
+            # winner digest) shaped this run's config, if any
+            **(self._tuned_provenance or {}),
         ))
 
         # the jitted step

@@ -1,0 +1,26 @@
+"""Signals-driven autotuner (ROADMAP item 4): search {remat ladder, microbatch,
+prefetch depths, MoE dispatcher, layout} against the observability signals and
+emit a tuned config per (model, mesh, seq) cell with a fully auditable trial
+ledger (docs/observability.md "Autotuning & the perf lab")."""
+
+from automodel_tpu.tuning.policy import attribute_winner, order_trials, prune
+from automodel_tpu.tuning.runner import (
+    TrialLedger,
+    apply_tuned_config,
+    run_search,
+    write_tuned_config,
+)
+from automodel_tpu.tuning.space import REMAT_LADDER, SearchSpace, Trial
+
+__all__ = [
+    "REMAT_LADDER",
+    "SearchSpace",
+    "Trial",
+    "TrialLedger",
+    "apply_tuned_config",
+    "attribute_winner",
+    "order_trials",
+    "prune",
+    "run_search",
+    "write_tuned_config",
+]

@@ -1,0 +1,148 @@
+"""Signal-guided search policy: what to try first, what never to compile.
+
+The signals bundle (observability/signals.py) already diagnoses each cell:
+the roofline/measured ``bound`` names the binding resource, and the memory
+plan (observability/memory_plan.py) says whether a config fits its chip
+before anything compiles. This module turns those two signals into policy:
+
+- **Pruning** — a trial whose memory plan says ``fits is False`` is recorded
+  and discarded *before any compile*. ``fits is None`` (no known HBM limit —
+  CPU hosts without an override) never prunes: honesty over guessing.
+- **Ordering** — exploration starts with the knob class the bound implicates.
+  Compute-bound cells move remat down the ladder (spend memory to stop
+  replaying the forward) and layouts; memory-bound cells move remat up and
+  the microbatch split; input-bound cells move the prefetch depths;
+  comms/moe_a2a-bound cells move the dispatcher and sharding layout.
+- **Attribution** — the winner is never a mystery: ``attribute_winner``
+  produces a machine-readable line citing the signal keys and deltas that
+  decided it, which the ledger (runner.py) persists next to the winner.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from automodel_tpu.tuning.space import REMAT_LADDER, Trial
+
+__all__ = ["KNOB_PRIORITY", "prune", "order_trials", "attribute_winner"]
+
+# bound -> knob classes in exploration order (space.Trial field-name groups).
+# The first entries are the knobs the bound diagnosis implicates; the rest
+# follow so an exhaustive space still enumerates completely.
+_REMAT = ("remat_policy",)
+_MICRO = ("micro_batch_size", "grad_acc_steps")
+_PREFETCH = ("prefetch_host_depth", "prefetch_device_depth")
+_DISPATCH = ("dispatcher",)
+_LAYOUT = ("layout",)
+KNOB_PRIORITY: dict[str, tuple[tuple[str, ...], ...]] = {
+    "compute": (_REMAT, _LAYOUT, _MICRO, _PREFETCH, _DISPATCH),
+    "memory": (_REMAT, _MICRO, _LAYOUT, _PREFETCH, _DISPATCH),
+    "input": (_PREFETCH, _MICRO, _REMAT, _LAYOUT, _DISPATCH),
+    "comms": (_DISPATCH, _LAYOUT, _MICRO, _REMAT, _PREFETCH),
+    "moe_a2a": (_DISPATCH, _LAYOUT, _MICRO, _REMAT, _PREFETCH),
+}
+
+# remat exploration direction per bound: compute-bound walks DOWN the ladder
+# (toward "full": save more, recompute less), memory-bound walks UP (toward
+# "none": save less). +1 = prefer higher ladder index first.
+_REMAT_DIRECTION = {"compute": +1, "memory": -1}
+
+
+def prune(trial: Trial, plan: Any) -> str | None:
+    """Reason to discard ``trial`` before compiling, or None to keep it.
+
+    ``plan`` is the trial's analytic MemoryPlan (or None when the caller could
+    not build one). Only an explicit ``fits is False`` verdict prunes — the
+    plan's job is to stop configs that CANNOT fit from ever compiling, not to
+    guess about unknown chips.
+    """
+    if plan is None:
+        return None
+    if plan.fits is False:
+        headroom = plan.headroom_bytes
+        total = plan.total_bytes
+        return (f"memory_plan: does not fit — total {total / 2**30:.4f} GiB, "
+                f"headroom {headroom / 2**30:.4f} GiB (mem_plan/fits=false)")
+    return None
+
+
+def _knob_rank(moved: list[str], priority: tuple[tuple[str, ...], ...]) -> int:
+    """Earliest priority class a trial's moved knobs fall into; trials that
+    move nothing (the baseline itself) sort first."""
+    if not moved:
+        return -1
+    ranks = []
+    for knob in moved:
+        for i, group in enumerate(priority):
+            if knob in group:
+                ranks.append(i)
+                break
+        else:
+            ranks.append(len(priority))
+    return min(ranks)
+
+
+def _remat_key(trial: Trial, direction: int) -> float:
+    try:
+        idx = REMAT_LADDER.index(trial.remat_policy)
+    except ValueError:
+        idx = 0  # repo-specific ladder names sort as the most-remat end
+    return -direction * idx
+
+
+def order_trials(trials: list[Trial], bound: str | None,
+                 baseline: Trial | None = None) -> list[Trial]:
+    """Deterministic, signal-guided exploration order.
+
+    Primary key: which knob class the trial explores relative to ``baseline``,
+    ranked by the bound's KNOB_PRIORITY (unknown/None bound keeps "compute"'s
+    order — the least surprising default). Secondary: fewer knobs moved at
+    once first (attribution stays readable when early trials are one-knob
+    moves). Then the bound's remat direction, then the digest for stability.
+    """
+    base = baseline or (trials[0] if trials else Trial())
+    priority = KNOB_PRIORITY.get(bound or "", KNOB_PRIORITY["compute"])
+    direction = _REMAT_DIRECTION.get(bound or "", +1)
+
+    def key(t: Trial):
+        moved = t.moved_knobs(base)
+        return (_knob_rank(moved, priority), len(moved),
+                _remat_key(t, direction), t.digest())
+
+    return sorted(trials, key=key)
+
+
+def attribute_winner(winner: dict[str, Any],
+                     runner_up: dict[str, Any] | None,
+                     bound: str | None = None) -> dict[str, Any]:
+    """The signal-citing attribution the ledger stores next to the winner.
+
+    ``winner`` / ``runner_up`` are ledger entries (runner.py shape): a dict
+    with ``digest``, ``trial`` (override mapping) and ``outcome.metrics``
+    holding the ``tuner/*`` rows the trial emitted. Returns ``{"line",
+    "signal_keys", "deltas"}`` where every entry of ``signal_keys`` is a real
+    key present in the winner's metrics (tests enforce this), and ``deltas``
+    maps each cited key to (runner_up value -> winner value).
+    """
+    metrics = (winner.get("outcome") or {}).get("metrics") or {}
+    cited = [k for k in ("tuner/tps", "tuner/hbm_gib_peak") if metrics.get(k) is not None]
+    deltas: dict[str, Any] = {}
+    clauses: list[str] = []
+    other = (runner_up or {}).get("outcome", {}).get("metrics") or {}
+    for key in cited:
+        ours, theirs = metrics.get(key), other.get(key)
+        deltas[key] = {"winner": ours, "runner_up": theirs}
+        if theirs:
+            rel = (ours - theirs) / abs(theirs) * 100.0
+            clauses.append(f"{key} {theirs:.6g} -> {ours:.6g} ({rel:+.1f}%)")
+        else:
+            clauses.append(f"{key} {ours:.6g} (no runner-up)")
+    if bound:
+        clauses.append(f"cell bound={bound}")
+    moved = sorted(set(winner.get("trial") or {})
+                   - {k for k, v in (runner_up or {}).get("trial", {}).items()
+                      if (winner.get("trial") or {}).get(k) == v})
+    if moved:
+        clauses.append("moved " + ", ".join(moved))
+    line = f"winner {winner.get('digest')}: " + "; ".join(clauses)
+    return {"line": line, "signal_keys": cited, "deltas": deltas}

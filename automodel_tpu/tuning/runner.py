@@ -1,0 +1,316 @@
+"""Trial runner + the crash-safe trial ledger (``tuner_report.json``).
+
+Every trial the tuner considers leaves an auditable record: its config digest,
+the signals snapshot it was judged on, and an outcome — ``pruned(reason)``
+(the memory plan rejected it before any compile), ``ran(metrics)``, or
+``failed(error)``. The ledger file is written atomically after every trial
+(tmp + rename, the write_signals/TraceTimeline discipline) and is *resumable*:
+re-running the same search skips trials whose digest already carries an
+outcome, byte-identically preserving their entries — a crash mid-search costs
+one trial, not the search. Entries carry no wallclock timestamps, so the same
+trials + the same measurements produce the same bytes (golden-testable).
+
+Trials also emit flat ``tuner/*`` metric rows (the families contract in
+tools/check_metric_keys.py) through the caller's metric sink, and one
+``tuner/<digest>`` span per trial on the Chrome-trace timeline (events.py), so
+a tuning session reads like any other run in Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+from typing import Any, Callable
+
+from automodel_tpu.tuning import policy as _policy
+from automodel_tpu.tuning.space import Trial
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["TUNER_REPORT_VERSION", "TrialLedger", "validate_report",
+           "run_search", "write_tuned_config", "apply_tuned_config"]
+
+TUNER_REPORT_VERSION = 1
+
+
+def _atomic_write_json(path: str, doc: dict[str, Any]) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=".tuner_report.", dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class TrialLedger:
+    """The resumable ``tuner_report.json``: one entry per trial, atomic after
+    every append, deterministic bytes for identical searches."""
+
+    def __init__(self, path: str, cell: dict[str, Any] | None = None,
+                 bound: str | None = None):
+        self.path = str(path)
+        doc: dict[str, Any] | None = None
+        if os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError) as exc:
+                # a torn write cannot happen (atomic rename); a hand-corrupted
+                # file must not silently erase the audit trail
+                raise ValueError(f"{self.path}: unreadable tuner report ({exc})")
+            if doc.get("version") != TUNER_REPORT_VERSION:
+                raise ValueError(
+                    f"{self.path}: tuner report version {doc.get('version')!r}, "
+                    f"expected {TUNER_REPORT_VERSION}")
+        if doc is None:
+            doc = {"version": TUNER_REPORT_VERSION, "cell": dict(cell or {}),
+                   "bound": bound, "trials": [], "winner": None}
+        self.doc = doc
+
+    @property
+    def completed(self) -> dict[str, dict[str, Any]]:
+        """digest -> entry for every trial that already has an outcome."""
+        return {e["digest"]: e for e in self.doc.get("trials", [])
+                if e.get("outcome")}
+
+    def record(self, entry: dict[str, Any]) -> None:
+        self.doc["trials"].append(entry)
+        self.write()
+
+    def finalize(self, winner_digest: str | None,
+                 attribution: dict[str, Any] | None) -> None:
+        self.doc["winner"] = (
+            {"digest": winner_digest, "attribution": attribution}
+            if winner_digest is not None else None)
+        self.write()
+
+    def write(self) -> None:
+        _atomic_write_json(self.path, self.doc)
+
+
+def validate_report(doc: Any) -> list[str]:
+    """Schema-check a tuner report; returns problems ([] = valid)."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"report is {type(doc).__name__}, expected object"]
+    if doc.get("version") != TUNER_REPORT_VERSION:
+        problems.append(f"version is {doc.get('version')!r}, "
+                        f"expected {TUNER_REPORT_VERSION}")
+    trials = doc.get("trials")
+    if not isinstance(trials, list):
+        return problems + ["trials is not a list"]
+    ran: set[str] = set()
+    for i, e in enumerate(trials):
+        where = f"trials[{i}]"
+        if not isinstance(e, dict):
+            problems.append(f"{where} is not an object")
+            continue
+        if not isinstance(e.get("digest"), str):
+            problems.append(f"{where}.digest missing")
+        if not isinstance(e.get("trial"), dict):
+            problems.append(f"{where}.trial (override mapping) missing")
+        outcome = e.get("outcome")
+        if not isinstance(outcome, dict):
+            problems.append(f"{where}.outcome missing")
+            continue
+        status = outcome.get("status")
+        payload = {"pruned": "reason", "ran": "metrics", "failed": "error"}
+        if status not in payload:
+            problems.append(f"{where}.outcome.status is {status!r}")
+            continue
+        if payload[status] not in outcome:
+            problems.append(f"{where}.outcome lacks {payload[status]!r} "
+                            f"(status {status})")
+        if status == "ran":
+            ran.add(e.get("digest"))
+    winner = doc.get("winner")
+    if winner is not None:
+        if not isinstance(winner, dict) or winner.get("digest") not in ran:
+            problems.append("winner.digest does not name a ran trial")
+        attribution = (winner or {}).get("attribution") or {}
+        if not attribution.get("line") or not attribution.get("signal_keys"):
+            problems.append("winner.attribution lacks line/signal_keys")
+    return problems
+
+
+def _metric_row(index: int, digest: str, status: str,
+                metrics: dict[str, Any] | None) -> dict[str, Any]:
+    """The flat ``tuner/*`` row one trial contributes to the metric stream."""
+    row: dict[str, Any] = {
+        "tuner/trial": index,
+        "tuner/digest": digest,
+        "tuner/outcome": status,
+    }
+    for key in ("tuner/tps", "tuner/hbm_gib_peak", "tuner/headroom_gib"):
+        if metrics and metrics.get(key) is not None:
+            row[key] = metrics[key]
+    return row
+
+
+def run_search(
+    trials: list[Trial],
+    *,
+    measure: Callable[[Trial], dict[str, Any]],
+    ledger: TrialLedger,
+    plan_fn: Callable[[Trial], Any] | None = None,
+    bound: str | None = None,
+    baseline: Trial | None = None,
+    timeline: Any = None,
+    metric_sink: Callable[[dict[str, Any]], None] | None = None,
+) -> dict[str, Any]:
+    """Walk ``trials`` in signal-guided order; return the winner + attribution.
+
+    ``measure(trial)`` runs one short measured window and returns at least
+    ``{"tps": float}``; optional keys: ``hbm_gib_peak``, ``headroom_gib``, and
+    ``signals`` (a signals.py cell snapshot stored verbatim on the ledger
+    entry). ``plan_fn(trial)`` builds the trial's analytic MemoryPlan for
+    pre-compile pruning (None = nothing to prune on). Every trial emits one
+    ``tuner/*`` metric row through ``metric_sink`` and one span on
+    ``timeline``; the ledger is written after each trial.
+    """
+    ordered = _policy.order_trials(trials, bound, baseline=baseline)
+    done = ledger.completed
+    skipped = 0
+    for index, trial in enumerate(ordered):
+        digest = trial.digest()
+        if digest in done:
+            skipped += 1
+            continue
+        t0 = timeline.now() if timeline is not None else 0.0
+        plan = plan_fn(trial) if plan_fn is not None else None
+        reason = _policy.prune(trial, plan)
+        snapshot = None
+        if reason is not None:
+            status, outcome = "pruned", {"status": "pruned", "reason": reason}
+            metrics = _plan_metrics(plan)
+            snapshot = _plan_snapshot(plan)
+        else:
+            try:
+                raw = dict(measure(trial))
+                snapshot = raw.pop("signals", None)
+                metrics = {f"tuner/{k}": v for k, v in raw.items()
+                           if isinstance(v, (int, float))}
+                metrics.update(_plan_metrics(plan))
+                status, outcome = "ran", {"status": "ran", "metrics": metrics}
+            except Exception as exc:  # noqa: BLE001 — a dead trial is a ledger
+                # entry, not a dead search
+                logger.warning("tuner trial %s failed: %r", digest, exc)
+                status, outcome = "failed", {"status": "failed", "error": repr(exc)}
+                metrics = None
+        entry = {"index": index, "digest": digest, "trial": trial.overrides(),
+                 "outcome": outcome, "signals": snapshot}
+        ledger.record(entry)
+        done[digest] = entry
+        row = _metric_row(index, digest, status, metrics)
+        if metric_sink is not None:
+            metric_sink(row)
+        if timeline is not None:
+            timeline.complete(f"tuner/{digest}", "tuner", t0,
+                              timeline.now() - t0, outcome=status,
+                              tps=(metrics or {}).get("tuner/tps"))
+    ran = [e for e in ledger.doc["trials"]
+           if e["outcome"]["status"] == "ran"
+           and e["outcome"]["metrics"].get("tuner/tps") is not None]
+    ran.sort(key=lambda e: (-e["outcome"]["metrics"]["tuner/tps"], e["digest"]))
+    winner = ran[0] if ran else None
+    attribution = None
+    if winner is not None:
+        attribution = _policy.attribute_winner(
+            winner, ran[1] if len(ran) > 1 else None, bound=bound)
+        ledger.finalize(winner["digest"], attribution)
+        if metric_sink is not None:
+            metric_sink({"tuner/winner": winner["digest"],
+                         "tuner/best_tps": winner["outcome"]["metrics"]["tuner/tps"]})
+    else:
+        ledger.finalize(None, None)
+    counts = {"total": len(ordered), "skipped_resume": skipped}
+    for e in ledger.doc["trials"]:
+        s = e["outcome"]["status"]
+        counts[s] = counts.get(s, 0) + 1
+    return {"winner": winner, "attribution": attribution,
+            "report_path": ledger.path, "counts": counts}
+
+
+def _plan_metrics(plan: Any) -> dict[str, Any]:
+    if plan is None:
+        return {}
+    out: dict[str, Any] = {}
+    head = plan.headroom_bytes
+    if head is not None:
+        out["tuner/headroom_gib"] = round(head / 2**30, 4)
+    return out
+
+
+def _plan_snapshot(plan: Any) -> dict[str, Any] | None:
+    """A signals cell holding just the memory section — what a pruned trial
+    was judged on (it never compiled, so nothing else exists)."""
+    if plan is None:
+        return None
+    from automodel_tpu.observability.signals import build_cell
+
+    return build_cell(memory_plan=plan)
+
+
+# ------------------------------------------------------------- tuned configs
+def write_tuned_config(path: str, *, cell_name: str, entry: dict[str, Any],
+                       attribution: dict[str, Any] | None,
+                       source: str = "bench.py --tune") -> None:
+    """Emit the winning trial as a ``tuned/<cell>.yaml`` the recipe loads.
+
+    The file is two sections: ``overrides`` (dotted config paths, applied with
+    ``ConfigNode.set_by_path``) and ``tuned`` (provenance: cell, digest,
+    source, the attribution line) — so a tuned run's run_header can say
+    exactly where its knobs came from.
+    """
+    import yaml
+
+    doc = {
+        "tuned": {
+            "cell": cell_name,
+            "digest": entry["digest"],
+            "source": source,
+            "attribution": (attribution or {}).get("line"),
+        },
+        "overrides": dict(entry["trial"]),
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write("# generated by the autotuner — docs/observability.md "
+                "\"Autotuning & the perf lab\"\n")
+        yaml.safe_dump(doc, f, sort_keys=True, default_flow_style=False)
+    os.replace(tmp, path)
+
+
+def apply_tuned_config(cfg: Any, path: str) -> dict[str, Any]:
+    """Apply a tuned config onto a recipe ConfigNode; return the provenance
+    fields the run_header records (``tuned_config``/``tuned_cell``/
+    ``tuned_digest``). Raises with a pointer at the generator when the file
+    is missing — a tuned config is an artifact, not something to guess."""
+    import yaml
+
+    try:
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+    except FileNotFoundError:
+        raise FileNotFoundError(
+            f"tuned_config {path!r} not found — generate it with "
+            f"`python bench.py --tune` (docs/observability.md "
+            f"\"Autotuning & the perf lab\")")
+    overrides = (doc or {}).get("overrides") or {}
+    for key, value in sorted(overrides.items()):
+        cfg.set_by_path(key, value)
+    meta = (doc or {}).get("tuned") or {}
+    return {"tuned_config": str(path), "tuned_cell": meta.get("cell"),
+            "tuned_digest": meta.get("digest")}

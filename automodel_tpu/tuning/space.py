@@ -1,0 +1,133 @@
+"""The autotuner search space: one Trial per knob combination, as config paths.
+
+ROADMAP item 4 names the knobs: the remat ladder, the microbatch /
+grad-accumulation split, the input-pipeline prefetch depths, the MoE
+dispatcher, and layout variants. A Trial is a frozen value assignment over
+exactly those knobs; ``overrides()`` renders it as the dotted config paths the
+recipe loader (`config/loader.py` ``set_by_path``) and BackendConfig already
+accept, and ``digest()`` is the stable identity the trial ledger keys resume
+on. The space enumerates combinations; *ordering* them by the cell's signals
+and *pruning* the ones the memory plan rejects is policy.py's job — the space
+itself stays a dumb, exhaustive, deterministic enumeration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import json
+from typing import Any, Iterable
+
+__all__ = ["REMAT_LADDER", "LAYOUTS", "Trial", "SearchSpace"]
+
+# the remat ladder ordered by activation footprint, smallest first: "none"
+# remats everything (minimal memory, maximal recompute), "full" saves
+# everything (no recompute, maximal memory). "Moving remat down" (compute-bound
+# cells: spend memory to stop replaying the forward) walks toward "full";
+# "moving remat up" (memory-bound cells) walks toward "none".
+REMAT_LADDER = ("none", "dots_no_batch", "dots", "full")
+
+# layout variants: how the layer stack is laid out for the compiler. "scan"
+# stacks layer params and lax.scans over them (fast compiles, PP-friendly);
+# "unrolled" gives XLA the whole unrolled graph to schedule (slower compiles,
+# sometimes better fusion/overlap) — backend.scan_layers underneath.
+LAYOUTS = ("scan", "unrolled")
+
+
+@dataclasses.dataclass(frozen=True)
+class Trial:
+    """One point in the search space. ``None`` means "leave the base config
+    alone" — the knob does not appear in the override set or the digest, so a
+    space that never touches a knob cannot invalidate ledger entries."""
+
+    remat_policy: str = "none"
+    micro_batch_size: int | None = None
+    grad_acc_steps: int | None = None
+    prefetch_host_depth: int | None = None
+    prefetch_device_depth: int | None = None
+    dispatcher: str | None = None  # "dense" | "a2a"; MoE cells with ep > 1 only
+    layout: str | None = None  # "scan" | "unrolled"
+
+    def overrides(self) -> dict[str, Any]:
+        """The trial as dotted config-path overrides (recipe + bench shared)."""
+        out: dict[str, Any] = {"backend.remat_policy": self.remat_policy}
+        if self.micro_batch_size is not None:
+            out["micro_batch_size"] = int(self.micro_batch_size)
+        if self.grad_acc_steps is not None:
+            out["step_scheduler.grad_acc_steps"] = int(self.grad_acc_steps)
+        if self.prefetch_host_depth is not None:
+            out["dataloader.prefetch.enabled"] = True
+            out["dataloader.prefetch.host_depth"] = int(self.prefetch_host_depth)
+        if self.prefetch_device_depth is not None:
+            out["dataloader.prefetch.enabled"] = True
+            out["dataloader.prefetch.device_depth"] = int(self.prefetch_device_depth)
+        if self.dispatcher is not None:
+            out["backend.dispatcher"] = self.dispatcher
+        if self.layout is not None:
+            out["backend.scan_layers"] = self.layout == "scan"
+        return out
+
+    def digest(self) -> str:
+        """Stable trial identity: sha256 over the sorted override items. The
+        ledger resumes on this, so it must not depend on dict order, float
+        repr, or anything outside the overrides themselves."""
+        blob = json.dumps(sorted(self.overrides().items()), default=str)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def moved_knobs(self, base: "Trial") -> list[str]:
+        """Knob names where this trial differs from ``base`` (policy ordering
+        ranks trials by WHICH knob class they explore)."""
+        out = []
+        for f in dataclasses.fields(self):
+            if getattr(self, f.name) != getattr(base, f.name):
+                out.append(f.name)
+        return out
+
+
+@dataclasses.dataclass
+class SearchSpace:
+    """Axis values to cross. ``microbatch_splits`` holds (micro_batch_size,
+    grad_acc_steps) pairs — enumerate them together so every split keeps the
+    same tokens per optimizer step. ``ep`` gates the dispatcher axis: the a2a
+    dispatcher is an expert-parallel all_to_all, meaningless (and rejected by
+    the models) without an ep axis > 1."""
+
+    remat_policies: tuple[str, ...] = REMAT_LADDER
+    microbatch_splits: tuple[tuple[int, int], ...] = ()
+    prefetch_depths: tuple[tuple[int, int], ...] = ()  # (host_depth, device_depth)
+    dispatchers: tuple[str, ...] = ()
+    layouts: tuple[str, ...] = ()
+    ep: int = 1
+
+    @classmethod
+    def smoke(cls, micro_batch: int = 2, oversize_micro_batch: int = 64,
+              ep: int = 1) -> "SearchSpace":
+        """The CPU smoke space ``bench.py --tune`` walks: small enough to
+        compile every surviving trial in CI, with one deliberately oversized
+        microbatch split the memory plan must prune before compile."""
+        return cls(
+            remat_policies=("none", "dots"),
+            microbatch_splits=((micro_batch, 1), (max(micro_batch // 2, 1), 2),
+                               (oversize_micro_batch, 1)),
+            prefetch_depths=((2, 2), (4, 2)),
+            layouts=("scan",),
+            ep=ep,
+        )
+
+    def enumerate(self) -> list[Trial]:
+        """The full cross product, deterministic order. Axes left empty
+        contribute a single "leave the base config alone" value."""
+        splits: Iterable = self.microbatch_splits or ((None, None),)
+        depths: Iterable = self.prefetch_depths or ((None, None),)
+        dispatchers: Iterable = (self.dispatchers or (None,)) if self.ep > 1 else (None,)
+        layouts: Iterable = self.layouts or (None,)
+        out = []
+        for remat, (mb, ga), (hd, dd), disp, layout in itertools.product(
+                self.remat_policies, splits, depths, dispatchers, layouts):
+            out.append(Trial(
+                remat_policy=remat, micro_batch_size=mb, grad_acc_steps=ga,
+                prefetch_host_depth=hd, prefetch_device_depth=dd,
+                dispatcher=disp, layout=layout,
+            ))
+        return out

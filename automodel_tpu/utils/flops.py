@@ -19,7 +19,7 @@ from __future__ import annotations
 
 from typing import Any
 
-__all__ = ["flops_per_token", "mfu", "PEAK_TFLOPS"]
+__all__ = ["flops_per_token", "vision_tower_flops", "mfu", "PEAK_TFLOPS"]
 
 # bf16 dense peak per chip
 PEAK_TFLOPS: dict[str, float] = {
@@ -150,9 +150,45 @@ def _layer_kinds(get, L: int) -> list[str]:
     return ["attn"] * L
 
 
-def flops_per_token(cfg: Any, seq_len: int, training: bool = True) -> float:
-    """FLOPs per token for a decoder config (ours or an HF-config-like dict)."""
+def vision_tower_flops(cfg: Any) -> float:
+    """Forward FLOPs for ONE image through a CLIP-style ViT tower.
+
+    ``cfg`` is a CLIPVisionConfig-like object or HF ``vision_config`` dict.
+    Patch embedding is the conv-as-matmul count (``num_patches`` projections of
+    a ``3*patch^2`` pixel column); each of the ``num_hidden_layers`` encoder
+    layers runs full MHA plus an UN-gated 2-matmul MLP (fc1/fc2 — not the
+    3-matmul gated count dense decoders use) over ``num_patches + 1`` tokens
+    (the CLS token attends too).
+    """
     get = _getter(cfg)
+    d = get("hidden_size")
+    inter = get("intermediate_size")
+    L = get("num_hidden_layers")
+    patch = get("patch_size", 14) or 14
+    image = get("image_size", 336) or 336
+    num_patches = (image // patch) ** 2
+    n_pos = num_patches + 1  # + CLS
+    patch_embed = num_patches * 2 * (3 * patch * patch) * d
+    per_token_attn = (2 * d * 3 * d) + (2 * d * d) + (2 * 2 * n_pos * d)
+    per_token_mlp = 2 * 2 * d * inter
+    return float(patch_embed + n_pos * L * (per_token_attn + per_token_mlp))
+
+
+def flops_per_token(cfg: Any, seq_len: int, training: bool = True,
+                    num_images: int = 1) -> float:
+    """FLOPs per token for a decoder config (ours or an HF-config-like dict).
+
+    VLM configs (llava lineage: a ``vision_config``/``text_config`` pair, or
+    our LlavaConfig's ``vision``/``text``) count the decoder from the text
+    config and amortize ``num_images`` vision-tower forwards over ``seq_len``
+    tokens — so MFU on llava-style runs credits the vision compute instead of
+    pretending the image tokens were free.
+    """
+    get = _getter(cfg)
+    vision = get("vision_config") or get("vision")
+    text = get("text_config") or get("text")
+    if text is not None:
+        get = _getter(text)
     d = get("hidden_size")
     L = get("num_hidden_layers")
     v = get("vocab_size")
@@ -196,6 +232,8 @@ def flops_per_token(cfg: Any, seq_len: int, training: bool = True) -> float:
         mlp_total = n_mlp_layers * 3 * 2 * d * inter
 
     fwd = attn_total + mlp_total + 2 * d * v
+    if vision is not None:
+        fwd += vision_tower_flops(vision) * max(int(num_images), 0) / float(seq_len)
     return 3.0 * fwd if training else fwd
 
 

@@ -296,23 +296,13 @@ MATRIX_SEQ_LENS = (2048, 4096, 8192)
 
 def _matrix_dense_model(cpu: bool):
     from automodel_tpu.models.common.backend import BackendConfig
-    from automodel_tpu.models.llama.model import LlamaConfig, LlamaForCausalLM
+    from automodel_tpu.models.llama.model import LlamaForCausalLM
 
+    cfg = _tune_model_config(cpu)
     if cpu:
-        cfg = LlamaConfig(
-            vocab_size=2048, hidden_size=256, intermediate_size=1024,
-            num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
-            head_dim=32, max_position_embeddings=512,
-        )
         backend = BackendConfig(dtype="float32")
     else:
-        # Llama-3.2-1B dims + the tuned single-chip backend (see _measure)
-        cfg = LlamaConfig(
-            vocab_size=128256, hidden_size=2048, intermediate_size=8192,
-            num_hidden_layers=16, num_attention_heads=32, num_key_value_heads=8,
-            head_dim=64, rope_theta=500000.0, tie_word_embeddings=True,
-            max_position_embeddings=131072,
-        )
+        # the tuned single-chip backend (see _measure)
         backend = BackendConfig(dtype="bfloat16", remat_policy="mlp_attn_dots",
                                 attention="flash", attention_segments=False)
     return LlamaForCausalLM(cfg, backend), cfg.vocab_size
@@ -618,6 +608,314 @@ def _matrix_bench(cpu: bool, dynamics: bool = False,
     return doc
 
 
+# ------------------------------------------------------------------ tune mode
+def _tune_measure_factory(cpu: bool, nominal_seq: int, plan_cache: dict):
+    """Build the per-trial measure() the tuner runner calls: model with the
+    trial's backend knobs, AOT compile, a short timed window through the
+    overlapped input pipeline at the trial's prefetch depths. Returns raw
+    metrics plus a signals-cell snapshot for the ledger."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from automodel_tpu.data.collate import stack_batches
+    from automodel_tpu.data.llm.mock import MockSFTDataset
+    from automodel_tpu.data.loader import DataLoader
+    from automodel_tpu.data.prefetch import InputPipeline, PrefetchConfig
+    from automodel_tpu.models.common.backend import BackendConfig
+    from automodel_tpu.models.llama.model import LlamaForCausalLM
+    from automodel_tpu.observability import signals as sig
+    from automodel_tpu.observability.hlo_costs import (
+        compiled_cost_metrics,
+        device_specs,
+        roofline_metrics,
+    )
+    from automodel_tpu.observability.memory_plan import compiled_memory_attribution
+    from automodel_tpu.ops.losses import masked_cross_entropy
+    from automodel_tpu.training.step_scheduler import StepScheduler
+    from automodel_tpu.training.train_step import make_train_step
+
+    cfg = _tune_model_config(cpu)
+    seq_len = min(nominal_seq, 128) if cpu else nominal_seq
+    n_steps = 3 if cpu else 10
+    devices = jax.device_count()
+
+    def backend_for(trial) -> BackendConfig:
+        kw = dict(dtype="float32") if cpu else dict(
+            dtype="bfloat16", attention="flash", attention_segments=False)
+        kw["remat_policy"] = trial.remat_policy
+        if trial.layout is not None:
+            kw["scan_layers"] = trial.layout == "scan"
+        if trial.dispatcher is not None:
+            kw["dispatcher"] = trial.dispatcher
+        return BackendConfig(**kw)
+
+    def measure(trial) -> dict:
+        backend = backend_for(trial)
+        model = LlamaForCausalLM(cfg, backend)
+        micro_batch = int(trial.micro_batch_size or (2 if cpu else 4))
+
+        def forward_loss(p, batch, num_label_tokens):
+            logits = model(p, batch["input_ids"], positions=batch["positions"],
+                           segment_ids=batch["segment_ids"])
+            return masked_cross_entropy(logits, batch["labels"], num_label_tokens)
+
+        optimizer = optax.chain(optax.scale_by_factored_rms(), optax.scale(-1e-5))
+        step = jax.jit(make_train_step(forward_loss, optimizer),
+                       donate_argnums=(0, 1))
+        params = model.init(jax.random.key(0), jnp.dtype(backend.dtype))
+        opt_state = jax.jit(optimizer.init)(params)
+
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (1, micro_batch, seq_len)).astype(np.int32)
+        sample_stack = {
+            "input_ids": ids, "labels": ids.copy(),
+            "positions": np.ascontiguousarray(np.broadcast_to(
+                np.arange(seq_len, dtype=np.int32), ids.shape)),
+            "segment_ids": np.ones_like(ids),
+        }
+        compiled = step.lower(params, opt_state, sample_stack).compile()
+        hlo = None
+        try:
+            hlo = compiled.as_text()
+        except Exception:  # noqa: BLE001 — costs/roofline degrade gracefully
+            pass
+        costs = compiled_cost_metrics(compiled, hlo_text=hlo)
+        roof = roofline_metrics(costs, device_specs(jax.devices()[0].device_kind))
+        attribution = compiled_memory_attribution(compiled)
+        peak_gib = (round(attribution["peak_est"] / 2**30, 4)
+                    if attribution else None)
+
+        def collate(samples):
+            arr = np.asarray([s["input_ids"] for s in samples], np.int32)[:, :seq_len]
+            return {
+                "input_ids": arr, "labels": arr.copy(),
+                "positions": np.ascontiguousarray(np.broadcast_to(
+                    np.arange(arr.shape[-1], dtype=np.int32), arr.shape)),
+                "segment_ids": np.ones_like(arr),
+            }
+
+        ds = MockSFTDataset(vocab_size=cfg.vocab_size, seq_len=seq_len,
+                            num_samples=micro_batch * (n_steps + 3), seed=0,
+                            item_delay_s=0.002)
+        dl = DataLoader(ds, batch_size=micro_batch, collate_fn=collate, seed=0)
+        sched = StepScheduler(grad_acc_steps=1, num_epochs=1,
+                              max_steps=n_steps + 1, dataloader=dl,
+                              handle_sigterm=False)
+        pipe = InputPipeline(
+            scheduler=sched, dataloader=dl, stack_fn=stack_batches,
+            put_fn=jax.device_put,
+            config=PrefetchConfig(
+                enabled=trial.prefetch_host_depth is not None,
+                host_depth=int(trial.prefetch_host_depth or 2),
+                device_depth=int(trial.prefetch_device_depth or 2)))
+        try:
+            first = pipe.get()
+            params, opt_state, m = compiled(params, opt_state, first.stack)
+            float(m["loss"])  # host sync before the clock starts
+            done = 0
+            t0 = time.perf_counter()
+            while done < n_steps:
+                item = pipe.get()
+                if item is None:
+                    break
+                params, opt_state, m = compiled(params, opt_state, item.stack)
+                done += 1
+            float(m["loss"])
+            dt = time.perf_counter() - t0
+        finally:
+            pipe.close()
+        tps = round(done * micro_batch * seq_len / dt / devices, 1)
+        out = {"tps": tps,
+               "signals": sig.build_cell(
+                   cell={"model": "dense", "seq_len": nominal_seq},
+                   roofline=roof or None, costs=costs,
+                   memory_plan=plan_cache.get(trial.digest()))}
+        if peak_gib is not None:
+            out["hbm_gib_peak"] = peak_gib
+        return out
+
+    return measure
+
+
+def _tune_model_config(cpu: bool):
+    """The dense cell's dims, shared by the matrix bench and the tuner (the
+    tuner rebuilds the model per trial with the trial's backend knobs)."""
+    from automodel_tpu.models.llama.model import LlamaConfig
+
+    if cpu:
+        return LlamaConfig(
+            vocab_size=2048, hidden_size=256, intermediate_size=1024,
+            num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
+            head_dim=32, max_position_embeddings=512,
+        )
+    # Llama-3.2-1B dims
+    return LlamaConfig(
+        vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+        num_hidden_layers=16, num_attention_heads=32, num_key_value_heads=8,
+        head_dim=64, rope_theta=500000.0, tie_word_embeddings=True,
+        max_position_embeddings=131072,
+    )
+
+
+def _tune_bench(cpu: bool, out_dir: str = "tuned",
+                baseline_path: str | None = None) -> dict:
+    """``--tune``: a pruned, signal-ordered search over the dense smoke cell.
+
+    Emits one ``tuner/*`` JSON row per trial as it lands (the matrix-row
+    contract), an atomic resumable ``<out_dir>/tuner_report.json`` ledger, a
+    ``tuner_timeline.json`` with one span per trial, the winning trial as
+    ``<out_dir>/<cell>.yaml`` (loadable via the recipe's ``tuned_config``
+    key), and — when ``baseline_path`` exists — merges the winning cell's
+    ``tuned/<cell>/*`` metrics into it through regression.write_baseline so
+    the perf gate enforces tuned numbers from then on.
+    """
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from automodel_tpu.observability import regression
+    from automodel_tpu.observability.events import TraceTimeline
+    from automodel_tpu.observability.memory_plan import build_memory_plan
+    from automodel_tpu.tuning import SearchSpace, TrialLedger, run_search
+    from automodel_tpu.tuning.runner import write_tuned_config
+
+    nominal_seq = 2048
+    seq_len = min(nominal_seq, 128) if cpu else nominal_seq
+    devices = jax.device_count()
+    mesh_name = f"{jax.devices()[0].platform}{devices}"
+    cell_name = f"dense_s{nominal_seq}_{mesh_name}"
+
+    space = (SearchSpace.smoke(micro_batch=2) if cpu else SearchSpace(
+        microbatch_splits=((4, 1), (2, 2), (1, 4)),
+        prefetch_depths=((2, 2), (4, 2), (4, 4)),
+        layouts=("scan", "unrolled"),
+    ))
+    trials = space.enumerate()
+    baseline_trial = trials[0]
+
+    # pre-compile memory plans: abstract params/opt-state shapes only — a trial
+    # the plan rejects never compiles. The synthetic HBM line sits at 3x the
+    # baseline trial's footprint, so the deliberately oversized microbatch
+    # split in the smoke space is pruned, not compiled.
+    from automodel_tpu.models.common.backend import BackendConfig
+    from automodel_tpu.models.llama.model import LlamaForCausalLM
+
+    cfg = _tune_model_config(cpu)
+    optimizer = optax.chain(optax.scale_by_factored_rms(), optax.scale(-1e-5))
+    plan_cache: dict = {}
+
+    def plan_for(trial, limit_gib):
+        backend = BackendConfig(dtype="float32" if cpu else "bfloat16",
+                                remat_policy=trial.remat_policy)
+        model = LlamaForCausalLM(cfg, backend)
+        aparams = model.abstract_params(jnp.dtype(backend.dtype))
+        aopt = jax.eval_shape(optimizer.init, aparams)
+        return build_memory_plan(
+            aparams, aopt,
+            micro_batch_size=int(trial.micro_batch_size or (2 if cpu else 4)),
+            seq_len=seq_len,
+            grad_acc_steps=int(trial.grad_acc_steps or 1),
+            model_config=cfg,
+            hbm_limit_override_gib=limit_gib,
+        )
+
+    base_plan = plan_for(baseline_trial, None)
+    limit_gib = round(base_plan.total_bytes * 3 / 2**30, 6)
+
+    def plan_fn(trial):
+        plan = plan_for(trial, limit_gib)
+        plan_cache[trial.digest()] = plan
+        return plan
+
+    # exploration order comes from the cell's analytic bound: one baseline
+    # measure (compile + costs + roofline) before the search proper
+    measure = _tune_measure_factory(cpu, nominal_seq, plan_cache)
+    plan_cache[baseline_trial.digest()] = base_plan
+    probe = measure(baseline_trial)
+    bound = ((probe.get("signals") or {}).get("analytic") or {}).get("roofline_bound")
+
+    os.makedirs(out_dir, exist_ok=True)
+    report_path = os.path.join(out_dir, "tuner_report.json")
+    ledger = TrialLedger(report_path,
+                         cell={"model": "dense", "seq_len": nominal_seq,
+                               "mesh": mesh_name},
+                         bound=bound)
+    timeline = TraceTimeline(os.path.join(out_dir, "tuner_timeline.json"))
+
+    def metric_sink(row):
+        print(json.dumps({"tuner_row": True, **row}), flush=True)
+
+    result = run_search(trials, measure=measure, ledger=ledger,
+                        plan_fn=plan_fn, bound=bound, baseline=baseline_trial,
+                        timeline=timeline, metric_sink=metric_sink)
+    timeline.close()
+
+    winner = result["winner"]
+    doc = {
+        "ok": True,
+        "metric": f"bench tune: pruned search over {cell_name}",
+        "value": (winner["outcome"]["metrics"].get("tuner/tps")
+                  if winner else None),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,
+        "tuner": {
+            "cell": cell_name,
+            "bound": bound,
+            "counts": result["counts"],
+            "report": report_path,
+            "winner": winner["digest"] if winner else None,
+            "attribution": (result["attribution"] or {}).get("line"),
+        },
+        "extra": {"device": str(jax.devices()[0])},
+    }
+    if cpu:
+        doc["extra"]["fallback"] = "cpu"
+        doc["extra"]["measured_seq_len"] = seq_len
+    if winner is None:
+        doc["ok"] = False
+        doc["error"] = "no trial ran to completion"
+        return doc
+
+    tuned_path = os.path.join(out_dir, f"{cell_name}.yaml")
+    write_tuned_config(tuned_path, cell_name=cell_name, entry=winner,
+                       attribution=result["attribution"])
+    doc["tuner"]["tuned_config"] = tuned_path
+
+    tuned_metrics = {
+        f"tuned/{cell_name}/{k.rsplit('/', 1)[-1]}": v
+        for k, v in winner["outcome"]["metrics"].items()
+        if k in ("tuner/tps", "tuner/hbm_gib_peak")
+    }
+    # gate-ready form: load_run_metrics lifts these so the same stdout capture
+    # that announced the winner can be gated against the merged baseline
+    doc["tuner"]["metrics"] = tuned_metrics
+    if baseline_path and os.path.exists(baseline_path):
+        regression.write_baseline(
+            baseline_path, tuned_metrics, merge=True,
+            meta={"source": "bench.py --tune", "cell": cell_name,
+                  "winner": winner["digest"],
+                  "attribution": (result["attribution"] or {}).get("line")})
+        comps = regression.compare(
+            tuned_metrics,
+            {k: v for k, v in regression.load_baseline(baseline_path).items()
+             if k in tuned_metrics})
+        doc["tuner"]["baseline"] = baseline_path
+        doc["tuner"]["gate"] = ("PASS" if all(c.ok for c in comps)
+                                else "FAIL")
+    return doc
+
+
+def _flag_value(argv: list[str], flag: str) -> str | None:
+    if flag in argv:
+        i = argv.index(flag)
+        if i + 1 < len(argv):
+            return argv[i + 1]
+    return None
+
+
 # Substrings that identify "the accelerator is broken/absent", not "our code is
 # broken". BENCH_r05 widened this set: the TPU can also die at the first real
 # dispatch with libtpu/PJRT-level errors the original init-focused markers
@@ -736,16 +1034,28 @@ def main(argv: list[str] | None = None) -> int:
     # --profile: one traced step per matrix cell -> measured_* gate keys +
     # the signals bundle on the summary doc (matrix mode only)
     profile = "--profile" in argv
+    # --tune: the perf-lab loop closes — pruned, signal-ordered search over
+    # the dense smoke cell with an auditable resumable ledger (tuning/)
+    tune = "--tune" in argv
+    tune_dir = _flag_value(argv, "--tune-dir") or "tuned"
+    tune_baseline = _flag_value(argv, "--tune-baseline")
     mode_args = (("--matrix",) if matrix else ()) + (
         ("--dynamics",) if dynamics else ()) + (
-        ("--profile",) if profile else ())
+        ("--profile",) if profile else ()) + (
+        ("--tune", "--tune-dir", tune_dir) if tune else ()) + (
+        ("--tune-baseline", tune_baseline) if tune and tune_baseline else ())
     if "--cpu" in argv:
         try:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-            doc = (_matrix_bench(cpu=True, dynamics=dynamics, profile=profile)
-                   if matrix else _cpu_fallback_bench(dynamics=dynamics))
+            if tune:
+                doc = _tune_bench(cpu=True, out_dir=tune_dir,
+                                  baseline_path=tune_baseline)
+            else:
+                doc = (_matrix_bench(cpu=True, dynamics=dynamics,
+                                     profile=profile)
+                       if matrix else _cpu_fallback_bench(dynamics=dynamics))
             print(json.dumps(doc), flush=True)
             return 0
         except Exception as exc:  # noqa: BLE001 — the JSON contract is the point
@@ -763,8 +1073,13 @@ def main(argv: list[str] | None = None) -> int:
             # would grind for hours — go straight to the tiny fallback.
             print("bench: no accelerator attached; running tiny CPU fallback",
                   file=sys.stderr)
-            doc = (_matrix_bench(cpu=True, dynamics=dynamics, profile=profile)
-                   if matrix else _cpu_fallback_bench(dynamics=dynamics))
+            if tune:
+                doc = _tune_bench(cpu=True, out_dir=tune_dir,
+                                  baseline_path=tune_baseline)
+            else:
+                doc = (_matrix_bench(cpu=True, dynamics=dynamics,
+                                     profile=profile)
+                       if matrix else _cpu_fallback_bench(dynamics=dynamics))
             doc.setdefault("extra", {})["fallback_reason"] = "default backend is cpu"
             print(json.dumps(doc), flush=True)
             return 0
@@ -774,8 +1089,12 @@ def main(argv: list[str] | None = None) -> int:
             reason = f"first-dispatch canary failed: {exc!r}"
             print(f"bench: {reason}; retrying on CPU", file=sys.stderr)
             return _spawn_cpu_fallback(reason, extra_args=mode_args)
-        doc = (_matrix_bench(cpu=False, dynamics=dynamics, profile=profile)
-               if matrix else _full_bench(dynamics=dynamics))
+        if tune:
+            doc = _tune_bench(cpu=False, out_dir=tune_dir,
+                              baseline_path=tune_baseline)
+        else:
+            doc = (_matrix_bench(cpu=False, dynamics=dynamics, profile=profile)
+                   if matrix else _full_bench(dynamics=dynamics))
         print(json.dumps(doc), flush=True)
         return 0
     except Exception as exc:  # noqa: BLE001

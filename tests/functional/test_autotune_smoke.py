@@ -1,0 +1,171 @@
+"""Autotune end-to-end smoke (docs/observability.md "Autotuning & the perf
+lab"): ``bench.py --tune`` completes a pruned search on the CPU smoke cell
+with a schema-valid resumable ledger, the tuned yaml is accepted by the
+finetune recipe with provenance in the run header, and the winning cell gates
+through tools/bench_gate.py against the merged baseline.
+
+Marked ``slow`` + ``perf`` (out of tier-1): run with ``pytest -m perf``."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.perf]
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+BENCH = os.path.join(REPO, "bench.py")
+GATE = os.path.join(REPO, "tools", "bench_gate.py")
+
+
+@pytest.fixture(scope="module")
+def tune_run(tmp_path_factory):
+    """One ``bench.py --tune --cpu`` search shared by the assertions below."""
+    tmp = tmp_path_factory.mktemp("autotune")
+    baseline = tmp / "BASELINE.json"
+    shutil.copy(os.path.join(REPO, "BASELINE.json"), baseline)
+    out_dir = tmp / "tuned"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    result = subprocess.run(
+        [sys.executable, BENCH, "--tune", "--cpu",
+         "--tune-dir", str(out_dir), "--tune-baseline", str(baseline)],
+        capture_output=True, text=True, timeout=1500, env=env, cwd=REPO)
+    assert result.returncode == 0, result.stdout + result.stderr
+    (tmp / "stdout.jsonl").write_text(result.stdout)
+    return tmp
+
+
+def test_tune_completes_pruned_search_with_auditable_ledger(tune_run):
+    from automodel_tpu.tuning.runner import validate_report
+
+    lines = [json.loads(ln) for ln in
+             (tune_run / "stdout.jsonl").read_text().splitlines() if ln.strip()]
+    summary = lines[-1]
+    assert summary["ok"], summary
+    tuner = summary["tuner"]
+    assert tuner["counts"]["ran"] > 0 and tuner["counts"]["pruned"] > 0
+
+    # per-trial rows ride stdout with the tuner/* keys under contract
+    rows = [ln for ln in lines if ln.get("tuner_row")]
+    assert len(rows) == tuner["counts"]["total"] + 1  # + the winner row
+    assert {"tuner/trial", "tuner/digest", "tuner/outcome"} <= set(rows[0])
+    assert rows[-1]["tuner/winner"] == tuner["winner"]
+
+    # the ledger: schema-valid, every trial has an outcome, winner attribution
+    # cites signal keys that really exist in the winner's metrics
+    doc = json.load(open(tune_run / "tuned" / "tuner_report.json"))
+    assert validate_report(doc) == []
+    assert all(e["outcome"]["status"] in ("pruned", "ran", "failed")
+               for e in doc["trials"])
+    winner = next(e for e in doc["trials"]
+                  if e["digest"] == doc["winner"]["digest"])
+    attribution = doc["winner"]["attribution"]
+    for key in attribution["signal_keys"]:
+        assert key in winner["outcome"]["metrics"]
+    # pruned trials never compiled: their reason cites the memory-plan verdict
+    pruned = [e for e in doc["trials"] if e["outcome"]["status"] == "pruned"]
+    assert all("mem_plan/fits=false" in e["outcome"]["reason"] for e in pruned)
+
+    # a trial span per trial on the Chrome-trace timeline
+    timeline = json.load(open(tune_run / "tuned" / "tuner_timeline.json"))
+    events = timeline["traceEvents"] if isinstance(timeline, dict) else timeline
+    spans = [e for e in events if str(e.get("name", "")).startswith("tuner/")]
+    assert len(spans) == tuner["counts"]["total"] - tuner["counts"].get(
+        "skipped_resume", 0)
+
+
+def test_winning_cell_lands_in_baseline_and_gates_green(tune_run):
+    summary = json.loads(
+        (tune_run / "stdout.jsonl").read_text().splitlines()[-1])
+    cell = summary["tuner"]["cell"]
+    base = json.load(open(tune_run / "BASELINE.json"))
+    assert f"tuned/{cell}/tps" in base["metrics"]
+    assert base["metrics_meta"]["tuner"]["winner"] == summary["tuner"]["winner"]
+
+    gate = subprocess.run(
+        [sys.executable, GATE, "--run", str(tune_run / "stdout.jsonl"),
+         "--baseline", str(tune_run / "BASELINE.json"),
+         "--only", f"tuned/{cell}/tps", "--only", f"tuned/{cell}/hbm_gib_peak",
+         "--tolerance", "default=0.5", "--require", f"tuned/{cell}/tps"],
+        capture_output=True, text=True, timeout=120)
+    assert gate.returncode == 0, gate.stdout + gate.stderr
+    assert "[gate] PASS" in gate.stdout
+
+
+def test_train_ft_accepts_tuned_config_with_header_provenance(
+        tune_run, tmp_path, cpu_devices):
+    from automodel_tpu.config.loader import load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    summary = json.loads(
+        (tune_run / "stdout.jsonl").read_text().splitlines()[-1])
+    tuned_yaml = tune_run / "tuned" / f"{summary['tuner']['cell']}.yaml"
+    assert tuned_yaml.exists()
+    cfg_text = f"""
+    seed: 7
+    output_dir: {tmp_path}/out
+    tuned_config: {tuned_yaml}
+    model:
+      config:
+        architectures: [LlamaForCausalLM]
+        vocab_size: 128
+        hidden_size: 64
+        intermediate_size: 128
+        num_hidden_layers: 2
+        num_attention_heads: 8
+        num_key_value_heads: 4
+        max_position_embeddings: 256
+    distributed:
+      # dp degree 2: must divide the tuned winner's micro_batch_size (2)
+      dp_shard: 2
+      tp: 4
+    backend:
+      dtype: float32
+    dataset:
+      _target_: automodel_tpu.data.llm.mock.MockSFTDataset
+      vocab_size: 128
+      seq_len: 32
+      num_samples: 64
+      seed: 0
+      pattern: arith
+    micro_batch_size: 8
+    seq_len: 32
+    step_scheduler:
+      grad_acc_steps: 1
+      max_steps: 2
+      num_epochs: 1
+      handle_sigterm: false
+    optimizer:
+      lr: 1.0e-2
+    checkpoint:
+      enabled: false
+    """
+    p = tmp_path / "cfg.yaml"
+    p.write_text(textwrap.dedent(cfg_text))
+    cfg = load_config(p)
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+    recipe.run_train_validation_loop()
+
+    overrides = json.load(open(
+        tune_run / "tuned" / "tuner_report.json"))["winner"]
+    winner_entry = next(
+        e for e in json.load(open(tune_run / "tuned" / "tuner_report.json"))["trials"]
+        if e["digest"] == overrides["digest"])
+    # the tuned knobs actually shaped the run
+    assert cfg.get("backend.remat_policy") == (
+        winner_entry["trial"]["backend.remat_policy"])
+    assert int(cfg.get("micro_batch_size")) == (
+        winner_entry["trial"]["micro_batch_size"])
+    # provenance rides the run header
+    rows = [json.loads(line) for line in open(tmp_path / "out" / "training.jsonl")]
+    header = next(r for r in rows if r.get("run_header"))
+    assert header["tuned_config"] == str(tuned_yaml)
+    assert header["tuned_cell"] == summary["tuner"]["cell"]
+    assert header["tuned_digest"] == summary["tuner"]["winner"]

@@ -17,6 +17,10 @@ from automodel_tpu.ops.losses import linear_cross_entropy, masked_cross_entropy
 transformers = pytest.importorskip("transformers")
 torch = pytest.importorskip("torch")
 
+# heavyweight torch-parity leg: a full torch training loop per test. Out of the
+# tier-1 budget; CI's functional job opts back in with -m "" (docs/testing)
+pytestmark = pytest.mark.slow
+
 
 def _tiny_hf(seed=0):
     torch.manual_seed(seed)

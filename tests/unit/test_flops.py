@@ -10,7 +10,7 @@ import jax.numpy as jnp
 
 from automodel_tpu.models.auto import AutoModelForCausalLM
 from automodel_tpu.models.common.backend import BackendConfig
-from automodel_tpu.utils.flops import flops_per_token, mfu
+from automodel_tpu.utils.flops import flops_per_token, mfu, vision_tower_flops
 
 
 def _param_count(model, exclude=("embed", "lm_head", "wte")):
@@ -111,3 +111,48 @@ class TestFlopsPerArch:
     def test_mfu_device_table(self):
         assert 0.49 < mfu(12_000, 8.2e9, "TPU v5 lite") < 0.51
         assert mfu(1000, 1e9, "unknown accelerator") == 0.0
+
+
+class TestVisionTowerFlops:
+    # tiny tower, every term hand-computable: 8x8 image, 4x4 patches ->
+    # 4 patches + CLS = 5 positions
+    VCFG = {
+        "hidden_size": 8, "intermediate_size": 16, "num_hidden_layers": 2,
+        "num_attention_heads": 2, "image_size": 8, "patch_size": 4,
+    }
+
+    def test_pins_hand_computed_count(self):
+        d, inter, L, patch = 8, 16, 2, 4
+        num_patches = (8 // 4) ** 2          # 4
+        n_pos = num_patches + 1              # 5
+        patch_embed = num_patches * 2 * (3 * patch * patch) * d   # 4*2*48*8 = 3072
+        attn = 2 * d * 3 * d + 2 * d * d + 2 * 2 * n_pos * d      # 384+128+160 = 672
+        mlp = 2 * 2 * d * inter                                   # 512
+        expected = patch_embed + n_pos * L * (attn + mlp)         # 3072+5*2*1184 = 14912
+        assert expected == 14912
+        assert vision_tower_flops(self.VCFG) == expected
+
+    def test_accepts_config_objects(self):
+        from automodel_tpu.models.vision.clip_vit import CLIPVisionConfig
+
+        cfg = CLIPVisionConfig(**{k: v for k, v in self.VCFG.items()
+                                  if k != "num_attention_heads"},
+                               num_attention_heads=2)
+        assert vision_tower_flops(cfg) == vision_tower_flops(self.VCFG)
+
+    def test_vlm_config_amortizes_vision_over_seq(self):
+        text = {
+            "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+        }
+        vlm = {"architectures": ["LlavaForConditionalGeneration"],
+               "vision_config": self.VCFG, "text_config": text}
+        seq = 64
+        text_only = flops_per_token(text, seq, training=False)
+        with_vision = flops_per_token(vlm, seq, training=False, num_images=2)
+        expected_extra = vision_tower_flops(self.VCFG) * 2 / seq
+        assert with_vision - text_only == expected_extra
+        # training keeps the 3x fwd multiplier over the combined count
+        assert flops_per_token(vlm, seq, training=True, num_images=2) == (
+            3.0 * with_vision)

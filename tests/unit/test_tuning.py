@@ -1,0 +1,273 @@
+"""The autotuner's auditable-loop contract (docs/observability.md "Autotuning
+& the perf lab"): pruning never discards a config that fits, the trial ledger
+resumes byte-identically with completed trials skipped, the winner's
+attribution always cites real signal keys, and the tuned yaml round-trips
+through the recipe config loader. The golden fixture pins the exact report
+bytes a deterministic search produces — no timestamps, no dict-order drift."""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.tuning import (
+    REMAT_LADDER,
+    SearchSpace,
+    Trial,
+    TrialLedger,
+    apply_tuned_config,
+    attribute_winner,
+    order_trials,
+    prune,
+    run_search,
+    write_tuned_config,
+)
+from automodel_tpu.tuning.runner import TUNER_REPORT_VERSION, validate_report
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "tuner_report_golden.json")
+
+
+@dataclasses.dataclass
+class FakePlan:
+    """MemoryPlan shape the policy + signals snapshot consume."""
+
+    fits: bool | None
+    total_bytes: int = 100 * 2**20
+    headroom_bytes: int | None = 20 * 2**20
+    hbm_limit_bytes: int | None = 120 * 2**20
+
+
+def _golden_space() -> SearchSpace:
+    return SearchSpace(
+        remat_policies=("none", "dots"),
+        microbatch_splits=((2, 1), (64, 1)),
+        prefetch_depths=((2, 2),),
+        layouts=("scan",),
+    )
+
+
+def _golden_plan(trial: Trial) -> FakePlan:
+    if (trial.micro_batch_size or 0) >= 64:
+        return FakePlan(fits=False, total_bytes=400 * 2**20,
+                        headroom_bytes=-280 * 2**20)
+    return FakePlan(fits=True)
+
+
+def _golden_measure(trial: Trial) -> dict:
+    # deterministic in the trial alone: same space -> same report bytes
+    tps = 100.0 + 10.0 * REMAT_LADDER.index(trial.remat_policy)
+    tps += float(trial.prefetch_host_depth or 0)
+    return {"tps": tps, "hbm_gib_peak": 0.05,
+            "signals": {"cell": {"model": "dense", "seq_len": 2048}}}
+
+
+def _run_golden(report_path: str, trials=None, measure=_golden_measure) -> dict:
+    ledger = TrialLedger(report_path,
+                         cell={"model": "dense", "seq_len": 2048},
+                         bound="memory")
+    return run_search(trials if trials is not None else _golden_space().enumerate(),
+                      measure=measure, ledger=ledger, plan_fn=_golden_plan,
+                      bound="memory")
+
+
+class TestSpace:
+    def test_enumeration_deterministic_with_unique_digests(self):
+        a = SearchSpace.smoke().enumerate()
+        b = SearchSpace.smoke().enumerate()
+        assert a == b
+        digests = [t.digest() for t in a]
+        assert len(set(digests)) == len(digests) == 12
+
+    def test_untouched_knobs_stay_out_of_overrides_and_digest(self):
+        bare = Trial(remat_policy="dots")
+        assert bare.overrides() == {"backend.remat_policy": "dots"}
+        with_depth = Trial(remat_policy="dots", prefetch_host_depth=2)
+        assert bare.digest() != with_depth.digest()
+        assert "dataloader.prefetch.enabled" in with_depth.overrides()
+
+    def test_dispatcher_axis_gated_on_ep(self):
+        space = SearchSpace(remat_policies=("none",), dispatchers=("dense", "a2a"))
+        assert all(t.dispatcher is None for t in space.enumerate())
+        space.ep = 2
+        assert {t.dispatcher for t in space.enumerate()} == {"dense", "a2a"}
+
+
+class TestPolicy:
+    @pytest.mark.parametrize("fits", [True, None])
+    def test_pruning_never_discards_a_fitting_config(self, fits):
+        # the property the perf lab stakes its honesty on: only an explicit
+        # does-not-fit verdict prunes; unknown limits (fits=None) never do
+        for trial in SearchSpace.smoke().enumerate():
+            assert prune(trial, FakePlan(fits=fits)) is None
+            assert prune(trial, None) is None
+
+    def test_pruning_reason_cites_the_plan_verdict(self):
+        reason = prune(Trial(), FakePlan(fits=False, headroom_bytes=-2**20))
+        assert "mem_plan/fits=false" in reason
+        assert "headroom" in reason
+
+    def test_input_bound_explores_prefetch_first(self):
+        base = Trial(remat_policy="none")
+        trials = [Trial(remat_policy="dots"),
+                  Trial(remat_policy="none", prefetch_host_depth=4,
+                        prefetch_device_depth=2),
+                  base]
+        ordered = order_trials(trials, "input", baseline=base)
+        assert ordered[0] == base  # moves nothing
+        assert ordered[1].prefetch_host_depth == 4
+
+    def test_memory_bound_walks_remat_toward_none(self):
+        base = Trial(remat_policy="dots")
+        trials = [Trial(remat_policy="full"), Trial(remat_policy="none")]
+        ordered = order_trials(trials, "memory", baseline=base)
+        assert ordered[0].remat_policy == "none"
+        ordered = order_trials(trials, "compute", baseline=base)
+        assert ordered[0].remat_policy == "full"
+
+    def test_attribution_cites_only_real_signal_keys(self):
+        result = _run_golden_tmp()
+        attribution = result["attribution"]
+        metrics = result["winner"]["outcome"]["metrics"]
+        assert attribution["signal_keys"]
+        for key in attribution["signal_keys"]:
+            assert key in metrics
+            assert key in attribution["line"]
+        assert result["winner"]["digest"] in attribution["line"]
+
+
+def _run_golden_tmp():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        return _run_golden(os.path.join(d, "tuner_report.json"))
+
+
+class TestLedger:
+    def test_golden_fixture_bytes(self, tmp_path):
+        path = tmp_path / "tuner_report.json"
+        _run_golden(str(path))
+        assert path.read_bytes() == open(FIXTURE, "rb").read(), (
+            "deterministic search no longer reproduces the golden report — "
+            "if the schema changed on purpose, regenerate the fixture "
+            "(see _regen_golden_fixture in this file)")
+
+    def test_golden_fixture_is_schema_valid(self):
+        doc = json.load(open(FIXTURE))
+        assert validate_report(doc) == []
+        statuses = [e["outcome"]["status"] for e in doc["trials"]]
+        assert "pruned" in statuses and "ran" in statuses
+
+    def test_resume_skips_completed_trials_byte_identically(self, tmp_path):
+        path = tmp_path / "tuner_report.json"
+        _run_golden(str(path))
+        before = path.read_bytes()
+
+        def exploding_measure(trial):
+            raise AssertionError("resume must not re-measure completed trials")
+
+        result = _run_golden(str(path), measure=exploding_measure)
+        assert path.read_bytes() == before
+        assert result["counts"]["skipped_resume"] == result["counts"]["total"]
+
+    def test_resume_mid_search_completes_only_the_remainder(self, tmp_path):
+        path = tmp_path / "tuner_report.json"
+        all_trials = _golden_space().enumerate()
+        head = order_trials(all_trials, "memory")[:2]
+        _run_golden(str(path), trials=head)
+        head_entries = json.load(open(path))["trials"]
+
+        measured = []
+
+        def counting_measure(trial):
+            measured.append(trial.digest())
+            return _golden_measure(trial)
+
+        result = _run_golden(str(path), measure=counting_measure)
+        doc = json.load(open(path))
+        assert validate_report(doc) == []
+        assert doc["trials"][:2] == head_entries  # untouched, not re-run
+        assert set(measured).isdisjoint(e["digest"] for e in head_entries)
+        assert result["counts"]["skipped_resume"] == 2
+        assert len(doc["trials"]) == len(all_trials)
+
+    def test_every_trial_has_an_outcome_and_failures_stay_in_the_ledger(
+            self, tmp_path):
+        path = tmp_path / "tuner_report.json"
+
+        def flaky_measure(trial):
+            if trial.remat_policy == "dots":
+                raise RuntimeError("boom")
+            return _golden_measure(trial)
+
+        result = _run_golden(str(path), measure=flaky_measure)
+        doc = json.load(open(path))
+        assert validate_report(doc) == []
+        statuses = {e["outcome"]["status"] for e in doc["trials"]}
+        assert statuses == {"ran", "pruned", "failed"}
+        failed = [e for e in doc["trials"] if e["outcome"]["status"] == "failed"]
+        assert all("boom" in e["outcome"]["error"] for e in failed)
+        assert result["winner"]["outcome"]["metrics"]["tuner/tps"] > 0
+
+    def test_ledger_rejects_corrupt_and_mismatched_files(self, tmp_path):
+        bad = tmp_path / "tuner_report.json"
+        bad.write_text("{not json")
+        with pytest.raises(ValueError, match="unreadable"):
+            TrialLedger(str(bad))
+        bad.write_text(json.dumps({"version": 99, "trials": []}))
+        with pytest.raises(ValueError, match="version"):
+            TrialLedger(str(bad))
+
+    def test_validate_report_flags_broken_docs(self):
+        assert validate_report([]) != []
+        assert validate_report({"version": TUNER_REPORT_VERSION}) != []
+        doc = {"version": TUNER_REPORT_VERSION,
+               "trials": [{"digest": "d", "trial": {},
+                           "outcome": {"status": "ran"}}],
+               "winner": {"digest": "other",
+                          "attribution": {"line": "x", "signal_keys": ["k"]}}}
+        problems = validate_report(doc)
+        assert any("lacks 'metrics'" in p for p in problems)
+        assert any("winner.digest" in p for p in problems)
+
+    def test_attribute_winner_without_runner_up(self):
+        winner = {"digest": "abc", "trial": {"backend.remat_policy": "dots"},
+                  "outcome": {"metrics": {"tuner/tps": 10.0}}}
+        out = attribute_winner(winner, None, bound="compute")
+        assert out["signal_keys"] == ["tuner/tps"]
+        assert "no runner-up" in out["line"]
+        assert "bound=compute" in out["line"]
+
+
+class TestTunedConfig:
+    def test_yaml_roundtrip_through_config_loader(self, tmp_path):
+        result = _run_golden(str(tmp_path / "tuner_report.json"))
+        path = tmp_path / "dense_s2048_test.yaml"
+        write_tuned_config(str(path), cell_name="dense_s2048_test",
+                           entry=result["winner"],
+                           attribution=result["attribution"])
+        cfg = ConfigNode({"backend": {"remat_policy": "full"},
+                          "micro_batch_size": 1})
+        provenance = apply_tuned_config(cfg, str(path))
+        overrides = result["winner"]["trial"]
+        assert cfg.get("backend.remat_policy") == overrides["backend.remat_policy"]
+        assert cfg.get("micro_batch_size") == overrides["micro_batch_size"]
+        assert cfg.get("dataloader.prefetch.enabled") is True
+        assert provenance == {"tuned_config": str(path),
+                              "tuned_cell": "dense_s2048_test",
+                              "tuned_digest": result["winner"]["digest"]}
+
+    def test_missing_tuned_config_raises_with_pointer(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="bench.py --tune"):
+            apply_tuned_config(ConfigNode({}), str(tmp_path / "nope.yaml"))
+
+
+def _regen_golden_fixture():  # pragma: no cover — maintenance helper
+    """python -c "import tests.unit.test_tuning as t; t._regen_golden_fixture()" """
+    _run_golden(FIXTURE)
+
+
+if __name__ == "__main__":  # allow direct regen without pytest
+    _regen_golden_fixture()
