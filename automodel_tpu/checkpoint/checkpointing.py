@@ -29,7 +29,7 @@ import jax
 import numpy as np
 
 from automodel_tpu.checkpoint.manifest import (
-    has_manifest, verify_manifest, write_manifest,
+    SAVING_MARKER, has_manifest, verify_manifest, write_manifest,
 )
 from automodel_tpu.checkpoint.reshard import (
     TOPOLOGY_KEY, ModelSignatureMismatch, describe_delta, mesh_delta,
@@ -45,6 +45,12 @@ __all__ = ["CheckpointingConfig", "Checkpointer", "ModelSignatureMismatch"]
 # from the restore-step minimum instead of dragging it to "nothing restorable"
 # (agreed_restore_step allow_joiners). Fits int64 allgather comfortably.
 _ABSTAIN = 2**31 - 1
+
+# SAVING_MARKER (imported from manifest.py, which must exclude it from the
+# inventory): written into the step dir before the first array byte, removed
+# in wait() only after the integrity manifest commits. A step dir still
+# carrying it was torn by a crash/kill mid-save and must never restore — even
+# when it otherwise looks complete (the manifest-less "legacy" window).
 
 
 @dataclasses.dataclass
@@ -147,8 +153,14 @@ class Checkpointer:
         the final name only at finalize, so a crash between an async ``save``
         and ``wait`` leaves tmp residue and/or no ``model`` tree — such a dir
         must never win the no-symlink fallback (the symlink itself is only
-        written post-finalize, checkpointing.wait)."""
+        written post-finalize, checkpointing.wait). The ``.saving`` intent
+        marker covers the remaining window: a kill AFTER the arrays finalize
+        but BEFORE the manifest leaves a complete-looking dir that would pass
+        as a legacy (manifest-less) step — the marker, removed only post-
+        manifest, proves it torn."""
         if not os.path.isdir(os.path.join(d, "model")):
+            return False
+        if os.path.exists(os.path.join(d, SAVING_MARKER)):
             return False
         return not any(".orbax-checkpoint-tmp" in name for name in os.listdir(d))
 
@@ -211,6 +223,16 @@ class Checkpointer:
         self.wait()  # finalize any in-flight async save (writes its latest symlink)
         d = self.step_dir(step)
         os.makedirs(d, exist_ok=True)
+        if jax.process_index() == 0:
+            # save-intent marker: Orbax's tmp-dir rename covers a crash during
+            # the array write, but a kill in the window between array finalize
+            # and the manifest leaves a complete-looking dir with no manifest —
+            # which verify_step would wave through as "legacy". The marker is
+            # removed only after the manifest commits (wait()), so any dir
+            # still carrying it is torn by construction and never a restore
+            # candidate.
+            with open(os.path.join(d, SAVING_MARKER), "w", encoding="utf-8") as f:
+                f.write(str(step))
         with_retry(self.ckptr.save, os.path.join(d, "model"), params, force=True,
                    config=self._retry, description="orbax model save")
         if opt_state is not None:
@@ -281,6 +303,12 @@ class Checkpointer:
                 # its presence implies a committed step (checkpoint/manifest.py)
                 if self.config.write_manifest:
                     write_manifest(self.step_dir(self._pending), step=self._pending)
+                # intent marker off only once the step is fully committed —
+                # the ordering marker -> arrays -> manifest -> unmark -> latest
+                # makes "marker present" equivalent to "torn"
+                marker = os.path.join(self.step_dir(self._pending), SAVING_MARKER)
+                if os.path.exists(marker):
+                    os.unlink(marker)
                 self._update_latest(self._pending)
             self._pending = None
 

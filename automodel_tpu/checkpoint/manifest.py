@@ -25,9 +25,14 @@ import zlib
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["MANIFEST_NAME", "build_manifest", "write_manifest", "verify_manifest", "has_manifest"]
+__all__ = ["MANIFEST_NAME", "SAVING_MARKER", "build_manifest",
+           "write_manifest", "verify_manifest", "has_manifest"]
 
 MANIFEST_NAME = "manifest.json"
+# Save-intent marker (see checkpointing.py): present in the step dir for the
+# whole save, removed only AFTER the manifest commits — so it must never be
+# inventoried, or every committed step would verify as "missing" it.
+SAVING_MARKER = ".saving"
 _CHUNK = 1 << 20  # 1 MiB read chunks: bounded memory on multi-GB array files
 
 
@@ -44,12 +49,16 @@ def _file_crc32(path: str) -> str:
 
 def _walk_files(step_dir: str) -> list[str]:
     """Relative paths of every regular file under ``step_dir`` (sorted), minus
-    the manifest itself and any orbax tmp residue (never part of a commit)."""
+    the manifest itself, any orbax tmp residue (never part of a commit), and
+    the ``.saving`` intent marker — the manifest is written while the marker
+    is still present (marker comes off only post-manifest, checkpointing.wait)
+    so inventorying it would make every committed step "missing" it."""
     out: list[str] = []
     for root, dirs, files in os.walk(step_dir):
         dirs[:] = [d for d in dirs if ".orbax-checkpoint-tmp" not in d]
         for name in files:
-            if name == MANIFEST_NAME or ".orbax-checkpoint-tmp" in name:
+            if name in (MANIFEST_NAME, SAVING_MARKER) \
+                    or ".orbax-checkpoint-tmp" in name:
                 continue
             fp = os.path.join(root, name)
             if os.path.islink(fp):

@@ -350,6 +350,12 @@ class Observability:
                 server_port=config.profiler_port,
                 signum=config.resolve_signal(),
             )
+        # external liveness: when a supervisor (resilience/supervisor.py) set
+        # AUTOMODEL_HEARTBEAT_FILE, every heartbeat() also beats that file —
+        # the hang detector outside this process keys off its mtime/step
+        from automodel_tpu.resilience.supervisor import HeartbeatWriter
+
+        self.heartbeat_writer: HeartbeatWriter | None = HeartbeatWriter.from_env()
 
     @classmethod
     def from_config(cls, cfg: Any, out_dir: str,
@@ -566,6 +572,8 @@ class Observability:
     def heartbeat(self, step: int | None = None) -> None:
         if self.watchdog is not None:
             self.watchdog.heartbeat(step)
+        if self.heartbeat_writer is not None:
+            self.heartbeat_writer.beat(step)
 
     def on_step_start(self, step: int) -> None:
         if self.profiler is not None:

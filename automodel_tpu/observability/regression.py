@@ -211,6 +211,40 @@ def load_run_metrics(path: str) -> dict[str, float]:
     return {**summarize_rows(rows), **tuner}
 
 
+def incomplete_cells(path: str) -> list[dict[str, Any]]:
+    """Per-cell status entries for cells that did NOT run, from a
+    ``bench.py --matrix`` artifact carrying the harness's ``cells`` list
+    (summary doc or stdout capture). Empty for artifacts that predate
+    per-cell status — those gate exactly as before. This is how the gate
+    refuses to bless a partial matrix silently: the cells that ran still
+    gate, but a missing cell is named and the exit code says artifact-error
+    (2), not pass."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return []
+    docs: list[dict[str, Any]] = []
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            docs = [doc]
+    except json.JSONDecodeError:
+        for ln in text.splitlines():
+            try:
+                d = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(d, dict):
+                docs.append(d)
+    cells_doc = next((d for d in reversed(docs)
+                      if isinstance(d.get("cells"), list)), None)
+    if cells_doc is None:
+        return []
+    return [c for c in cells_doc["cells"]
+            if isinstance(c, dict) and c.get("status") != "ran"]
+
+
 def load_baseline(path: str) -> dict[str, float]:
     with open(path) as f:
         doc = json.load(f)
@@ -350,6 +384,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="like --write-baseline but update in place: other "
                              "metrics and non-metric document fields survive "
                              "(the autotuner's path into a committed baseline)")
+    parser.add_argument("--allow-incomplete", action="store_true",
+                        help="gate only the cells that ran even when the "
+                             "artifact names cells that didn't (default: a "
+                             "missing cell is an artifact error, exit 2)")
     args = parser.parse_args(argv)
 
     try:
@@ -373,12 +411,22 @@ def main(argv: list[str] | None = None) -> int:
     if not baseline:
         print(f"[gate] ERROR: no gate metrics in baseline {args.baseline}")
         return 2
+    missing = incomplete_cells(args.run)
     results = compare(run, baseline, tolerances, require=args.require)
     for comparison in results:
         print(comparison.line())
+    for c in missing:
+        print(f"[gate] MISSING CELL: {c.get('id')} "
+              f"status={c.get('status')} taxonomy={c.get('taxonomy')}")
     failed = [c.metric for c in results if not c.ok]
     if failed:
         print(f"[gate] REGRESSION: {', '.join(failed)} outside tolerance")
         return 1
+    if missing and not args.allow_incomplete:
+        ids = ", ".join(str(c.get("id")) for c in missing)
+        print(f"[gate] ERROR: {len(missing)} cell(s) did not run: {ids} "
+              f"(gated cells pass; pass --allow-incomplete to accept a "
+              f"partial matrix)")
+        return 2
     print("[gate] PASS")
     return 0

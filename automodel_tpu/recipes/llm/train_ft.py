@@ -263,6 +263,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             out_dir = default_output_dir("train")
         os.makedirs(out_dir, exist_ok=True)
         self.output_dir = out_dir  # one resolved dir for every artifact writer
+        # kill/hang chaos sentinels must survive the restart they cause, so
+        # their fired-marks live with the run's other artifacts
+        if self.chaos is not None:
+            self.chaos.state_dir = out_dir
         self.metric_logger = MetricLogger(os.path.join(out_dir, "training.jsonl"))
         self.val_metric_logger = MetricLogger(os.path.join(out_dir, "validation.jsonl"))
         from automodel_tpu.loggers.experiment_loggers import build_experiment_loggers
@@ -1228,6 +1232,16 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 from automodel_tpu.resilience.elastic import ElasticTopologyChange
 
                 raise ElasticTopologyChange(step, new_mesh)
+            if self.chaos is not None and self.chaos.should_kill(step):
+                # hard process death (resilience/chaos.py): SIGKILL to self,
+                # no cleanup — only the supervisor can turn this into a
+                # restart-from-newest-verifiable-checkpoint
+                self.checkpointer.wait()
+                self.chaos.kill(step)
+            if self.chaos is not None and self.chaos.should_hang(step):
+                # silent hang: stop heartbeating; the supervisor's staleness
+                # detector must SIGABRT (capturing the watchdog stack dump)
+                self.chaos.hang(step)
             obs.on_step_end(step, sync=metrics.get("loss"))
             # agreed at the CONSUMED step (deterministic across hosts even
             # while the prefetch worker advances the scheduler's own counter)
@@ -1468,6 +1482,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             hf_params=hf_params, consolidated=consolidated,
         )
         self.resilience.record_checkpoint(step)
+        if d and self.chaos is not None and self.chaos.should_kill(step, point="save"):
+            # torn-write injection: with async save the arrays are still
+            # in flight and the manifest/latest commit has NOT happened — the
+            # restart must reject this step and walk back (checkpointing.py)
+            self.chaos.kill(step)
         if d and self.chaos is not None and self.chaos.should_corrupt(step):
             # fault injection: finalize first (manifest written, latest committed)
             # so the truncation exercises verify-and-walk-back, not a half save

@@ -1,6 +1,7 @@
 """Fault-tolerant training: anomaly rollback, checkpoint integrity + fallback
 restore, coordinated preemption, elastic topology (mesh-shape-agnostic resume),
-transient-fault retry, and a deterministic fault-injection harness
+transient-fault retry, process supervision (heartbeat hang detection, failure
+taxonomy, bounded auto-restart), and a deterministic fault-injection harness
 (docs/resilience.md)."""
 
 from automodel_tpu.resilience.anomaly import AnomalyDetector, RecoveryPolicy, Verdict
@@ -14,6 +15,10 @@ from automodel_tpu.resilience.elastic import (
     repartition_dataloader_state,
 )
 from automodel_tpu.resilience.manager import ResilienceManager
+from automodel_tpu.resilience.supervisor import (
+    HeartbeatWriter, Supervisor, SupervisorConfig, classify_error_text,
+    classify_failure, read_heartbeat,
+)
 
 __all__ = [
     "AnomalyConfig",
@@ -23,13 +28,19 @@ __all__ = [
     "ElasticConfig",
     "ElasticTopologyChange",
     "FlakyIO",
+    "HeartbeatWriter",
     "PreemptionConfig",
     "RecoveryPolicy",
     "ResilienceConfig",
     "ResilienceManager",
     "RollbackConfig",
+    "Supervisor",
+    "SupervisorConfig",
     "Verdict",
+    "classify_error_text",
+    "classify_failure",
     "merge_host_states",
     "plan_warmup_micro_counts",
     "repartition_dataloader_state",
+    "read_heartbeat",
 ]

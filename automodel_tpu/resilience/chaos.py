@@ -26,6 +26,20 @@ classes the resilience layer must survive, all CPU-runnable:
   the resized mesh the harness must restart on — the in-process equivalent of
   a preemption that hands back a different slice, driving the elastic restore
   path (docs/resilience.md) without hand-built checkpoints.
+- **Hard process death** (``kill_at_step``): SIGKILL to self at the named
+  step — no cleanup, no atexit, no flushes; with ``kill_point: "save"`` the
+  kill lands between the checkpoint's array writes and its manifest/latest
+  commit, leaving a genuinely torn step on disk. Proves the supervisor's
+  detect -> classify -> restart path and the restore's torn-step walk-back.
+- **Silent hang** (``hang_at_step``): the step loop stops heartbeating and
+  sleeps — the process is alive but makes no progress, exactly what a wedged
+  collective looks like from outside. The stall watchdog dumps stacks, the
+  supervisor's hang detector kills and restarts.
+
+The kill/hang faults fire once *per run directory*, not per process: a
+sentinel file under ``state_dir`` (bound by the recipe to its output dir)
+marks a fired injection, so the restarted process replays the step without
+re-dying and the recovery proof closes instead of crash-looping.
 
 Injection is step-keyed and config-driven, so a chaos run is exactly
 reproducible (tools/chaos_smoke.py asserts recovery on a mock recipe).
@@ -36,6 +50,9 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import signal
+import sys
+import time
 from typing import Any, Callable
 
 import numpy as np
@@ -59,6 +76,13 @@ class ChaosConfig:
     # topology change: checkpoint + die at these steps, restart on elastic_mesh
     elastic_steps: tuple[int, ...] = ()
     elastic_mesh: dict | None = None  # e.g. {"dp_shard": 4} — axes of the resized slice
+    # hard process death (SIGKILL to self, no cleanup); "save" lands the kill
+    # inside the checkpoint commit window -> torn step on disk
+    kill_at_step: tuple[int, ...] = ()
+    kill_point: str = "step"  # "step" | "save"
+    # silent hang: stop heartbeating and sleep (the supervisor must notice)
+    hang_at_step: tuple[int, ...] = ()
+    hang_hold_s: float = 3600.0
 
     @classmethod
     def from_dict(cls, raw: Any) -> "ChaosConfig":
@@ -80,6 +104,10 @@ class ChaosConfig:
             corrupt_target=str(d.get("corrupt_target", "largest")),
             elastic_steps=tuple(int(s) for s in (d.get("elastic_steps") or ())),
             elastic_mesh={str(k): int(v) for k, v in dict(mesh).items()} if mesh else None,
+            kill_at_step=tuple(int(s) for s in (d.get("kill_at_step") or ())),
+            kill_point=str(d.get("kill_point", "step")),
+            hang_at_step=tuple(int(s) for s in (d.get("hang_at_step") or ())),
+            hang_hold_s=float(d.get("hang_hold_s", 3600.0)),
         )
 
 
@@ -92,6 +120,9 @@ class ChaosInjector:
         self._fired_spike: set[int] = set()
         self._fired_corrupt: set[int] = set()
         self._fired_elastic: set[int] = set()
+        # kill/hang must stay fired across the process restart they cause, so
+        # their fired-marks are sentinel files under state_dir, not sets
+        self.state_dir: str | None = None
 
     @property
     def enabled(self) -> bool:
@@ -224,6 +255,70 @@ class ChaosInjector:
             step, mesh,
         )
         return mesh
+
+    # -- hard process death / silent hang ------------------------------------
+    def _sentinel(self, kind: str, step: int) -> str | None:
+        if not self.state_dir:
+            return None
+        return os.path.join(self.state_dir, f"chaos_{kind}_{step}.fired")
+
+    def _fired_on_disk(self, kind: str, step: int) -> bool:
+        p = self._sentinel(kind, step)
+        return p is not None and os.path.exists(p)
+
+    def _mark_fired(self, kind: str, step: int) -> None:
+        p = self._sentinel(kind, step)
+        if p is None:
+            logger.warning(
+                "chaos: no state_dir bound — %s at step %d would re-fire after "
+                "restart (crash loop); firing anyway", kind, step)
+            return
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "w", encoding="utf-8") as f:
+            f.write(f"{os.getpid()}\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def should_kill(self, step: int, point: str = "step") -> bool:
+        return (
+            self.enabled
+            and step in self.config.kill_at_step
+            and self.config.kill_point == point
+            and not self._fired_on_disk("kill", step)
+        )
+
+    def kill(self, step: int) -> None:
+        """SIGKILL to self — no cleanup, no atexit, no checkpoint flush. The
+        sentinel is fsync'd first so the restarted process replays the step
+        without re-dying."""
+        self._mark_fired("kill", step)
+        logger.warning("chaos: SIGKILL to self at step %d (%s point)",
+                       step, self.config.kill_point)
+        for stream in (sys.stdout, sys.stderr):
+            try:
+                stream.flush()
+            except Exception:
+                pass
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def should_hang(self, step: int) -> bool:
+        return (
+            self.enabled
+            and step in self.config.hang_at_step
+            and not self._fired_on_disk("hang", step)
+        )
+
+    def hang(self, step: int) -> None:
+        """Stop making progress without dying: sleep in small increments for
+        up to ``hang_hold_s`` while NOT heartbeating — from outside this is
+        indistinguishable from a wedged collective. The supervisor's hang
+        detector (or the in-process stall watchdog) must end it."""
+        self._mark_fired("hang", step)
+        logger.warning("chaos: hanging at step %d for up to %.0fs "
+                       "(no heartbeats)", step, self.config.hang_hold_s)
+        deadline = time.monotonic() + float(self.config.hang_hold_s)
+        while time.monotonic() < deadline:
+            time.sleep(0.1)
 
     def _pick_target(self, step_dir: str) -> str | None:
         name = self.config.corrupt_target

@@ -1,0 +1,309 @@
+"""Per-cell isolation for multi-cell jobs: the resumable cell ledger
+(docs/observability.md "Resumable matrix & cell isolation").
+
+BENCH_r05 is the motivating failure: ``bench.py --matrix`` was one process
+walking six {model} x {seq} cells, so one mid-matrix death made the entire
+round's numbers unverifiable. Here each cell runs in an **isolated
+subprocess** with a per-cell timeout, and every cell leaves a record in a
+crash-safe ledger (the ``tuning/runner.py`` ``TrialLedger`` discipline:
+atomic tmp+rename after every cell, no wallclock timestamps, resume skips
+completed cells byte-identically):
+
+- ``ran`` — the cell's rows + optional signals snapshot, replayed verbatim
+  on resume;
+- ``failed`` — the supervisor taxonomy (``classify_failure``) + the real
+  stderr tail, after bounded retry of *transient-classified* failures only
+  (a lowering error re-runs identically; retrying it just doubles the bill);
+- ``timeout`` — the cell exceeded its wall budget and was killed; recorded
+  as ``watchdog`` and NOT retried (a wedged cell already cost ``timeout_s``).
+
+The ledger is always valid JSON whatever dies, so the gate
+(``observability/regression.py``) can gate the cells that ran while loudly
+naming the ones that didn't. One dead cell costs one cell — never the
+artifact.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Callable
+
+from automodel_tpu.resilience.supervisor import classify_failure
+from automodel_tpu.utils.retry import RetryConfig
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "CELL_REPORT_VERSION",
+    "CellLedger",
+    "cell_digest",
+    "validate_cell_report",
+    "run_isolated",
+    "run_cells",
+    "preflight_probe",
+]
+
+CELL_REPORT_VERSION = 1
+
+
+def cell_digest(spec: dict[str, Any]) -> str:
+    """Content digest of a cell spec: resume only skips a completed cell when
+    the spec that produced it is bit-for-bit the same (flags changed -> the
+    old numbers answer a different question -> re-run)."""
+    blob = json.dumps(spec, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def _atomic_write_json(path: str, doc: dict[str, Any]) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=".cell_ledger.", dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class CellLedger:
+    """The resumable per-cell artifact: header (preflight verdict, device),
+    one entry per cell, atomic after every record, deterministic bytes."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        doc: dict[str, Any] | None = None
+        if os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError) as exc:
+                # atomic rename means a torn write cannot happen; a corrupted
+                # file must not silently erase the record
+                raise ValueError(f"{self.path}: unreadable cell ledger ({exc})")
+            if doc.get("version") != CELL_REPORT_VERSION:
+                raise ValueError(
+                    f"{self.path}: cell ledger version {doc.get('version')!r}, "
+                    f"expected {CELL_REPORT_VERSION}")
+        if doc is None:
+            doc = {"version": CELL_REPORT_VERSION, "header": {}, "cells": []}
+        self.doc = doc
+
+    def entry(self, cell_id: str) -> dict[str, Any] | None:
+        return next((e for e in self.doc["cells"] if e.get("id") == cell_id),
+                    None)
+
+    def set_header(self, header: dict[str, Any]) -> None:
+        self.doc["header"] = dict(header)
+        self.write()
+
+    def record(self, entry: dict[str, Any]) -> None:
+        """Upsert by cell id: a resumed re-run of a failed cell replaces its
+        old entry instead of appending a duplicate."""
+        for i, e in enumerate(self.doc["cells"]):
+            if e.get("id") == entry["id"]:
+                self.doc["cells"][i] = entry
+                break
+        else:
+            self.doc["cells"].append(entry)
+        self.write()
+
+    def write(self) -> None:
+        _atomic_write_json(self.path, self.doc)
+
+
+def validate_cell_report(doc: Any) -> list[str]:
+    """Schema-check a cell ledger; returns problems ([] = valid)."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"ledger is {type(doc).__name__}, expected object"]
+    if doc.get("version") != CELL_REPORT_VERSION:
+        problems.append(f"version is {doc.get('version')!r}, "
+                        f"expected {CELL_REPORT_VERSION}")
+    if not isinstance(doc.get("header"), dict):
+        problems.append("header is not an object")
+    cells = doc.get("cells")
+    if not isinstance(cells, list):
+        return problems + ["cells is not a list"]
+    for i, e in enumerate(cells):
+        where = f"cells[{i}]"
+        if not isinstance(e, dict):
+            problems.append(f"{where} is not an object")
+            continue
+        if not isinstance(e.get("id"), str):
+            problems.append(f"{where}.id missing")
+        if not isinstance(e.get("digest"), str):
+            problems.append(f"{where}.digest missing")
+        if not isinstance(e.get("spec"), dict):
+            problems.append(f"{where}.spec missing")
+        outcome = e.get("outcome")
+        if not isinstance(outcome, dict):
+            problems.append(f"{where}.outcome missing")
+            continue
+        status = outcome.get("status")
+        payload = {"ran": "rows", "failed": "taxonomy", "timeout": "taxonomy"}
+        if status not in payload:
+            problems.append(f"{where}.outcome.status is {status!r}")
+            continue
+        if payload[status] not in outcome:
+            problems.append(f"{where}.outcome lacks {payload[status]!r} "
+                            f"(status {status})")
+        if status == "failed" and "tail" not in outcome:
+            problems.append(f"{where}.outcome lacks 'tail' (status failed)")
+    return problems
+
+
+def run_isolated(argv: list[str], timeout_s: float = 900.0,
+                 env: dict[str, str] | None = None) -> dict[str, Any]:
+    """One subprocess, wall-bounded. Returns
+    ``{"returncode", "timed_out", "docs", "stdout", "stderr_tail"}`` — docs is
+    every stdout line that parses as a JSON object, in order. On timeout the
+    child is killed and whatever output it produced is still collected."""
+    timed_out = False
+    try:
+        result = subprocess.run(
+            argv, env=env, capture_output=True, text=True, timeout=timeout_s)
+        rc, out, err = result.returncode, result.stdout or "", result.stderr or ""
+    except subprocess.TimeoutExpired as exc:
+        timed_out = True
+        rc = None
+
+        def _text(v: Any) -> str:
+            if v is None:
+                return ""
+            return v.decode(errors="replace") if isinstance(v, bytes) else str(v)
+
+        out, err = _text(exc.stdout), _text(exc.stderr)
+    docs = []
+    for line in out.splitlines():
+        try:
+            doc = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(doc, dict):
+            docs.append(doc)
+    return {"returncode": rc, "timed_out": timed_out, "docs": docs,
+            "stdout": out, "stderr_tail": err[-8000:]}
+
+
+def run_cells(
+    specs: list[dict[str, Any]],
+    *,
+    argv_for: Callable[[dict[str, Any]], list[str]],
+    ledger: CellLedger,
+    timeout_s: float = 900.0,
+    retries: int = 1,
+    env: dict[str, str] | None = None,
+    runner: Callable[..., dict[str, Any]] = run_isolated,
+    on_entry: Callable[[dict[str, Any], bool], None] | None = None,
+    backoff: RetryConfig | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> dict[str, int]:
+    """Walk ``specs`` (each ``{"id": ..., ...}``) through isolated subprocesses.
+
+    A spec whose ledger entry already says ``ran`` with the same digest is
+    skipped (``on_entry(entry, True)`` lets the caller replay its rows);
+    everything else runs, with bounded retry only when ``classify_failure``
+    says the failure is transient. Returns outcome counts.
+    """
+    policy = backoff or RetryConfig(base_delay_s=1.0, max_delay_s=30.0)
+    counts = {"total": len(specs), "skipped_resume": 0,
+              "ran": 0, "failed": 0, "timeout": 0}
+    for spec in specs:
+        cid = str(spec["id"])
+        digest = cell_digest(spec)
+        prev = ledger.entry(cid)
+        if (prev is not None and prev.get("digest") == digest
+                and (prev.get("outcome") or {}).get("status") == "ran"):
+            counts["skipped_resume"] += 1
+            if on_entry is not None:
+                on_entry(prev, True)
+            continue
+        attempts = 0
+        while True:
+            attempts += 1
+            res = runner(argv_for(spec), timeout_s=timeout_s, env=env)
+            if res["timed_out"]:
+                # a wedged cell already cost timeout_s; re-running a
+                # deterministic wedge would double the bill, so timeouts are
+                # terminal for the cell (the supervisor taxonomy calls it
+                # what the hang detector would: watchdog)
+                outcome = {"status": "timeout", "taxonomy": "watchdog",
+                           "transient": False, "timeout_s": float(timeout_s),
+                           "tail": res["stderr_tail"][-4000:],
+                           "attempts": attempts}
+                break
+            final = next((d for d in reversed(res["docs"]) if "ok" in d), None)
+            if res["returncode"] == 0 and final is not None and final.get("ok"):
+                outcome = {"status": "ran", "attempts": attempts,
+                           "rows": final.get("rows") or [],
+                           "signals": final.get("signals")}
+                break
+            tail = res["stderr_tail"]
+            if final is not None and final.get("error"):
+                tail = (tail + "\n" + str(final["error"]))[-8000:]
+            verdict = classify_failure(returncode=res["returncode"],
+                                       stderr_tail=tail)
+            if verdict["transient"] and attempts <= retries:
+                d = policy.delay(attempts - 1)
+                logger.warning(
+                    "cell %s failed transiently (%s); retry %d/%d in %.1fs",
+                    cid, verdict["taxonomy"], attempts, retries, d)
+                sleep(d)
+                continue
+            outcome = {"status": "failed", "taxonomy": verdict["taxonomy"],
+                       "transient": verdict["transient"],
+                       "returncode": res["returncode"],
+                       "tail": tail[-4000:], "attempts": attempts}
+            break
+        entry = {"id": cid, "digest": digest, "spec": dict(spec),
+                 "outcome": outcome}
+        ledger.record(entry)
+        counts[outcome["status"]] = counts.get(outcome["status"], 0) + 1
+        if on_entry is not None:
+            on_entry(entry, False)
+    return counts
+
+
+def preflight_probe() -> dict[str, Any]:
+    """The health rung that runs before any cell: backend attach, one tiny
+    jitted dispatch, and an HBM probe. Meant to run inside its own subprocess
+    (``bench.py --preflight``) so a wedged backend poisons nothing; the
+    verdict lands in the ledger header. Never raises — a failed rung comes
+    back as ``{"ok": False, "failed_rung": ..., "error": ...}``."""
+    out: dict[str, Any] = {"ok": False}
+    rung = "backend-attach"
+    try:
+        import jax
+
+        out["backend"] = jax.default_backend()
+        out["device"] = str(jax.devices()[0])
+        out["device_count"] = jax.device_count()
+        rung = "dispatch"
+        import jax.numpy as jnp
+
+        got = int(jax.jit(lambda x: x + 1)(jnp.arange(8)).sum())
+        if got != 36:
+            raise RuntimeError(f"canary dispatch returned {got}, expected 36")
+        rung = "hbm-probe"
+        from automodel_tpu.observability.memory import device_memory_stats
+
+        stats = device_memory_stats()
+        out["hbm"] = {k: v for k, v in stats.items() if v is not None} or None
+        out["ok"] = True
+    except Exception as exc:  # noqa: BLE001 — the verdict IS the product
+        out["failed_rung"] = rung
+        out["error"] = repr(exc)
+    return out

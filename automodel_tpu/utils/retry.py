@@ -21,13 +21,17 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import os
 import random
+import socket
 import time
+import zlib
 from typing import Any, Callable, Iterable, TypeVar
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["RetryConfig", "retry", "with_retry", "is_transient"]
+__all__ = ["RetryConfig", "retry", "with_retry", "is_transient",
+           "host_jitter_seed"]
 
 F = TypeVar("F", bound=Callable[..., Any])
 
@@ -42,9 +46,36 @@ _TRANSIENT_NAMES = frozenset({
 })
 
 
+def host_jitter_seed(ident: str | None = None) -> int:
+    """Deterministic per-host jitter seed.
+
+    When every worker of a pod dies together (runtime restart, pod-wide
+    preemption), module-global ``random`` gives each host a jitter drawn from
+    the SAME default-seeded state only when the processes happen to diverge —
+    and identical container images with identical startup paths often don't,
+    so the retries land simultaneously and thundering-herd the TPU runtime.
+    Seeding from the hostname decorrelates hosts *deterministically*: the same
+    host replays the same delay curve across restarts (reproducible, log-
+    diffable), while different hosts spread out. ``AUTOMODEL_RETRY_SEED``
+    overrides the identity for tests and for multi-worker-per-host layouts.
+    """
+    if ident is None:
+        ident = os.environ.get("AUTOMODEL_RETRY_SEED") or socket.gethostname()
+    return zlib.crc32(str(ident).encode())
+
+
+_host_rng = random.Random(host_jitter_seed())
+
+
 @dataclasses.dataclass(frozen=True)
 class RetryConfig:
-    """Backoff policy: delay_n = min(base * mult**n, max_delay) * U(1-j, 1+j)."""
+    """Backoff policy: delay_n = min(base * mult**n, max_delay) * U(1-j, 1+j).
+
+    The jitter factor is drawn from a per-host deterministically seeded RNG
+    (:func:`host_jitter_seed`), so delays always stay inside the
+    ``[d*(1-j), d*(1+j)]`` envelope, hosts decorrelate, and a given host's
+    curve is reproducible run to run.
+    """
 
     max_attempts: int = 3
     base_delay_s: float = 0.5
@@ -61,11 +92,12 @@ class RetryConfig:
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in dict(raw).items() if k in known})
 
-    def delay(self, attempt: int) -> float:
+    def delay(self, attempt: int, rng: random.Random | None = None) -> float:
         """Seconds to sleep before retry number ``attempt`` (0-based)."""
         d = min(self.base_delay_s * self.multiplier**attempt, self.max_delay_s)
         if self.jitter:
-            d *= 1.0 + random.uniform(-self.jitter, self.jitter)
+            r = rng if rng is not None else _host_rng
+            d *= 1.0 + r.uniform(-self.jitter, self.jitter)
         return max(d, 0.0)
 
 

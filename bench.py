@@ -565,13 +565,11 @@ def _profile_cell_step(compiled, params, opt_state, sample_stack, hlo,
         return {}, None
 
 
-def _matrix_bench(cpu: bool, dynamics: bool = False,
-                  profile: bool = False) -> dict:
-    """{dense, moe} x seq {2048,4096,8192} x prefetch {off, on}; one JSON line
-    per row as it lands (partial matrices stay useful if a later cell dies),
-    then a summary doc carrying all rows for the gate. With ``profile``, each
-    cell also runs one traced step (measured_* row keys) and the summary doc
-    carries a ``signals`` bundle (observability/signals.py schema)."""
+def _matrix_bench_inline(cpu: bool, dynamics: bool = False,
+                         profile: bool = False) -> dict:
+    """``--no-isolate``: every cell in THIS process (the pre-r05 monolith —
+    one dead cell still kills the rest). Kept for debugging a single
+    interpreter; the default path is the per-cell subprocess harness below."""
     import jax
 
     rows: list[dict] = []
@@ -599,6 +597,167 @@ def _matrix_bench(cpu: bool, dynamics: bool = False,
         "matrix": rows,
         "extra": {"device": str(jax.devices()[0]), "rows": len(rows)},
     }
+    if signal_cells:
+        from automodel_tpu.observability.signals import build_signals
+
+        doc["signals"] = build_signals(signal_cells)
+    if cpu:
+        doc["extra"]["fallback"] = "cpu"
+    return doc
+
+
+def _cell_argv(spec: dict, script: str | None = None) -> list[str]:
+    """The child invocation for one cell: same interpreter, same script,
+    ``--cell kind:seq`` plus the run's mode flags."""
+    import os
+
+    argv = [sys.executable, script or os.path.abspath(__file__),
+            "--cell", f"{spec['kind']}:{spec['seq_len']}"]
+    for flag in ("cpu", "dynamics", "profile"):
+        if spec.get(flag):
+            argv.append(f"--{flag}")
+    return argv
+
+
+def _bench_chaos_hook(cell_id: str) -> None:
+    """CI fault injection for the harness itself: ``AUTOMODEL_BENCH_CHAOS``
+    (JSON: ``{"fail": [cell ids], "hang": [cell ids], "hang_s": n}``) forces
+    a named cell to die or to wedge past its timeout — proving a poisoned
+    cell costs one cell, never the artifact. Resume without the env var
+    re-runs only the poisoned cells."""
+    import os
+
+    raw = os.environ.get("AUTOMODEL_BENCH_CHAOS")
+    if not raw:
+        return
+    spec = json.loads(raw)
+    if cell_id in (spec.get("fail") or ()):
+        raise RuntimeError(f"bench chaos: forced failure in cell {cell_id}")
+    if cell_id in (spec.get("hang") or ()):
+        hold = float(spec.get("hang_s", 3600.0))
+        print(f"bench chaos: hanging cell {cell_id} for {hold:.0f}s",
+              file=sys.stderr)
+        time.sleep(hold)
+
+
+def _cell_main(cell: str, cpu: bool, dynamics: bool = False,
+               profile: bool = False) -> dict:
+    """``--cell kind:seq`` child mode: one isolated cell, rows as JSON lines,
+    then a final doc the harness records (``{"ok", "cell", "rows", "signals"}``
+    — the rows ride the doc so the ledger can replay them on resume)."""
+    kind, _, seq = cell.partition(":")
+    cell_id = f"{kind}_s{seq}"
+    _bench_chaos_hook(cell_id)
+    rows, signals_cell = _matrix_cell(kind, int(seq), cpu,
+                                      dynamics=dynamics, profile=profile)
+    for row in rows:
+        print(json.dumps(row), flush=True)
+    return {"ok": True, "cell": cell_id, "rows": rows, "signals": signals_cell}
+
+
+def _matrix_bench(cpu: bool, dynamics: bool = False, profile: bool = False,
+                  out_dir: str = "bench_matrix", resume: bool = False,
+                  cell_timeout_s: float = 900.0, cell_retries: int = 1) -> dict:
+    """{dense, moe} x seq {2048,4096,8192}, each cell in an isolated
+    subprocess with a wall budget (resilience/harness.py). One JSON line per
+    row as it lands; completed cells recorded in the resumable
+    ``<out_dir>/matrix_ledger.json``; a failed cell becomes a taxonomy-labeled
+    ledger entry instead of killing the matrix (BENCH_r05). The summary doc
+    keeps the gate contract (``matrix`` rows + headline) and adds per-cell
+    status (``cells``) plus the preflight verdict; ``ok`` is False when any
+    cell did not run. ``--resume`` re-runs only the incomplete cells,
+    byte-identically preserving completed entries."""
+    import os
+
+    from automodel_tpu.resilience.harness import (
+        CellLedger, run_cells, run_isolated,
+    )
+
+    os.makedirs(out_dir, exist_ok=True)
+    ledger_path = os.path.join(out_dir, "matrix_ledger.json")
+    if not resume and os.path.exists(ledger_path):
+        # a fresh run must not silently inherit a stale ledger's completions
+        os.unlink(ledger_path)
+    ledger = CellLedger(ledger_path)
+
+    # preflight health rung in its own subprocess: a wedged backend poisons
+    # one probe, and the verdict is stamped into the artifact header
+    script = os.path.abspath(__file__)
+    pf_argv = [sys.executable, script, "--preflight"] + (["--cpu"] if cpu else [])
+    pf = run_isolated(pf_argv, timeout_s=min(cell_timeout_s, 300.0))
+    pf_doc = next((d for d in reversed(pf["docs"]) if "ok" in d), None) or {
+        "ok": False,
+        "error": ("preflight timed out" if pf["timed_out"]
+                  else f"preflight rc={pf['returncode']} with no JSON line"),
+        "tail": pf["stderr_tail"][-2000:],
+    }
+    ledger.set_header({"preflight": pf_doc, "mode": {
+        "cpu": cpu, "dynamics": dynamics, "profile": profile}})
+    if not pf_doc.get("ok"):
+        return {
+            "ok": False,
+            "metric": "bench matrix: {dense,moe} x seq x prefetch tokens/s/chip",
+            "value": None, "unit": "tokens/s/chip", "vs_baseline": None,
+            "error": f"preflight failed: {pf_doc.get('error')}",
+            "matrix": [], "cells": [],
+            "extra": {"preflight": pf_doc, "ledger": ledger_path},
+        }
+
+    specs = [
+        {"id": f"{kind}_s{nominal}", "kind": kind, "seq_len": nominal,
+         "cpu": cpu, "dynamics": dynamics, "profile": profile}
+        for kind in ("dense", "moe") for nominal in MATRIX_SEQ_LENS
+    ]
+
+    def emit(entry: dict, replayed: bool) -> None:
+        outcome = entry["outcome"]
+        if outcome["status"] == "ran":
+            for row in outcome.get("rows") or []:
+                print(json.dumps(row), flush=True)
+        else:
+            print(f"bench: cell {entry['id']} {outcome['status']} "
+                  f"({outcome.get('taxonomy')})", file=sys.stderr)
+
+    counts = run_cells(
+        specs, argv_for=_cell_argv, ledger=ledger,
+        timeout_s=cell_timeout_s, retries=cell_retries, on_entry=emit)
+
+    rows: list[dict] = []
+    signal_cells: list[dict] = []
+    cells_status: list[dict] = []
+    for e in ledger.doc["cells"]:
+        outcome = e["outcome"]
+        status = {"id": e["id"], "status": outcome["status"]}
+        if outcome["status"] == "ran":
+            rows.extend(outcome.get("rows") or [])
+            if outcome.get("signals"):
+                signal_cells.append(outcome["signals"])
+        else:
+            status["taxonomy"] = outcome.get("taxonomy")
+            status["tail"] = (outcome.get("tail") or "")[-500:]
+        cells_status.append(status)
+    incomplete = [c["id"] for c in cells_status if c["status"] != "ran"]
+    headline = next(
+        (r["tokens_per_sec_per_chip"] for r in rows
+         if r["model"] == "dense" and r["seq_len"] == 2048 and r["prefetch"]),
+        None,
+    )
+    doc = {
+        "ok": not incomplete,
+        "metric": "bench matrix: {dense,moe} x seq x prefetch tokens/s/chip",
+        "value": headline,
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,
+        "matrix": rows,
+        "cells": cells_status,
+        "incomplete_cells": incomplete,
+        "extra": {"rows": len(rows), "ledger": ledger_path,
+                  "preflight": pf_doc, "counts": counts,
+                  "device": pf_doc.get("device")},
+    }
+    if incomplete:
+        doc["error"] = (f"{len(incomplete)} cell(s) did not run: "
+                        + ", ".join(incomplete))
     if signal_cells:
         from automodel_tpu.observability.signals import build_signals
 
@@ -916,14 +1075,25 @@ def _flag_value(argv: list[str], flag: str) -> str | None:
     return None
 
 
-# Substrings that identify "the accelerator is broken/absent", not "our code is
-# broken". BENCH_r05 widened this set: the TPU can also die at the first real
-# dispatch with libtpu/PJRT-level errors the original init-focused markers
-# missed, leaving rc=1 and a raw traceback where the JSON line should be.
-_BACKEND_ERRORS = ("initialize backend", "UNAVAILABLE", "No visible",
-                   "failed to connect", "DEADLINE_EXCEEDED", "libtpu",
-                   "PJRT", "Device or resource busy", "already in use",
-                   "TPU platform", "halted", "hardware failure")
+def _classify(text: str) -> tuple[str, bool]:
+    """``(taxonomy, transient)`` for an error message / traceback tail.
+
+    Delegates to the supervisor's classifier (resilience/supervisor.py), which
+    fixes the r05 misclassification: the old substring set here matched
+    "UNAVAILABLE"/"initialize backend" anywhere, so a *lowering* error whose
+    message merely contained init-looking text (BENCH_r05's
+    ``convert_element_type`` failure) retried and fell back to CPU as if the
+    chip were absent. The classifier's non-transient markers (setup/compile
+    error, lowering frames) override init-looking text — only genuinely
+    transient init errors may retry or fall back."""
+    from automodel_tpu.resilience.supervisor import classify_error_text
+
+    return classify_error_text(text)
+
+
+def _transient_backend_error(exc: BaseException) -> bool:
+    taxonomy, transient = _classify(repr(exc))
+    return transient and taxonomy in ("backend-init", "preemption")
 
 
 def _init_backend(max_attempts: int = 3) -> str:
@@ -931,11 +1101,11 @@ def _init_backend(max_attempts: int = 3) -> str:
     (``utils/retry.py`` policy curve). A TPU attach can fail transiently while
     a previous holder releases the chips ("Device or resource busy",
     UNAVAILABLE) — sleeping through the handoff beats falling straight to the
-    tiny CPU bench. Only errors matching ``_BACKEND_ERRORS`` retry; anything
-    else is a code bug and raises immediately. On exhaustion the LAST named
-    init error raises, and main() routes it into the guaranteed final JSON
-    line (``fallback_reason`` on the CPU-fallback doc, or the ``error`` field
-    when even that fails)."""
+    tiny CPU bench. Only errors the taxonomy classifier marks transient retry;
+    anything else is a code/compiler bug and raises immediately. On exhaustion
+    the LAST named init error raises, and main() routes it into the guaranteed
+    final JSON line (``fallback_reason`` on the CPU-fallback doc, or the
+    ``error`` field when even that fails)."""
     from automodel_tpu.utils.retry import RetryConfig
 
     policy = RetryConfig(max_attempts=max_attempts, base_delay_s=1.0,
@@ -947,7 +1117,7 @@ def _init_backend(max_attempts: int = 3) -> str:
 
             return jax.default_backend()  # first real backend touch
         except Exception as exc:  # noqa: BLE001 — filtered just below
-            if not any(marker in repr(exc) for marker in _BACKEND_ERRORS):
+            if not _transient_backend_error(exc):
                 raise
             last = exc
             if attempt + 1 >= max_attempts:
@@ -1039,11 +1209,63 @@ def main(argv: list[str] | None = None) -> int:
     tune = "--tune" in argv
     tune_dir = _flag_value(argv, "--tune-dir") or "tuned"
     tune_baseline = _flag_value(argv, "--tune-baseline")
+    # matrix isolation knobs (resilience/harness.py)
+    matrix_dir = _flag_value(argv, "--matrix-dir") or "bench_matrix"
+    resume = "--resume" in argv
+    cell_timeout_s = float(_flag_value(argv, "--cell-timeout") or 900.0)
+    cell_retries = int(_flag_value(argv, "--cell-retries") or 1)
+    isolate = "--no-isolate" not in argv
     mode_args = (("--matrix",) if matrix else ()) + (
         ("--dynamics",) if dynamics else ()) + (
         ("--profile",) if profile else ()) + (
         ("--tune", "--tune-dir", tune_dir) if tune else ()) + (
-        ("--tune-baseline", tune_baseline) if tune and tune_baseline else ())
+        ("--tune-baseline", tune_baseline) if tune and tune_baseline else ()) + (
+        # isolation knobs forward only when explicitly given — the fallback
+        # child keeps its own defaults otherwise
+        tuple(f for f in ("--resume", "--no-isolate") if f in argv)) + (
+        ("--matrix-dir", matrix_dir)
+        if _flag_value(argv, "--matrix-dir") else ()) + (
+        ("--cell-timeout", str(cell_timeout_s))
+        if _flag_value(argv, "--cell-timeout") else ())
+    cell = _flag_value(argv, "--cell")
+    if "--preflight" in argv or cell:
+        # child modes for the per-cell harness: run in THIS process (the
+        # harness already isolated us), keep the one-JSON-line contract
+        try:
+            if "--cpu" in argv:
+                import jax
+
+                jax.config.update("jax_platforms", "cpu")
+            if "--preflight" in argv:
+                from automodel_tpu.resilience.harness import preflight_probe
+
+                doc = preflight_probe()
+            else:
+                doc = _cell_main(cell, cpu="--cpu" in argv,
+                                 dynamics=dynamics, profile=profile)
+            print(json.dumps(doc), flush=True)
+            return 0 if doc.get("ok") else 1
+        except Exception as exc:  # noqa: BLE001 — taxonomy-labeled final line
+            import traceback
+
+            tail = traceback.format_exc()[-2000:]
+            taxonomy, transient = _classify(repr(exc) + "\n" + tail)
+            sys.stderr.write(tail)
+            sys.stderr.flush()
+            print(json.dumps({"ok": False, "error": repr(exc),
+                              "taxonomy": taxonomy, "transient": transient,
+                              "tail": tail}), flush=True)
+            return 1
+
+    def _matrix(cpu: bool) -> dict:
+        if not isolate:
+            return _matrix_bench_inline(cpu=cpu, dynamics=dynamics,
+                                        profile=profile)
+        return _matrix_bench(cpu=cpu, dynamics=dynamics, profile=profile,
+                             out_dir=matrix_dir, resume=resume,
+                             cell_timeout_s=cell_timeout_s,
+                             cell_retries=cell_retries)
+
     if "--cpu" in argv:
         try:
             import jax
@@ -1053,11 +1275,10 @@ def main(argv: list[str] | None = None) -> int:
                 doc = _tune_bench(cpu=True, out_dir=tune_dir,
                                   baseline_path=tune_baseline)
             else:
-                doc = (_matrix_bench(cpu=True, dynamics=dynamics,
-                                     profile=profile)
+                doc = (_matrix(cpu=True)
                        if matrix else _cpu_fallback_bench(dynamics=dynamics))
             print(json.dumps(doc), flush=True)
-            return 0
+            return 0 if doc.get("ok") else 1
         except Exception as exc:  # noqa: BLE001 — the JSON contract is the point
             sys.stderr.flush()
             print(json.dumps({"ok": False, "error": repr(exc)}), flush=True)
@@ -1077,12 +1298,11 @@ def main(argv: list[str] | None = None) -> int:
                 doc = _tune_bench(cpu=True, out_dir=tune_dir,
                                   baseline_path=tune_baseline)
             else:
-                doc = (_matrix_bench(cpu=True, dynamics=dynamics,
-                                     profile=profile)
+                doc = (_matrix(cpu=True)
                        if matrix else _cpu_fallback_bench(dynamics=dynamics))
             doc.setdefault("extra", {})["fallback_reason"] = "default backend is cpu"
             print(json.dumps(doc), flush=True)
-            return 0
+            return 0 if doc.get("ok") else 1
         try:
             _canary_dispatch()
         except Exception as exc:  # noqa: BLE001 — any canary failure is a backend fault
@@ -1093,18 +1313,25 @@ def main(argv: list[str] | None = None) -> int:
             doc = _tune_bench(cpu=False, out_dir=tune_dir,
                               baseline_path=tune_baseline)
         else:
-            doc = (_matrix_bench(cpu=False, dynamics=dynamics, profile=profile)
+            doc = (_matrix(cpu=False)
                    if matrix else _full_bench(dynamics=dynamics))
         print(json.dumps(doc), flush=True)
-        return 0
+        return 0 if doc.get("ok") else 1
     except Exception as exc:  # noqa: BLE001
+        import traceback
+
         reason = repr(exc)
-        if any(marker in reason for marker in _BACKEND_ERRORS):
+        taxonomy, transient = _classify(
+            reason + "\n" + traceback.format_exc()[-2000:])
+        if transient and taxonomy in ("backend-init", "preemption"):
             print(f"bench: backend unavailable ({reason}); retrying on CPU",
                   file=sys.stderr)
             return _spawn_cpu_fallback(reason, extra_args=mode_args)
         sys.stderr.flush()
-        print(json.dumps({"ok": False, "error": reason}), flush=True)
+        # satellite contract (BENCH_r05): the final line names the failure
+        # class and carries the real traceback tail, not just the repr
+        print(json.dumps({"ok": False, "error": reason, "taxonomy": taxonomy,
+                          "tail": traceback.format_exc()[-2000:]}), flush=True)
         return 1
 
 

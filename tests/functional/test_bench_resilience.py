@@ -1,0 +1,64 @@
+"""Pytest entry for bench matrix resilience (tools/supervisor_smoke.py
+``matrix`` phase + a forced-hang cell, docs/observability.md "Resumable matrix
+& cell isolation").
+
+Marked ``slow`` (real bench cells compile); run with ``pytest -m slow`` or
+``-m ""``. The matrix phase drives the acceptance scenario end to end:
+a poisoned cell still yields a schema-valid artifact naming the absent cell,
+``bench_gate`` exits 2 naming it, ``--allow-incomplete`` gates the cells that
+ran, and ``--resume`` re-runs only the incomplete cell while replaying the
+completed entries byte-identically.
+
+The forced-hang case runs here (not in the smoke) because a hung cell must
+burn its whole ``--cell-timeout`` wall budget — the test keeps that budget
+tiny. Fast stub-runner coverage of the same retry/skip logic lives in
+tests/unit/test_bench_cells.py.
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO / "tools"))
+
+
+@pytest.mark.slow
+def test_matrix_survives_poisoned_cell_and_resumes(tmp_path, cpu_devices):
+    import supervisor_smoke
+
+    assert supervisor_smoke.main(str(tmp_path), phase="matrix") == 0
+
+
+@pytest.mark.slow
+def test_hung_cell_times_out_as_watchdog(tmp_path, cpu_devices):
+    """A wedged cell costs its wall budget and nothing else: one real
+    ``bench.py --cell`` child hangs via the chaos hook (which fires before
+    any compilation), the harness kills it at the budget, and the ledger
+    records status=timeout/taxonomy=watchdog with a single attempt even
+    though retries are allowed (timeouts are never retried)."""
+    from automodel_tpu.resilience.harness import (
+        CellLedger, run_cells, validate_cell_report,
+    )
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONHASHSEED": "0",
+        "AUTOMODEL_BENCH_CHAOS": json.dumps({"hang": ["dense_s2048"]}),
+    })
+    spec = {"id": "dense_s2048", "kind": "dense", "seq_len": 2048, "cpu": True}
+    argv = [sys.executable, str(REPO / "bench.py"), "--cell", "dense:2048",
+            "--cpu"]
+    ledger = CellLedger(str(tmp_path / "ledger.json"))
+    counts = run_cells([spec], argv_for=lambda s: argv, ledger=ledger,
+                       timeout_s=45.0, retries=3, env=env)
+    assert counts["timeout"] == 1 and counts["ran"] == 0
+    assert validate_cell_report(ledger.doc) == []
+    out = ledger.entry("dense_s2048")["outcome"]
+    assert out["status"] == "timeout" and out["taxonomy"] == "watchdog"
+    assert out["attempts"] == 1, "timeouts must not be retried"

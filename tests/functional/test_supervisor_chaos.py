@@ -1,0 +1,39 @@
+"""Pytest entry for the supervisor chaos scenarios (tools/supervisor_smoke.py,
+docs/resilience.md "Supervised runs").
+
+Marked ``chaos`` + ``slow`` so the real-training phases stay out of the tier-1
+``-m 'not slow'`` suite; run explicitly with ``pytest -m chaos``. Each phase
+launches tools/supervise.py around the real train recipe with chaos injection:
+
+- ``supervise``: SIGKILL at step 6 + silent hang at step 10 -> two restarts,
+  resume from the newest verifiable checkpoint, continuous step coverage,
+  taxonomies crash/unknown then watchdog, timeline spans per episode.
+- ``torn``: SIGKILL inside an async save -> the torn step is walked back past
+  on restart (``.saving`` marker + no manifest), re-saved, and CRC-verifies.
+
+The process-level supervisor mechanics (poll/kill/reap, budget, heartbeat)
+have fast coverage in tests/unit/test_supervisor.py.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "tools"))
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_supervisor_recovers_kill_and_hang(tmp_path, cpu_devices):
+    import supervisor_smoke
+
+    assert supervisor_smoke.main(str(tmp_path), phase="supervise") == 0
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_torn_save_walked_back_and_recommitted(tmp_path, cpu_devices):
+    import supervisor_smoke
+
+    assert supervisor_smoke.main(str(tmp_path), phase="torn") == 0

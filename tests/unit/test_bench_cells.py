@@ -1,0 +1,279 @@
+"""Per-cell bench isolation: ledger, digests, retry policy, schema validation
+(automodel_tpu/resilience/harness.py, docs/observability.md "Resumable matrix
+& cell isolation").
+
+``run_cells`` is exercised with stub runners (no subprocesses) so the retry /
+skip / record logic is tested in isolation; ``run_isolated`` gets two quick
+real-subprocess cases. The full ``bench.py --matrix`` resilience scenario —
+poisoned cells, gate exit 2, byte-identical resume — lives in
+tests/functional/test_bench_resilience.py.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from automodel_tpu.resilience.harness import (
+    CELL_REPORT_VERSION,
+    CellLedger,
+    cell_digest,
+    preflight_probe,
+    run_cells,
+    run_isolated,
+    validate_cell_report,
+)
+from automodel_tpu.utils.retry import RetryConfig
+
+
+# ------------------------------------------------------------------ digest
+class TestCellDigest:
+    def test_key_order_does_not_matter(self):
+        assert cell_digest({"a": 1, "b": [2, 3]}) == \
+            cell_digest({"b": [2, 3], "a": 1})
+
+    def test_value_change_changes_digest(self):
+        assert cell_digest({"id": "c", "seq": 4096}) != \
+            cell_digest({"id": "c", "seq": 8192})
+
+
+# ------------------------------------------------------------------ ledger
+class TestCellLedger:
+    def test_record_is_atomic_and_reloadable(self, tmp_path):
+        p = str(tmp_path / "ledger.json")
+        led = CellLedger(p)
+        led.set_header({"device": "cpu"})
+        led.record({"id": "a", "digest": "d1", "spec": {"id": "a"},
+                    "outcome": {"status": "ran", "rows": [], "attempts": 1}})
+        # no stray tmp files left behind
+        assert os.listdir(tmp_path) == ["ledger.json"]
+        led2 = CellLedger(p)
+        assert led2.doc["header"] == {"device": "cpu"}
+        assert led2.entry("a")["digest"] == "d1"
+        assert led2.entry("missing") is None
+
+    def test_record_upserts_by_id(self, tmp_path):
+        led = CellLedger(str(tmp_path / "ledger.json"))
+        led.record({"id": "a", "digest": "d1", "spec": {},
+                    "outcome": {"status": "failed", "taxonomy": "unknown",
+                                "tail": "", "attempts": 1}})
+        led.record({"id": "a", "digest": "d1", "spec": {},
+                    "outcome": {"status": "ran", "rows": [], "attempts": 1}})
+        assert len(led.doc["cells"]) == 1
+        assert led.entry("a")["outcome"]["status"] == "ran"
+
+    def test_corrupted_ledger_refuses_to_load(self, tmp_path):
+        p = tmp_path / "ledger.json"
+        p.write_text("{torn")
+        with pytest.raises(ValueError, match="unreadable"):
+            CellLedger(str(p))
+
+    def test_version_mismatch_refuses_to_load(self, tmp_path):
+        p = tmp_path / "ledger.json"
+        p.write_text(json.dumps({"version": 999, "header": {}, "cells": []}))
+        with pytest.raises(ValueError, match="version"):
+            CellLedger(str(p))
+
+
+# ------------------------------------------------------------------ schema
+class TestValidateCellReport:
+    def _valid(self):
+        return {
+            "version": CELL_REPORT_VERSION,
+            "header": {"preflight": {"ok": True}},
+            "cells": [
+                {"id": "a", "digest": "d", "spec": {},
+                 "outcome": {"status": "ran", "rows": [{"tps": 1.0}],
+                             "attempts": 1}},
+                {"id": "b", "digest": "d", "spec": {},
+                 "outcome": {"status": "failed", "taxonomy": "compile",
+                             "tail": "boom", "attempts": 1}},
+                {"id": "c", "digest": "d", "spec": {},
+                 "outcome": {"status": "timeout", "taxonomy": "watchdog",
+                             "attempts": 1}},
+            ],
+        }
+
+    def test_valid_doc_has_no_problems(self):
+        assert validate_cell_report(self._valid()) == []
+
+    def test_each_status_demands_its_payload(self):
+        doc = self._valid()
+        del doc["cells"][0]["outcome"]["rows"]       # ran needs rows
+        del doc["cells"][1]["outcome"]["taxonomy"]   # failed needs taxonomy
+        del doc["cells"][1]["outcome"]["tail"]       # ... and a tail
+        problems = validate_cell_report(doc)
+        assert any("rows" in p for p in problems)
+        assert any("taxonomy" in p for p in problems)
+        assert any("tail" in p for p in problems)
+
+    def test_structural_failures(self):
+        assert validate_cell_report([]) != []
+        assert any("version" in p for p in validate_cell_report(
+            {"version": 0, "header": {}, "cells": []}))
+        doc = self._valid()
+        doc["cells"].append({"id": "d", "digest": "d", "spec": {},
+                             "outcome": {"status": "exploded"}})
+        assert any("exploded" in p for p in validate_cell_report(doc))
+
+
+# ------------------------------------------------------------- run_cells
+def _mk_spec(cid, **extra):
+    return {"id": cid, **extra}
+
+
+def _ok_result(rows=None):
+    return {"returncode": 0, "timed_out": False,
+            "docs": [{"ok": True, "rows": rows or [{"tps": 1.0}]}],
+            "stdout": "", "stderr_tail": ""}
+
+
+def _fail_result(stderr, rc=1):
+    return {"returncode": rc, "timed_out": False, "docs": [],
+            "stdout": "", "stderr_tail": stderr}
+
+
+def _timeout_result():
+    return {"returncode": None, "timed_out": True, "docs": [],
+            "stdout": "", "stderr_tail": "still lowering..."}
+
+
+class _StubRunner:
+    """Scripted per-cell results: pops the next result for the cell id."""
+
+    def __init__(self, script):
+        self.script = {k: list(v) for k, v in script.items()}
+        self.calls = []
+
+    def __call__(self, argv, timeout_s=None, env=None):
+        cid = argv[-1]
+        self.calls.append(cid)
+        return self.script[cid].pop(0)
+
+
+def _run(specs, runner, tmp_path, **over):
+    led = CellLedger(str(tmp_path / "ledger.json"))
+    over.setdefault("backoff", RetryConfig(base_delay_s=0.0, jitter=0.0))
+    over.setdefault("sleep", lambda s: None)
+    counts = run_cells(specs, argv_for=lambda s: ["run", s["id"]],
+                       ledger=led, runner=runner, **over)
+    return counts, led
+
+
+class TestRunCells:
+    def test_success_records_rows(self, tmp_path):
+        runner = _StubRunner({"a": [_ok_result([{"tps": 7.0}])]})
+        counts, led = _run([_mk_spec("a")], runner, tmp_path)
+        assert counts == {"total": 1, "skipped_resume": 0, "ran": 1,
+                          "failed": 0, "timeout": 0}
+        entry = led.entry("a")
+        assert entry["outcome"]["rows"] == [{"tps": 7.0}]
+        assert entry["digest"] == cell_digest(_mk_spec("a"))
+        assert validate_cell_report(led.doc) == []
+
+    def test_resume_skips_same_digest_and_replays(self, tmp_path):
+        spec = _mk_spec("a", seq=4096)
+        runner = _StubRunner({"a": [_ok_result()]})
+        _run([spec], runner, tmp_path)
+        assert runner.calls == ["a"]
+        replayed = []
+        counts, led = _run([spec], runner, tmp_path,
+                           on_entry=lambda e, r: replayed.append((e["id"], r)))
+        assert counts["skipped_resume"] == 1 and counts["ran"] == 0
+        assert runner.calls == ["a"], "resume must not re-run a completed cell"
+        assert replayed == [("a", True)]
+
+    def test_changed_spec_invalidates_resume(self, tmp_path):
+        runner = _StubRunner({"a": [_ok_result(), _ok_result()]})
+        _run([_mk_spec("a", seq=4096)], runner, tmp_path)
+        counts, _ = _run([_mk_spec("a", seq=8192)], runner, tmp_path)
+        assert counts["ran"] == 1 and counts["skipped_resume"] == 0
+        assert runner.calls == ["a", "a"]
+
+    def test_failed_cell_reruns_on_resume(self, tmp_path):
+        runner = _StubRunner({"a": [_fail_result("Mosaic failed"),
+                                    _ok_result()]})
+        counts, led = _run([_mk_spec("a")], runner, tmp_path)
+        assert counts["failed"] == 1
+        counts, led = _run([_mk_spec("a")], runner, tmp_path)
+        assert counts["ran"] == 1
+        assert led.entry("a")["outcome"]["status"] == "ran"
+        assert len(led.doc["cells"]) == 1
+
+    def test_transient_failure_retries_then_succeeds(self, tmp_path):
+        runner = _StubRunner(
+            {"a": [_fail_result("Unable to initialize backend"),
+                   _ok_result()]})
+        counts, led = _run([_mk_spec("a")], runner, tmp_path, retries=1)
+        assert counts["ran"] == 1 and counts["failed"] == 0
+        assert led.entry("a")["outcome"]["attempts"] == 2
+
+    def test_non_transient_failure_never_retries(self, tmp_path):
+        # the r05 rule: a lowering error re-runs identically, so retrying
+        # it only doubles the bill
+        runner = _StubRunner(
+            {"a": [_fail_result("setup/compile error: INVALID_ARGUMENT")]})
+        counts, led = _run([_mk_spec("a")], runner, tmp_path, retries=3)
+        assert counts["failed"] == 1
+        out = led.entry("a")["outcome"]
+        assert out["taxonomy"] == "compile" and out["attempts"] == 1
+        assert runner.calls == ["a"]
+
+    def test_timeout_is_terminal_watchdog(self, tmp_path):
+        runner = _StubRunner({"a": [_timeout_result()]})
+        counts, led = _run([_mk_spec("a")], runner, tmp_path, retries=3,
+                           timeout_s=12.5)
+        assert counts["timeout"] == 1
+        out = led.entry("a")["outcome"]
+        assert out["status"] == "timeout" and out["taxonomy"] == "watchdog"
+        assert out["timeout_s"] == 12.5 and out["attempts"] == 1
+        assert runner.calls == ["a"], "a timed-out cell must not be retried"
+
+    def test_child_error_doc_feeds_the_classifier(self, tmp_path):
+        # rc 0 but final doc says not-ok: the error string must reach the
+        # taxonomy (this is how --cell reports in-process failures)
+        res = {"returncode": 0, "timed_out": False,
+               "docs": [{"ok": False, "error": "RESOURCE_EXHAUSTED on alloc"}],
+               "stdout": "", "stderr_tail": ""}
+        runner = _StubRunner({"a": [res]})
+        counts, led = _run([_mk_spec("a")], runner, tmp_path)
+        assert counts["failed"] == 1
+        out = led.entry("a")["outcome"]
+        assert out["taxonomy"] == "oom"
+        assert "RESOURCE_EXHAUSTED" in out["tail"]
+
+    def test_one_dead_cell_costs_one_cell(self, tmp_path):
+        runner = _StubRunner({"a": [_ok_result()],
+                              "b": [_fail_result("boom", rc=2)],
+                              "c": [_ok_result()]})
+        counts, led = _run([_mk_spec(c) for c in "abc"], runner, tmp_path)
+        assert counts["ran"] == 2 and counts["failed"] == 1
+        assert [e["outcome"]["status"] for e in led.doc["cells"]] == \
+            ["ran", "failed", "ran"]
+        assert validate_cell_report(led.doc) == []
+
+
+# --------------------------------------------------------- run_isolated
+class TestRunIsolated:
+    def test_collects_json_docs_from_stdout(self):
+        src = ("import json\n"
+               "print('plain log line')\n"
+               "print(json.dumps({'ok': True, 'rows': [1]}))\n")
+        res = run_isolated([sys.executable, "-c", src], timeout_s=60.0)
+        assert res["returncode"] == 0 and not res["timed_out"]
+        assert res["docs"] == [{"ok": True, "rows": [1]}]
+
+    def test_timeout_kills_and_reports(self):
+        res = run_isolated(
+            [sys.executable, "-c", "import time; time.sleep(60)"],
+            timeout_s=0.5)
+        assert res["timed_out"] and res["returncode"] is None
+
+
+# ------------------------------------------------------------- preflight
+class TestPreflight:
+    def test_probe_passes_on_cpu(self):
+        out = preflight_probe()
+        assert out["ok"], out
+        assert out["backend"] == "cpu" and out["device_count"] >= 1

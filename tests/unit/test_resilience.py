@@ -6,6 +6,7 @@ data-cursor fast-forward that rollback rides on."""
 import json
 import math
 import os
+import time
 
 import numpy as np
 import pytest
@@ -173,6 +174,40 @@ class TestRetry:
         cfg = RetryConfig(base_delay_s=1.0, multiplier=2.0, max_delay_s=3.0, jitter=0.0)
         assert [cfg.delay(a) for a in range(4)] == [1.0, 2.0, 3.0, 3.0]
 
+    def test_jitter_stays_inside_envelope(self):
+        import random
+
+        cfg = RetryConfig(base_delay_s=2.0, multiplier=2.0, max_delay_s=100.0,
+                          jitter=0.25)
+        rng = random.Random(1234)
+        for attempt, nominal in enumerate([2.0, 4.0, 8.0]):
+            for _ in range(200):
+                d = cfg.delay(attempt, rng=rng)
+                assert nominal * 0.75 <= d <= nominal * 1.25, (attempt, d)
+
+    def test_jitter_seed_is_per_host_deterministic(self, monkeypatch):
+        from automodel_tpu.utils.retry import host_jitter_seed
+
+        # the env override pins the seed (CI determinism); absent it, the
+        # hostname decides — two different idents must not collide so a pod
+        # of supervisors spreads its restarts instead of thundering-herding
+        monkeypatch.setenv("AUTOMODEL_RETRY_SEED", "42")
+        assert host_jitter_seed() == host_jitter_seed()
+        monkeypatch.delenv("AUTOMODEL_RETRY_SEED")
+        assert host_jitter_seed("host-a") == host_jitter_seed("host-a")
+        assert host_jitter_seed("host-a") != host_jitter_seed("host-b")
+
+    def test_jittered_delays_vary_but_mean_near_nominal(self):
+        import random
+
+        cfg = RetryConfig(base_delay_s=1.0, multiplier=1.0, max_delay_s=10.0,
+                          jitter=0.25)
+        rng = random.Random(7)
+        draws = [cfg.delay(0, rng=rng) for _ in range(500)]
+        assert len(set(draws)) > 100, "jitter produced near-constant delays"
+        mean = sum(draws) / len(draws)
+        assert 0.95 <= mean <= 1.05, mean
+
     def test_decorator_form(self):
         state = {"n": 0}
 
@@ -220,6 +255,21 @@ class TestManifest:
         open(fp, "wb").write(bytes(data))
         assert any("checksum" in p for p in verify_manifest(d))
         assert verify_manifest(d, check_checksums=False) == []  # size-only mode
+
+    def test_saving_marker_never_inventoried(self, tmp_path):
+        # the manifest is written while the .saving intent marker is still
+        # present (it comes off only post-manifest) — inventorying it would
+        # make EVERY committed step verify as "missing file '.saving'"
+        from automodel_tpu.checkpoint.manifest import SAVING_MARKER
+
+        d = self._step_dir(tmp_path)
+        with open(os.path.join(d, SAVING_MARKER), "w") as f:
+            f.write("3")
+        write_manifest(d, step=3)
+        m = json.load(open(os.path.join(d, MANIFEST_NAME)))
+        assert SAVING_MARKER not in m["files"], sorted(m["files"])
+        os.unlink(os.path.join(d, SAVING_MARKER))
+        assert verify_manifest(d) == []
 
     def test_missing_inventoried_file_detected(self, tmp_path):
         d = self._step_dir(tmp_path)
@@ -349,6 +399,38 @@ class TestChaos:
         target = chaos.corrupt_checkpoint(1, str(d))
         assert target.endswith("big.bin")
         assert os.path.getsize(d / "big.bin") == 500
+
+    def test_kill_hang_keyed_and_point_gated(self):
+        cfg = ChaosConfig(enabled=True, kill_at_step=(5,), kill_point="save",
+                          hang_at_step=(7,))
+        chaos = ChaosInjector(cfg)
+        assert not chaos.should_kill(5)            # step-point query, save-keyed
+        assert chaos.should_kill(5, point="save")
+        assert not chaos.should_kill(4, point="save")
+        assert chaos.should_hang(7) and not chaos.should_hang(6)
+
+    def test_kill_fires_once_across_restarts_via_sentinel(self, tmp_path):
+        cfg = ChaosConfig(enabled=True, kill_at_step=(5,))
+        chaos = ChaosInjector(cfg)
+        chaos.state_dir = str(tmp_path)
+        assert chaos.should_kill(5)
+        chaos._mark_fired("kill", 5)               # what kill() does before SIGKILL
+        assert not chaos.should_kill(5)            # in-process memory
+        fresh = ChaosInjector(cfg)                 # "restarted process"
+        fresh.state_dir = str(tmp_path)
+        assert not fresh.should_kill(5), "sentinel must survive the restart"
+        elsewhere = ChaosInjector(cfg)
+        elsewhere.state_dir = str(tmp_path / "other_run")
+        assert elsewhere.should_kill(5)            # different run dir, fresh fault
+
+    def test_hang_holds_then_returns(self, tmp_path):
+        cfg = ChaosConfig(enabled=True, hang_at_step=(3,), hang_hold_s=0.2)
+        chaos = ChaosInjector(cfg)
+        chaos.state_dir = str(tmp_path)
+        t0 = time.monotonic()
+        chaos.hang(3)
+        assert time.monotonic() - t0 >= 0.2
+        assert not chaos.should_hang(3)  # sentinel on disk: fires once
 
 
 # ---------------------------------------------------------------- manager

@@ -1,0 +1,194 @@
+"""Run supervisor: heartbeat contract, failure taxonomy, bounded restart
+(automodel_tpu/resilience/supervisor.py, docs/resilience.md "Supervised runs").
+
+The Supervisor tests drive REAL subprocesses (tiny ``python -c`` children) so
+the poll/kill/reap loop is exercised for real — with poll intervals and hang
+timeouts shrunk to keep each case under a second. The full training-loop
+chaos scenario (SIGKILL + silent hang + torn save) lives in
+tests/functional/test_supervisor_chaos.py (``pytest -m chaos``).
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+from automodel_tpu.resilience.supervisor import (
+    HEARTBEAT_ENV,
+    HeartbeatWriter,
+    Supervisor,
+    SupervisorConfig,
+    classify_error_text,
+    classify_failure,
+    read_heartbeat,
+)
+from automodel_tpu.utils.retry import RetryConfig
+
+
+# ---------------------------------------------------------------- taxonomy
+class TestClassifier:
+    def test_oom_wins_over_everything(self):
+        text = "RESOURCE_EXHAUSTED while lowering; Unable to initialize backend"
+        assert classify_error_text(text) == ("oom", False)
+
+    def test_lowering_error_is_not_backend_init(self):
+        # BENCH_r05: a convert_element_type lowering failure whose message
+        # contains init-looking text must NOT classify as a retryable
+        # backend-unavailable — retrying re-runs the same deterministic error
+        text = ("setup/compile error: INVALID_ARGUMENT: convert_element_type "
+                "... UNAVAILABLE: Unable to initialize backend")
+        assert classify_error_text(text) == ("compile", False)
+
+    def test_backend_init_is_transient(self):
+        assert classify_error_text("failed to connect to libtpu") == (
+            "backend-init", True)
+        assert classify_error_text("PJRT plugin UNAVAILABLE") == (
+            "backend-init", True)
+
+    def test_numerics_preemption_data_unknown(self):
+        assert classify_error_text("loss=nan at step 12") == ("numerics", False)
+        assert classify_error_text("SIGTERM received; exiting") == (
+            "preemption", True)
+        assert classify_error_text("DataLoader worker crashed") == ("data", False)
+        assert classify_error_text("something else entirely") == (
+            "unknown", False)
+
+    def test_hang_beats_everything(self):
+        v = classify_failure(returncode=-9, stderr_tail="RESOURCE_EXHAUSTED",
+                             hang=True)
+        assert v["taxonomy"] == "watchdog" and v["transient"]
+
+    def test_signal_deaths(self):
+        assert classify_failure(returncode=-signal.SIGTERM)["taxonomy"] == \
+            "preemption"
+        v = classify_failure(returncode=-signal.SIGKILL)
+        assert v["taxonomy"] == "crash" and v["transient"]
+        assert classify_failure(returncode=3)["taxonomy"] == "unknown"
+
+    def test_forensics_artifacts_mtime_gated(self, tmp_path):
+        oom = tmp_path / "oom_report.json"
+        oom.write_text("{}")
+        stale_cutoff = os.path.getmtime(oom) + 10  # report predates episode
+        v = classify_failure(returncode=1, out_dir=str(tmp_path),
+                             since=stale_cutoff)
+        assert v["taxonomy"] == "unknown"
+        v = classify_failure(returncode=1, out_dir=str(tmp_path),
+                             since=os.path.getmtime(oom) - 10)
+        assert v["taxonomy"] == "oom" and v["evidence"] == str(oom)
+
+
+# ---------------------------------------------------------------- heartbeat
+class TestHeartbeat:
+    def test_roundtrip_and_throttle(self, tmp_path):
+        p = str(tmp_path / "hb.json")
+        w = HeartbeatWriter(p, min_interval_s=60.0)
+        w.beat(3)
+        doc = read_heartbeat(p)
+        assert doc["step"] == 3 and doc["pid"] == os.getpid()
+        os.unlink(p)
+        w.beat(3)  # same step inside the interval: throttled, no rewrite
+        assert read_heartbeat(p) is None
+        w.beat(4)  # step change always writes
+        assert read_heartbeat(p)["step"] == 4
+
+    def test_from_env(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(HEARTBEAT_ENV, raising=False)
+        assert HeartbeatWriter.from_env() is None
+        monkeypatch.setenv(HEARTBEAT_ENV, str(tmp_path / "hb.json"))
+        w = HeartbeatWriter.from_env()
+        assert w is not None and w.path == str(tmp_path / "hb.json")
+
+    def test_unreadable_heartbeat_is_none(self, tmp_path):
+        p = tmp_path / "hb.json"
+        p.write_text("{torn")
+        assert read_heartbeat(str(p)) is None
+
+
+# ---------------------------------------------------------------- supervisor
+def _cfg(**over):
+    over.setdefault("poll_interval_s", 0.02)
+    over.setdefault("grace_s", 0.5)
+    over.setdefault("backoff", RetryConfig(base_delay_s=0.0, jitter=0.0))
+    return SupervisorConfig(**over)
+
+
+def _run(tmp_path, child_src, *child_args, **cfg_over):
+    sup = Supervisor(
+        [sys.executable, "-c", child_src, *child_args],
+        str(tmp_path / "out"), config=_cfg(**cfg_over),
+        sleep=lambda s: None,
+    )
+    rc = sup.run()
+    return rc, sup
+
+
+class TestSupervisor:
+    def test_clean_exit_completes_first_episode(self, tmp_path):
+        rc, sup = _run(tmp_path, "pass")
+        assert rc == 0
+        report = json.load(open(sup.report_path))
+        assert report["status"] == "completed"
+        assert report["restarts"] == 0 and len(report["episodes"]) == 1
+        rows = [json.loads(ln) for ln in
+                open(os.path.join(sup.out_dir, "supervisor.jsonl"))]
+        assert rows[-1]["supervisor/returncode"] == 0
+
+    def test_crash_once_then_success_restarts(self, tmp_path):
+        marker = str(tmp_path / "second_run")
+        src = ("import os,sys\n"
+               "p=sys.argv[1]\n"
+               "if os.path.exists(p): sys.exit(0)\n"
+               "open(p,'w').write('x')\n"
+               "sys.stderr.write('boom\\n'); sys.exit(1)\n")
+        rc, sup = _run(tmp_path, src, marker, max_restarts=2)
+        assert rc == 0
+        report = json.load(open(sup.report_path))
+        assert report["status"] == "completed" and report["restarts"] == 1
+        assert report["episodes"][0]["taxonomy"] == "unknown"
+        assert "boom" in report["episodes"][0]["stderr_tail"]
+        assert report["episodes"][1]["returncode"] == 0
+
+    def test_budget_exhausted_aborts_with_reason(self, tmp_path):
+        rc, sup = _run(tmp_path, "import sys; sys.exit(3)", max_restarts=1)
+        assert rc == 3
+        report = json.load(open(sup.report_path))
+        assert report["status"] == "aborted"
+        assert "restart budget exhausted" in report["abort_reason"]
+        assert len(report["episodes"]) == 2  # initial + 1 restart
+
+    def test_stale_heartbeat_is_killed_as_watchdog(self, tmp_path):
+        src = ("import json,os,time\n"
+               "p=os.environ['AUTOMODEL_HEARTBEAT_FILE']\n"
+               "open(p,'w').write(json.dumps("
+               "{'step':1,'time':time.time(),'pid':os.getpid()}))\n"
+               "time.sleep(60)\n")
+        t0 = time.monotonic()
+        rc, sup = _run(tmp_path, src, max_restarts=0, hang_timeout_s=0.5)
+        assert time.monotonic() - t0 < 30, "hang detector never fired"
+        assert rc != 0
+        report = json.load(open(sup.report_path))
+        ep = report["episodes"][0]
+        assert ep["hang"] and ep["taxonomy"] == "watchdog"
+        assert ep["heartbeat_step"] == 1
+
+    def test_silent_uninstrumented_child_is_not_a_hang(self, tmp_path):
+        # no heartbeat ever written: the detector must stay disarmed and let
+        # the child finish (sleep longer than hang_timeout_s)
+        rc, sup = _run(tmp_path, "import time; time.sleep(1.2)",
+                       max_restarts=0, hang_timeout_s=0.4)
+        assert rc == 0
+        report = json.load(open(sup.report_path))
+        assert report["status"] == "completed"
+        assert not report["episodes"][0]["hang"]
+
+    def test_heartbeat_env_exported_and_timeline_written(self, tmp_path):
+        src = ("import os,sys\n"
+               "sys.exit(0 if os.environ.get('AUTOMODEL_HEARTBEAT_FILE') "
+               "else 7)\n")
+        rc, sup = _run(tmp_path, src)
+        assert rc == 0, "child did not see the heartbeat env var"
+        timeline = json.load(open(
+            os.path.join(sup.out_dir, "supervisor_timeline.json")))
+        names = {e.get("name") for e in timeline["traceEvents"]}
+        assert "supervisor/episode_0" in names

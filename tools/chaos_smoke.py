@@ -48,7 +48,12 @@ resilience:
 """ % (NAN_STEP, CORRUPT_STEP)
 
 
-def _write_cfg(root: str, name: str, *, ckpt: bool, chaos: bool) -> str:
+def _write_cfg(root: str, name: str, *, ckpt: bool, chaos: bool,
+               max_steps: int = MAX_STEPS, ckpt_every: int = CKPT_EVERY,
+               async_save: bool = False, resilience: str | None = None) -> str:
+    """Write the tiny-llama CPU smoke config. ``resilience`` overrides the
+    default chaos block (tools/supervisor_smoke.py reuses this writer with
+    kill/hang injections); the defaults reproduce the classic smoke."""
     text = textwrap.dedent(f"""\
     seed: 7
     output_dir: {root}/{name}/out
@@ -78,10 +83,10 @@ def _write_cfg(root: str, name: str, *, ckpt: bool, chaos: bool) -> str:
     seq_len: 32
     step_scheduler:
       grad_acc_steps: 1
-      max_steps: {MAX_STEPS}
+      max_steps: {max_steps}
       num_epochs: 10
       handle_sigterm: false
-      ckpt_every_steps: {CKPT_EVERY if ckpt else 0}
+      ckpt_every_steps: {ckpt_every if ckpt else 0}
     optimizer:
       lr: 1.0e-2
       weight_decay: 0.0
@@ -91,9 +96,10 @@ def _write_cfg(root: str, name: str, *, ckpt: bool, chaos: bool) -> str:
     checkpoint:
       enabled: {str(ckpt).lower()}
       checkpoint_dir: {root}/{name}/ckpt
+      async_save: {str(async_save).lower()}
     """)
     if chaos:
-        text += _RESILIENCE
+        text += resilience if resilience is not None else _RESILIENCE
     path = os.path.join(root, f"{name}.yaml")
     os.makedirs(os.path.join(root, name), exist_ok=True)
     with open(path, "w") as f:

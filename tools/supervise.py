@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Run any entrypoint under the run supervisor (docs/resilience.md).
+
+Wraps the command after ``--`` in a monitored subprocess: heartbeat-based hang
+detection (SIGABRT + restart), failure taxonomy from exit status + stderr +
+forensics artifacts, and bounded restart with jittered backoff. The child is
+re-invoked with the SAME argv on every restart — a recipe with checkpointing
+enabled resumes from its newest verifiable checkpoint (elastic restore
+included), so a restart on a degraded topology proceeds instead of aborting.
+
+Usage::
+
+    python tools/supervise.py --out-dir out/run1 [--max-restarts 3] \\
+        [--hang-timeout 900] [--poll-interval 0.5] -- \\
+        python -m automodel_tpu.recipes.llm.train_ft --config run.yaml
+
+The episode history lands atomically in ``<out-dir>/supervisor_report.json``
+(plus a Chrome-trace ``supervisor_timeline.json`` and flat ``supervisor/*``
+rows in ``supervisor.jsonl``). Exit status: the child's final status — 0 on
+success, the last failing status (or 1) when the restart budget is spent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if "--" in argv:
+        split = argv.index("--")
+        own, child = argv[:split], argv[split + 1:]
+    else:
+        own, child = argv, []
+    parser = argparse.ArgumentParser(
+        prog="supervise", description=__doc__.splitlines()[0])
+    parser.add_argument("--out-dir", required=True,
+                        help="child artifact dir: heartbeat file, stall dumps, "
+                             "supervisor_report.json all live here")
+    parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument("--hang-timeout", type=float, default=900.0,
+                        metavar="SECONDS",
+                        help="no-heartbeat window before SIGABRT (keep it above "
+                             "the child's watchdog.threshold_s so the stack "
+                             "dump lands first)")
+    parser.add_argument("--poll-interval", type=float, default=0.5)
+    parser.add_argument("--grace", type=float, default=10.0,
+                        help="seconds between SIGABRT and SIGKILL")
+    args = parser.parse_args(own)
+    if not child:
+        parser.error("no child command given; usage: supervise.py [opts] -- cmd ...")
+
+    from automodel_tpu.resilience.supervisor import Supervisor, SupervisorConfig
+    from automodel_tpu.utils.retry import RetryConfig
+
+    config = SupervisorConfig(
+        max_restarts=args.max_restarts,
+        hang_timeout_s=args.hang_timeout,
+        poll_interval_s=args.poll_interval,
+        grace_s=args.grace,
+        backoff=RetryConfig(base_delay_s=2.0, max_delay_s=60.0),
+    )
+    os.makedirs(args.out_dir, exist_ok=True)
+    sup = Supervisor(child, args.out_dir, config=config)
+    rc = sup.run()
+    print(f"[supervise] {sup.report['status']} after "
+          f"{len(sup.report['episodes'])} episode(s), "
+          f"{sup.report['restarts']} restart(s) -> {sup.report_path}",
+          file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Self-checking CPU smoke for supervised runs + the resumable bench matrix
+(docs/resilience.md "Supervised runs", docs/observability.md "Resumable
+matrix & cell isolation").
+
+Three phases, each independently selectable with ``--phase``:
+
+- ``supervise``: a tiny mock-llama training run under ``tools/supervise.py``
+  with two chaos injections — SIGKILL after step 6 and a silent hang at step
+  10. Asserts the supervisor classifies the kill as ``crash`` and the hang as
+  ``watchdog``, restarts twice from the latest verifiable checkpoint, the
+  loss trajectory stays finite through both outages, and
+  ``supervisor_report.json`` + the timeline spans tell the story.
+- ``torn``: the same run with ``async_save`` and a ``kill_point: save``
+  injection — the process dies while step-8 array writes are in flight and
+  before the manifest commits. Asserts the restart walks BACK past the torn
+  step-8 directory to step 4 (never resumes from unverifiable bytes) and
+  still finishes.
+- ``matrix``: ``bench.py --matrix --cpu`` with one cell poisoned to fail
+  (``AUTOMODEL_BENCH_CHAOS``). Asserts the artifact is schema-valid with the
+  failure recorded per-cell, ``bench_gate.py`` gates the cells that ran while
+  exiting 2 naming the poisoned one, ``--resume`` re-runs ONLY the incomplete
+  cell (completed entries replay byte-identically), and the resumed artifact
+  gates clean.
+
+Usage:  JAX_PLATFORMS=cpu python tools/supervisor_smoke.py \
+            [--workdir DIR] [--phase supervise|torn|matrix|all]
+
+The same scenarios run under pytest as ``pytest -m chaos``
+(tests/functional/test_supervisor_chaos.py, test_bench_resilience.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+from chaos_smoke import _write_cfg  # noqa: E402  (shared tiny-llama config)
+MAX_STEPS = 14
+CKPT_EVERY = 4
+KILL_STEP = 6
+HANG_STEP = 10
+SAVE_KILL_STEP = 8
+POISON_CELL = "moe_s4096"
+
+_KILL_HANG = textwrap.dedent(f"""\
+resilience:
+  enabled: true
+  chaos:
+    enabled: true
+    kill_at_step: [{KILL_STEP}]
+    hang_at_step: [{HANG_STEP}]
+    hang_hold_s: 120
+""")
+
+_TORN_SAVE = textwrap.dedent(f"""\
+resilience:
+  enabled: true
+  chaos:
+    enabled: true
+    kill_at_step: [{SAVE_KILL_STEP}]
+    kill_point: save
+""")
+
+
+def _env() -> dict[str, str]:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.setdefault("PYTHONHASHSEED", "0")
+    return env
+
+
+def _supervise(cfg_path: str, out_dir: str, *, max_restarts: int,
+               hang_timeout: float = 20.0) -> int:
+    argv = [
+        sys.executable, os.path.join(REPO, "tools", "supervise.py"),
+        "--out-dir", out_dir,
+        "--max-restarts", str(max_restarts),
+        "--hang-timeout", str(hang_timeout),
+        "--poll-interval", "0.2", "--grace", "5",
+        "--",
+        sys.executable, "-m", "automodel_tpu.recipes.llm.train_ft",
+        "-c", cfg_path,
+    ]
+    return subprocess.run(argv, env=_env(), cwd=REPO).returncode
+
+
+def _loss_rows(out_dir: str) -> list[dict]:
+    with open(os.path.join(out_dir, "training.jsonl")) as f:
+        rows = [json.loads(line) for line in f]
+    return [r for r in rows if "loss" in r and "step" in r]
+
+
+def _report(out_dir: str) -> dict:
+    with open(os.path.join(out_dir, "supervisor_report.json")) as f:
+        return json.load(f)
+
+
+def phase_supervise(root: str) -> None:
+    print(f"[supervisor_smoke] supervise: SIGKILL at step {KILL_STEP}, "
+          f"silent hang at step {HANG_STEP} ...")
+    cfg = _write_cfg(root, "supervised", ckpt=True, chaos=True,
+                     resilience=_KILL_HANG)
+    out_dir = os.path.join(root, "supervised", "out")
+    rc = _supervise(cfg, out_dir, max_restarts=3)
+    assert rc == 0, f"supervised run exited {rc}"
+
+    report = _report(out_dir)
+    assert report["status"] == "completed", report["status"]
+    assert report["restarts"] == 2, f"restarts={report['restarts']}"
+    taxonomies = [e.get("taxonomy") for e in report["episodes"]]
+    assert len(report["episodes"]) == 3, taxonomies
+    # SIGKILL leaves no stderr marker: classified off the signal death
+    assert taxonomies[0] in ("crash", "unknown"), taxonomies
+    assert taxonomies[1] == "watchdog", taxonomies
+    assert report["episodes"][1]["hang"], "hang episode not flagged as hang"
+    assert taxonomies[2] is None, taxonomies
+
+    rows = _loss_rows(out_dir)
+    losses = [r["loss"] for r in rows]
+    assert losses and all(v == v for v in losses), "non-finite loss logged"
+    steps = {r["step"] for r in rows}
+    missing = set(range(1, MAX_STEPS + 1)) - steps - {KILL_STEP, HANG_STEP}
+    assert not missing, f"loss trajectory has holes: {sorted(missing)}"
+    assert MAX_STEPS in steps, "run never reached the final step"
+
+    with open(os.path.join(out_dir, "supervisor_timeline.json")) as f:
+        names = {ev.get("name") for ev in json.load(f).get("traceEvents", [])}
+    for want in ("supervisor/episode_0", "supervisor/episode_1",
+                 "supervisor/episode_2", "supervisor/restart_1",
+                 "supervisor/restart_2"):
+        assert want in names, f"timeline lacks {want}: {sorted(names)}"
+    print(f"[supervisor_smoke]     taxonomies {taxonomies}, "
+          f"{len(steps)} distinct steps, final loss {losses[-1]:.3f}")
+
+
+def phase_torn(root: str) -> None:
+    print(f"[supervisor_smoke] torn: SIGKILL mid-async-save of step "
+          f"{SAVE_KILL_STEP} ...")
+    cfg = _write_cfg(root, "torn", ckpt=True, chaos=True, async_save=True,
+                     resilience=_TORN_SAVE)
+    out_dir = os.path.join(root, "torn", "out")
+    rc = _supervise(cfg, out_dir, max_restarts=2)
+    assert rc == 0, f"torn-save run exited {rc}"
+
+    report = _report(out_dir)
+    assert report["status"] == "completed", report["status"]
+    assert report["restarts"] == 1, f"restarts={report['restarts']}"
+    assert report["episodes"][0].get("taxonomy") in ("crash", "unknown")
+
+    # the restart must resume from step 4, not the torn step-8 bytes: the
+    # first logged step after the sequence rewinds is CKPT_EVERY + 1
+    steps = [r["step"] for r in _loss_rows(out_dir)]
+    rewinds = [steps[i] for i in range(1, len(steps))
+               if steps[i] <= steps[i - 1]]
+    assert rewinds == [CKPT_EVERY + 1], (
+        f"expected one rewind to step {CKPT_EVERY + 1} (walk-back past the "
+        f"torn step_{SAVE_KILL_STEP}), got {rewinds} in {steps}")
+    assert steps[-1] == MAX_STEPS, steps[-2:]
+
+    # the re-saved step-8 checkpoint must now verify (marker removed,
+    # manifest committed)
+    from automodel_tpu.checkpoint.checkpointing import SAVING_MARKER
+    from automodel_tpu.checkpoint.manifest import has_manifest, verify_manifest
+    step8 = os.path.join(root, "torn", "ckpt", f"step_{SAVE_KILL_STEP}")
+    assert not os.path.exists(os.path.join(step8, SAVING_MARKER))
+    assert has_manifest(step8), f"step_{SAVE_KILL_STEP} lacks a manifest"
+    problems = verify_manifest(step8)
+    assert not problems, (
+        f"re-saved step_{SAVE_KILL_STEP} fails verification: {problems}")
+    print(f"[supervisor_smoke]     rewound to step {CKPT_EVERY + 1}, "
+          f"finished at {steps[-1]}, step_{SAVE_KILL_STEP} re-verified")
+
+
+def phase_matrix(root: str) -> None:
+    from automodel_tpu.observability import regression
+    from automodel_tpu.resilience.harness import validate_cell_report
+
+    bm = os.path.join(root, "bench_matrix")
+    shutil.rmtree(bm, ignore_errors=True)
+    base_argv = [sys.executable, os.path.join(REPO, "bench.py"), "--matrix",
+                 "--cpu", "--matrix-dir", bm, "--cell-timeout", "600"]
+
+    print(f"[supervisor_smoke] matrix: poisoned cell {POISON_CELL} ...")
+    env = _env()
+    env["AUTOMODEL_BENCH_CHAOS"] = json.dumps({"fail": [POISON_CELL]})
+    res = subprocess.run(base_argv, env=env, cwd=REPO, capture_output=True,
+                         text=True)
+    assert res.returncode != 0, "poisoned matrix run must exit non-zero"
+    doc = json.loads(res.stdout.splitlines()[-1])
+    assert doc["ok"] is False and doc["incomplete_cells"] == [POISON_CELL], doc
+    assert len(doc["cells"]) == 6, doc["cells"]
+    failed = next(c for c in doc["cells"] if c["id"] == POISON_CELL)
+    assert failed["status"] == "failed" and failed.get("taxonomy"), failed
+
+    ledger_path = os.path.join(bm, "matrix_ledger.json")
+    with open(ledger_path) as f:
+        ledger = json.load(f)
+    problems = validate_cell_report(ledger)
+    assert not problems, f"artifact schema-invalid after poisoning: {problems}"
+    kept = {e["id"]: e for e in ledger["cells"]
+            if e["outcome"]["status"] == "ran"}
+    assert len(kept) == 5, sorted(kept)
+
+    summary = os.path.join(root, "summary.json")
+    with open(summary, "w") as f:
+        json.dump(doc, f)
+    baseline = os.path.join(root, "baseline.json")
+    rc = regression.main(["--run", summary, "--baseline", baseline,
+                          "--write-baseline"])
+    assert rc == 0, "baseline write failed"
+    rc = regression.main(["--run", summary, "--baseline", baseline])
+    assert rc == 2, f"gate on a partial matrix must exit 2, got {rc}"
+    rc = regression.main(["--run", summary, "--baseline", baseline,
+                          "--allow-incomplete"])
+    assert rc == 0, "gate --allow-incomplete must pass the present cells"
+
+    print("[supervisor_smoke] matrix: --resume completes the poisoned cell ...")
+    res = subprocess.run(base_argv + ["--resume"], env=_env(), cwd=REPO,
+                         capture_output=True, text=True)
+    assert res.returncode == 0, (
+        f"resume exited {res.returncode}: {res.stderr[-2000:]}")
+    doc2 = json.loads(res.stdout.splitlines()[-1])
+    assert doc2["ok"] is True and doc2["incomplete_cells"] == [], doc2
+    assert doc2["extra"]["counts"]["skipped_resume"] == 5, doc2["extra"]
+    with open(ledger_path) as f:
+        ledger2 = json.load(f)
+    after = {e["id"]: e for e in ledger2["cells"]}
+    for cid, entry in kept.items():
+        assert after[cid] == entry, f"resume rewrote completed cell {cid}"
+
+    with open(summary, "w") as f:
+        json.dump(doc2, f)
+    rc = regression.main(["--run", summary, "--baseline", baseline])
+    assert rc == 0, f"gate on the completed matrix must pass, got {rc}"
+    print("[supervisor_smoke]     resume byte-identical for 5 cells, "
+          "gate 2 -> 0")
+
+
+PHASES = {"supervise": phase_supervise, "torn": phase_torn,
+          "matrix": phase_matrix}
+
+
+def main(workdir: str | None = None, phase: str = "all") -> int:
+    owns_workdir = workdir is None
+    root = workdir or tempfile.mkdtemp(prefix="supervisor_smoke_")
+    try:
+        print(f"[supervisor_smoke] workdir {root}")
+        for name, fn in PHASES.items():
+            if phase in ("all", name):
+                fn(root)
+        print("[supervisor_smoke] PASS")
+        return 0
+    finally:
+        if owns_workdir:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--workdir", default=None,
+                        help="keep artifacts here instead of a temp dir")
+    parser.add_argument("--phase", default="all",
+                        choices=["all", *PHASES])
+    args = parser.parse_args()
+    sys.exit(main(args.workdir, args.phase))
