@@ -18,6 +18,29 @@ import jax
 __all__ = ["MetricsSample", "MetricLogger", "build_run_header"]
 
 
+def _episode_from_env() -> dict[str, Any]:
+    """Episode identity exported by the supervisor (resilience/supervisor.py
+    EPISODE_ENV — literal duplicated here because importing the resilience
+    package would pull the heavy manager into every logger user). Stamped into
+    the run header and every metric row so the multi-episode training.jsonl
+    segments are attributable without filename archaeology."""
+    raw = os.environ.get("AUTOMODEL_EPISODE")
+    if not raw:
+        return {}
+    try:
+        doc = json.loads(raw)
+    except json.JSONDecodeError:
+        return {}
+    if not isinstance(doc, dict):
+        return {}
+    out: dict[str, Any] = {}
+    if isinstance(doc.get("index"), int):
+        out["episode"] = doc["index"]
+    if isinstance(doc.get("run_id"), str):
+        out["run_id"] = doc["run_id"]
+    return out
+
+
 def build_run_header(cfg: Any = None, mesh: Any = None, model_id: str | None = None,
                      **extra: Any) -> dict[str, Any]:
     """The one-time run-header row: everything needed to join a training.jsonl
@@ -101,6 +124,7 @@ class MetricLogger:
     def __init__(self, path: str | os.PathLike, main_process_only: bool = True):
         self.path = str(path)
         self._fh: IO[str] | None = None
+        self._episode = _episode_from_env()
         self.enabled = not main_process_only or jax.process_index() == 0
         if self.enabled:
             os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
@@ -109,6 +133,8 @@ class MetricLogger:
     def log(self, step: int, **metrics: Any) -> None:
         if not self.enabled or self._fh is None:
             return
+        if "episode" in self._episode:
+            metrics = {"episode": self._episode["episode"], **metrics}
         self._fh.write(MetricsSample(step=step, metrics=metrics).to_json() + "\n")
         self._fh.flush()
 
@@ -118,7 +144,8 @@ class MetricLogger:
         of their metric keys (or absence of ``run_header``)."""
         if not self.enabled or self._fh is None:
             return
-        rec: dict[str, Any] = {"run_header": True, "ts": round(time.time(), 3)}
+        rec: dict[str, Any] = {"run_header": True, "ts": round(time.time(), 3),
+                               **self._episode}
         for k, v in fields.items():
             rec[k] = _jsonable(v)[0] if not isinstance(v, dict) else v
         self._fh.write(json.dumps(rec, allow_nan=False, default=str) + "\n")

@@ -44,6 +44,12 @@ from automodel_tpu.observability.oom import (
     live_buffer_inventory,
 )
 from automodel_tpu.observability.profiling import OnDemandProfiler
+from automodel_tpu.observability.runledger import (
+    BADPUT_CLASSES,
+    build_ledger,
+    update_run_ledger,
+    validate_ledger,
+)
 from automodel_tpu.observability.signals import (
     build_signals,
     validate_signals,
@@ -60,6 +66,7 @@ from automodel_tpu.observability.watchdog import StallWatchdog
 compile_cache.install()
 
 __all__ = [
+    "BADPUT_CLASSES",
     "BUCKETS",
     "CrossHostAggregator",
     "DynamicsConfig",
@@ -78,6 +85,7 @@ __all__ = [
     "TraceTimeline",
     "analyze_trace",
     "bucket_for_path",
+    "build_ledger",
     "build_memory_plan",
     "build_signals",
     "dynamics_tree",
@@ -102,6 +110,8 @@ __all__ = [
     "roofline_metrics",
     "routing_entropy",
     "tree_shard_bytes",
+    "update_run_ledger",
+    "validate_ledger",
     "validate_signals",
     "write_signals",
 ]

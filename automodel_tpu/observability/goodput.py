@@ -21,8 +21,11 @@ from typing import Callable
 
 __all__ = ["BUCKETS", "GoodputTracker"]
 
-# buckets the train loop bills explicitly; the remainder is idle
-BUCKETS = ("compile", "data_wait", "device_step", "eval", "checkpoint", "rollback")
+# buckets the train loop bills explicitly; the remainder is idle. ``restore``
+# is checkpoint load on resume (incl. the elastic re-partition path) — billed
+# via bill_preceding() because it happens before the tracker exists.
+BUCKETS = ("compile", "data_wait", "device_step", "eval", "checkpoint",
+           "rollback", "restore")
 
 
 class GoodputTracker:
@@ -48,6 +51,14 @@ class GoodputTracker:
         self._totals.setdefault(bucket, 0.0)
         self._totals[bucket] += max(float(seconds), 0.0)
 
+    def bill_preceding(self, bucket: str, seconds: float) -> None:
+        """Bill time spent *before* this tracker existed (checkpoint restore on
+        resume happens before observability is constructed). Rewinds the wall
+        origin by the same amount so fractions still sum to 1."""
+        seconds = max(float(seconds), 0.0)
+        self._start -= seconds
+        self.add(bucket, seconds)
+
     @property
     def wall_s(self) -> float:
         return max(self._clock() - self._start, 1e-9)
@@ -67,4 +78,7 @@ class GoodputTracker:
         totals = self.totals()
         out = {f"goodput/{b}": round(v / wall, 4) for b, v in totals.items()}
         out["goodput"] = round(totals["device_step"] / wall, 4)
+        # bare key on purpose: `goodput/` values are fractions summing to 1,
+        # and the run ledger needs the absolute wall to de-normalize them
+        out["goodput_wall_s"] = round(wall, 3)
         return out

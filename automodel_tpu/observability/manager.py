@@ -569,6 +569,20 @@ class Observability:
         logger.info("jit compile + first execute: %.1fs (cumulative %.1fs)",
                     seconds, self.compile_time_s)
 
+    def record_restore(self, seconds: float) -> None:
+        """Checkpoint restore on resume (incl. the elastic re-partition path).
+        Happens before this object exists, so the time is back-billed: the
+        goodput wall origin rewinds by the same amount and the `restore`
+        bucket absorbs it — fractions keep summing to 1 and the run ledger
+        sees the restore cost instead of it vanishing into idle."""
+        seconds = max(float(seconds), 0.0)
+        if seconds <= 0.0:
+            return
+        if self.goodput is not None:
+            self.goodput.bill_preceding("restore", seconds)
+        if self.timeline is not None:
+            self.timeline.complete("restore", "phase", 0.0, seconds)
+
     def heartbeat(self, step: int | None = None) -> None:
         if self.watchdog is not None:
             self.watchdog.heartbeat(step)

@@ -37,24 +37,45 @@ __all__ = [
     "main",
 ]
 
+# run-ledger families (observability/runledger.py): the badput taxonomy and
+# the supervisor's failure classes, spelled out as FULL keys below because
+# their basenames ("restore", "idle", "crash", ...) are not gate metrics on
+# their own and the basename fallback would guess the wrong direction.
+_BADPUT_CLASSES = ("restart_backoff", "reinit", "restore", "recompile",
+                   "wasted_steps", "data_stall", "eval", "checkpoint", "idle")
+_FAILURE_CLASSES = ("oom", "numerics", "compile", "backend-init", "preemption",
+                    "data", "watchdog", "crash", "unknown")
+
 DEFAULT_TOLERANCES = {"tps": 0.05, "mfu": 0.05, "step_time_s": 0.05, "goodput": 0.05,
                       "hbm_gib_peak": 0.05, "hbm_headroom_gib": 0.05,
                       # measured-profile keys (bench.py --profile): a single
                       # traced step jitters more than a 10-step average
                       "measured_step_time_s": 0.15, "overlap_frac": 0.1,
                       "measured_frac_compute": 0.1, "measured_frac_comm": 0.1,
-                      "measured_frac_moe_a2a": 0.1, "measured_frac_host": 0.1}
+                      "measured_frac_moe_a2a": 0.1, "measured_frac_host": 0.1,
+                      # run-ledger keys: goodput_e2e gates like throughput;
+                      # the badput/recovery families are chaos-amplified (one
+                      # extra retrained step doubles a small count), so they
+                      # get SLO-sized slack rather than perf-sized
+                      "goodput_e2e": 0.05, "wasted_steps": 0.25, "recovery_s": 0.25,
+                      **{f"badput/{c}": 0.25 for c in _BADPUT_CLASSES},
+                      **{f"recovery_s/{c}": 0.25 for c in _FAILURE_CLASSES}}
 # regression direction: True = lower is a regression, False = higher is.
 # Memory gates both ways: peak HBM regresses by RISING (a model change that
 # quietly grows the footprint eats the retry margin long before it OOMs),
 # headroom regresses by DROPPING. Measured-profile directions: overlap and
 # the compute share of the step regress by dropping (less hidden comms, more
-# exposed); the comm/moe_a2a/host shares regress by rising.
+# exposed); the comm/moe_a2a/host shares regress by rising. Run-ledger
+# directions: goodput_e2e regresses by dropping; every badput fraction, the
+# wasted-step count, and time-to-recovery regress by RISING.
 HIGHER_IS_BETTER = {"tps": True, "mfu": True, "goodput": True, "step_time_s": False,
                     "hbm_gib_peak": False, "hbm_headroom_gib": True,
                     "measured_step_time_s": False, "overlap_frac": True,
                     "measured_frac_compute": True, "measured_frac_comm": False,
-                    "measured_frac_moe_a2a": False, "measured_frac_host": False}
+                    "measured_frac_moe_a2a": False, "measured_frac_host": False,
+                    "goodput_e2e": True, "wasted_steps": False, "recovery_s": False,
+                    **{f"badput/{c}": False for c in _BADPUT_CLASSES},
+                    **{f"recovery_s/{c}": False for c in _FAILURE_CLASSES}}
 
 
 def _metric_basename(metric: str) -> str:
@@ -167,6 +188,26 @@ def _from_benchmark_json(doc: dict[str, Any]) -> dict[str, float]:
     return out
 
 
+def _from_run_ledger(doc: dict[str, Any]) -> dict[str, float]:
+    """A ``run_ledger.json`` document (observability/runledger.py) gates
+    directly: ``goodput_e2e``, ``wasted_steps``, the ``badput/<class>``
+    fractions, and per-failure-class ``recovery_s/<class>`` means."""
+    from automodel_tpu.observability.runledger import gate_metrics
+
+    return gate_metrics(doc)
+
+
+def _from_ledger_section(doc: dict[str, Any]) -> dict[str, float]:
+    """``bench.py --ledger`` attaches the flattened ledger metrics under
+    ``ledger`` in its summary doc; they merge into the cell metrics so one
+    stdout capture gates throughput AND recovery cost."""
+    section = doc.get("ledger")
+    if not isinstance(section, dict):
+        return {}
+    return {k: float(v) for k, v in section.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
+
 def _from_tuner_doc(doc: dict[str, Any]) -> dict[str, float]:
     """``bench.py --tune`` summary doc: the winner's gate-ready metrics ride
     under ``tuner.metrics`` as ``tuned/<cell>/<basename>`` keys, so the same
@@ -189,10 +230,14 @@ def load_run_metrics(path: str) -> dict[str, float]:
     except json.JSONDecodeError:
         doc = None
     if isinstance(doc, dict):
+        if isinstance(doc.get("badput"), dict) and "goodput_e2e" in doc:
+            return _from_run_ledger(doc)  # run_ledger.json
         if isinstance(doc.get("matrix"), list):  # bench.py --matrix summary doc
-            return _from_matrix_rows(doc["matrix"])
+            return {**_from_matrix_rows(doc["matrix"]),
+                    **_from_ledger_section(doc)}
         if "metric" in doc and "value" in doc:
-            return {**_from_bench_line(doc), **_from_tuner_doc(doc)}
+            return {**_from_bench_line(doc), **_from_tuner_doc(doc),
+                    **_from_ledger_section(doc)}
         if "tokens_per_sec" in doc:
             return _from_benchmark_json(doc)
         if "metrics" in doc:  # a baseline file doubles as a synthetic run

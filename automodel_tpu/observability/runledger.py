@@ -1,0 +1,566 @@
+"""Run-lifetime goodput ledger: badput taxonomy across restarts and rollbacks.
+
+``GoodputTracker`` dies with its process, so a supervised run that crashes
+hourly reports the same per-episode ``goodput`` as one that never does — the
+restart backoff, re-init, restore, recompile, and the optimizer steps
+*re-trained* since the last verifiable checkpoint are invisible. This module
+stitches the artifacts every subsystem already writes — the multi-episode
+``training.jsonl`` (run_header + goodput rows stamped with ``episode``),
+``supervisor_report.json`` episodes with their failure taxonomy, and the
+resilience rollback events — into one atomic ``run_ledger.json`` that accounts
+every wall second of the run.
+
+Accounting is interval-union style like ``trace_analysis.py``: each class of
+seconds is carved out of the run's wall window and ``idle`` is defined as the
+remainder, so ``goodput_e2e + sum(badput_frac) == 1`` by construction rather
+than by hope. The badput taxonomy:
+
+- ``restart_backoff`` — supervisor sleep between a death and the next episode
+- ``reinit``          — process boot to goodput-tracker start (imports, mesh,
+  model build) of every episode after the first, plus episodes that died
+  before logging anything
+- ``restore``         — checkpoint restore on resume (the ``restore`` goodput
+  bucket) plus in-process rollback restores (the ``rollback`` bucket)
+- ``recompile``       — the per-episode ``compile`` bucket (a warm restart
+  with a persistent cache shrinks this; the ledger is how you see it)
+- ``wasted_steps``    — device-step time spent re-executing optimizer steps a
+  previous episode already ran past, or steps a rollback discarded
+- ``data_stall`` / ``eval`` / ``checkpoint`` — the matching tracker buckets
+- ``idle``            — everything unaccounted, including the death window
+  between an episode's last metric row and the supervisor reaping it
+
+**Wasted steps** come from step-number overlap between consecutive episode
+segments (a crash-restart resumes from the newest verifiable checkpoint and
+re-trains up to where the dead episode had logged) plus the walk-back recorded
+by ``rollback_done`` events. **Time-to-recovery** is crash -> first productive
+step (the first logged step exceeding everything trained before the failure),
+keyed by the supervisor's ``classify_failure`` taxonomy.
+
+The supervisor updates the ledger after every episode (and on abort); a flat
+``ledger/*`` + ``badput/*`` metric row rides ``supervisor.jsonl``, badput
+spans land on the supervisor timeline, ``tools/goodput_report.py`` renders the
+ledger, and ``regression.py`` gates ``goodput_e2e`` / ``badput/*`` /
+``wasted_steps`` / ``recovery_s`` like any other perf metric
+(docs/observability.md "Run-level goodput & SLOs").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Any
+
+__all__ = [
+    "BADPUT_CLASSES",
+    "RUN_LEDGER_VERSION",
+    "LEDGER_FILENAME",
+    "EpisodeSegment",
+    "segments_from_rows",
+    "wasted_step_counts",
+    "build_ledger",
+    "update_run_ledger",
+    "load_ledger",
+    "validate_ledger",
+    "gate_metrics",
+    "ledger_metric_rows",
+    "emit_timeline_spans",
+]
+
+RUN_LEDGER_VERSION = 1
+LEDGER_FILENAME = "run_ledger.json"
+
+# every wall second of the run lands in exactly one of these, or in goodput
+BADPUT_CLASSES = ("restart_backoff", "reinit", "restore", "recompile",
+                  "wasted_steps", "data_stall", "eval", "checkpoint", "idle")
+
+# goodput-tracker bucket -> ledger badput class for the non-device buckets.
+# ``rollback`` is an in-process restore (params/opt/rng re-loaded from the
+# newest clean checkpoint) — same badput class as the cross-process restore.
+_BUCKET_TO_CLASS = {
+    "compile": "recompile",
+    "data_wait": "data_stall",
+    "restore": "restore",
+    "rollback": "restore",
+    "eval": "eval",
+    "checkpoint": "checkpoint",
+    "idle": "idle",
+}
+
+
+# ------------------------------------------------------------------ segments
+
+
+@dataclasses.dataclass
+class EpisodeSegment:
+    """One episode's slice of the metric stream, reduced for accounting."""
+
+    index: int
+    steps: list[int] = dataclasses.field(default_factory=list)
+    # (ts, step) per trained (loss-carrying) row, stream order
+    step_rows: list[tuple[float, int]] = dataclasses.field(default_factory=list)
+    first_ts: float | None = None
+    last_ts: float | None = None
+    # cumulative goodput state at the segment's last snapshot
+    tracker_wall_s: float = 0.0
+    tracker_end_ts: float | None = None
+    bucket_s: dict[str, float] = dataclasses.field(default_factory=dict)
+    # optimizer steps a rollback_done event discarded (from_step - to_step)
+    rollback_wasted: int = 0
+
+    @property
+    def tracker_start_ts(self) -> float | None:
+        if self.tracker_end_ts is None:
+            return None
+        return self.tracker_end_ts - self.tracker_wall_s
+
+
+def _row_episode(row: dict[str, Any]) -> int | None:
+    ep = row.get("episode")
+    return int(ep) if isinstance(ep, (int, float)) and not isinstance(ep, bool) \
+        else None
+
+
+def segments_from_rows(rows: list[dict[str, Any]]) -> dict[int, EpisodeSegment]:
+    """Group a (possibly multi-episode) metric stream into episode segments.
+
+    Primary key is the ``episode`` stamp the supervisor exports via
+    ``AUTOMODEL_EPISODE``; streams that predate the stamp fall back to
+    splitting on ``run_header`` rows (each episode writes exactly one).
+    """
+    stamped = any(_row_episode(r) is not None for r in rows)
+    out: dict[int, EpisodeSegment] = {}
+    fallback_index = 0
+    seen_header = False
+    for row in rows:
+        if stamped:
+            index = _row_episode(row)
+            if index is None:
+                index = fallback_index
+            else:
+                fallback_index = index
+        else:
+            if row.get("run_header") and seen_header:
+                fallback_index += 1
+            index = fallback_index
+        seen_header = seen_header or bool(row.get("run_header"))
+        seg = out.setdefault(index, EpisodeSegment(index=index))
+        ts = row.get("ts")
+        ts = float(ts) if isinstance(ts, (int, float)) else None
+        if ts is not None:
+            seg.first_ts = ts if seg.first_ts is None else min(seg.first_ts, ts)
+            seg.last_ts = ts if seg.last_ts is None else max(seg.last_ts, ts)
+        if "loss" in row and isinstance(row.get("step"), int):
+            seg.steps.append(row["step"])
+            if ts is not None:
+                seg.step_rows.append((ts, row["step"]))
+        if row.get("resilience/event") == "rollback_done":
+            frm, to = row.get("resilience/from_step"), row.get("resilience/to_step")
+            if isinstance(frm, int) and isinstance(to, int):
+                seg.rollback_wasted += max(frm - to, 0)
+        wall = row.get("goodput_wall_s")
+        if isinstance(wall, (int, float)) and wall >= seg.tracker_wall_s:
+            seg.tracker_wall_s = float(wall)
+            seg.tracker_end_ts = ts if ts is not None else seg.tracker_end_ts
+            seg.bucket_s = {
+                k.split("/", 1)[1]: max(float(v), 0.0) * float(wall)
+                for k, v in row.items()
+                if k.startswith("goodput/") and isinstance(v, (int, float))
+            }
+    for seg in out.values():
+        seg.steps.sort()
+    return out
+
+
+def wasted_step_counts(
+    segments: dict[int, EpisodeSegment],
+) -> tuple[int, dict[int, int]]:
+    """(total, per-episode) optimizer steps whose work was thrown away.
+
+    Two sources: step-number overlap between consecutive episode segments
+    (a restart resumes from the newest verifiable checkpoint and re-executes
+    everything the dead episode had already logged past it — elastic resumes
+    included, the optimizer-step numbering is topology-invariant), and the
+    walk-back recorded by in-process ``rollback_done`` events (steps trained
+    and then discarded when params rewound).
+    """
+    per: dict[int, int] = {}
+    prev_max: int | None = None
+    total = 0
+    for index in sorted(segments):
+        seg = segments[index]
+        overlap = 0
+        if prev_max is not None:
+            overlap = sum(1 for s in seg.steps if s <= prev_max)
+        per[index] = overlap + seg.rollback_wasted
+        total += per[index]
+        if seg.steps:
+            prev_max = max(prev_max, seg.steps[-1]) if prev_max is not None \
+                else seg.steps[-1]
+    return total, per
+
+
+# ------------------------------------------------------------------ ledger
+
+
+def _report_episodes(report: dict[str, Any] | None) -> dict[int, dict[str, Any]]:
+    out: dict[int, dict[str, Any]] = {}
+    for ep in (report or {}).get("episodes", []) or []:
+        if isinstance(ep, dict) and isinstance(ep.get("index"), int):
+            out[ep["index"]] = ep
+    return out
+
+
+def build_ledger(rows: list[dict[str, Any]],
+                 report: dict[str, Any] | None = None) -> dict[str, Any] | None:
+    """Reduce a run's artifacts to the run-lifetime goodput ledger document.
+
+    ``rows`` is the parsed multi-episode training.jsonl; ``report`` the
+    supervisor report (None for unsupervised runs — the ledger then covers
+    the logged window only, with no backoff/reinit attribution). Returns None
+    when there is nothing to account (no rows and no episodes).
+    """
+    segments = segments_from_rows(rows)
+    rep_eps = _report_episodes(report)
+    indices = sorted(set(segments) | set(rep_eps))
+    if not indices:
+        return None
+    wasted_total, wasted_per = wasted_step_counts(segments)
+
+    # -- per-episode wall windows -------------------------------------------
+    windows: dict[int, tuple[float, float]] = {}
+    clock = 0.0  # synthetic clock for segments with no timestamps at all
+    for index in indices:
+        seg = segments.get(index)
+        rep = rep_eps.get(index, {})
+        start = rep.get("started")
+        start = float(start) if isinstance(start, (int, float)) else None
+        if start is None and seg is not None:
+            cands = [t for t in (seg.tracker_start_ts, seg.first_ts)
+                     if t is not None]
+            start = min(cands) if cands else None
+        dur = rep.get("duration_s")
+        end = start + float(dur) if start is not None \
+            and isinstance(dur, (int, float)) else None
+        if end is None and seg is not None and seg.last_ts is not None:
+            end = seg.last_ts if start is None else max(seg.last_ts, start)
+        if start is None:
+            start = end if end is not None else clock
+        if end is None:
+            end = start
+        windows[index] = (start, max(end, start))
+        clock = max(clock, end)
+
+    # -- seconds accounting --------------------------------------------------
+    goodput_s = 0.0
+    totals = {c: 0.0 for c in BADPUT_CLASSES}
+    episodes_out: list[dict[str, Any]] = []
+    for pos, index in enumerate(indices):
+        seg = segments.get(index)
+        rep = rep_eps.get(index, {})
+        start, end = windows[index]
+        ep_sec = {c: 0.0 for c in BADPUT_CLASSES}
+        ep_good = 0.0
+        if seg is not None and seg.tracker_end_ts is not None:
+            t_start = seg.tracker_start_ts
+            ep_sec["reinit"] += max(t_start - start, 0.0)
+            # tracker-window buckets; the snapshot fractions were rounded, so
+            # any slack between their sum and the tracker wall goes to idle
+            dev = seg.bucket_s.get("device_step", 0.0)
+            accounted = 0.0
+            for bucket, sec in seg.bucket_s.items():
+                cls = _BUCKET_TO_CLASS.get(bucket)
+                if cls is not None:
+                    ep_sec[cls] += sec
+                    accounted += sec
+            n_steps = len(seg.steps)
+            wasted_frac = min(wasted_per.get(index, 0) / n_steps, 1.0) \
+                if n_steps else (1.0 if wasted_per.get(index) else 0.0)
+            ep_sec["wasted_steps"] += dev * wasted_frac
+            ep_good += dev * (1.0 - wasted_frac)
+            accounted += dev
+            ep_sec["idle"] += max(seg.tracker_wall_s - accounted, 0.0)
+            # death/teardown window after the last snapshot
+            ep_sec["idle"] += max(end - seg.tracker_end_ts, 0.0)
+        else:
+            # died (or was reaped) before the tracker ever snapshot: the
+            # whole episode is initialization that never paid off
+            ep_sec["reinit"] += end - start
+        if pos + 1 < len(indices):
+            nxt_start = windows[indices[pos + 1]][0]
+            ep_sec["restart_backoff"] += max(nxt_start - end, 0.0)
+        goodput_s += ep_good
+        for c, v in ep_sec.items():
+            totals[c] += v
+        steps = seg.steps if seg is not None else []
+        episodes_out.append({
+            "index": index,
+            "taxonomy": rep.get("taxonomy"),
+            "hang": bool(rep.get("hang", False)),
+            "start_ts": round(start, 3),
+            "end_ts": round(end, 3),
+            "steps": [steps[0], steps[-1]] if steps else None,
+            "trained_steps": len(steps),
+            "wasted_steps": wasted_per.get(index, 0),
+            "seconds": {"goodput": round(ep_good, 3),
+                        **{c: round(v, 3) for c, v in ep_sec.items()}},
+        })
+
+    # -- close the books: idle is the remainder, fractions sum to 1 ----------
+    run_start = windows[indices[0]][0]
+    run_end = max(w[1] for w in windows.values())
+    accounted = goodput_s + sum(totals.values())
+    measured = run_end - run_start
+    if measured > accounted:
+        totals["idle"] += measured - accounted
+        wall = measured
+    else:
+        # clock skew between row timestamps and the supervisor's wall clock:
+        # the components are the ground truth, the window stretches to fit
+        wall = accounted
+    wall = max(wall, 1e-9)
+    badput_frac = {c: round(totals[c] / wall, 6) for c in BADPUT_CLASSES
+                   if c != "idle"}
+    goodput_e2e = round(goodput_s / wall, 6)
+    # idle absorbs the rounding so the fractions sum to exactly 1
+    badput_frac["idle"] = round(1.0 - goodput_e2e - sum(badput_frac.values()), 6)
+
+    # -- recovery: failure -> first productive step --------------------------
+    all_step_rows = sorted(
+        (ts, step, seg.index)
+        for seg in segments.values() for ts, step in seg.step_rows)
+    recovery: dict[str, list[float]] = {}
+    for ep in episodes_out:
+        if ep["taxonomy"] is None:
+            continue
+        fail_end = ep["end_ts"]
+        prev_max = max(
+            (segments[i].steps[-1] for i in segments
+             if i <= ep["index"] and segments[i].steps), default=None)
+        rec = None
+        for ts, step, seg_index in all_step_rows:
+            if seg_index <= ep["index"]:
+                continue
+            if prev_max is None or step > prev_max:
+                rec = max(ts - fail_end, 0.0)
+                break
+        ep["recovery_s"] = round(rec, 3) if rec is not None else None
+        if rec is not None:
+            recovery.setdefault(ep["taxonomy"], []).append(rec)
+
+    run_id = (report or {}).get("run_id")
+    if run_id is None:
+        run_id = next((r.get("run_id") for r in rows
+                       if r.get("run_header") and r.get("run_id")), None)
+    all_steps = [s for seg in segments.values() for s in seg.steps]
+    return {
+        "version": RUN_LEDGER_VERSION,
+        "run_id": run_id,
+        "status": (report or {}).get("status", "unsupervised"),
+        "restarts": int((report or {}).get("restarts", max(len(indices) - 1, 0))),
+        "wall_s": round(wall, 3),
+        "goodput_s": round(goodput_s, 3),
+        "goodput_e2e": goodput_e2e,
+        "badput": {c: round(totals[c], 3) for c in BADPUT_CLASSES},
+        "badput_frac": badput_frac,
+        "wasted_steps": wasted_total,
+        "productive_steps": len(set(all_steps)),
+        "final_step": max(all_steps) if all_steps else None,
+        "recovery": {
+            cls: {"count": len(vals),
+                  "mean_s": round(sum(vals) / len(vals), 3),
+                  "max_s": round(max(vals), 3)}
+            for cls, vals in sorted(recovery.items())
+        },
+        "episodes": episodes_out,
+    }
+
+
+# ------------------------------------------------------------------ file IO
+
+
+def _atomic_write_json(path: str, doc: dict[str, Any]) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=".run_ledger.", dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _read_jsonl(path: str) -> list[dict[str, Any]]:
+    rows: list[dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # a torn tail line must not sink the ledger
+                if isinstance(row, dict):
+                    rows.append(row)
+    except OSError:
+        pass
+    return rows
+
+
+def update_run_ledger(out_dir: str,
+                      report: dict[str, Any] | None = None) -> dict[str, Any] | None:
+    """Rebuild ``<out_dir>/run_ledger.json`` from the run's artifacts.
+
+    Idempotent and crash-safe (tmp + rename); called by the supervisor after
+    every episode and by ``tools/goodput_report.py`` on demand. ``report``
+    defaults to the on-disk ``supervisor_report.json`` when present.
+    """
+    rows = _read_jsonl(os.path.join(out_dir, "training.jsonl"))
+    if report is None:
+        try:
+            with open(os.path.join(out_dir, "supervisor_report.json")) as f:
+                report = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            report = None
+    ledger = build_ledger(rows, report=report)
+    if ledger is None:
+        return None
+    _atomic_write_json(os.path.join(out_dir, LEDGER_FILENAME), ledger)
+    return ledger
+
+
+def load_ledger(path: str) -> dict[str, Any]:
+    """Read a ledger document; ``path`` may be the file or the run directory."""
+    if os.path.isdir(path):
+        path = os.path.join(path, LEDGER_FILENAME)
+    with open(path) as f:
+        return json.load(f)
+
+
+# ------------------------------------------------------------------ schema
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_ledger(doc: Any) -> list[str]:
+    """Schema problems with a ledger document; empty list = valid."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return ["ledger is not a JSON object"]
+    if doc.get("version") != RUN_LEDGER_VERSION:
+        problems.append(f"version {doc.get('version')!r} != {RUN_LEDGER_VERSION}")
+    if not _is_num(doc.get("wall_s")) or doc.get("wall_s", 0) <= 0:
+        problems.append("wall_s missing or non-positive")
+    g = doc.get("goodput_e2e")
+    if not _is_num(g) or not 0.0 <= g <= 1.0:
+        problems.append("goodput_e2e missing or outside [0, 1]")
+    for field in ("badput", "badput_frac"):
+        sec = doc.get(field)
+        if not isinstance(sec, dict) or set(sec) != set(BADPUT_CLASSES):
+            problems.append(f"{field} keys != badput taxonomy")
+            continue
+        bad = [c for c, v in sec.items() if not _is_num(v) or v < 0]
+        if bad:
+            problems.append(f"{field} has negative/non-numeric classes: {bad}")
+    if isinstance(doc.get("badput_frac"), dict) and _is_num(g):
+        fracs = [v for v in doc["badput_frac"].values() if _is_num(v)]
+        if abs(g + sum(fracs) - 1.0) > 1e-3:
+            problems.append(
+                f"goodput_e2e + sum(badput_frac) = {g + sum(fracs):.6f} != 1")
+    if not isinstance(doc.get("wasted_steps"), int) or doc["wasted_steps"] < 0:
+        problems.append("wasted_steps missing or negative")
+    eps = doc.get("episodes")
+    if not isinstance(eps, list) or not eps:
+        problems.append("episodes missing or empty")
+    else:
+        for ep in eps:
+            if not isinstance(ep, dict) or not isinstance(ep.get("index"), int):
+                problems.append(f"malformed episode entry: {ep!r}")
+                continue
+            sec = ep.get("seconds")
+            if not isinstance(sec, dict) or "goodput" not in sec:
+                problems.append(f"episode {ep['index']}: seconds malformed")
+    rec = doc.get("recovery")
+    if not isinstance(rec, dict):
+        problems.append("recovery missing")
+    else:
+        for cls, st in rec.items():
+            if not isinstance(st, dict) or not _is_num(st.get("mean_s")) \
+                    or st.get("mean_s", 0) < 0 or not st.get("count"):
+                problems.append(f"recovery[{cls!r}] malformed")
+    return problems
+
+
+# ------------------------------------------------------------------ emission
+
+
+def gate_metrics(ledger: dict[str, Any]) -> dict[str, float]:
+    """Flatten a ledger into regression-gateable metrics: ``goodput_e2e``,
+    ``wasted_steps``, ``badput/<class>`` fractions, and per-failure-class
+    ``recovery_s/<class>`` mean seconds."""
+    out: dict[str, float] = {}
+    if _is_num(ledger.get("goodput_e2e")):
+        out["goodput_e2e"] = float(ledger["goodput_e2e"])
+    if _is_num(ledger.get("wasted_steps")):
+        out["wasted_steps"] = float(ledger["wasted_steps"])
+    for cls, frac in (ledger.get("badput_frac") or {}).items():
+        if _is_num(frac):
+            out[f"badput/{cls}"] = float(frac)
+    for cls, st in (ledger.get("recovery") or {}).items():
+        if isinstance(st, dict) and _is_num(st.get("mean_s")):
+            out[f"recovery_s/{cls}"] = float(st["mean_s"])
+    return out
+
+
+def ledger_metric_rows(ledger: dict[str, Any]) -> dict[str, Any]:
+    """One flat ``ledger/*`` + ``badput/*`` row for the supervisor's metric
+    stream — the run-level counterpart of the per-step goodput snapshot."""
+    row: dict[str, Any] = {
+        "ledger/goodput_e2e": ledger.get("goodput_e2e"),
+        "ledger/wall_s": ledger.get("wall_s"),
+        "ledger/wasted_steps": ledger.get("wasted_steps"),
+        "ledger/episodes": len(ledger.get("episodes") or []),
+    }
+    for cls, frac in (ledger.get("badput_frac") or {}).items():
+        row[f"badput/{cls}"] = frac
+    for cls, st in (ledger.get("recovery") or {}).items():
+        if isinstance(st, dict):
+            row[f"ledger/recovery_s/{cls}"] = st.get("mean_s")
+    return row
+
+
+def emit_timeline_spans(ledger: dict[str, Any], timeline: Any,
+                        episode_t0s: list[float] | None = None) -> None:
+    """Chrome-trace badput spans on the supervisor timeline (tid 4): one span
+    per episode per non-zero class, laid out sequentially inside the episode's
+    window so Perfetto shows where each episode's wall clock went next to the
+    ``supervisor/episode_*`` spans."""
+    if timeline is None:
+        return
+    t0s = episode_t0s or []
+    cursor = 0.0
+    for pos, ep in enumerate(ledger.get("episodes") or []):
+        sec = ep.get("seconds") or {}
+        t = t0s[pos] if pos < len(t0s) else cursor
+        for cls in ("goodput",) + BADPUT_CLASSES:
+            dur = sec.get(cls)
+            if not _is_num(dur) or dur <= 0:
+                continue
+            name = "goodput_e2e" if cls == "goodput" else f"badput/{cls}"
+            cat = "goodput" if cls == "goodput" else "badput"
+            timeline.complete(name, cat, t, dur, tid=4,
+                              episode=ep.get("index"),
+                              taxonomy=ep.get("taxonomy"))
+            t += dur
+        cursor = t

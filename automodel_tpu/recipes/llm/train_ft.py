@@ -107,6 +107,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         # unverified events during _maybe_resume) buffer here; flushed once the
         # loggers come up
         self._deferred_events: list[tuple[int, dict]] = []
+        # wall seconds _maybe_resume spent restoring (observability does not
+        # exist yet at that point; back-billed to the `restore` goodput bucket
+        # once it does, so resume cost stops vanishing into idle)
+        self._restore_s = 0.0
         self.dist = initialize_distributed(auto=bool(cfg.get("distributed.auto_init", False)))
         self.rng = StatefulRNG(seed=int(cfg.get("seed", 42)))
 
@@ -284,6 +288,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self.observability = Observability.from_config(
             cfg.get("observability"), out_dir, metric_sink=self._log_event
         )
+        # back-bill the checkpoint restore _maybe_resume already paid for
+        # (satellite of the run ledger: resume cost must not read as idle)
+        if self._restore_s:
+            self.observability.record_restore(self._restore_s)
         # axis sizes let the compile-cost row attribute collective bytes to
         # ep/dp/tp/pp (and the roofline grow its moe_a2a bound category)
         self.observability.mesh_axes = {
@@ -728,6 +736,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
     def _maybe_resume(self):
         if not self.checkpointer.config.enabled:
             return
+        t0 = time.perf_counter()
         # verified restore with walk-back: a truncated/corrupt latest step falls
         # back to the newest step that passes its integrity manifest, agreed
         # across hosts (docs/resilience.md). load_latest_verified returns None
@@ -749,6 +758,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         if elastic is not None and el.enabled:
             self._repartition_client_state(client, host_rows, step)
         self._apply_client_state(client)
+        self._restore_s = time.perf_counter() - t0
 
     def _repartition_client_state(self, client: dict, host_rows, step: int):
         """Elastic resume (docs/resilience.md): Orbax already resharded the

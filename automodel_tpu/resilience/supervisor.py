@@ -62,6 +62,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "HEARTBEAT_ENV",
+    "EPISODE_ENV",
     "SUPERVISOR_REPORT_VERSION",
     "HeartbeatWriter",
     "read_heartbeat",
@@ -72,7 +73,13 @@ __all__ = [
 ]
 
 HEARTBEAT_ENV = "AUTOMODEL_HEARTBEAT_FILE"
-SUPERVISOR_REPORT_VERSION = 1
+# JSON {"index": episode, "run_id": ...} exported to every child so the
+# MetricLogger can stamp episode identity into the shared training.jsonl
+# (loggers/metric_logger.py duplicates the literal to stay import-light)
+EPISODE_ENV = "AUTOMODEL_EPISODE"
+# v2: run-level run_id/started, per-episode started timestamps (the run
+# ledger stitches episode wall windows from them)
+SUPERVISOR_REPORT_VERSION = 2
 
 # -------------------------------------------------------------- heartbeat file
 
@@ -350,9 +357,13 @@ class Supervisor:
         self._popen = popen
         self._sleep = sleep
         self._metric_sink = metric_sink
+        self.run_id = f"{int(time.time()):x}-{os.getpid():x}"
+        self._episode_t0s: list[float] = []
         self.report: dict[str, Any] = {
             "version": SUPERVISOR_REPORT_VERSION,
             "argv": self.argv,
+            "run_id": self.run_id,
+            "started": round(time.time(), 3),
             "status": "running",
             "restarts": 0,
             "max_restarts": int(self.config.max_restarts),
@@ -372,8 +383,10 @@ class Supervisor:
             pass
         env = dict(self.env)
         env[HEARTBEAT_ENV] = self.heartbeat_path
+        env[EPISODE_ENV] = json.dumps({"index": index, "run_id": self.run_id})
         started = time.time()
         t0 = self.timeline.now()
+        self._episode_t0s.append(t0)
         child = self._popen(self.argv, env=env, stderr=subprocess.PIPE,
                             text=True)
         tee = _StderrTee(child.stderr, cfg.stderr_tail_lines)
@@ -407,6 +420,7 @@ class Supervisor:
         episode: dict[str, Any] = {
             "index": index,
             "returncode": rc,
+            "started": round(started, 3),
             "duration_s": round(duration, 3),
             "hang": hang,
             "heartbeat_step": (last_beat or {}).get("step"),
@@ -461,6 +475,26 @@ class Supervisor:
     def _write_report(self) -> None:
         _atomic_write_json(self.report_path, self.report)
 
+    def _update_ledger(self, final: bool = False) -> None:
+        """Rebuild ``run_ledger.json`` from the artifacts on disk (after every
+        episode and at terminal states) and emit its flat ``ledger/*`` row.
+        Badput timeline spans land only once, at the terminal update, so the
+        trace carries one consolidated lane. Ledger failure never takes the
+        supervisor down — accounting is forensics, not control flow."""
+        try:
+            from automodel_tpu.observability import runledger
+
+            ledger = runledger.update_run_ledger(self.out_dir,
+                                                 report=self.report)
+            if ledger is None:
+                return
+            self._emit(runledger.ledger_metric_rows(ledger))
+            if final:
+                runledger.emit_timeline_spans(ledger, self.timeline,
+                                              self._episode_t0s)
+        except Exception:
+            logger.debug("run ledger update failed", exc_info=True)
+
     # -- run loop -----------------------------------------------------------
     def run(self) -> int:
         """Supervise until the child exits 0, or the restart budget is spent.
@@ -483,6 +517,9 @@ class Supervisor:
             if episode["returncode"] == 0 and not episode["hang"]:
                 self.report["status"] = "completed"
                 self._write_report()
+                # ledger row first: the episode row stays the stream's last
+                # line, which is what log tails (and tests) key off
+                self._update_ledger(final=True)
                 self._emit(row)
                 self.timeline.close()
                 return 0
@@ -494,6 +531,7 @@ class Supervisor:
                     f"restart budget exhausted after {restarts} restarts; "
                     f"last failure: {episode.get('taxonomy', 'unknown')}")
                 self._write_report()
+                self._update_ledger(final=True)
                 self._emit(row)
                 self.timeline.close()
                 logger.error("supervisor: %s", self.report["abort_reason"])
@@ -504,6 +542,7 @@ class Supervisor:
             self._emit(row)
             self.report["status"] = "restarting"
             self._write_report()
+            self._update_ledger()
             self.timeline.instant(
                 f"supervisor/restart_{restarts}", "supervisor",
                 taxonomy=episode.get("taxonomy"), delay_s=round(delay, 3))

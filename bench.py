@@ -1209,6 +1209,23 @@ def main(argv: list[str] | None = None) -> int:
     tune = "--tune" in argv
     tune_dir = _flag_value(argv, "--tune-dir") or "tuned"
     tune_baseline = _flag_value(argv, "--tune-baseline")
+    # --ledger RUN_DIR|run_ledger.json: merge the run-lifetime goodput ledger
+    # (observability/runledger.py) into the summary doc as gate-able
+    # goodput_e2e / badput/* / wasted_steps / recovery_s keys, so one capture
+    # gates throughput AND recovery cost (docs/observability.md)
+    ledger_path = _flag_value(argv, "--ledger")
+
+    def _emit_doc(doc: dict) -> None:
+        if ledger_path:
+            try:
+                from automodel_tpu.observability import runledger
+
+                doc["ledger"] = runledger.gate_metrics(
+                    runledger.load_ledger(ledger_path))
+            except Exception as exc:  # noqa: BLE001 — a bad ledger must not
+                # sink the bench line; the error is named instead
+                doc.setdefault("extra", {})["ledger_error"] = repr(exc)
+        print(json.dumps(doc), flush=True)
     # matrix isolation knobs (resilience/harness.py)
     matrix_dir = _flag_value(argv, "--matrix-dir") or "bench_matrix"
     resume = "--resume" in argv
@@ -1277,7 +1294,7 @@ def main(argv: list[str] | None = None) -> int:
             else:
                 doc = (_matrix(cpu=True)
                        if matrix else _cpu_fallback_bench(dynamics=dynamics))
-            print(json.dumps(doc), flush=True)
+            _emit_doc(doc)
             return 0 if doc.get("ok") else 1
         except Exception as exc:  # noqa: BLE001 — the JSON contract is the point
             sys.stderr.flush()
@@ -1301,7 +1318,7 @@ def main(argv: list[str] | None = None) -> int:
                 doc = (_matrix(cpu=True)
                        if matrix else _cpu_fallback_bench(dynamics=dynamics))
             doc.setdefault("extra", {})["fallback_reason"] = "default backend is cpu"
-            print(json.dumps(doc), flush=True)
+            _emit_doc(doc)
             return 0 if doc.get("ok") else 1
         try:
             _canary_dispatch()
@@ -1315,7 +1332,7 @@ def main(argv: list[str] | None = None) -> int:
         else:
             doc = (_matrix(cpu=False)
                    if matrix else _full_bench(dynamics=dynamics))
-        print(json.dumps(doc), flush=True)
+        _emit_doc(doc)
         return 0 if doc.get("ok") else 1
     except Exception as exc:  # noqa: BLE001
         import traceback

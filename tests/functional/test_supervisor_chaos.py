@@ -11,11 +11,21 @@ launches tools/supervise.py around the real train recipe with chaos injection:
 - ``torn``: SIGKILL inside an async save -> the torn step is walked back past
   on restart (``.saving`` marker + no manifest), re-saved, and CRC-verifies.
 
+The ``supervise`` phase also validates the run-lifetime goodput ledger the
+supervisor writes over the chaos run (schema, fractions summing to 1, wasted
+steps, per-class recovery, SLO gate — docs/observability.md "Run-level
+goodput & SLOs"); ``test_run_ledger_counts_retrained_steps`` is the focused
+kill-only version of that assertion.
+
 The process-level supervisor mechanics (poll/kill/reap, budget, heartbeat)
-have fast coverage in tests/unit/test_supervisor.py.
+have fast coverage in tests/unit/test_supervisor.py; the ledger math has
+fast coverage in tests/unit/test_runledger.py.
 """
 
+import json
+import os
 import sys
+import textwrap
 from pathlib import Path
 
 import pytest
@@ -29,6 +39,46 @@ def test_supervisor_recovers_kill_and_hang(tmp_path, cpu_devices):
     import supervisor_smoke
 
     assert supervisor_smoke.main(str(tmp_path), phase="supervise") == 0
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_run_ledger_counts_retrained_steps(tmp_path, cpu_devices):
+    # one SIGKILL at step 6, resume from the step-4 checkpoint: the ledger
+    # must count the re-trained steps as wasted and give the crash a finite
+    # time-to-recovery
+    import supervisor_smoke as sm
+
+    from automodel_tpu.observability import runledger
+
+    kill_only = textwrap.dedent(f"""\
+    resilience:
+      enabled: true
+      chaos:
+        enabled: true
+        kill_at_step: [{sm.KILL_STEP}]
+    """)
+    cfg = sm._write_cfg(str(tmp_path), "killonly", ckpt=True, chaos=True,
+                        max_steps=10, resilience=kill_only)
+    out_dir = os.path.join(str(tmp_path), "killonly", "out")
+    assert sm._supervise(cfg, out_dir, max_restarts=2) == 0
+
+    ledger = runledger.load_ledger(out_dir)
+    assert runledger.validate_ledger(ledger) == []
+    total = ledger["goodput_e2e"] + sum(ledger["badput_frac"].values())
+    assert abs(total - 1.0) < 1e-3
+    # kill@6 with ckpt_every=4 -> episode 1 re-trains step 5 (and 6)
+    assert ledger["wasted_steps"] > 0
+    assert ledger["episodes"][1]["wasted_steps"] > 0
+    ep0 = ledger["episodes"][0]
+    assert ep0["taxonomy"] in ("crash", "unknown")
+    assert ep0["recovery_s"] is not None and 0.0 <= ep0["recovery_s"] < 300.0
+    assert ledger["recovery"][ep0["taxonomy"]]["count"] == 1
+    # episode stamps made the segments attributable
+    with open(os.path.join(out_dir, "training.jsonl")) as f:
+        rows = [json.loads(line) for line in f]
+    episodes = {r.get("episode") for r in rows if "loss" in r}
+    assert episodes == {0, 1}
 
 
 @pytest.mark.chaos

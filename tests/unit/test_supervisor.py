@@ -192,3 +192,70 @@ class TestSupervisor:
             os.path.join(sup.out_dir, "supervisor_timeline.json")))
         names = {e.get("name") for e in timeline["traceEvents"]}
         assert "supervisor/episode_0" in names
+
+    def test_episode_env_exported_with_index_and_run_id(self, tmp_path):
+        # the child sees {"index", "run_id"} and the index advances per episode
+        marker = str(tmp_path / "second_run")
+        src = ("import json,os,sys\n"
+               "ep=json.loads(os.environ['AUTOMODEL_EPISODE'])\n"
+               "assert isinstance(ep['index'],int) and ep['run_id']\n"
+               "p=sys.argv[1]\n"
+               "if os.path.exists(p): sys.exit(0 if ep['index']==1 else 7)\n"
+               "open(p,'w').write('x')\n"
+               "sys.exit(1 if ep['index']==0 else 7)\n")
+        rc, sup = _run(tmp_path, src, marker, max_restarts=2)
+        # episode 0 dies after asserting its index; the restarted child only
+        # exits 0 when it sees index 1 — rc==0 proves the stamp advanced
+        assert rc == 0
+        assert len(json.load(open(sup.report_path))["episodes"]) == 2
+
+    def test_report_v2_has_run_identity_and_episode_starts(self, tmp_path):
+        rc, sup = _run(tmp_path, "import sys; sys.exit(3)", max_restarts=1)
+        report = json.load(open(sup.report_path))
+        assert report["version"] == 2
+        assert report["run_id"] == sup.run_id
+        assert report["started"] > 0
+        starts = [ep["started"] for ep in report["episodes"]]
+        assert len(starts) == 2 and starts[0] <= starts[1]
+
+    def test_run_ledger_written_from_child_metric_stream(self, tmp_path):
+        # end to end: the child stamps its episode into training.jsonl via the
+        # real MetricLogger env contract, dies once, and the supervisor's
+        # ledger counts the re-trained step + a finite crash recovery time
+        src = (
+            "import json,os,sys,time\n"
+            "ep=json.loads(os.environ['AUTOMODEL_EPISODE'])['index']\n"
+            "steps=[1,2,3] if ep==0 else [3,4,5]\n"
+            "with open(os.path.join(sys.argv[1],'training.jsonl'),'a') as f:\n"
+            "    for s in steps:\n"
+            "        f.write(json.dumps({'step':s,'ts':time.time(),"
+            "'episode':ep,'loss':1.0})+'\\n')\n"
+            "    f.write(json.dumps({'step':steps[-1],'ts':time.time(),"
+            "'episode':ep,'loss':1.0,'goodput_wall_s':0.2,"
+            "'goodput/device_step':1.0})+'\\n')\n"
+            "sys.exit(9 if ep==0 else 0)\n")
+        rc, sup = _run(tmp_path, src, str(tmp_path / "out"), max_restarts=2)
+        assert rc == 0
+        from automodel_tpu.observability import runledger
+        ledger = runledger.load_ledger(sup.out_dir)
+        assert runledger.validate_ledger(ledger) == []
+        assert ledger["wasted_steps"] == 1  # step 3 re-trained after the crash
+        assert ledger["restarts"] == 1
+        assert ledger["run_id"] == sup.run_id
+        ep0 = ledger["episodes"][0]
+        assert ep0["taxonomy"] == "unknown"
+        assert ep0["recovery_s"] is not None and ep0["recovery_s"] >= 0.0
+        assert ledger["recovery"]["unknown"]["count"] == 1
+        # the supervisor metric stream carries the flat ledger row
+        rows = [json.loads(ln) for ln in
+                open(os.path.join(sup.out_dir, "supervisor.jsonl"))]
+        ledger_rows = [r for r in rows if "ledger/goodput_e2e" in r]
+        assert ledger_rows, "no ledger/* row emitted"
+        assert ledger_rows[-1]["ledger/episodes"] == 2
+        assert "badput/idle" in ledger_rows[-1]
+        # badput spans land on the terminal timeline
+        timeline = json.load(open(
+            os.path.join(sup.out_dir, "supervisor_timeline.json")))
+        names = {e.get("name") for e in timeline["traceEvents"]}
+        assert "badput/wasted_steps" in names
+        assert "goodput_e2e" in names

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Render a run's run-lifetime goodput ledger (docs/observability.md
+"Run-level goodput & SLOs").
+
+    python tools/goodput_report.py RUN_DIR            # table from run_ledger.json
+    python tools/goodput_report.py RUN_DIR --rebuild  # restitch from artifacts
+    python tools/goodput_report.py RUN_DIR --json     # the ledger document
+
+The supervisor keeps ``run_ledger.json`` current after every episode;
+``--rebuild`` restitches it from ``training.jsonl`` + ``supervisor_report.json``
+(useful for unsupervised runs, or after hand-editing artifacts in a postmortem).
+Exit codes: 0 = rendered, 1 = schema problems, 2 = no ledger and nothing to
+build one from.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from automodel_tpu.observability import runledger  # noqa: E402
+
+
+def _fmt_table(ledger: dict) -> str:
+    lines = []
+    wall = ledger.get("wall_s") or 0.0
+    lines.append(f"run {ledger.get('run_id') or '?'}  status={ledger.get('status')}  "
+                 f"wall={wall:.1f}s  episodes={len(ledger.get('episodes') or [])}  "
+                 f"restarts={ledger.get('restarts')}")
+    lines.append(f"goodput_e2e {ledger.get('goodput_e2e', 0.0) * 100:6.2f}%  "
+                 f"({ledger.get('goodput_s', 0.0):.1f}s device-step that stuck)")
+    lines.append(f"{'badput class':<18} {'seconds':>10} {'frac':>8}")
+    badput = ledger.get("badput") or {}
+    fracs = ledger.get("badput_frac") or {}
+    for cls in runledger.BADPUT_CLASSES:
+        sec = badput.get(cls, 0.0)
+        if not sec:
+            continue
+        lines.append(f"{cls:<18} {sec:>10.2f} {fracs.get(cls, 0.0) * 100:>7.2f}%")
+    lines.append(f"wasted_steps={ledger.get('wasted_steps')}  "
+                 f"productive_steps={ledger.get('productive_steps')}  "
+                 f"final_step={ledger.get('final_step')}")
+    rec = ledger.get("recovery") or {}
+    if rec:
+        lines.append(f"{'recovery class':<18} {'count':>6} {'mean_s':>9} {'max_s':>9}")
+        for cls, st in rec.items():
+            lines.append(f"{cls:<18} {st.get('count', 0):>6} "
+                         f"{st.get('mean_s', 0.0):>9.2f} {st.get('max_s', 0.0):>9.2f}")
+    for ep in ledger.get("episodes") or []:
+        steps = ep.get("steps")
+        span = f"steps {steps[0]}..{steps[1]}" if steps else "no steps"
+        tail = f"  recovery={ep['recovery_s']:.2f}s" \
+            if ep.get("recovery_s") is not None else ""
+        lines.append(f"  episode {ep['index']}: {span}  "
+                     f"wasted={ep.get('wasted_steps', 0)}  "
+                     f"taxonomy={ep.get('taxonomy') or '-'}{tail}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="goodput_report",
+                                     description=__doc__.splitlines()[0])
+    parser.add_argument("run_dir", help="run directory (or a run_ledger.json path)")
+    parser.add_argument("--json", action="store_true",
+                        help="print the ledger document instead of the table")
+    parser.add_argument("--rebuild", action="store_true",
+                        help="restitch the ledger from the run's artifacts "
+                             "before rendering")
+    args = parser.parse_args(argv)
+
+    path = args.run_dir
+    run_dir = path if os.path.isdir(path) else os.path.dirname(path) or "."
+    ledger = None
+    if args.rebuild or (os.path.isdir(path) and not os.path.exists(
+            os.path.join(path, runledger.LEDGER_FILENAME))):
+        ledger = runledger.update_run_ledger(run_dir)
+    if ledger is None:
+        try:
+            ledger = runledger.load_ledger(path)
+        except (OSError, json.JSONDecodeError):
+            ledger = runledger.update_run_ledger(run_dir)
+    if ledger is None:
+        print(f"goodput_report: no ledger at {path} and no artifacts to "
+              f"build one from (training.jsonl / supervisor_report.json)",
+              file=sys.stderr)
+        return 2
+    problems = runledger.validate_ledger(ledger)
+    if args.json:
+        print(json.dumps(ledger, indent=2, sort_keys=True))
+    else:
+        print(_fmt_table(ledger))
+    for p in problems:
+        print(f"goodput_report: SCHEMA: {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

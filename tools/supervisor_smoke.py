@@ -141,10 +141,75 @@ def phase_supervise(root: str) -> None:
         names = {ev.get("name") for ev in json.load(f).get("traceEvents", [])}
     for want in ("supervisor/episode_0", "supervisor/episode_1",
                  "supervisor/episode_2", "supervisor/restart_1",
-                 "supervisor/restart_2"):
+                 "supervisor/restart_2", "goodput_e2e"):
         assert want in names, f"timeline lacks {want}: {sorted(names)}"
+
+    _check_run_ledger(root, out_dir, report)
     print(f"[supervisor_smoke]     taxonomies {taxonomies}, "
           f"{len(steps)} distinct steps, final loss {losses[-1]:.3f}")
+
+
+def _check_run_ledger(root: str, out_dir: str, report: dict) -> None:
+    """The acceptance criterion end to end: the chaos run left an atomic,
+    schema-valid run_ledger.json whose fractions sum to 1 with the kill's
+    re-trained steps counted, per-episode classes matching the supervisor's
+    taxonomy, finite recovery times — and bench_gate exits non-zero when
+    goodput_e2e regresses against a baseline written from the real ledger
+    (docs/observability.md "Run-level goodput & SLOs")."""
+    from automodel_tpu.observability import regression, runledger
+
+    print("[supervisor_smoke] supervise: run ledger + SLO gate ...")
+    ledger = runledger.load_ledger(out_dir)
+    problems = runledger.validate_ledger(ledger)
+    assert not problems, f"run_ledger.json schema-invalid: {problems}"
+    total = ledger["goodput_e2e"] + sum(ledger["badput_frac"].values())
+    assert abs(total - 1.0) < 1e-3, f"fractions sum to {total}, not 1"
+    # the kill at step 6 forces a resume from step 4: steps 5 (and 6) are
+    # re-trained, and the hang at 10 adds more — wasted work must be visible
+    assert ledger["wasted_steps"] > 0, "kill+resume left wasted_steps == 0"
+    assert ledger["badput"]["wasted_steps"] > 0.0
+    assert ledger["restarts"] == 2 and len(ledger["episodes"]) == 3
+    assert ledger["run_id"] == report["run_id"]
+    # per-episode badput classes line up with the supervisor's taxonomy, and
+    # every failed episode has a finite time-to-recovery
+    for ep, rep_ep in zip(ledger["episodes"], report["episodes"]):
+        assert ep["taxonomy"] == rep_ep.get("taxonomy"), (ep, rep_ep)
+        if ep["taxonomy"] is not None:
+            assert ep["recovery_s"] is not None and ep["recovery_s"] >= 0.0, ep
+    classes = set(ledger["recovery"])
+    assert classes == {t for t in (e.get("taxonomy")
+                                   for e in report["episodes"]) if t}, classes
+    # the resume paths billed restore time (satellite: no longer idle)
+    assert ledger["badput"]["restore"] > 0.0, ledger["badput"]
+    # the supervisor metric stream carries the flat ledger/badput row
+    with open(os.path.join(out_dir, "supervisor.jsonl")) as f:
+        rows = [json.loads(line) for line in f]
+    ledger_rows = [r for r in rows if "ledger/goodput_e2e" in r]
+    assert ledger_rows and ledger_rows[-1]["ledger/episodes"] == 3
+
+    # SLO gate: baseline from the real ledger gates itself clean, then a
+    # degraded copy (half the goodput, idle absorbing) must exit 1
+    ledger_path = os.path.join(out_dir, runledger.LEDGER_FILENAME)
+    baseline = os.path.join(root, "slo_baseline.json")
+    rc = regression.main(["--run", ledger_path, "--baseline", baseline,
+                          "--write-baseline"])
+    assert rc == 0, "SLO baseline write failed"
+    rc = regression.main(["--run", ledger_path, "--baseline", baseline])
+    assert rc == 0, f"real ledger must gate clean against itself, got {rc}"
+    degraded = dict(ledger)
+    degraded["goodput_e2e"] = round(ledger["goodput_e2e"] * 0.5, 6)
+    degraded["badput_frac"] = dict(ledger["badput_frac"])
+    degraded["badput_frac"]["idle"] = round(
+        ledger["badput_frac"]["idle"] + ledger["goodput_e2e"] * 0.5, 6)
+    degraded_path = os.path.join(root, "degraded_ledger.json")
+    with open(degraded_path, "w") as f:
+        json.dump(degraded, f)
+    rc = regression.main(["--run", degraded_path, "--baseline", baseline])
+    assert rc == 1, f"gate must trip on a halved goodput_e2e, got {rc}"
+    print(f"[supervisor_smoke]     ledger valid: goodput_e2e="
+          f"{ledger['goodput_e2e']:.3f}, wasted_steps="
+          f"{ledger['wasted_steps']}, recovery classes {sorted(classes)}, "
+          f"gate 0 -> 1 on degradation")
 
 
 def phase_torn(root: str) -> None:
