@@ -28,6 +28,13 @@ _REMAT_POLICIES = {
     # half of mlp_dots: fits alongside losses that still materialize logits
     "mlp_gate_dot": jax.checkpoint_policies.save_only_these_names("mlp_gate"),
     "mlp_gate_attn": jax.checkpoint_policies.save_only_these_names("mlp_gate", "attn_out"),
+    # save only the post-activation (tokens*K, I) expert tensor — HALF of
+    # mlp_gate_dot's (tokens*K, 2I) footprint for gated experts. The down-proj
+    # backward reads it saved; only the gate_up GEMM + activation replay. The
+    # MoE-tuned rung: with the Pallas grouped GEMM (custom VJP, no saved
+    # intermediates of its own) this is the cheapest save that still skips the
+    # fattest recompute, so the tuner can trade it against dots/none.
+    "mlp_act_dot": jax.checkpoint_policies.save_only_these_names("mlp_act"),
     # additionally keep k/v + the attention output: replay shrinks to the q
     # projection + elementwise (q is recomputed for the flash backward; saving it
     # too was measured 20MB over the 15.75G HBM line at the 1B bench shape)
@@ -65,14 +72,22 @@ class BackendConfig:
     remat_policy: str = "none"
     scan_layers: bool = True
     dtype: str = "bfloat16"
-    # MoE knobs (used by MoE families only). "ragged_dot" IS the TPU grouped GEMM:
-    # jax.lax.ragged_dot lowers to XLA's native ragged matmul (the megablocks/gmm
-    # equivalent); a hand-written Pallas grouped GEMM would duplicate it.
-    experts_backend: str = "ragged_dot"  # "ragged_dot" | "dense"
+    # MoE knobs (used by MoE families only). "ragged_dot" is XLA's native ragged
+    # matmul (the megablocks/gmm equivalent); "pallas" routes the same sorted
+    # layout through the blocked Pallas grouped GEMM (ops/pallas/grouped_gemm.py:
+    # hand-scheduled tiles, fused custom-VJP backward, per-shape ragged_dot
+    # fallback); "dense" is the GShard one-hot einsum path.
+    experts_backend: str = "ragged_dot"  # "ragged_dot" | "pallas" | "dense"
     dispatcher: str = "dense"  # "dense" (GSPMD ragged/one-hot) | "a2a" (EP all_to_all)
     # a2a only: per-destination-rank send capacity = ep_capacity_factor * T * K / ep.
     # Overflow copies are dropped AND reported (stats["dropped_token_frac"]).
     ep_capacity_factor: float = 1.5
+    # a2a only: split dispatch/combine into this many capacity slices so chunk
+    # i's expert GEMM overlaps chunk i+1's all_to_all (XLA's latency-hiding
+    # scheduler overlaps them once the dependency graph allows it). 1 = one
+    # monolithic a2a. Token selection and dropped_frac are EXACT under any
+    # chunk count (routing/capacity math happens before slicing).
+    a2a_chunks: int = 1
     fake_balanced_gate: bool = False  # benchmark mode: uniform routing, no gate math
     fake_gate_noise: float = 0.0
 
@@ -83,12 +98,15 @@ class BackendConfig:
             raise ValueError(
                 f"unknown context_parallel {self.context_parallel!r} (allgather | ring)"
             )
-        if self.experts_backend not in ("ragged_dot", "dense"):
+        if self.experts_backend not in ("ragged_dot", "pallas", "dense"):
             raise ValueError(
-                f"unknown experts_backend {self.experts_backend!r} (ragged_dot | dense)"
+                f"unknown experts_backend {self.experts_backend!r} "
+                "(ragged_dot | pallas | dense)"
             )
         if self.dispatcher not in ("dense", "a2a"):
             raise ValueError(f"unknown dispatcher {self.dispatcher!r} (dense | a2a)")
+        if int(self.a2a_chunks) < 1:
+            raise ValueError(f"a2a_chunks must be >= 1, got {self.a2a_chunks}")
 
     @property
     def jnp_dtype(self):

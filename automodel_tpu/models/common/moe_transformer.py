@@ -167,6 +167,7 @@ def make_moe_layer_fns(
     attention_fn=None,
     training: bool = True,
     seq_len_hint: int = 0,
+    ep_manual_axis: str | None = None,
 ):
     """State-dict layer bodies shared by moe_decoder_forward and the pp pipeline.
 
@@ -179,6 +180,11 @@ def make_moe_layer_fns(
     ``attention_fn(lp, x, positions, segment_ids, is_sliding, rules) -> attn_out``
     overrides the default GQA block — the hook MLA-style families plug into (so the
     scan / aux / dense-prefix machinery here is the single copy).
+
+    ``ep_manual_axis``: the caller runs these layer fns inside a manual region
+    over that axis (the pp pipeline's flattened {pp, ep} region) — the a2a MoE
+    block then dispatches directly over it instead of opening a nested shard_map
+    (see moe.dispatch.make_moe_block_forward).
     """
     dtype = backend.jnp_dtype
     emit_aux = cfg.moe.aux_loss_coeff > 0 and training and not backend.fake_balanced_gate
@@ -237,7 +243,8 @@ def make_moe_layer_fns(
             return layer_inputs
         return (*layer_inputs, None)
 
-    moe_block = make_moe_block_forward(cfg.moe, backend, rules, training=training)
+    moe_block = make_moe_block_forward(cfg.moe, backend, rules, training=training,
+                                       ep_manual_axis=ep_manual_axis)
 
     def mlp_sublayer(lp, h):
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)

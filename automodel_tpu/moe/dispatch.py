@@ -2,19 +2,37 @@
 
 TPU-native replacement for DeepEP fused dispatch/combine
 (reference moe/megatron/fused_a2a.py:250,282 + MoEFlexTokenDispatcher,
-token_dispatcher.py:339): NVSHMEM buffers + fused CUDA all-to-alls become two
+token_dispatcher.py:339): NVSHMEM buffers + fused CUDA all-to-alls become
 ``lax.all_to_all`` collectives over ICI inside a partial-manual ``shard_map`` —
 manual over ``ep`` only, so FSDP/TP sharding on other axes stays GSPMD-managed.
 
 Protocol per ep-shard (capacity-bucketed, static shapes):
   route -> bucket token copies by destination rank (expert // E_local) with a fixed
-  per-destination capacity -> all_to_all (dispatch) -> local grouped GEMM via
-  ``ragged_dot`` -> all_to_all (combine) -> weighted scatter-add at origin.
+  per-destination capacity -> all_to_all (dispatch) -> local grouped GEMM -> all_to_all
+  (combine) -> weighted scatter-add at origin.
 Copies beyond capacity are dropped (standard capacity-factor trade-off; DeepEP is
 dropless, the dropless path here is ``grouped_experts_apply`` under plain GSPMD).
 The dispatch *accounts* for every drop: it returns ``dropped_frac`` (dropped copies /
 valid copies, globally summed) so a mis-set ``capacity_factor`` is visible in the
 training metrics instead of silently changing the loss.
+
+a2a/compute overlap (``n_chunks > 1``): the capacity dim is split into K slices
+and the dispatch a2a / expert GEMM / combine a2a run as three software-pipelined
+sweeps, so chunk *i*'s GEMM has no data dependence on chunk *i+1*'s all_to_all
+and XLA's latency-hiding scheduler overlaps them (the DeepEP async-dispatch
+discipline, expressed as graph structure instead of CUDA streams). Routing, the
+capacity cutoff, and ``dropped_frac`` are computed globally BEFORE slicing, so
+which copies survive — and the forward output, loss, and activation gradients —
+are bit-exact under any chunk count (per-row GEMM results don't depend on which
+rows share a chunk). The one numeric difference: expert WEIGHT grads accumulate
+per-chunk partial sums, a float reassociation of the monolithic GEMM's reduction
+(measured ~2e-7 relative on fp32).
+
+The body (:func:`make_ep_dispatch_body`) is shard_map-free: it assumes it is
+already inside a region manual over ``ep_axis``. :func:`make_ep_moe_forward`
+wraps it in its own partial-manual shard_map (the standalone GSPMD path);
+``parallel/pipeline.py`` calls it directly inside the flattened {pp, ep} manual
+region (a2a x PP composition — a nested shard_map over ep would be rejected).
 """
 
 from __future__ import annotations
@@ -28,10 +46,11 @@ from automodel_tpu.moe.experts import sorted_ragged_ffn
 from automodel_tpu.moe.gate import fake_balanced_route, route
 from automodel_tpu.moe.layers import _shared_experts_forward, moe_forward
 
-__all__ = ["make_ep_moe_forward", "make_moe_block_forward"]
+__all__ = ["make_ep_dispatch_body", "make_ep_moe_forward", "make_moe_block_forward"]
 
 
-def make_moe_block_forward(cfg: MoEConfig, backend, rules=None, *, training: bool = True):
+def make_moe_block_forward(cfg: MoEConfig, backend, rules=None, *, training: bool = True,
+                           ep_manual_axis: str | None = None):
     """Dispatcher-aware MoE block shared by every MoE model family.
 
     Returns ``fn(moe_params, x, token_mask) -> (y, aux_loss, expert_load, dropped_frac)``
@@ -42,7 +61,34 @@ def make_moe_block_forward(cfg: MoEConfig, backend, rules=None, *, training: boo
       fused_a2a.py:250). ``dropped_frac`` reports capacity overflow.
     - ``"dense"`` (default): GSPMD-managed :func:`moe_forward` — ``ragged_dot``
       sorted path is dropless, so ``dropped_frac`` is a constant 0.
+
+    ``ep_manual_axis``: set when the caller is ALREADY inside a manual region
+    over that axis (the pp pipeline's flattened {pp, ep} region). The a2a body
+    then runs directly — no nested shard_map, no sharding constraints (which
+    clash with manual axes) — with ``x`` already carrying the per-ep-shard
+    batch slice and expert params the local expert shard.
     """
+    if backend.dispatcher == "a2a" and ep_manual_axis is not None:
+        def manual_fn(moe_params, x, token_mask=None):
+            if token_mask is None:
+                token_mask = jnp.ones(x.shape[:2], bool)
+            # axis_size is static inside the manual region; the body builder is
+            # a cheap closure, so deriving ep at trace time costs nothing
+            ep = jax.lax.axis_size(ep_manual_axis)
+            body = make_ep_dispatch_body(
+                cfg, ep,
+                capacity_factor=backend.ep_capacity_factor,
+                training=training,
+                fake_balanced_gate=backend.fake_balanced_gate,
+                fake_gate_noise=backend.fake_gate_noise,
+                ep_axis=ep_manual_axis,
+                n_chunks=backend.a2a_chunks,
+                experts_backend=backend.experts_backend,
+            )
+            return body(moe_params, x, token_mask)
+
+        return manual_fn
+
     if backend.dispatcher == "a2a":
         mesh = getattr(rules, "mesh", None)
         if mesh is None or "ep" not in mesh.axis_names:
@@ -53,12 +99,13 @@ def make_moe_block_forward(cfg: MoEConfig, backend, rules=None, *, training: boo
         if mesh.shape["ep"] == 1:
             import logging
 
-            # measured (tools/bench_a2a_dispatch.py, v5e, qwen3-moe proxy):
-            # 2.25x slower than dense at ep=1 — the capacity-padded buffers and
-            # scatter/gather layout buy nothing when no routing crosses ranks.
+            # measured (tools/bench_a2a_dispatch.py): at ep=1 the all_to_all is
+            # a self-copy, so the delta is pure bucketing overhead (one-hot-
+            # cumsum queue positions + (ep, cap, D) scatter layout) — a2a was
+            # 2.25x slower than dense on a v5e chip (577ms vs 257ms/step).
             # With real expert parallelism (--ep 4 --devices 8, virtual mesh)
-            # the explicit a2a is ~8x FASTER than the dense GSPMD path — which
-            # is what it exists for.
+            # the explicit a2a measured ~2.05x FASTER than the dense GSPMD
+            # path (1.77s vs 3.63s/step) — which is what it exists for.
             logging.getLogger(__name__).warning(
                 "dispatcher='a2a' with ep=1: measured ~2.3x slower than the "
                 "default dense dispatcher on one chip; use dispatcher='dense' "
@@ -71,6 +118,8 @@ def make_moe_block_forward(cfg: MoEConfig, backend, rules=None, *, training: boo
             training=training,
             fake_balanced_gate=backend.fake_balanced_gate,
             fake_gate_noise=backend.fake_gate_noise,
+            n_chunks=backend.a2a_chunks,
+            experts_backend=backend.experts_backend,
         )
         act_sharding = rules.sharding(("batch", "act_seq", "act_embed"))
 
@@ -93,24 +142,27 @@ def make_moe_block_forward(cfg: MoEConfig, backend, rules=None, *, training: boo
             dispatcher="capacity" if backend.experts_backend == "dense" else "ragged",
             fake_balanced_gate=backend.fake_balanced_gate,
             fake_gate_noise=backend.fake_gate_noise,
+            experts_backend=backend.experts_backend,
         )
         return y, aux, load, jnp.float32(0)
 
     return fn
 
 
-def _local_grouped_gemm(cfg: MoEConfig, expert_params: dict, x, expert_ids, n_local_experts):
-    """Sorted ragged_dot over the local expert shard; x (N, D), expert_ids (N,)."""
+def _local_grouped_gemm(cfg: MoEConfig, expert_params: dict, x, expert_ids,
+                        n_local_experts, experts_backend: str = "ragged_dot"):
+    """Sorted grouped GEMM over the local expert shard; x (N, D), expert_ids (N,)."""
     sort_idx = jnp.argsort(expert_ids)
     group_sizes = jnp.bincount(expert_ids, length=n_local_experts).astype(jnp.int32)
-    out = sorted_ragged_ffn(cfg, expert_params, x[sort_idx], expert_ids[sort_idx], group_sizes)
+    out = sorted_ragged_ffn(cfg, expert_params, x[sort_idx], expert_ids[sort_idx],
+                            group_sizes, experts_backend=experts_backend)
     # unsort back to slot order
     return jnp.zeros_like(out).at[sort_idx].set(out)
 
 
-def make_ep_moe_forward(
+def make_ep_dispatch_body(
     cfg: MoEConfig,
-    mesh: Mesh,
+    ep: int,
     *,
     capacity_factor: float = 1.5,
     capacity: int | None = None,
@@ -118,17 +170,17 @@ def make_ep_moe_forward(
     fake_balanced_gate: bool = False,
     fake_gate_noise: float = 0.0,
     ep_axis: str = "ep",
+    n_chunks: int = 1,
+    experts_backend: str = "ragged_dot",
 ):
-    """Build ``fn(params, x, token_mask) -> (y, aux_loss, expert_load, dropped_frac)``
-    with explicit EP a2a dispatch. ``x`` is (B, S, D) with batch sharded over data axes
-    (incl. ep); expert params are sharded over ``ep`` on their leading dim.
-    ``dropped_frac`` is a global fp32 scalar: token copies dropped over capacity /
-    valid token copies.
+    """The per-shard a2a dispatch protocol, assuming a manual region over
+    ``ep_axis`` is already open. Returns ``shard_fn(params, x, token_mask) ->
+    (y, aux_loss, expert_load, dropped_frac)`` with ``x`` (B_local, S, D).
     """
-    ep = mesh.shape[ep_axis]
     if cfg.n_routed_experts % ep != 0:
         raise ValueError(f"n_routed_experts {cfg.n_routed_experts} not divisible by ep {ep}")
     n_local = cfg.n_routed_experts // ep
+    nch = max(1, int(n_chunks))
 
     def shard_fn(params, x, token_mask):
         B, S, D = x.shape  # B already divided by ep (manual), auto-sharded over dp
@@ -147,6 +199,11 @@ def make_ep_moe_forward(
             )
 
         cap = capacity if capacity is not None else max(1, int(capacity_factor * T * K / ep))
+        # send buffers pad the capacity dim up to a chunk multiple; the cutoff
+        # itself stays `cap`, so which copies survive — and dropped_frac — are
+        # EXACT under any chunk count (the pad slots are never filled)
+        cap_pad = -(-cap // nch) * nch
+        cc = cap_pad // nch
 
         dest = (indices // n_local).reshape(-1)  # (T*K,) destination ep rank
         local_eid = (indices % n_local).reshape(-1)
@@ -160,25 +217,42 @@ def make_ep_moe_forward(
         oh = jax.nn.one_hot(dest, ep, dtype=jnp.int32)
         pos = ((jnp.cumsum(oh, axis=0) - oh) * oh).sum(-1)
         keep = (pos < cap) & valid_copy
-        slot = jnp.where(keep, pos, cap)  # cap is out-of-bounds -> scatter drops it
+        slot = jnp.where(keep, pos, cap_pad)  # cap_pad is out-of-bounds -> scatter drops it
 
-        with jax.named_scope("ep_dispatch"):
-            send_x = jnp.zeros((ep, cap, D), x.dtype).at[dest, slot].set(x2[tok], mode="drop")
-            send_eid = jnp.zeros((ep, cap), jnp.int32).at[dest, slot].set(local_eid, mode="drop")
+        send_x = jnp.zeros((ep, cap_pad, D), x.dtype).at[dest, slot].set(x2[tok], mode="drop")
+        send_eid = jnp.zeros((ep, cap_pad), jnp.int32).at[dest, slot].set(local_eid, mode="drop")
+        sx = send_x.reshape(ep, nch, cc, D)
+        se = send_eid.reshape(ep, nch, cc)
 
-            recv_x = jax.lax.all_to_all(send_x, ep_axis, split_axis=0, concat_axis=0)
-            recv_eid = jax.lax.all_to_all(send_eid, ep_axis, split_axis=0, concat_axis=0)
+        # Three software-pipelined sweeps: chunk i's GEMM depends only on chunk
+        # i's dispatch, so the scheduler runs it under chunk i+1's all_to_all
+        # (and chunk i's combine under chunk i+1's GEMM). With nch=1 this is
+        # the original monolithic dispatch -> GEMM -> combine.
+        recvs = []
+        for i in range(nch):
+            with jax.named_scope("ep_dispatch"):
+                rx = jax.lax.all_to_all(sx[:, i], ep_axis, split_axis=0, concat_axis=0)
+                rid = jax.lax.all_to_all(se[:, i], ep_axis, split_axis=0, concat_axis=0)
+            recvs.append((rx, rid))
 
-        with jax.named_scope("ep_experts"):
-            out = _local_grouped_gemm(
-                cfg, params["experts"], recv_x.reshape(ep * cap, D), recv_eid.reshape(-1), n_local
-            ).reshape(ep, cap, D)
+        outs = []
+        for rx, rid in recvs:
+            with jax.named_scope("ep_experts"):
+                outs.append(
+                    _local_grouped_gemm(
+                        cfg, params["experts"], rx.reshape(ep * cc, D), rid.reshape(-1),
+                        n_local, experts_backend,
+                    ).reshape(ep, cc, D)
+                )
 
-        with jax.named_scope("ep_combine"):
-            back = jax.lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0)
+        backs = []
+        for out in outs:
+            with jax.named_scope("ep_combine"):
+                backs.append(jax.lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0))
+        back = jnp.stack(backs, axis=1).reshape(ep, cap_pad, D)
 
         # Combine at origin: gather each copy's result, weight it, drop overflow.
-        gathered = back[dest, jnp.minimum(slot, cap - 1)]  # (T*K, D)
+        gathered = back[dest, jnp.minimum(slot, cap_pad - 1)]  # (T*K, D)
         w = (weights.reshape(-1) * keep).astype(jnp.float32)
         y = jnp.zeros((T, D), jnp.float32).at[tok].add(gathered.astype(jnp.float32) * w[:, None])
         y = y.astype(x.dtype)
@@ -195,6 +269,36 @@ def make_ep_moe_forward(
         )
         dropped_frac = n_dropped / jnp.maximum(n_valid, 1.0)
         return y.reshape(B, S, D), aux_loss, expert_load, dropped_frac
+
+    return shard_fn
+
+
+def make_ep_moe_forward(
+    cfg: MoEConfig,
+    mesh: Mesh,
+    *,
+    capacity_factor: float = 1.5,
+    capacity: int | None = None,
+    training: bool = True,
+    fake_balanced_gate: bool = False,
+    fake_gate_noise: float = 0.0,
+    ep_axis: str = "ep",
+    n_chunks: int = 1,
+    experts_backend: str = "ragged_dot",
+):
+    """Build ``fn(params, x, token_mask) -> (y, aux_loss, expert_load, dropped_frac)``
+    with explicit EP a2a dispatch. ``x`` is (B, S, D) with batch sharded over data axes
+    (incl. ep); expert params are sharded over ``ep`` on their leading dim.
+    ``dropped_frac`` is a global fp32 scalar: token copies dropped over capacity /
+    valid token copies — exact regardless of ``n_chunks``.
+    """
+    ep = mesh.shape[ep_axis]
+    shard_fn = make_ep_dispatch_body(
+        cfg, ep,
+        capacity_factor=capacity_factor, capacity=capacity, training=training,
+        fake_balanced_gate=fake_balanced_gate, fake_gate_noise=fake_gate_noise,
+        ep_axis=ep_axis, n_chunks=n_chunks, experts_backend=experts_backend,
+    )
 
     # Manual specs cover only the ep axis; everything else stays auto/GSPMD.
     def param_specs(params):

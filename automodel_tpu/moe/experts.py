@@ -1,11 +1,15 @@
 """Grouped expert FFNs (reference GroupedExperts*, components/moe/experts.py:158,478,661).
 
-Two TPU-native compute paths replace the reference's four CUDA backends
+TPU-native compute paths replacing the reference's four CUDA backends
 (loop / torch._grouped_mm / DeepEP+gmm / TransformerEngine):
 
 - ``ragged_dot`` (default, dropless): sort token copies by expert id, one
-  ``jax.lax.ragged_dot`` per projection (the MXU-native grouped GEMM — the analogue of
-  megablocks/gmm), scatter-add back. No capacity, no dropped tokens, static shapes.
+  ``jax.lax.ragged_dot`` per projection (XLA's native grouped GEMM), scatter-add back.
+  No capacity, no dropped tokens, static shapes.
+- ``pallas``: the same sorted layout through the blocked Pallas grouped GEMM
+  (``ops/pallas/grouped_gemm.py``) — a hand-scheduled tile list with a fused
+  custom-VJP backward, selected via ``backend.experts_backend="pallas"``. Falls
+  back to ``ragged_dot`` per-shape when the tile picker rejects the dims.
 - ``capacity`` (GShard-style): one-hot dispatch/combine einsums with a fixed per-expert
   capacity. Fully dense — XLA lays the all-to-all automatically when experts are sharded
   on ``ep`` — at the cost of dropped tokens past capacity.
@@ -75,27 +79,40 @@ def expert_activation(cfg: MoEConfig, h: jnp.ndarray) -> jnp.ndarray:
     return jnp.square(jax.nn.relu(h))
 
 
+def _expert_gemm(xs, w, group_sizes, experts_backend: str):
+    """One grouped GEMM over the sorted-by-expert layout, backend-selected."""
+    if experts_backend == "pallas":
+        from automodel_tpu.ops.pallas.grouped_gemm import grouped_matmul
+
+        # interpret off-TPU: CPU tests exercise the real kernel logic; the
+        # tile picker still gates the compiled path per shape on TPU
+        return grouped_matmul(xs, w, group_sizes, interpret=jax.default_backend() != "tpu")
+    return jax.lax.ragged_dot(xs, w, group_sizes)
+
+
 def sorted_ragged_ffn(
     cfg: MoEConfig,
     params: dict,
     xs: jnp.ndarray,  # (N, D) tokens sorted so each expert's rows are contiguous
     sorted_expert_ids: jnp.ndarray,  # (N,) expert id of each row (ascending)
     group_sizes: jnp.ndarray,  # (n_experts_in_params,) per-expert row counts
+    *,
+    experts_backend: str = "ragged_dot",  # "ragged_dot" | "pallas"
 ) -> jnp.ndarray:
     """The grouped-GEMM FFN core shared by the GSPMD and explicit-EP paths:
-    ragged_dot gate_up -> bias -> activation -> ragged_dot down -> bias."""
+    grouped GEMM gate_up -> bias -> activation -> grouped GEMM down -> bias."""
     from jax.ad_checkpoint import checkpoint_name
 
     # "mlp_gate"/"mlp_act": the (tokens*K, 2I) expert intermediates are the MoE
     # analogue of the dense gate/up tensors — the mlp_* remat policies
     # (backend.py) save/recompute them the same way
     h = checkpoint_name(
-        jax.lax.ragged_dot(xs, params["gate_up_proj"], group_sizes), "mlp_gate"
+        _expert_gemm(xs, params["gate_up_proj"], group_sizes, experts_backend), "mlp_gate"
     )
     if "gate_up_bias" in params:
         h = h + params["gate_up_bias"][sorted_expert_ids]
     act = checkpoint_name(expert_activation(cfg, h).astype(xs.dtype), "mlp_act")
-    out = jax.lax.ragged_dot(act, params["down_proj"], group_sizes)
+    out = _expert_gemm(act, params["down_proj"], group_sizes, experts_backend)
     if "down_bias" in params:
         out = out + params["down_bias"][sorted_expert_ids]
     return out
@@ -108,6 +125,8 @@ def grouped_experts_apply(
     weights: jnp.ndarray,  # (T, K)
     indices: jnp.ndarray,  # (T, K) int32
     token_mask: jnp.ndarray | None = None,  # (T,) bool; masked tokens contribute zero
+    *,
+    experts_backend: str = "ragged_dot",
 ) -> jnp.ndarray:
     """Dropless grouped-GEMM expert compute; returns (T, D).
 
@@ -132,7 +151,8 @@ def grouped_experts_apply(
     # explicit-EP path uses as ep_dispatch/ep_combine)
     with jax.named_scope("moe_dispatch"):
         xs = x[token_ids]  # (T*K, D) gathered copies, expert-contiguous
-    out = sorted_ragged_ffn(cfg, params, xs, flat_expert[sort_idx], group_sizes)
+    out = sorted_ragged_ffn(cfg, params, xs, flat_expert[sort_idx], group_sizes,
+                            experts_backend=experts_backend)
 
     with jax.named_scope("moe_combine"):
         w_sorted = weights.reshape(-1)[sort_idx].astype(jnp.float32)

@@ -101,6 +101,7 @@ def moe_forward(
     capacity_factor: float = 1.25,
     fake_balanced_gate: bool = False,
     fake_gate_noise: float = 0.0,
+    experts_backend: str = "ragged_dot",  # "ragged_dot" | "pallas" (ragged only)
 ):
     """Returns ``(y, aux_loss|None, expert_load (E,))``; y has x's shape.
 
@@ -130,7 +131,8 @@ def moe_forward(
                 cfg, params["experts"], x2, weights, indices, mask, capacity_factor=capacity_factor
             )
         else:
-            y = grouped_experts_apply(cfg, params["experts"], x2, weights, indices, mask)
+            y = grouped_experts_apply(cfg, params["experts"], x2, weights, indices, mask,
+                                      experts_backend=experts_backend)
 
     if cfg.n_shared_experts > 0:
         with jax.named_scope("moe_shared_experts"):

@@ -53,6 +53,10 @@ DEFAULT_TOLERANCES = {"tps": 0.05, "mfu": 0.05, "step_time_s": 0.05, "goodput": 
                       "measured_step_time_s": 0.15, "overlap_frac": 0.1,
                       "measured_frac_compute": 0.1, "measured_frac_comm": 0.1,
                       "measured_frac_moe_a2a": 0.1, "measured_frac_host": 0.1,
+                      # static HLO share of collective bytes on the ep a2a
+                      # axis: deterministic for a given (model, seq, batch)
+                      # but compiler-version sensitive, so measured-sized slack
+                      "a2a_byte_share": 0.1,
                       # run-ledger keys: goodput_e2e gates like throughput;
                       # the badput/recovery families are chaos-amplified (one
                       # extra retrained step doubles a small count), so they
@@ -73,6 +77,7 @@ HIGHER_IS_BETTER = {"tps": True, "mfu": True, "goodput": True, "step_time_s": Fa
                     "measured_step_time_s": False, "overlap_frac": True,
                     "measured_frac_compute": True, "measured_frac_comm": False,
                     "measured_frac_moe_a2a": False, "measured_frac_host": False,
+                    "a2a_byte_share": False,
                     "goodput_e2e": True, "wasted_steps": False, "recovery_s": False,
                     **{f"badput/{c}": False for c in _BADPUT_CLASSES},
                     **{f"recovery_s/{c}": False for c in _FAILURE_CLASSES}}
@@ -157,9 +162,13 @@ def _from_matrix_rows(rows: Iterable[dict[str, Any]]) -> dict[str, float]:
     name instead of hiding inside an average. ``bench.py --profile`` rows add
     the measured-profile keys (``<key>/measured_*`` + ``<key>/overlap_frac``,
     every basename in HIGHER_IS_BETTER) so compute/comms overlap is gated,
-    not just throughput. Decoration fields (``a2a_byte_share``, ``steps``,
-    ``measured_seq_len``, the ``measured_bound`` string) stay out: they are
-    diagnostics, not directional performance metrics.
+    not just throughput. MoE rows add ``<key>/a2a_byte_share`` — the static
+    HLO share of collective bytes on the ep all_to_all axis, which regresses
+    by RISING (a dispatch change that bloats a2a traffic shows up here before
+    the trace does). Remaining decoration fields (``steps``,
+    ``measured_seq_len``, ``dropped_token_frac``, the ``measured_bound``
+    string) stay out: they are diagnostics, not directional performance
+    metrics.
     """
     out: dict[str, float] = {}
     for row in rows:
@@ -170,6 +179,8 @@ def _from_matrix_rows(rows: Iterable[dict[str, Any]]) -> dict[str, float]:
             out[f"{key}/moe_tps"] = float(row["moe/tokens_per_sec_per_chip"])
         if row.get("hbm_gib_peak") is not None:
             out[f"{key}/hbm_gib_peak"] = float(row["hbm_gib_peak"])
+        if row.get("a2a_byte_share") is not None:
+            out[f"{key}/a2a_byte_share"] = float(row["a2a_byte_share"])
         for k, v in row.items():
             if (k in ("measured_step_time_s", "overlap_frac")
                     or k.startswith("measured_frac_")) \

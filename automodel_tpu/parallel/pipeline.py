@@ -178,7 +178,10 @@ def pipeline_spmd(
 
 
 def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp", with_aux: bool = False,
-                          aux_out_specs=None, circular_repeats: int = 1):
+                          aux_out_specs=None, circular_repeats: int = 1,
+                          extra_manual_axes: tuple = (),
+                          layer_param_specs=None, x_stack_specs=None,
+                          h_out_spec: P = P()):
     """Wrap (layer_apply, head_loss) into a pp-pipelined loss function.
 
     Returns ``fn(layer_params, other_params, x_stack, batch_stack, layer_apply,
@@ -209,6 +212,15 @@ def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp", with_aux: bool = F
     (sharding rule "layers" -> pp). With ``circular_repeats=V`` the caller
     reshapes them to (V, pp, L/(V*pp), ...) — round-major interleaving — and this
     wrapper shards dim 1 over pp.
+
+    ``extra_manual_axes``: additional mesh axes to make manual alongside ``pp``
+    in ONE flattened region (a2a x PP: the explicit-EP MoE dispatcher must issue
+    its ``all_to_all`` over a manual ep axis, and shard_map cannot nest — so ep
+    joins the pp region instead). The caller then supplies matching manual
+    specs: ``layer_param_specs`` / ``x_stack_specs`` are callables
+    ``tree -> spec-tree`` (e.g. expert weights P(pp, "ep"); activations
+    P(None, "ep") — batch split over ep), ``h_out_spec`` covers the output
+    stack. Each defaults to the pp-only behavior when None.
     """
     pp = mesh.shape[pp_axis]
     V = circular_repeats
@@ -232,17 +244,19 @@ def make_pipeline_forward(mesh: Mesh, *, pp_axis: str = "pp", with_aux: bool = F
             h = jax.lax.psum(jnp.where(is_last, h, jnp.zeros_like(h)), pp_axis)
             return (h, aux) if with_aux else h
 
-        layer_specs = jax.tree.map(
-            lambda _: P(None, pp_axis) if V > 1 else P(pp_axis), layer_params
+        layer_specs = layer_param_specs(layer_params) if layer_param_specs is not None else (
+            jax.tree.map(lambda _: P(None, pp_axis) if V > 1 else P(pp_axis), layer_params)
         )
-        x_specs = jax.tree.map(lambda _: P(), x_stack)
-        out_specs = (P(), aux_out_specs) if with_aux else P()
+        x_specs = x_stack_specs(x_stack) if x_stack_specs is not None else (
+            jax.tree.map(lambda _: P(), x_stack)
+        )
+        out_specs = (h_out_spec, aux_out_specs) if with_aux else h_out_spec
         outs = jax.shard_map(
             body,
             mesh=mesh,
             in_specs=(layer_specs, x_specs),
             out_specs=out_specs,
-            axis_names={pp_axis},
+            axis_names={pp_axis, *extra_manual_axes},
         )(layer_params, x_stack)
         h_stack, aux = outs if with_aux else (outs, None)
         if head_loss_fn is None:
@@ -427,7 +441,9 @@ def make_moe_pp_hidden(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
     Returns ``hidden_fn(params, batch_stack, num_label_tokens) ->
     (h_stack, aux_loss, {"expert_load": (num_moe_layers, E)})`` where
     ``aux_loss`` is the already-weighted load-balance penalty (0 when disabled)
-    to ADD to the caller's data loss.
+    to ADD to the caller's data loss. Under ``backend.dispatcher == "a2a"`` the
+    manual region flattens to {pp, ep} (the EP all_to_all runs inside each
+    stage) and extras gains ``dropped_token_frac``.
     """
     from automodel_tpu.models.common.moe_transformer import make_moe_layer_fns
     from automodel_tpu.models.common.transformer import embed_lookup
@@ -436,16 +452,23 @@ def make_moe_pp_hidden(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
     dtype = backend.jnp_dtype
     pp = mesh.shape[pp_axis]
     V = circular_repeats
-    if backend.dispatcher == "a2a":
+    # a2a x PP: the explicit-EP dispatcher's all_to_all needs a manual ep axis,
+    # and shard_map cannot nest — so the pp manual region FLATTENS to {pp, ep}
+    # and the MoE layer fns dispatch directly over ep inside each stage. Expert
+    # weights enter manual-sharded over both (layer dim -> pp, expert dim ->
+    # ep); activations enter batch-split over ep, exactly the per-shard slice
+    # make_ep_dispatch_body's protocol expects.
+    a2a = backend.dispatcher == "a2a"
+    ep_axis = "ep"
+    if a2a and ep_axis not in mesh.axis_names:
         raise ValueError(
-            "dispatcher='a2a' cannot run inside the pp manual region (nested "
-            "shard_map over ep); use the default GSPMD dispatcher under pp — the "
-            "ep mesh axis still shards the expert GEMMs"
+            "dispatcher='a2a' under pp requires the mesh to carry an 'ep' axis "
+            f"(MeshContext(ep=...)); got axes {mesh.axis_names}"
         )
     attention_fn = model.make_attention_fn() if hasattr(model, "make_attention_fn") else None
     dense_layer_fn, moe_layer_fn = make_moe_layer_fns(
         cfg, backend, rules=None, attention_fn=attention_fn, training=True,
-        seq_len_hint=seq_len_hint,
+        seq_len_hint=seq_len_hint, ep_manual_axis=ep_axis if a2a else None,
     )
     k_dense = cfg.first_k_dense_replace
     emit_aux = cfg.moe.aux_loss_coeff > 0 and not backend.fake_balanced_gate
@@ -453,9 +476,39 @@ def make_moe_pp_hidden(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
     aux_specs = {"load": load_spec}
     if emit_aux:
         aux_specs["aux"] = load_spec
+    if a2a:
+        # per-stage capacity-overflow accounting rides the aux channel (the
+        # dispatch body psums it over ep, so it leaves the region pp-sharded
+        # per layer and ep-replicated, same shape discipline as load)
+        aux_specs["dropped"] = load_spec
+
+    def _a2a_layer_specs(layer_params):
+        """Manual specs for the flattened {pp, ep} region: expert-weight leaves
+        (keyed by the exact 'experts' dict level — 'shared_experts' stays
+        replicated over ep) shard expert dim over ep on top of layer dim -> pp."""
+        def spec(path, _):
+            is_expert = any(
+                isinstance(k, jax.tree_util.DictKey) and k.key == "experts" for k in path
+            )
+            if not is_expert:
+                return P(None, pp_axis) if V > 1 else P(pp_axis)
+            # (L, E, ...) -> P(pp, ep); circular (V, pp, Lb, E, ...) -> dim 3
+            return P(None, pp_axis, None, ep_axis) if V > 1 else P(pp_axis, ep_axis)
+
+        return jax.tree_util.tree_map_with_path(spec, layer_params)
+
+    def _a2a_x_specs(x_stack):
+        # (n_micro, B, ...) activation/metadata stacks split batch over ep;
+        # rank-1 ride-alongs (aux_weight) stay replicated
+        return jax.tree.map(lambda a: P(None, ep_axis) if a.ndim >= 2 else P(), x_stack)
+
     pipeline = make_pipeline_forward(
         mesh, pp_axis=pp_axis, with_aux=True, aux_out_specs=aux_specs,
         circular_repeats=V,
+        extra_manual_axes=(ep_axis,) if a2a else (),
+        layer_param_specs=_a2a_layer_specs if a2a else None,
+        x_stack_specs=_a2a_x_specs if a2a else None,
+        h_out_spec=P(None, ep_axis) if a2a else P(),
     )
 
     def embed_fn(other, mb):
@@ -477,11 +530,14 @@ def make_moe_pp_hidden(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
     def layer_apply(stage, state):
         lp_stack, sliding = stage
         aux_weight = state.pop("aux_weight", None)
-        # droppeds discarded: a2a is rejected above, so the channel is always 0
-        state, (auxs, loads, _droppeds) = jax.lax.scan(
+        state, (auxs, loads, droppeds) = jax.lax.scan(
             backend.layer_remat(moe_layer_fn), state, (lp_stack, sliding)
         )
         out = {"load": loads}
+        if a2a:
+            # (Lb,) per-layer dropped fraction; the tick loop sums it over the
+            # stage's real microbatches (hidden_fn divides the mean back out)
+            out["dropped"] = droppeds
         if emit_aux:
             # weight this stage's aux by the CURRENT microbatch's label-token
             # fraction (rides the ring with the activation, see forward_loss) —
@@ -516,6 +572,11 @@ def make_moe_pp_hidden(model, mesh: Mesh, rules=None, *, pp_axis: str = "pp",
             # (V, pp*Lb, E) round-major -> (L, E) global layer order
             load = load.reshape(-1, *load.shape[2:])
         extras = {"expert_load": load}
+        if a2a:
+            n_micro = jax.tree.leaves(batch_stack)[0].shape[0]
+            # per-layer sums over microbatch ticks -> mean over layers & micros,
+            # matching the non-pp stats["dropped_token_frac"] contract
+            extras["dropped_token_frac"] = aux["dropped"].mean() / n_micro
         if emit_aux:
             aux_loss = cfg.moe.aux_loss_coeff * aux["aux"].sum()
             # unscaled balance loss for the moe/aux_loss telemetry row

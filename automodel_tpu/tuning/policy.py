@@ -32,7 +32,10 @@ __all__ = ["KNOB_PRIORITY", "prune", "order_trials", "attribute_winner"]
 _REMAT = ("remat_policy",)
 _MICRO = ("micro_batch_size", "grad_acc_steps")
 _PREFETCH = ("prefetch_host_depth", "prefetch_device_depth")
-_DISPATCH = ("dispatcher",)
+# the MoE hot-path levers move together: which dispatcher, how many overlap
+# chunks its a2a is sliced into, and which grouped-GEMM backend feeds it —
+# comms/moe_a2a-bound cells explore all three first
+_DISPATCH = ("dispatcher", "a2a_chunks", "experts_backend")
 _LAYOUT = ("layout",)
 KNOB_PRIORITY: dict[str, tuple[tuple[str, ...], ...]] = {
     "compute": (_REMAT, _LAYOUT, _MICRO, _PREFETCH, _DISPATCH),

@@ -25,8 +25,11 @@ __all__ = ["REMAT_LADDER", "LAYOUTS", "Trial", "SearchSpace"]
 # remats everything (minimal memory, maximal recompute), "full" saves
 # everything (no recompute, maximal memory). "Moving remat down" (compute-bound
 # cells: spend memory to stop replaying the forward) walks toward "full";
-# "moving remat up" (memory-bound cells) walks toward "none".
-REMAT_LADDER = ("none", "dots_no_batch", "dots", "full")
+# "moving remat up" (memory-bound cells) walks toward "none". "mlp_act_dot"
+# (save only the post-activation expert tensor) is the MoE-tuned rung: the
+# smallest non-empty save set, sized to compose with the Pallas grouped GEMM's
+# custom VJP (which saves only its own operands).
+REMAT_LADDER = ("none", "mlp_act_dot", "dots_no_batch", "dots", "full")
 
 # layout variants: how the layer stack is laid out for the compiler. "scan"
 # stacks layer params and lax.scans over them (fast compiles, PP-friendly);
@@ -48,6 +51,8 @@ class Trial:
     prefetch_device_depth: int | None = None
     dispatcher: str | None = None  # "dense" | "a2a"; MoE cells with ep > 1 only
     layout: str | None = None  # "scan" | "unrolled"
+    experts_backend: str | None = None  # "ragged_dot" | "pallas"; MoE cells only
+    a2a_chunks: int | None = None  # a2a dispatch/combine overlap slices; ep > 1 only
 
     def overrides(self) -> dict[str, Any]:
         """The trial as dotted config-path overrides (recipe + bench shared)."""
@@ -66,6 +71,10 @@ class Trial:
             out["backend.dispatcher"] = self.dispatcher
         if self.layout is not None:
             out["backend.scan_layers"] = self.layout == "scan"
+        if self.experts_backend is not None:
+            out["backend.experts_backend"] = self.experts_backend
+        if self.a2a_chunks is not None:
+            out["backend.a2a_chunks"] = int(self.a2a_chunks)
         return out
 
     def digest(self) -> str:
@@ -98,6 +107,12 @@ class SearchSpace:
     prefetch_depths: tuple[tuple[int, int], ...] = ()  # (host_depth, device_depth)
     dispatchers: tuple[str, ...] = ()
     layouts: tuple[str, ...] = ()
+    # MoE hot-path knobs, gated on ep > 1 like the dispatcher (the expert-GEMM
+    # backend and a2a chunk count are levers the moe_a2a/comms bounds implicate;
+    # chunk counts only change anything under dispatcher="a2a" — the space stays
+    # a dumb cross product, policy.py orders and the runner measures)
+    experts_backends: tuple[str, ...] = ()
+    a2a_chunk_counts: tuple[int, ...] = ()
     ep: int = 1
 
     @classmethod
@@ -122,12 +137,15 @@ class SearchSpace:
         depths: Iterable = self.prefetch_depths or ((None, None),)
         dispatchers: Iterable = (self.dispatchers or (None,)) if self.ep > 1 else (None,)
         layouts: Iterable = self.layouts or (None,)
+        backends: Iterable = (self.experts_backends or (None,)) if self.ep > 1 else (None,)
+        chunks: Iterable = (self.a2a_chunk_counts or (None,)) if self.ep > 1 else (None,)
         out = []
-        for remat, (mb, ga), (hd, dd), disp, layout in itertools.product(
-                self.remat_policies, splits, depths, dispatchers, layouts):
+        for remat, (mb, ga), (hd, dd), disp, layout, eb, nch in itertools.product(
+                self.remat_policies, splits, depths, dispatchers, layouts,
+                backends, chunks):
             out.append(Trial(
                 remat_policy=remat, micro_batch_size=mb, grad_acc_steps=ga,
                 prefetch_host_depth=hd, prefetch_device_depth=dd,
-                dispatcher=disp, layout=layout,
+                dispatcher=disp, layout=layout, experts_backend=eb, a2a_chunks=nch,
             ))
         return out

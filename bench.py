@@ -308,10 +308,13 @@ def _matrix_dense_model(cpu: bool):
     return LlamaForCausalLM(cfg, backend), cfg.vocab_size
 
 
-def _matrix_moe_model(cpu: bool):
+def _matrix_moe_model(cpu: bool, dispatcher: str = "dense",
+                      experts_backend: str = "ragged_dot", a2a_chunks: int = 1):
     from automodel_tpu.models.common.backend import BackendConfig
     from automodel_tpu.models.qwen3_moe.model import Qwen3MoeForCausalLM
 
+    moe_knobs = dict(dispatcher=dispatcher, experts_backend=experts_backend,
+                     a2a_chunks=a2a_chunks)
     if cpu:
         hf = dict(
             vocab_size=2048, hidden_size=256, intermediate_size=512,
@@ -320,7 +323,7 @@ def _matrix_moe_model(cpu: bool):
             max_position_embeddings=512, num_experts=8, num_experts_per_tok=2,
             norm_topk_prob=True, router_aux_loss_coef=0.01,
         )
-        backend = BackendConfig(dtype="float32")
+        backend = BackendConfig(dtype="float32", **moe_knobs)
     else:
         # 1B-class MoE: same token FLOPs ballpark as the dense row so the
         # dense-vs-moe tokens/s gap in one matrix is the dispatch overhead
@@ -333,8 +336,25 @@ def _matrix_moe_model(cpu: bool):
             router_aux_loss_coef=0.01,
         )
         backend = BackendConfig(dtype="bfloat16", remat_policy="mlp_attn_dots",
-                                attention="flash", attention_segments=False)
+                                attention="flash", attention_segments=False,
+                                **moe_knobs)
     return Qwen3MoeForCausalLM.from_config(hf, backend), hf["vocab_size"]
+
+
+# the moe_a2a cells exercise the explicit EP dispatch hot path: dispatcher=a2a
+# over an ep mesh spanning every device, chunked so expert GEMMs overlap the
+# next chunk's all_to_all, with both grouped-GEMM backends. One seq point is
+# enough — the dispatch/overlap story does not need the seq sweep.
+MATRIX_A2A_KINDS = ("moe_a2a", "moe_a2a_pallas")
+
+
+def _matrix_cells() -> list[tuple[str, int]]:
+    """Every (kind, nominal_seq) cell in the matrix: dense/moe across
+    MATRIX_SEQ_LENS plus the a2a hot-path variants at the headline seq."""
+    cells = [(kind, nominal) for kind in ("dense", "moe")
+             for nominal in MATRIX_SEQ_LENS]
+    cells += [(kind, MATRIX_SEQ_LENS[0]) for kind in MATRIX_A2A_KINDS]
+    return cells
 
 
 def _matrix_cell(kind: str, nominal_seq: int, cpu: bool,
@@ -370,12 +390,35 @@ def _matrix_cell(kind: str, nominal_seq: int, cpu: bool,
     from automodel_tpu.training.step_scheduler import StepScheduler
     from automodel_tpu.training.train_step import make_train_step
 
-    is_moe = kind == "moe"
-    model, vocab = _matrix_moe_model(cpu) if is_moe else _matrix_dense_model(cpu)
+    a2a = kind in MATRIX_A2A_KINDS
+    is_moe = kind == "moe" or a2a
+    rules = None
+    if a2a:
+        from automodel_tpu.parallel.mesh import MeshContext, default_sharding_rules
+
+        # an ep mesh over every device: the explicit dispatch path degrades
+        # gracefully at ep=1 (single-host runs without forced devices), and
+        # a2a cells always carry overlap_frac — the a2a/compute overlap IS
+        # the metric these cells exist to gate, so the one profiled step is
+        # not optional here
+        mesh = MeshContext(ep=jax.device_count()).build_mesh()
+        rules = default_sharding_rules().with_mesh(mesh)
+        model, vocab = _matrix_moe_model(
+            cpu, dispatcher="a2a", a2a_chunks=2,
+            experts_backend="pallas" if kind == "moe_a2a_pallas"
+            else "ragged_dot")
+        profile = True
+    else:
+        model, vocab = (_matrix_moe_model(cpu) if is_moe
+                        else _matrix_dense_model(cpu))
     seq_len = min(nominal_seq, 128) if cpu else nominal_seq
     micro_batch = 2 if cpu else {2048: 4, 4096: 2, 8192: 1}[nominal_seq]
     n_steps = 3 if cpu else 10
     devices = jax.device_count()
+    if a2a:
+        # the dispatch shard_map splits the batch dim over ep: round the
+        # microbatch up to a whole multiple of the mesh
+        micro_batch = -(-micro_batch // devices) * devices
 
     def forward_loss(p, batch, num_label_tokens):
         if is_moe:
@@ -383,19 +426,42 @@ def _matrix_cell(kind: str, nominal_seq: int, cpu: bool,
                 p, batch["input_ids"], positions=batch["positions"],
                 segment_ids=batch["segment_ids"],
                 token_mask=batch["segment_ids"] != 0, training=True,
+                rules=rules,
             )
             loss = masked_cross_entropy(out, batch["labels"], num_label_tokens)
-            return loss, {"expert_load": stats["expert_load"]}
+            aux = {"expert_load": stats["expert_load"]}
+            if a2a:
+                aux["dropped_frac"] = stats["dropped_token_frac"]
+            return loss, aux
         logits = model(p, batch["input_ids"], positions=batch["positions"],
                        segment_ids=batch["segment_ids"])
         return masked_cross_entropy(logits, batch["labels"], num_label_tokens)
 
     optimizer = optax.chain(optax.scale_by_factored_rms(), optax.scale(-1e-5))
-    step = jax.jit(make_train_step(forward_loss, optimizer, dynamics=dynamics),
-                   donate_argnums=(0, 1))
+    step_fn = make_train_step(forward_loss, optimizer, dynamics=dynamics)
 
-    params = model.init(jax.random.key(0), jnp.dtype(model.backend.dtype))
-    opt_state = jax.jit(optimizer.init)(params)
+    if a2a:
+        # sharded init: expert weights land distributed over the ep axis, so
+        # the lowered step is the real multi-device dispatch program
+        shardings = rules.tree_sharding(model.logical_axes())
+        from automodel_tpu.parallel.sharding_utils import make_sharded_init
+
+        params = jax.jit(
+            lambda k: model.init(k, jnp.dtype(model.backend.dtype)),
+            out_shardings=shardings)(jax.random.key(0))
+        opt_state = make_sharded_init(optimizer, params, mesh)(params)
+        # pin the carry outputs to the carry input shardings — XLA is
+        # otherwise free to re-lay the donated params between steps, which
+        # the AOT-compiled call rejects on the next invocation
+        step = jax.jit(
+            step_fn, donate_argnums=(0, 1),
+            out_shardings=(jax.tree.map(lambda a: a.sharding, params),
+                           jax.tree.map(lambda a: a.sharding, opt_state),
+                           None))
+    else:
+        step = jax.jit(step_fn, donate_argnums=(0, 1))
+        params = model.init(jax.random.key(0), jnp.dtype(model.backend.dtype))
+        opt_state = jax.jit(optimizer.init)(params)
 
     # AOT compile from a synthetic stack of the pipeline's exact shapes; the
     # optimized HLO also yields the a2a byte share
@@ -498,6 +564,8 @@ def _matrix_cell(kind: str, nominal_seq: int, cpu: bool,
             row["moe/tokens_per_sec_per_chip"] = round(
                 routed_per_step * done / dt / devices, 1)
             row["a2a_byte_share"] = a2a_share
+            if a2a:
+                row["dropped_token_frac"] = round(float(m["dropped_frac"]), 4)
         rows.append(row)
     signals_cell = None
     if profile:
@@ -574,15 +642,14 @@ def _matrix_bench_inline(cpu: bool, dynamics: bool = False,
 
     rows: list[dict] = []
     signal_cells: list[dict] = []
-    for kind in ("dense", "moe"):
-        for nominal in MATRIX_SEQ_LENS:
-            cell_rows, signals_cell = _matrix_cell(
-                kind, nominal, cpu, dynamics=dynamics, profile=profile)
-            for row in cell_rows:
-                print(json.dumps(row), flush=True)
-                rows.append(row)
-            if signals_cell is not None:
-                signal_cells.append(signals_cell)
+    for kind, nominal in _matrix_cells():
+        cell_rows, signals_cell = _matrix_cell(
+            kind, nominal, cpu, dynamics=dynamics, profile=profile)
+        for row in cell_rows:
+            print(json.dumps(row), flush=True)
+            rows.append(row)
+        if signals_cell is not None:
+            signal_cells.append(signals_cell)
     headline = next(
         (r["tokens_per_sec_per_chip"] for r in rows
          if r["model"] == "dense" and r["seq_len"] == 2048 and r["prefetch"]),
@@ -658,7 +725,8 @@ def _cell_main(cell: str, cpu: bool, dynamics: bool = False,
 def _matrix_bench(cpu: bool, dynamics: bool = False, profile: bool = False,
                   out_dir: str = "bench_matrix", resume: bool = False,
                   cell_timeout_s: float = 900.0, cell_retries: int = 1) -> dict:
-    """{dense, moe} x seq {2048,4096,8192}, each cell in an isolated
+    """{dense, moe} x seq {2048,4096,8192} plus the moe_a2a hot-path cells
+    at the headline seq (_matrix_cells), each cell in an isolated
     subprocess with a wall budget (resilience/harness.py). One JSON line per
     row as it lands; completed cells recorded in the resumable
     ``<out_dir>/matrix_ledger.json``; a failed cell becomes a taxonomy-labeled
@@ -706,7 +774,7 @@ def _matrix_bench(cpu: bool, dynamics: bool = False, profile: bool = False,
     specs = [
         {"id": f"{kind}_s{nominal}", "kind": kind, "seq_len": nominal,
          "cpu": cpu, "dynamics": dynamics, "profile": profile}
-        for kind in ("dense", "moe") for nominal in MATRIX_SEQ_LENS
+        for kind, nominal in _matrix_cells()
     ]
 
     def emit(entry: dict, replayed: bool) -> None:

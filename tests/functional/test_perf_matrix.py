@@ -34,7 +34,7 @@ def matrix_run(tmp_path_factory):
     env.pop("XLA_FLAGS", None)  # 8 virtual devices would slow the tiny cells
     result = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--matrix", "--cpu"],
-        capture_output=True, text=True, timeout=580, env=env,
+        capture_output=True, text=True, timeout=840, env=env,
     )
     assert result.returncode == 0, result.stderr[-2000:]
     path = tmp_path_factory.mktemp("matrix") / "matrix.jsonl"
@@ -50,20 +50,29 @@ def _rows_and_summary(path):
 
 def test_matrix_emits_one_parseable_row_per_cell(matrix_run):
     rows, summary = _rows_and_summary(matrix_run)
-    # {dense, moe} x 3 seq lens x {off, on}
-    assert len(rows) == 12
+    # {dense, moe} x 3 seq lens x {off, on}, plus the two a2a hot-path
+    # cells (moe_a2a, moe_a2a_pallas) at the headline seq x {off, on}
+    assert len(rows) == 16
     cells = {(r["model"], r["seq_len"], r["prefetch"]) for r in rows}
-    assert len(cells) == 12
+    assert len(cells) == 16
     for r in rows:
         assert r["tokens_per_sec_per_chip"] > 0
-        if r["model"] == "moe":
+        if r["model"].startswith("moe"):
             assert r["moe/tokens_per_sec_per_chip"] > 0
             assert 0.0 <= r["a2a_byte_share"] <= 1.0
         else:
             assert "moe/tokens_per_sec_per_chip" not in r
+    # the a2a cells run the explicit ep dispatch: real all_to_alls in the
+    # HLO (nonzero byte share) and a profiled step on the prefetch-on row
+    for kind in ("moe_a2a", "moe_a2a_pallas"):
+        on = next(r for r in rows if r["model"] == kind and r["prefetch"])
+        assert on["a2a_byte_share"] > 0
+        assert "dropped_token_frac" in on
+        if "overlap_frac" in on:  # profiled step is best-effort decoration
+            assert 0.0 <= on["overlap_frac"] <= 1.0
     assert summary["ok"] is True
     assert summary["value"] > 0  # headline: dense s2048 prefetch-on
-    assert len(summary["matrix"]) == 12
+    assert len(summary["matrix"]) == 16
 
 
 def test_gate_exit_codes_on_matrix_artifact(matrix_run, tmp_path):

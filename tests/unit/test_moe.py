@@ -422,6 +422,99 @@ class TestEPDispatchDropAccounting:
         )
 
 
+class TestChunkedDispatch:
+    """a2a/compute overlap chunking (``backend.a2a_chunks``): routing, the
+    capacity cutoff, and dropped_frac are computed globally BEFORE the send
+    buffer is sliced, so any chunk count must reproduce the unchunked
+    forward — and the activation/gate gradients — bit-for-bit. Expert WEIGHT
+    grads accumulate per-chunk partial sums (a float reassociation, measured
+    ~2e-7 relative; moe/dispatch.py docstring), so they get a tight allclose
+    instead. An ep-only mesh keeps every >1 axis manual, which the shimmed
+    CPU shard_map compiles (unlike the partial-manual meshes ep_a2a_compiles
+    skips)."""
+
+    def _setup(self, cpu_devices):
+        from automodel_tpu.parallel.mesh import MeshContext
+
+        mesh = MeshContext(ep=8, world_size=8).build_mesh(cpu_devices)
+        cfg = small_cfg(dim=32, moe_inter_dim=48, aux_loss_coeff=0.01)
+        params = init_moe_params(cfg, jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (8, 16, cfg.dim))
+        mask = jnp.ones((8, 16), bool)
+        return mesh, cfg, params, x, mask
+
+    def test_chunked_forward_bit_identical(self, cpu_devices):
+        from automodel_tpu.moe.dispatch import make_ep_moe_forward
+
+        mesh, cfg, params, x, mask = self._setup(cpu_devices)
+        results = {}
+        with jax.sharding.set_mesh(mesh):
+            for nch in (1, 2, 3, 4):
+                fn = make_ep_moe_forward(cfg, mesh, n_chunks=nch)
+                y, aux, load, dropped = jax.jit(fn)(params, x, mask)
+                results[nch] = (np.asarray(y), float(aux), np.asarray(load),
+                                float(dropped))
+        ref = results[1]
+        for nch in (2, 3, 4):
+            y, aux, load, dropped = results[nch]
+            assert np.array_equal(ref[0], y), f"n_chunks={nch} diverged"
+            assert ref[1] == aux and ref[3] == dropped
+            assert np.array_equal(ref[2], load)
+
+    def test_chunked_loss_and_grads(self, cpu_devices):
+        from automodel_tpu.moe.dispatch import make_ep_moe_forward
+
+        mesh, cfg, params, x, mask = self._setup(cpu_devices)
+
+        def loss(p, xin, nch):
+            fn = make_ep_moe_forward(cfg, mesh, n_chunks=nch)
+            y, aux, _, _ = fn(p, xin, mask)
+            return jnp.sum(y * y) + 0.01 * aux
+
+        with jax.sharding.set_mesh(mesh):
+            l1, (gp1, gx1) = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)),
+                                     static_argnums=2)(params, x, 1)
+            l3, (gp3, gx3) = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)),
+                                     static_argnums=2)(params, x, 3)
+        assert float(l1) == float(l3)  # losses reproduce exactly
+        # activation + gate grads are bit-identical (per-row independence)
+        assert np.array_equal(np.asarray(gx1), np.asarray(gx3))
+        assert np.array_equal(np.asarray(gp1["gate"]["weight"]),
+                              np.asarray(gp3["gate"]["weight"]))
+        # expert weight grads: per-chunk dw partial sums reassociate
+        for k in ("gate_up_proj", "down_proj"):
+            np.testing.assert_allclose(
+                np.asarray(gp1["experts"][k]), np.asarray(gp3["experts"][k]),
+                rtol=1e-5, atol=1e-6)
+
+    def test_chunking_preserves_drop_accounting(self, cpu_devices):
+        from automodel_tpu.moe.dispatch import make_ep_moe_forward
+
+        mesh, cfg, params, x, mask = self._setup(cpu_devices)
+        with jax.sharding.set_mesh(mesh):
+            dropped = {
+                nch: float(jax.jit(make_ep_moe_forward(
+                    cfg, mesh, capacity=2, n_chunks=nch))(params, x, mask)[3])
+                for nch in (1, 3)
+            }
+        # tight capacity drops copies; the count is chunk-invariant and exact
+        assert 0.0 < dropped[1] <= 1.0
+        assert dropped[1] == dropped[3]
+
+    def test_pallas_experts_through_a2a_dispatch(self, cpu_devices):
+        from automodel_tpu.moe.dispatch import make_ep_moe_forward
+
+        mesh, cfg, params, x, mask = self._setup(cpu_devices)
+        with jax.sharding.set_mesh(mesh):
+            yr = jax.jit(make_ep_moe_forward(cfg, mesh, n_chunks=2))(
+                params, x, mask)[0]
+            yp = jax.jit(make_ep_moe_forward(
+                cfg, mesh, n_chunks=2, experts_backend="pallas"))(
+                params, x, mask)[0]
+        np.testing.assert_allclose(np.asarray(yp), np.asarray(yr),
+                                   atol=1e-5, rtol=1e-5)
+
+
 def test_a2a_at_ep1_warns_with_measurement(caplog):
     """dispatcher='a2a' on a 1-rank ep axis logs the measured guidance
     (tools/bench_a2a_dispatch.py: 2.25x slower than dense on one chip)."""

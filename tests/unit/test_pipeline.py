@@ -218,3 +218,135 @@ class TestMoEPPAuxExactWeighting:
             want += coeff * float(stats["aux_loss"]) * (mb_tokens / n)
         np.testing.assert_allclose(float(got), want, rtol=2e-5)
         assert aux["expert_load"].shape == (2, 8)
+
+
+class TestMoEPPA2AComposition:
+    """a2a x PP: the pipeline's manual region is flattened to one manual mesh
+    over {pp, ep}, so the explicit EP dispatch runs INSIDE the pp stage body
+    (no nested shard_map). A pp2 x ep4 world-8 mesh is fully manual — every
+    axis of size > 1 is manual — which the shimmed CPU shard_map compiles, so
+    unlike the partial-manual pp meshes above these tests need no skip."""
+
+    HF_CFG = {
+        "architectures": ["Qwen3MoeForCausalLM"],
+        "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+        "moe_intermediate_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+        "num_experts": 8, "num_experts_per_tok": 2, "norm_topk_prob": True,
+        "router_aux_loss_coef": 0.0, "max_position_embeddings": 64,
+    }
+
+    def _build(self, **backend_kw):
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+
+        return AutoModelForCausalLM.from_config(
+            self.HF_CFG,
+            BackendConfig(dtype="float32", dispatcher="a2a", **backend_kw))
+
+    def _batch(self, n_micro=2, b=4, s=16):
+        rng = np.random.RandomState(3)
+        ids = rng.randint(0, 128, (n_micro, b, s)).astype(np.int32)
+        stack = {
+            "input_ids": jnp.asarray(ids), "labels": jnp.asarray(ids.copy()),
+            "positions": jnp.broadcast_to(
+                jnp.arange(s, dtype=jnp.int32), ids.shape),
+            "segment_ids": jnp.ones((n_micro, b, s), jnp.int32),
+        }
+        return stack, jnp.float32(n_micro * b * s)
+
+    def _mesh(self):
+        return MeshContext(pp=2, ep=4, world_size=8).build_mesh(jax.devices())
+
+    def test_steps_and_trains_with_drop_accounting(self):
+        from automodel_tpu.parallel.pipeline import make_moe_pp_loss
+
+        mesh = self._mesh()
+        model = self._build(ep_capacity_factor=8.0)
+        params = model.init(jax.random.key(1), jnp.float32)
+        batch_stack, n = self._batch()
+        with mesh:
+            pp_loss = make_moe_pp_loss(model, mesh)
+            loss, aux = jax.jit(lambda p, bs: pp_loss(p, bs, n))(
+                params, batch_stack)
+            g = jax.jit(jax.grad(lambda p, bs: pp_loss(p, bs, n)[0]))(
+                params, batch_stack)
+        assert np.isfinite(float(loss))
+        # ample capacity: the exact drop accounting reports zero
+        assert float(aux["dropped_token_frac"]) == 0.0
+        # the a2a path actually trained the experts on both pp stages
+        eg = np.asarray(g["moe_layers"]["moe"]["experts"]["gate_up_proj"])
+        assert np.isfinite(eg).all() and np.abs(eg).max() > 0
+
+    def test_ce_matches_dense_dispatcher_reference(self):
+        """With ample capacity (no drops) and aux coeff 0, pp+a2a reproduces
+        the non-pp dense-dispatcher CE. (The a2a aux term is pmean'd over ep
+        shards — per-shard load stats, not the global-batch aux — so CE is
+        the exact cross-dispatcher contract; see moe/dispatch.py.)"""
+        from automodel_tpu.models.auto import AutoModelForCausalLM
+        from automodel_tpu.parallel.pipeline import make_moe_pp_loss
+
+        mesh = self._mesh()
+        model = self._build(ep_capacity_factor=8.0)
+        params = model.init(jax.random.key(1), jnp.float32)
+        batch_stack, n = self._batch()
+        with mesh:
+            got, _ = jax.jit(
+                lambda p, bs: make_moe_pp_loss(model, mesh)(p, bs, n))(
+                params, batch_stack)
+
+        ref_model = AutoModelForCausalLM.from_config(
+            self.HF_CFG, BackendConfig(dtype="float32"))
+        want = 0.0
+        for i in range(batch_stack["input_ids"].shape[0]):
+            mb = jax.tree.map(lambda a: a[i], batch_stack)
+            logits, _ = ref_model(
+                params, mb["input_ids"], positions=mb["positions"],
+                segment_ids=mb["segment_ids"], training=True)
+            want += float(masked_cross_entropy(logits, mb["labels"], n))
+        np.testing.assert_allclose(float(got), want, rtol=2e-5)
+
+    def test_tight_capacity_reports_drops(self):
+        from automodel_tpu.parallel.pipeline import make_moe_pp_loss
+
+        mesh = self._mesh()
+        model = self._build(ep_capacity_factor=0.5)
+        params = model.init(jax.random.key(1), jnp.float32)
+        batch_stack, n = self._batch()
+        with mesh:
+            _, aux = jax.jit(
+                lambda p, bs: make_moe_pp_loss(model, mesh)(p, bs, n))(
+                params, batch_stack)
+        assert 0.0 < float(aux["dropped_token_frac"]) <= 1.0
+
+    def test_chunked_dispatch_under_pp_bit_identical(self):
+        from automodel_tpu.parallel.pipeline import make_moe_pp_loss
+
+        mesh = self._mesh()
+        params = self._build(ep_capacity_factor=8.0).init(
+            jax.random.key(1), jnp.float32)
+        batch_stack, n = self._batch()
+        losses = {}
+        with mesh:
+            for nch in (1, 3):
+                model = self._build(ep_capacity_factor=8.0, a2a_chunks=nch)
+                losses[nch] = float(jax.jit(
+                    lambda p, bs, m=model: make_moe_pp_loss(m, mesh)(p, bs, n))(
+                    params, batch_stack)[0])
+        assert losses[1] == losses[3]
+
+    def test_pallas_experts_under_pp_a2a(self):
+        from automodel_tpu.parallel.pipeline import make_moe_pp_loss
+
+        mesh = self._mesh()
+        params = self._build(ep_capacity_factor=8.0).init(
+            jax.random.key(1), jnp.float32)
+        batch_stack, n = self._batch()
+        losses = {}
+        with mesh:
+            for eb in ("ragged_dot", "pallas"):
+                model = self._build(ep_capacity_factor=8.0, experts_backend=eb)
+                losses[eb] = float(jax.jit(
+                    lambda p, bs, m=model: make_moe_pp_loss(m, mesh)(p, bs, n))(
+                    params, batch_stack)[0])
+        np.testing.assert_allclose(losses["pallas"], losses["ragged_dot"],
+                                   rtol=1e-5)

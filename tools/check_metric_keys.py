@@ -48,7 +48,7 @@ DOC = REPO / "docs" / "observability.md"
 FAMILIES = ("goodput", "mem_plan", "mem", "moe_load", "moe", "dynamics",
             "trace", "signals", "tuner", "supervisor", "ledger", "badput")
 _FAMILY_RE = re.compile(r"^(?:%s)/[^ ]+$" % "|".join(FAMILIES))
-BARE_KEYS = {"goodput", "overlap_frac"}
+BARE_KEYS = {"goodput", "overlap_frac", "a2a_byte_share"}
 # bare-prefix family: the measured trace-attribution keys ride log rows
 # without a slash namespace (measured_frac_compute, measured_t_comm_s,
 # measured_comm_axis_<ax>_s, measured_bound, ...); "*" appears in normalized
